@@ -1,0 +1,131 @@
+"""mpi4jax_tpu — TPU-native communication primitives for JAX.
+
+A from-scratch rebuild of the capabilities of mpi4jax (reference:
+``mpi4jax/__init__.py:26-41``) designed TPU-first: the twelve
+collective / point-to-point operations are JAX primitives whose
+lowerings emit **native XLA HLO collectives** (AllReduce, AllGather,
+AllToAll, CollectivePermute) over a ``jax.sharding.Mesh`` axis, instead
+of MPI custom-calls through a C extension. Communicators map onto mesh
+axes; ranks are ``lax.axis_index``; the launch model is
+``jax.distributed.initialize()`` + a global mesh rather than ``mpirun``.
+
+Ordering parity: the reference serializes all communication ops with a
+JAX ordered effect + XLA token threading (``_src/utils.py:45-53``).
+Ordered effects are not usable inside ``shard_map``, so this package
+achieves the same program-order guarantee with an ambient
+``optimization_barrier`` token chain (see ``mpi4jax_tpu/token.py``).
+
+Differentiation parity: ``allreduce`` is differentiable for ``SUM`` with
+JVP = allreduce-of-tangents and transpose = identity (reference
+``collective_ops/allreduce.py:138-159``); ``sendrecv`` transposes by
+swapping source and destination (``collective_ops/sendrecv.py:278-293``).
+"""
+
+__version__ = "0.1.0"
+
+from .comm import (  # noqa: F401
+    ANY_TAG,
+    BAND,
+    BOR,
+    BXOR,
+    CartComm,
+    Comm,
+    LAND,
+    LOR,
+    LXOR,
+    MAX,
+    MIN,
+    Op,
+    PROC_NULL,
+    PROD,
+    SUM,
+    get_default_comm,
+    resolve_comm,
+)
+from .ops import (  # noqa: F401
+    allgather,
+    allreduce,
+    alltoall,
+    barrier,
+    bcast,
+    gather,
+    recv,
+    reduce,
+    scan,
+    scatter,
+    send,
+    sendrecv,
+)
+from .debug import get_logging, set_logging  # noqa: F401
+
+
+def has_tpu_support() -> bool:
+    """True if a TPU backend is available to JAX.
+
+    Analog of the reference capability queries ``has_cuda_support`` /
+    ``has_sycl_support`` (``mpi4jax/__init__.py``).
+    """
+    import jax
+
+    try:
+        return any(d.platform in ("tpu", "axon") for d in jax.devices())
+    except RuntimeError:
+        return False
+
+
+def has_cuda_support() -> bool:
+    """Compatibility shim: this package has no CUDA/MPI bridge."""
+    return False
+
+
+def has_sycl_support() -> bool:
+    """Compatibility shim: this package has no SYCL/MPI bridge."""
+    return False
+
+
+def has_shm_support() -> bool:
+    """True if the native shared-memory CPU backend extension is built."""
+    try:
+        from .runtime import shm  # noqa: F401
+    except Exception:
+        return False
+    return shm.available()
+
+
+__all__ = [
+    "allgather",
+    "allreduce",
+    "alltoall",
+    "barrier",
+    "bcast",
+    "gather",
+    "recv",
+    "reduce",
+    "scan",
+    "scatter",
+    "send",
+    "sendrecv",
+    "Comm",
+    "CartComm",
+    "Op",
+    "SUM",
+    "PROD",
+    "MAX",
+    "MIN",
+    "LAND",
+    "LOR",
+    "LXOR",
+    "BAND",
+    "BOR",
+    "BXOR",
+    "PROC_NULL",
+    "ANY_TAG",
+    "get_default_comm",
+    "resolve_comm",
+    "has_tpu_support",
+    "has_cuda_support",
+    "has_sycl_support",
+    "has_shm_support",
+    "set_logging",
+    "get_logging",
+]

@@ -1,0 +1,327 @@
+"""Communicators and reduction operators, TPU-native.
+
+The reference marshals live ``mpi4py`` handles (``MPI.Comm``,
+``MPI.Op``) into XLA custom calls as int64 handles
+(``_src/utils.py:60-128``). Here a *communicator* is instead a set of
+mesh axis names of the enclosing ``shard_map``/``pjit``: rank is
+``lax.axis_index``, size is the product of ``lax.axis_size`` over the
+axes, and every collective lowers to the XLA HLO collective over those
+axes — riding the TPU ICI mesh with no host round-trip.
+
+Key mappings (reference -> here):
+
+- ``MPI.COMM_WORLD`` clone (``_src/utils.py:16-27``)  ->
+  :func:`get_default_comm`, which resolves to *all* axes bound by the
+  innermost ``mpi4jax_tpu``-created mesh context, or to the
+  conventional ``"ranks"`` axis.
+- ``MPI.Op`` handles (``_src/utils.py:119-128``) -> :class:`Op`
+  singletons (``SUM``/``MAX``/...), each knowing its native lax
+  collective (psum/pmax/pmin) or a generic all-gather fallback.
+- ``MPI_Cart_create``/``MPI_Cart_shift`` (used implicitly by the
+  reference's shallow-water process grid, ``examples/shallow_water.py:57-67``)
+  -> :class:`CartComm` with :meth:`CartComm.shift` producing the static
+  per-rank neighbor tables consumed by ``send``/``recv``/``sendrecv``.
+
+Single-program SPMD note: the reference is multi-controller (one process
+per rank), so ranks can take different code paths. Under ``shard_map``
+every rank traces the *same* program; rank-dependent behavior is
+expressed with per-rank tables (see ``PROC_NULL``) and traced
+``where(rank == root, ...)`` selects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+# MPI-parity sentinel constants (reference exposes mpi4py's:
+# MPI.PROC_NULL == -2 in mpi4py; we use -1 for table ergonomics and
+# document it — any negative entry means "no partner").
+PROC_NULL = -1
+ANY_TAG = -1
+
+#: Conventional world axis name used by mpi4jax_tpu mesh helpers.
+WORLD_AXIS = "ranks"
+
+AxisNames = Tuple[str, ...]
+
+
+class Op:
+    """A reduction operator (analog of ``mpi4py.MPI.Op``).
+
+    ``native`` names a lax collective used on the fast path (psum /
+    pmax / pmin lower to a single HLO AllReduce); operators without a
+    native HLO reduction (PROD, bitwise/logical ops) fall back to
+    all-gather + local reduction, which is semantically exact.
+    Reference dtype/op marshalling: ``_src/utils.py:101-128``.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        native: Optional[str],
+        combine: Callable,
+        reduce_along_axis: Callable,
+        differentiable: bool = False,
+    ):
+        self.name = name
+        self.native = native
+        self.combine = combine
+        self.reduce_along_axis = reduce_along_axis
+        self.differentiable = differentiable
+
+    def __repr__(self):
+        return f"Op({self.name})"
+
+    # Ops are singletons: identity hash/eq make them valid static
+    # primitive params (the reference wraps MPI.Op in HashableMPIType
+    # keyed on _addressof for the same purpose, utils.py:134-153).
+
+
+def _land(a, b):
+    return jnp.logical_and(a != 0, b != 0).astype(a.dtype)
+
+
+def _lor(a, b):
+    return jnp.logical_or(a != 0, b != 0).astype(a.dtype)
+
+
+def _lxor(a, b):
+    return jnp.logical_xor(a != 0, b != 0).astype(a.dtype)
+
+
+SUM = Op("SUM", "psum", lax.add, jnp.sum, differentiable=True)
+MAX = Op("MAX", "pmax", lax.max, jnp.max)
+MIN = Op("MIN", "pmin", lax.min, jnp.min)
+PROD = Op("PROD", None, lax.mul, jnp.prod)
+LAND = Op("LAND", None, _land, lambda g, axis: jnp.all(g != 0, axis=axis))
+LOR = Op("LOR", None, _lor, lambda g, axis: jnp.any(g != 0, axis=axis))
+LXOR = Op(
+    "LXOR",
+    None,
+    _lxor,
+    lambda g, axis: (jnp.sum((g != 0).astype(jnp.int32), axis=axis) % 2),
+)
+BAND = Op(
+    "BAND",
+    None,
+    jnp.bitwise_and,
+    lambda g, axis: jnp.bitwise_and.reduce(g, axis=axis),
+)
+BOR = Op(
+    "BOR",
+    None,
+    jnp.bitwise_or,
+    lambda g, axis: jnp.bitwise_or.reduce(g, axis=axis),
+)
+BXOR = Op(
+    "BXOR",
+    None,
+    jnp.bitwise_xor,
+    lambda g, axis: jnp.bitwise_xor.reduce(g, axis=axis),
+)
+
+
+def _as_axes(axis: Union[str, Sequence[str]]) -> AxisNames:
+    if isinstance(axis, str):
+        return (axis,)
+    return tuple(axis)
+
+
+class Comm:
+    """A communicator over one or more mesh axis names.
+
+    Unlike the reference's ``MPI.Comm`` (a live handle into libmpi,
+    marshalled via ``_src/utils.py:60-97``), a :class:`Comm` is a pure
+    static description: it names the mesh axes collectives run over.
+    It is hashable and used directly as a static jit-cache parameter,
+    serving the role of the reference's ``HashableMPIType`` wrapper
+    (``_src/utils.py:134-153``).
+    """
+
+    def __init__(self, axis: Union[str, Sequence[str]] = WORLD_AXIS):
+        self._axes = _as_axes(axis)
+        if not self._axes:
+            raise ValueError("Comm needs at least one axis name")
+
+    @property
+    def axes(self) -> AxisNames:
+        return self._axes
+
+    # -- MPI-style API ---------------------------------------------------
+    def Get_size(self) -> int:
+        """Static communicator size; requires being inside the mesh."""
+        return resolve_comm(self).size
+
+    def Get_rank(self):
+        """Traced linear rank (row-major over the axes)."""
+        return resolve_comm(self).rank()
+
+    def Clone(self) -> "Comm":
+        """Reference clones COMM_WORLD to isolate its traffic
+        (``_src/utils.py:16-27``). XLA collectives are matched by
+        channel id assigned per-op by the compiler, so namespace
+        isolation is automatic; Clone returns an equivalent Comm."""
+        return self.__class__(self._axes)
+
+    Dup = Clone
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._axes))
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._axes == self._axes
+
+    def __repr__(self):
+        return f"Comm(axes={self._axes})"
+
+
+class CartComm(Comm):
+    """Cartesian communicator (analog of ``MPI_Cart_create``).
+
+    The reference's shallow-water example hand-rolls a
+    ``(nproc_y, nproc_x)`` process grid and per-rank neighbor indices
+    (``examples/shallow_water.py:57-67,180-232``). Under single-program
+    SPMD those per-rank decisions become static *tables* indexed by
+    rank; :meth:`shift` builds them, ready to feed ``sendrecv``.
+    """
+
+    def __init__(
+        self,
+        dims: Sequence[int],
+        periods: Union[bool, Sequence[bool]] = True,
+        axis: Union[str, Sequence[str]] = WORLD_AXIS,
+    ):
+        super().__init__(axis)
+        self.dims = tuple(int(d) for d in dims)
+        if isinstance(periods, bool):
+            periods = (periods,) * len(self.dims)
+        self.periods = tuple(bool(p) for p in periods)
+        if len(self.periods) != len(self.dims):
+            raise ValueError("periods must match dims")
+        self._n = math.prod(self.dims)
+
+    @property
+    def nranks(self) -> int:
+        return self._n
+
+    def coords(self, rank: int) -> Tuple[int, ...]:
+        return tuple(int(c) for c in np.unravel_index(rank, self.dims))
+
+    def rank_at(self, coords: Sequence[int]) -> int:
+        return int(np.ravel_multi_index(tuple(coords), self.dims, mode="wrap"))
+
+    def neighbor(self, rank: int, dim: int, disp: int) -> int:
+        """Rank displaced by ``disp`` along ``dim``; PROC_NULL at a
+        non-periodic boundary (``MPI_Cart_shift`` semantics)."""
+        c = list(self.coords(rank))
+        c[dim] += disp
+        if not self.periods[dim] and not (0 <= c[dim] < self.dims[dim]):
+            return PROC_NULL
+        return self.rank_at(c)
+
+    def shift(self, dim: int, disp: int) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+        """Per-rank ``(source, dest)`` tables for a shift, like
+        ``MPI_Cart_shift``: rank r sends to ``dest[r]`` and receives
+        from ``source[r]``; entries are PROC_NULL at open boundaries."""
+        n = self._n
+        dest = tuple(self.neighbor(r, dim, disp) for r in range(n))
+        source = tuple(self.neighbor(r, dim, -disp) for r in range(n))
+        return source, dest
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._axes, self.dims, self.periods))
+
+    def __eq__(self, other):
+        return (
+            type(other) is type(self)
+            and other._axes == self._axes
+            and other.dims == self.dims
+            and other.periods == self.periods
+        )
+
+    def __repr__(self):
+        return f"CartComm(dims={self.dims}, periods={self.periods}, axes={self._axes})"
+
+
+@dataclasses.dataclass(frozen=True)
+class BoundComm:
+    """A communicator resolved against the current trace's axis env.
+
+    ``axes == ()`` encodes the world-size-1 case: op implementations
+    then use local (single-rank) semantics, which makes the whole
+    single-rank reference test matrix (§4 of SURVEY.md: the pytest run
+    without mpirun) work eagerly with no mesh at all.
+    """
+
+    axes: AxisNames
+    size: int
+
+    def rank(self):
+        if not self.axes:
+            return jnp.zeros((), jnp.int32)
+        # Row-major linear rank over the axes (matches the reference
+        # world where COMM_WORLD ranks are a flat 0..n-1 space).
+        r = jnp.zeros((), jnp.int32)
+        for name in self.axes:
+            r = r * lax.axis_size(name) + lax.axis_index(name)
+        return r
+
+    def require_single_axis(self, opname: str) -> str:
+        if len(self.axes) != 1:
+            raise NotImplementedError(
+                f"{opname} over a multi-axis communicator is not supported "
+                f"yet; use a single flattened mesh axis (got {self.axes})."
+            )
+        return self.axes[0]
+
+
+def _axis_is_bound(name: str) -> bool:
+    try:
+        lax.axis_size(name)
+        return True
+    except (NameError, KeyError):
+        return False
+
+
+def get_default_comm() -> Comm:
+    """Analog of the reference's lazily-cloned default communicator
+    (``_src/utils.py:16-27``): a Comm over the conventional
+    :data:`WORLD_AXIS` mesh axis."""
+    return Comm(WORLD_AXIS)
+
+
+def resolve_comm(comm: Optional[Comm]) -> BoundComm:
+    """Resolve ``comm`` against the current trace.
+
+    Inside a mesh context with the comm's axes bound, returns a
+    :class:`BoundComm` with the static size. Outside any mesh (plain
+    eager or jit without shard_map) resolves to the world-size-1
+    communicator, mirroring a 1-process ``mpirun`` run.
+    """
+    if comm is None:
+        comm = get_default_comm()
+    if not isinstance(comm, Comm):
+        raise TypeError(f"expected a Comm, got {type(comm)}")
+    bound = [a for a in comm.axes if _axis_is_bound(a)]
+    if not bound:
+        return BoundComm(axes=(), size=1)
+    if len(bound) != len(comm.axes):
+        missing = [a for a in comm.axes if a not in bound]
+        raise NameError(
+            f"communicator axes {missing} are not bound in the current "
+            f"trace (bound: {bound}); wrap the computation in "
+            f"shard_map over a mesh providing all communicator axes"
+        )
+    size = 1
+    for a in comm.axes:
+        size *= lax.axis_size(a)
+    return BoundComm(axes=comm.axes, size=int(size))
+
+

@@ -1,0 +1,45 @@
+"""Environment-variable configuration.
+
+The reference configures itself exclusively through environment
+variables (survey of them: ``SURVEY.md`` §5 / reference
+``decorators.py:30-35``, ``xla_bridge/__init__.py:110-129``). We keep
+that model with an ``MPI4JAX_TPU_`` prefix.
+
+Recognised variables:
+
+- ``MPI4JAX_TPU_DEBUG``: truthy -> per-op debug logging (analog of the
+  reference's ``MPI4JAX_DEBUG`` / C++ ``DebugTimer``,
+  ``mpi_ops_common.h:154-206``).
+- ``MPI4JAX_TPU_DEBUG_RUNTIME``: truthy -> additionally emit runtime
+  (device-side) log callbacks, not just trace-time emission logs.
+- ``MPI4JAX_TPU_NO_ORDERING``: truthy -> disable the ambient token
+  ordering chain (for benchmarking the effect of forced ordering).
+"""
+
+import os
+
+
+def is_truthy(value: str) -> bool:
+    """Reference semantics: ``decorators.py:30-31`` (`_is_truthy`)."""
+    return value.lower() in ("true", "1", "on")
+
+
+def is_falsy(value: str) -> bool:
+    """Reference semantics: ``decorators.py:34-35`` (`_is_falsy`)."""
+    return value.lower() in ("false", "0", "off")
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    value = os.environ.get(name, "")
+    if not value:
+        return default
+    if is_truthy(value):
+        return True
+    if is_falsy(value):
+        return False
+    return default
+
+
+DEBUG_LOGGING = env_flag("MPI4JAX_TPU_DEBUG")
+DEBUG_RUNTIME = env_flag("MPI4JAX_TPU_DEBUG_RUNTIME")
+NO_ORDERING = env_flag("MPI4JAX_TPU_NO_ORDERING")

@@ -1,0 +1,75 @@
+"""Per-op debug logging (analog of the reference DebugTimer).
+
+The reference logs every MPI call from C++ with rank, an 8-char random
+correlation id, op details and wall-clock duration
+(``xla_bridge/mpi_ops_common.h:116-206``), toggled by ``MPI4JAX_DEBUG``
+or ``set_logging()`` (``xla_bridge/__init__.py:110-129``).
+
+On the TPU path there is no host code at runtime, so logging splits in
+two:
+
+- *emission log* (always available): one line per op at trace time in
+  the reference's format, e.g. ``emit | a1b2c3d4 | AllReduce [8 items]``;
+- *runtime log* (``MPI4JAX_TPU_DEBUG_RUNTIME``): a ``jax.debug.callback``
+  per op printing ``r{rank} | {id} | {Op} ... done`` from the device,
+  with the per-rank prefix matching the reference format tested by
+  ``tests/collective_ops/test_common.py:118-146``.
+"""
+
+from __future__ import annotations
+
+import random
+import string
+
+import jax
+import jax.numpy as jnp
+
+from . import config
+
+_logging = config.DEBUG_LOGGING
+_runtime_logging = config.DEBUG_RUNTIME
+
+
+def set_logging(enabled: bool, runtime: bool | None = None) -> None:
+    """Toggle debug logging at runtime (reference
+    ``xla_bridge/__init__.py:114-121``)."""
+    global _logging, _runtime_logging
+    _logging = bool(enabled)
+    if runtime is not None:
+        _runtime_logging = bool(runtime)
+
+
+def get_logging() -> bool:
+    return _logging
+
+
+def _random_id(n: int = 8) -> str:
+    # Reference: random_id(), mpi_ops_common.h:116-124.
+    return "".join(random.choices(string.ascii_lowercase + string.digits, k=n))
+
+
+def log_emission(opname: str, details: str) -> str:
+    """Print a trace-time emission record; returns the correlation id."""
+    ident = _random_id()
+    if _logging:
+        print(f"emit | {ident} | {opname} {details}", flush=True)
+    return ident
+
+
+def _runtime_print(rank, ident, opname, details):
+    print(f"r{int(rank)} | {ident} | {opname} {details} done", flush=True)
+
+
+def log_runtime(bound_comm, ident: str, opname: str, details: str) -> None:
+    """Emit a device-side callback log line if runtime logging is on."""
+    if not (_logging and _runtime_logging):
+        return
+    try:
+        rank = bound_comm.rank()
+        jax.debug.callback(
+            _runtime_print, rank, ident=ident, opname=opname, details=details
+        )
+    except Exception:
+        # Logging must never break the computation (e.g. backends where
+        # callbacks inside shard_map are unsupported).
+        pass

@@ -1,0 +1,28 @@
+"""The twelve communication primitives (reference public API:
+``mpi4jax/__init__.py:26-41``)."""
+
+from .allreduce import allreduce  # noqa: F401
+from .allgather import allgather  # noqa: F401
+from .alltoall import alltoall  # noqa: F401
+from .barrier import barrier  # noqa: F401
+from .bcast import bcast  # noqa: F401
+from .gather import gather  # noqa: F401
+from .reduce import reduce  # noqa: F401
+from .scan import scan  # noqa: F401
+from .scatter import scatter  # noqa: F401
+from .p2p import recv, send, sendrecv  # noqa: F401
+
+__all__ = [
+    "allgather",
+    "allreduce",
+    "alltoall",
+    "barrier",
+    "bcast",
+    "gather",
+    "recv",
+    "reduce",
+    "scan",
+    "scatter",
+    "send",
+    "sendrecv",
+]

@@ -1,0 +1,93 @@
+"""Shared primitive scaffolding for all collective ops.
+
+Mirrors the role of the reference's per-op template
+(``_src/collective_ops/allreduce.py`` is the canonical instance, see
+SURVEY.md §2.2): every op is a JAX ``Primitive`` with
+
+- ``def_impl`` via ``xla.apply_primitive`` (eager parity,
+  reference ``_src/utils.py:56-57``),
+- an effectless ``abstract_eval`` (ordering is value-token based, see
+  ``mpi4jax_tpu/token.py``, replacing the reference's ordered effect),
+- an MLIR lowering built with ``mlir.lower_fun`` over a pure-JAX SPMD
+  implementation that emits ``lax`` collectives — these lower to native
+  XLA HLO collectives (AllReduce/AllGather/AllToAll/CollectivePermute)
+  on every platform, which *is* the TPU-native data path demanded by
+  ``BASELINE.json``'s north star (no FFI custom call, no host staging).
+
+Op emission goes through :func:`emit`, which adds debug logging and the
+ambient ordering-token ties.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Tuple
+
+import jax.extend as jex
+from jax.interpreters import batching, mlir, xla
+
+from .. import debug
+from ..token import ordered_call
+
+
+def define_primitive(
+    name: str,
+    *,
+    abstract_eval: Callable,
+    spmd_impl: Callable,
+    multiple_results: bool = False,
+):
+    """Create a collective primitive with lower_fun lowering.
+
+    ``spmd_impl(*operands, **params)`` must be pure JAX code legal
+    inside ``shard_map``; it is both the lowering (via
+    ``mlir.lower_fun``) and, through ``apply_primitive``, the eager
+    implementation.
+    """
+    p = jex.core.Primitive(name)
+    p.multiple_results = multiple_results
+    p.def_impl(partial(xla.apply_primitive, p))
+    p.def_abstract_eval(abstract_eval)
+    mlir.register_lowering(
+        p, mlir.lower_fun(spmd_impl, multiple_results=multiple_results)
+    )
+    return p
+
+
+def register_passthrough_batcher(prim, n_operands: int = 1):
+    """Batching rule for ops that act elementwise across ranks: bind
+    unchanged, keep batch dims (reference allreduce batching,
+    ``allreduce.py:132-135``)."""
+
+    def rule(vals, dims, **params):
+        out = prim.bind(*vals, **params)
+        if prim.multiple_results:
+            return out, [dims[0]] * len(out)
+        return out, dims[0]
+
+    batching.primitive_batchers[prim] = rule
+
+
+def emit(
+    prim,
+    inputs: Tuple,
+    params: dict,
+    *,
+    opname: str,
+    details: str,
+    bound_comm,
+) -> Tuple:
+    """Bind ``prim`` under the ambient ordering token, with logging.
+
+    Returns a tuple of outputs (even for single-result primitives).
+    """
+    ident = debug.log_emission(opname, details)
+    debug.log_runtime(bound_comm, ident, opname, details)
+
+    def bind(*args):
+        out = prim.bind(*args, **params)
+        if prim.multiple_results:
+            return tuple(out)
+        return (out,)
+
+    return ordered_call(bind, tuple(inputs))

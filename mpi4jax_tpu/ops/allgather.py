@@ -1,0 +1,54 @@
+"""allgather — gather every rank's array onto every rank.
+
+Rebuild of reference ``_src/collective_ops/allgather.py``: lowers to a
+single HLO AllGather over the ICI mesh (``lax.all_gather``). Output
+shape is ``(size, *x.shape)`` on every rank (reference
+``allgather.py:124-128``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+from jax.core import ShapedArray
+
+from ..comm import BoundComm, Comm, resolve_comm
+from ..token import NOTSET, raise_if_token_is_set
+from ..validation import enforce_types
+from ._core import define_primitive, emit
+
+
+def _allgather_abstract_eval(x, *, comm: BoundComm):
+    return ShapedArray((comm.size,) + x.shape, x.dtype)
+
+
+def _allgather_spmd(x, *, comm: BoundComm):
+    if not comm.axes or comm.size == 1:
+        return x[None]
+    return lax.all_gather(x, comm.axes, tiled=False)
+
+
+mpi_allgather_p = define_primitive(
+    "tpu_allgather",
+    abstract_eval=_allgather_abstract_eval,
+    spmd_impl=_allgather_spmd,
+)
+
+
+@enforce_types(comm=(type(None), Comm))
+def allgather(x, *, comm=None, token=NOTSET):
+    """Gather ``x`` from all ranks; every rank receives the stacked
+    result of shape ``(size, *x.shape)`` (reference
+    ``allgather.py:43-74``)."""
+    raise_if_token_is_set(token)
+    bound = resolve_comm(comm)
+    x = jnp.asarray(x)
+    (out,) = emit(
+        mpi_allgather_p,
+        (x,),
+        dict(comm=bound),
+        opname="AllGather",
+        details=f"[{x.size} items, n={bound.size}]",
+        bound_comm=bound,
+    )
+    return out

@@ -1,0 +1,137 @@
+"""allreduce — elementwise reduction across all ranks.
+
+TPU-native rebuild of reference ``_src/collective_ops/allreduce.py``:
+the primitive lowers to a single HLO AllReduce over the communicator's
+mesh axes (``lax.psum``/``pmax``/``pmin``) instead of an MPI custom
+call. AD parity with the reference:
+
+- JVP: allreduce of the tangents (``allreduce.py:138-149``), SUM only.
+- Transpose: the transpose of a SUM-allreduce is the *identity*, bound
+  with ``transpose=True`` and lowered with no communication at all so
+  XLA may schedule it freely (``allreduce.py:78-80,123-129,152-159``) —
+  this is the convention that makes distributed-sum gradients come out
+  per-rank-correct (netket-style ``custom_vjp`` pattern,
+  ``tests/collective_ops/test_allreduce.py:252-322``).
+- Batching: bind unchanged (``allreduce.py:132-135``).
+
+Non-native operators (PROD, logical/bitwise) use an exact
+all-gather + local-reduce fallback; SUM/MAX/MIN ride a single HLO
+AllReduce on the ICI mesh.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+from jax.interpreters import ad
+
+from ..comm import MAX, MIN, SUM, BoundComm, Comm, Op, resolve_comm
+from ..token import NOTSET, raise_if_token_is_set
+from ..validation import enforce_types
+from ._core import define_primitive, emit, register_passthrough_batcher
+
+
+def _allreduce_abstract_eval(x, *, op, comm, transpose):
+    return x
+
+
+def _native_reduce(x, op: Op, axes):
+    if op is SUM:
+        if x.dtype == jnp.bool_:
+            return lax.psum(x.astype(jnp.int32), axes).astype(jnp.bool_)
+        return lax.psum(x, axes)
+    if op is MAX:
+        return lax.pmax(x, axes)
+    if op is MIN:
+        return lax.pmin(x, axes)
+    raise AssertionError(op)
+
+
+def _generic_reduce(x, op: Op, axes):
+    # Exact fallback: AllGather + local reduction along the gathered
+    # axis. Associative+commutative ops don't care about rank order.
+    gathered = lax.all_gather(x, axes, tiled=False)
+    return op.reduce_along_axis(gathered, axis=0).astype(x.dtype)
+
+
+def _allreduce_spmd(x, *, op, comm: BoundComm, transpose):
+    if transpose:
+        # Identity, no communication (reference allreduce.py:78-80).
+        return x
+    if not comm.axes or comm.size == 1:
+        # World size 1: reduction over a single rank is the identity.
+        return x
+    if op.native is not None:
+        return _native_reduce(x, op, comm.axes)
+    return _generic_reduce(x, op, comm.axes)
+
+
+mpi_allreduce_p = define_primitive(
+    "tpu_allreduce",
+    abstract_eval=_allreduce_abstract_eval,
+    spmd_impl=_allreduce_spmd,
+)
+
+
+def _check_differentiable(op):
+    if not op.differentiable:
+        raise NotImplementedError(
+            f"allreduce is differentiable only for op=SUM (got {op.name}); "
+            "parity with reference allreduce.py:142-145"
+        )
+
+
+def _jvp_rule(primals, tangents, *, op, comm, transpose):
+    _check_differentiable(op)
+    (x,), (t,) = primals, tangents
+    primal_out = mpi_allreduce_p.bind(x, op=op, comm=comm, transpose=transpose)
+    if isinstance(t, ad.Zero):
+        tangent_out = ad.Zero.from_primal_value(primal_out)
+    else:
+        tangent_out = mpi_allreduce_p.bind(t, op=op, comm=comm, transpose=transpose)
+    return primal_out, tangent_out
+
+
+def _transpose_rule(ct, x, *, op, comm, transpose):
+    _check_differentiable(op)
+    if isinstance(ct, ad.Zero):
+        return (ct,)
+    return (mpi_allreduce_p.bind(ct, op=op, comm=comm, transpose=not transpose),)
+
+
+ad.primitive_jvps[mpi_allreduce_p] = _jvp_rule
+ad.primitive_transposes[mpi_allreduce_p] = _transpose_rule
+register_passthrough_batcher(mpi_allreduce_p)
+
+
+@enforce_types(op=Op, comm=(type(None), Comm))
+def allreduce(x, op=SUM, *, comm=None, token=NOTSET):
+    """Perform an allreduce operation across all ranks of ``comm``.
+
+    .. note::
+       Differentiable via ``jax.grad`` and related transforms when
+       ``op`` is :data:`mpi4jax_tpu.SUM` (reference parity:
+       ``allreduce.py:45-70``).
+
+    Arguments:
+        x: per-rank array or scalar input.
+        op: reduction operator (default :data:`SUM`).
+        comm: communicator (defaults to the world communicator over the
+            ``"ranks"`` mesh axis; size-1 outside any mesh).
+
+    Returns:
+        Array of the same shape as ``x`` holding the reduction over all
+        ranks.
+    """
+    raise_if_token_is_set(token)
+    bound = resolve_comm(comm)
+    x = jnp.asarray(x)
+    (out,) = emit(
+        mpi_allreduce_p,
+        (x,),
+        dict(op=op, comm=bound, transpose=False),
+        opname="AllReduce",
+        details=f"[{x.size} items, op={op.name}, n={bound.size}]",
+        bound_comm=bound,
+    )
+    return out

@@ -1,0 +1,63 @@
+"""alltoall — block-transposed exchange between all ranks.
+
+Rebuild of reference ``_src/collective_ops/alltoall.py``: lowers to a
+single HLO AllToAll over the ICI mesh (``lax.all_to_all``), the core of
+array redistribution / Ulysses-style sequence-head resharding
+(SURVEY.md §2.5). Semantics: input first axis must equal the
+communicator size (reference ``alltoall.py:65-67``); on output, block
+``j`` holds the block this rank received from rank ``j``; shape is
+preserved (``alltoall.py:131-132``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..comm import BoundComm, Comm, resolve_comm
+from ..token import NOTSET, raise_if_token_is_set
+from ..validation import enforce_types
+from ._core import define_primitive, emit
+
+
+def _alltoall_abstract_eval(x, *, comm: BoundComm):
+    return x
+
+
+def _alltoall_spmd(x, *, comm: BoundComm):
+    if not comm.axes or comm.size == 1:
+        return x
+    axis = comm.require_single_axis("alltoall")
+    return lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=False)
+
+
+mpi_alltoall_p = define_primitive(
+    "tpu_alltoall",
+    abstract_eval=_alltoall_abstract_eval,
+    spmd_impl=_alltoall_spmd,
+)
+
+
+@enforce_types(comm=(type(None), Comm))
+def alltoall(x, *, comm=None, token=NOTSET):
+    """Exchange blocks: rank r's input block ``x[j]`` is delivered to
+    rank j, which stores it at output block r (reference
+    ``alltoall.py:43-74``)."""
+    raise_if_token_is_set(token)
+    bound = resolve_comm(comm)
+    x = jnp.asarray(x)
+    if x.ndim < 1 or x.shape[0] != bound.size:
+        raise ValueError(
+            f"alltoall input must have leading axis of size {bound.size} "
+            f"(the communicator size), got shape {x.shape}; reference "
+            "parity: alltoall.py:65-67"
+        )
+    (out,) = emit(
+        mpi_alltoall_p,
+        (x,),
+        dict(comm=bound),
+        opname="AllToAll",
+        details=f"[{x.size} items, n={bound.size}]",
+        bound_comm=bound,
+    )
+    return out

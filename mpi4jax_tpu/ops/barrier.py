@@ -1,0 +1,58 @@
+"""barrier — synchronize all ranks.
+
+Rebuild of reference ``_src/collective_ops/barrier.py``: a data-free,
+token-only op (``barrier.py:59-86``). Here it is a scalar ``uint32``
+HLO AllReduce threaded into the ambient ordering-token chain: every op
+emitted after the barrier transitively depends on a collective in which
+all ranks participated — the same happens-before the reference's
+``MPI_Barrier`` provides (ordering proof test analog:
+``tests/collective_ops/test_barrier.py:17-57``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..comm import BoundComm, Comm, resolve_comm
+from ..token import NOTSET, raise_if_token_is_set
+from ..validation import enforce_types
+from ._core import define_primitive, emit, register_passthrough_batcher
+
+
+def _barrier_abstract_eval(tok, *, comm: BoundComm):
+    return tok
+
+
+def _barrier_spmd(tok, *, comm: BoundComm):
+    if not comm.axes or comm.size == 1:
+        return tok
+    return lax.psum(tok, comm.axes)
+
+
+mpi_barrier_p = define_primitive(
+    "tpu_barrier",
+    abstract_eval=_barrier_abstract_eval,
+    spmd_impl=_barrier_spmd,
+)
+register_passthrough_batcher(mpi_barrier_p)
+
+
+@enforce_types(comm=(type(None), Comm))
+def barrier(*, comm=None, token=NOTSET):
+    """Synchronize all ranks of ``comm`` (reference ``barrier.py:36-57``).
+
+    Returns nothing; subsequent communication ops are sequenced after
+    the barrier through the ambient token chain.
+    """
+    raise_if_token_is_set(token)
+    bound = resolve_comm(comm)
+    emit(
+        mpi_barrier_p,
+        (jnp.zeros((), jnp.uint32),),
+        dict(comm=bound),
+        opname="Barrier",
+        details=f"[n={bound.size}]",
+        bound_comm=bound,
+    )
+    return None

@@ -1,0 +1,70 @@
+"""gather — collect every rank's array at the root.
+
+Rebuild of reference ``_src/collective_ops/gather.py``. The reference
+returns the stacked ``(size, *x.shape)`` array on the root only and
+hands non-root ranks their input back via a size-0 aval trick
+(``gather.py:80-89,140-150``) — rank-dependent shapes that cannot exist
+in a single-program SPMD trace.
+
+**Documented TPU deviation (superset):** every rank receives the
+gathered ``(size, *x.shape)`` array. On TPU hardware there is no
+root-only HLO gather — XLA's collective set is AllGather /
+AllReduce / ReduceScatter / CollectivePermute — so a faithful
+root-only gather would cost the same AllGather plus masking. The
+``root`` argument is validated and kept for source compatibility.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+from jax.core import ShapedArray
+
+from ..comm import BoundComm, Comm, resolve_comm
+from ..token import NOTSET, raise_if_token_is_set
+from ..validation import enforce_types
+from ._core import define_primitive, emit
+
+
+def _gather_abstract_eval(x, *, root, comm: BoundComm):
+    return ShapedArray((comm.size,) + x.shape, x.dtype)
+
+
+def _gather_spmd(x, *, root, comm: BoundComm):
+    if not comm.axes or comm.size == 1:
+        return x[None]
+    return lax.all_gather(x, comm.axes, tiled=False)
+
+
+mpi_gather_p = define_primitive(
+    "tpu_gather",
+    abstract_eval=_gather_abstract_eval,
+    spmd_impl=_gather_spmd,
+)
+
+
+@enforce_types(root=(int, np.integer), comm=(type(None), Comm))
+def gather(x, root, *, comm=None, token=NOTSET):
+    """Gather ``x`` from all ranks (reference ``gather.py:47-89``).
+
+    Returns the stacked ``(size, *x.shape)`` array. Unlike the
+    reference (root-only result), every rank receives it — see module
+    docstring for why this is the TPU-native contract.
+    """
+    raise_if_token_is_set(token)
+    bound = resolve_comm(comm)
+    root = int(root)
+    if not 0 <= root < bound.size:
+        raise ValueError(f"root {root} out of range for size {bound.size}")
+    x = jnp.asarray(x)
+    (out,) = emit(
+        mpi_gather_p,
+        (x,),
+        dict(root=root, comm=bound),
+        opname="Gather",
+        details=f"[{x.size} items, root={root}, n={bound.size}]",
+        bound_comm=bound,
+    )
+    return out

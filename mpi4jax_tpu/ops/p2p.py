@@ -1,0 +1,373 @@
+"""send / recv / sendrecv — point-to-point messaging over the ICI mesh.
+
+Rebuild of reference ``_src/collective_ops/{send,recv,sendrecv}.py``.
+Every point-to-point transfer lowers to one HLO **CollectivePermute**
+(``lax.ppermute``) whose source→dest pair list covers all
+participating ranks at once — the native ICI pattern for halo
+exchanges and ring pipelines (SURVEY.md §2.5, §7 stage 4).
+
+Single-program SPMD changes two things relative to the reference's
+one-process-per-rank model:
+
+1. **Per-rank arguments become tables.** Reference code passes each
+   process its own ``dest``/``source`` int
+   (``examples/shallow_water.py:180-232``); here you pass a static
+   length-``size`` table (``dest[r]`` = where rank r sends), with
+   :data:`~mpi4jax_tpu.PROC_NULL` (-1) marking non-participants.
+   :meth:`mpi4jax_tpu.CartComm.shift` builds these tables for grid
+   topologies. Ranks receiving from ``PROC_NULL`` keep their template
+   values — exactly MPI's ``MPI_PROC_NULL`` recv semantics.
+
+2. **send/recv pairs are matched at trace time.** The reference relies
+   on its ordered effect to keep MPI matching deadlock-free across
+   per-rank programs (``tests/collective_ops/test_send_and_recv.py:91-110``).
+   In SPMD both sides of a transfer appear in the *same* trace, so
+   ``send`` records its operand in a per-trace channel queue and the
+   matching ``recv`` (same communicator, matching tag, mirror-image
+   tables) emits the fused CollectivePermute. Deadlock is impossible by
+   construction: there is one program, and each transfer is a single
+   collective. A ``send`` whose ``recv`` lies in a different jit trace
+   cannot be expressed on the TPU path (documented sharp bit):
+   ``parallel.spmd`` raises at trace end if unmatched sends remain
+   (``token.check_no_pending_sends``); raw ``shard_map`` users get a
+   warning when the trace's channel state is eventually evicted.
+
+AD parity: the transpose of a point-to-point transfer reverses every
+edge — the reference's "transpose swaps source and dest"
+(``sendrecv.py:278-293``). Improvement over the reference: forward-mode
+(JVP) is supported too; the reference forbids ``jacfwd`` through
+``sendrecv`` (``sendrecv.py:122-127``) only because its custom-call
+lowering cannot run the tangent transfer, a constraint the HLO path
+does not have.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+from jax.interpreters import ad, batching
+
+from ..comm import ANY_TAG, PROC_NULL, BoundComm, Comm, resolve_comm
+from ..token import NOTSET, pending_sends, raise_if_token_is_set
+from ..validation import enforce_types
+from .. import debug
+from ._core import define_primitive, emit
+
+Edge = Tuple[int, int]
+
+
+# ---------------------------------------------------------------------------
+# The fused point-to-point primitive
+# ---------------------------------------------------------------------------
+
+
+def _recv_mask(perm: Tuple[Edge, ...], comm: BoundComm):
+    table = np.zeros((comm.size,), bool)
+    for _, d in perm:
+        table[d] = True
+    return jnp.take(jnp.asarray(table), comm.rank())
+
+
+def _p2p_abstract_eval(x, template, *, perm, comm: BoundComm):
+    return template
+
+
+def _p2p_spmd(x, template, *, perm: Tuple[Edge, ...], comm: BoundComm):
+    if not perm:
+        return template
+    if not comm.axes or comm.size == 1:
+        # Only possible edge at size 1 is the self-edge (0, 0).
+        return x if perm == ((0, 0),) else template
+    axis = comm.require_single_axis("send/recv")
+    moved = lax.ppermute(x, axis, list(perm))
+    m = _recv_mask(perm, comm)
+    return jnp.where(m, moved, template)
+
+
+mpi_p2p_p = define_primitive(
+    "tpu_collective_permute",
+    abstract_eval=_p2p_abstract_eval,
+    spmd_impl=_p2p_spmd,
+)
+
+
+def _p2p_jvp(primals, tangents, *, perm, comm):
+    x, template = primals
+    tx, tt = tangents
+    out = mpi_p2p_p.bind(x, template, perm=perm, comm=comm)
+    if isinstance(tx, ad.Zero) and isinstance(tt, ad.Zero):
+        return out, ad.Zero.from_primal_value(out)
+    tx = ad.instantiate_zeros(tx)
+    tt = ad.instantiate_zeros(tt)
+    return out, mpi_p2p_p.bind(tx, tt, perm=perm, comm=comm)
+
+
+def _p2p_transpose(ct, x, template, *, perm, comm):
+    # out = where(recv_mask, ppermute(x, perm), template): linear in
+    # both operands. Reversing each edge (reference sendrecv
+    # transpose, sendrecv.py:278-293) routes each receiver's cotangent
+    # back to its sender; non-receivers contribute nothing.
+    if isinstance(ct, ad.Zero):
+        return ad.Zero.from_primal_value(x), ad.Zero.from_primal_value(template)
+    inv = tuple((d, s) for (s, d) in perm)
+    if not comm.axes or comm.size == 1:
+        m = jnp.asarray(bool(perm and perm == ((0, 0),)))
+    else:
+        m = _recv_mask(perm, comm)
+    zeros = jnp.zeros_like(ct)
+    ct_recv = jnp.where(m, ct, zeros)
+    d_x = mpi_p2p_p.bind(ct_recv, zeros, perm=inv, comm=comm)
+    d_template = jnp.where(m, zeros, ct)
+    return d_x, d_template
+
+
+def _p2p_batcher(vals, dims, *, perm, comm):
+    x, template = vals
+    dx, dt = dims
+    size = next(v.shape[d] for v, d in zip(vals, dims) if d is not None)
+    x = batching.bdim_at_front(x, dx, size)
+    template = batching.bdim_at_front(template, dt, size)
+    return mpi_p2p_p.bind(x, template, perm=perm, comm=comm), 0
+
+
+ad.primitive_jvps[mpi_p2p_p] = _p2p_jvp
+ad.primitive_transposes[mpi_p2p_p] = _p2p_transpose
+batching.primitive_batchers[mpi_p2p_p] = _p2p_batcher
+
+
+# ---------------------------------------------------------------------------
+# Table handling
+# ---------------------------------------------------------------------------
+
+TableLike = Union[int, np.integer, Sequence[int], np.ndarray]
+
+
+def _normalize_table(value: TableLike, size: int, what: str) -> Tuple[int, ...]:
+    """Normalize a per-rank partner table.
+
+    A bare int is accepted only at size 1 (where the reference's
+    per-process scalar argument and the table coincide); otherwise the
+    caller must supply one partner entry per rank — the SPMD
+    translation of the reference's per-process ``dest``/``source``
+    scalars (see module docstring).
+    """
+    if isinstance(value, (int, np.integer)):
+        if size == 1:
+            return (int(value),)
+        raise ValueError(
+            f"{what} must be a per-rank table of length {size} under SPMD "
+            f"(got bare int {int(value)}). Each entry gives that rank's "
+            f"partner, {PROC_NULL} (PROC_NULL) for none; build shift "
+            "patterns with CartComm.shift()."
+        )
+    table = tuple(int(v) for v in value)
+    if len(table) != size:
+        raise ValueError(
+            f"{what} table has length {len(table)}, expected communicator "
+            f"size {size}"
+        )
+    for r, v in enumerate(table):
+        if v >= size:
+            raise ValueError(f"{what}[{r}] = {v} out of range for size {size}")
+    return table
+
+
+def _edges_from_dest(dest: Tuple[int, ...]) -> Tuple[Edge, ...]:
+    edges = tuple((s, d) for s, d in enumerate(dest) if d >= 0)
+    dests = [d for _, d in edges]
+    if len(set(dests)) != len(dests):
+        raise ValueError(
+            f"dest table {dest} sends more than one message to the same "
+            "rank; a single transfer must form a partial permutation"
+        )
+    return edges
+
+
+def _edges_from_source(source: Tuple[int, ...]) -> Tuple[Edge, ...]:
+    edges = tuple((s, d) for d, s in enumerate(source) if s >= 0)
+    srcs = [s for s, _ in edges]
+    if len(set(srcs)) != len(srcs):
+        raise ValueError(
+            f"source table {source} receives more than one message from "
+            "the same rank; a single transfer must form a partial "
+            "permutation"
+        )
+    return edges
+
+
+def _check_tables_mirror(
+    send_edges: Tuple[Edge, ...], recv_edges: Tuple[Edge, ...]
+) -> None:
+    if set(send_edges) != set(recv_edges):
+        raise ValueError(
+            f"send dest table implies edges {sorted(set(send_edges))} but "
+            f"recv source table implies edges {sorted(set(recv_edges))}; "
+            "the tables must be mirror images of each other"
+        )
+
+
+# ---------------------------------------------------------------------------
+# sendrecv
+# ---------------------------------------------------------------------------
+
+
+@enforce_types(comm=(type(None), Comm))
+def sendrecv(
+    sendbuf,
+    recvbuf,
+    source: TableLike,
+    dest: TableLike,
+    *,
+    sendtag: int = 0,
+    recvtag: int = ANY_TAG,
+    comm=None,
+    status=None,
+    token=NOTSET,
+):
+    """Simultaneously send ``sendbuf`` and receive into a new array
+    (reference ``sendrecv.py:50-104``; like the reference — and unlike
+    mpi4py — the received data is *returned*, ``recvbuf`` is only a
+    shape/dtype template and is preserved on ranks whose ``source``
+    entry is PROC_NULL).
+
+    ``source``/``dest`` are per-rank tables (see module docstring);
+    ``CartComm.shift`` produces matched pairs for grid shifts.
+    """
+    raise_if_token_is_set(token)
+    if status is not None:
+        raise NotImplementedError(
+            "MPI.Status introspection has no analog for HLO collectives "
+            "(SURVEY.md §7 hard-parts); the TPU path does not support it"
+        )
+    bound = resolve_comm(comm)
+    if recvtag != ANY_TAG and recvtag != sendtag:
+        # In the fused SPMD transfer the sender and receiver are the
+        # same call, so the tags must agree (the reference's separate
+        # tags exist because its per-process sendrecv matches a remote
+        # process's sendrecv, sendrecv.py:50-104).
+        raise ValueError(
+            f"sendrecv recvtag ({recvtag}) must equal sendtag ({sendtag}) "
+            "or be ANY_TAG: the SPMD transfer is a single fused "
+            "CollectivePermute matching itself"
+        )
+    dest_t = _normalize_table(dest, bound.size, "dest")
+    source_t = _normalize_table(source, bound.size, "source")
+    send_edges = _edges_from_dest(dest_t)
+    recv_edges = _edges_from_source(source_t)
+    _check_tables_mirror(send_edges, recv_edges)
+    sendbuf = jnp.asarray(sendbuf)
+    recvbuf = jnp.asarray(recvbuf)
+    if sendbuf.shape != recvbuf.shape or sendbuf.dtype != recvbuf.dtype:
+        raise ValueError(
+            f"sendbuf (shape {sendbuf.shape}, {sendbuf.dtype}) and recvbuf "
+            f"template (shape {recvbuf.shape}, {recvbuf.dtype}) must match"
+        )
+    (out,) = emit(
+        mpi_p2p_p,
+        (sendbuf, recvbuf),
+        dict(perm=send_edges, comm=bound),
+        opname="Sendrecv",
+        details=f"[{sendbuf.size} items, {len(send_edges)} edges, n={bound.size}]",
+        bound_comm=bound,
+    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# send / recv with trace-time channel matching
+# ---------------------------------------------------------------------------
+
+
+@enforce_types(comm=(type(None), Comm))
+def send(x, dest: TableLike, *, tag: int = 0, comm=None, token=NOTSET):
+    """Send ``x`` according to the per-rank ``dest`` table (reference
+    ``send.py:44-80``). Returns nothing; the transfer is emitted when
+    the matching :func:`recv` appears later in the same trace."""
+    raise_if_token_is_set(token)
+    bound = resolve_comm(comm)
+    dest_t = _normalize_table(dest, bound.size, "dest")
+    edges = _edges_from_dest(dest_t)
+    x = jnp.asarray(x)
+    debug.log_emission(
+        "Send", f"[{x.size} items, {len(edges)} edges, tag={tag}, n={bound.size}]"
+    )
+    pending_sends().append(
+        dict(
+            x=x,
+            edges=edges,
+            tag=int(tag),
+            axes=bound.axes,
+            shape=x.shape,
+            dtype=x.dtype,
+        )
+    )
+    return None
+
+
+@enforce_types(comm=(type(None), Comm))
+def recv(
+    x,
+    source: TableLike,
+    *,
+    tag: int = ANY_TAG,
+    comm=None,
+    status=None,
+    token=NOTSET,
+):
+    """Receive according to the per-rank ``source`` table; ``x`` is a
+    shape/dtype template, never written (reference ``recv.py:47-84``).
+    Ranks whose ``source`` entry is PROC_NULL keep their template
+    values (``MPI_PROC_NULL`` semantics).
+
+    The matching :func:`send` must have been issued earlier in the same
+    traced program (see module docstring)."""
+    raise_if_token_is_set(token)
+    if status is not None:
+        raise NotImplementedError(
+            "MPI.Status introspection has no analog for HLO collectives "
+            "(SURVEY.md §7 hard-parts); the TPU path does not support it"
+        )
+    bound = resolve_comm(comm)
+    source_t = _normalize_table(source, bound.size, "source")
+    recv_edges = _edges_from_source(source_t)
+    x = jnp.asarray(x)
+
+    queue = pending_sends()
+    match_idx: Optional[int] = None
+    for i, rec in enumerate(queue):
+        if rec["axes"] != bound.axes:
+            continue
+        if tag != ANY_TAG and rec["tag"] != tag:
+            continue
+        if set(rec["edges"]) != set(recv_edges):
+            continue
+        match_idx = i
+        break
+    if match_idx is None:
+        raise RuntimeError(
+            f"recv(source={source_t}, tag={tag}): no matching send was "
+            "issued earlier in this traced program. On the TPU backend a "
+            "send/recv pair fuses into one CollectivePermute and must "
+            "therefore appear in the same jit/shard_map trace, send first "
+            "(see mpi4jax_tpu/ops/p2p.py docstring; reference ordering "
+            "test: test_send_and_recv.py:91-110)."
+        )
+    rec = queue.pop(match_idx)
+    if rec["shape"] != x.shape or rec["dtype"] != x.dtype:
+        raise ValueError(
+            f"matched send has shape {rec['shape']} dtype {rec['dtype']} "
+            f"but recv template has shape {x.shape} dtype {x.dtype}"
+        )
+    (out,) = emit(
+        mpi_p2p_p,
+        (rec["x"], x),
+        dict(perm=rec["edges"], comm=bound),
+        opname="Recv",
+        details=f"[{x.size} items, {len(recv_edges)} edges, tag={tag}, n={bound.size}]",
+        bound_comm=bound,
+    )
+    return out

@@ -1,0 +1,62 @@
+"""reduce — reduction onto one root rank.
+
+Rebuild of reference ``_src/collective_ops/reduce.py`` with exact
+user-visible parity: the root receives the reduction over all ranks,
+non-root ranks get their own input back unchanged (reference wrapper
+behavior, ``reduce.py:64-73,124-133``). Under SPMD this is a traced
+select: ``where(rank == root, allreduce(x), x)`` — one HLO AllReduce,
+which is also the fastest a root-targeted reduce can be on the ICI
+mesh (there is no root-only HLO reduce).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..comm import BoundComm, Comm, Op, SUM, resolve_comm
+from ..token import NOTSET, raise_if_token_is_set
+from ..validation import enforce_types
+from ._core import define_primitive, emit, register_passthrough_batcher
+from .allreduce import _allreduce_spmd
+
+
+def _reduce_abstract_eval(x, *, op, root, comm: BoundComm):
+    return x
+
+
+def _reduce_spmd(x, *, op, root, comm: BoundComm):
+    if not comm.axes or comm.size == 1:
+        return x
+    reduced = _allreduce_spmd(x, op=op, comm=comm, transpose=False)
+    return jnp.where(comm.rank() == root, reduced, x)
+
+
+mpi_reduce_p = define_primitive(
+    "tpu_reduce",
+    abstract_eval=_reduce_abstract_eval,
+    spmd_impl=_reduce_spmd,
+)
+register_passthrough_batcher(mpi_reduce_p)
+
+
+@enforce_types(op=Op, root=(int, np.integer), comm=(type(None), Comm))
+def reduce(x, op=SUM, root=0, *, comm=None, token=NOTSET):
+    """Reduce ``x`` onto rank ``root``; non-root ranks receive their
+    input back unchanged (reference ``reduce.py:41-73``)."""
+    raise_if_token_is_set(token)
+    bound = resolve_comm(comm)
+    root = int(root)
+    if not 0 <= root < bound.size:
+        raise ValueError(f"root {root} out of range for size {bound.size}")
+    x = jnp.asarray(x)
+    (out,) = emit(
+        mpi_reduce_p,
+        (x,),
+        dict(op=op, root=root, comm=bound),
+        opname="Reduce",
+        details=f"[{x.size} items, op={op.name}, root={root}, n={bound.size}]",
+        bound_comm=bound,
+    )
+    return out

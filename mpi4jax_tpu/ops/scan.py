@@ -1,0 +1,71 @@
+"""scan — inclusive prefix reduction across ranks (MPI_Scan).
+
+Rebuild of reference ``_src/collective_ops/scan.py`` (``scan.py:44-63``).
+XLA has no prefix-reduction collective, so this lowers to a
+Hillis–Steele ladder of ``ceil(log2(n))`` CollectivePermute rounds with
+masked accumulation — the O(log n) design SURVEY.md §7 calls for
+("`scan` ... needs an O(log n) ppermute ladder with masked
+accumulation"). Round d shifts partial prefixes forward by ``d`` ranks
+and ranks ``>= d`` fold the incoming value:
+
+    y_r <- combine(y_{r-d}, y_r)   for r >= d,  d = 1, 2, 4, ...
+
+Correctness oracle (SUM): rank r ends with ``sum(x_0..x_r)``
+(reference ``tests/collective_ops/test_scan.py:16``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..comm import BoundComm, Comm, Op, SUM, resolve_comm
+from ..token import NOTSET, raise_if_token_is_set
+from ..validation import enforce_types
+from ._core import define_primitive, emit, register_passthrough_batcher
+
+
+def _scan_abstract_eval(x, *, op, comm: BoundComm):
+    return x
+
+
+def _scan_spmd(x, *, op: Op, comm: BoundComm):
+    if not comm.axes or comm.size == 1:
+        return x
+    axis = comm.require_single_axis("scan")
+    n = comm.size
+    rank = lax.axis_index(axis)
+    y = x
+    d = 1
+    while d < n:
+        perm = [(i, i + d) for i in range(n - d)]
+        shifted = lax.ppermute(y, axis, perm)
+        y = jnp.where(rank >= d, op.combine(y, shifted), y)
+        d *= 2
+    return y
+
+
+mpi_scan_p = define_primitive(
+    "tpu_scan",
+    abstract_eval=_scan_abstract_eval,
+    spmd_impl=_scan_spmd,
+)
+register_passthrough_batcher(mpi_scan_p)
+
+
+@enforce_types(op=Op, comm=(type(None), Comm))
+def scan(x, op=SUM, *, comm=None, token=NOTSET):
+    """Inclusive prefix reduction: rank r receives the reduction of
+    ranks ``0..r`` (reference ``scan.py:36-63``)."""
+    raise_if_token_is_set(token)
+    bound = resolve_comm(comm)
+    x = jnp.asarray(x)
+    (out,) = emit(
+        mpi_scan_p,
+        (x,),
+        dict(op=op, comm=bound),
+        opname="Scan",
+        details=f"[{x.size} items, op={op.name}, n={bound.size}]",
+        bound_comm=bound,
+    )
+    return out

@@ -1,0 +1,16 @@
+"""Mesh / SPMD helpers: the TPU-native replacement for ``mpirun``.
+
+The reference's launch model is ``mpirun -n N python script.py`` with
+one process per rank (``README.rst:83-88``). The TPU-native model is a
+single controller (or ``jax.distributed``-initialized controllers on a
+multi-host pod) driving a :class:`jax.sharding.Mesh`; "ranks" are mesh
+positions and per-rank code runs inside ``shard_map``.
+"""
+
+from .mesh import (  # noqa: F401
+    WORLD_AXIS,
+    initialize,
+    spmd,
+    world_mesh,
+)
+from .halo import HaloExchange2D  # noqa: F401

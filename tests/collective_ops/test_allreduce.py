@@ -1,0 +1,233 @@
+"""Transform-matrix coverage for allreduce, mirroring the reference's
+``tests/collective_ops/test_allreduce.py`` (322 LoC: plain / jit /
+scalar / vmap / grad / jvp / vjp / linear_transpose / double+triple
+transpose, analytic oracles ``arr * size``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as m4t
+
+N = 8
+
+
+def base_arr(rank):
+    return np.ones((3, 2), np.float32) * (rank + 1)
+
+
+def test_allreduce_sum(run_spmd, per_rank):
+    arr = per_rank(base_arr)
+    out = run_spmd(lambda x: m4t.allreduce(x, op=m4t.SUM), arr)
+    expected = arr.sum(axis=0)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], expected)
+
+
+def test_allreduce_input_not_mutated(run_spmd, per_rank):
+    # Reference invariant: inputs never mutated (test_allreduce.py:17-21).
+    arr = per_rank(base_arr)
+    keep = arr.copy()
+    run_spmd(lambda x: m4t.allreduce(x, op=m4t.SUM), arr)
+    np.testing.assert_array_equal(arr, keep)
+
+
+def test_allreduce_scalar(run_spmd, per_rank):
+    arr = per_rank(lambda r: np.float32(r + 1))
+    out = run_spmd(lambda x: m4t.allreduce(x, op=m4t.SUM), arr)
+    np.testing.assert_allclose(out, np.full(N, arr.sum()))
+
+
+@pytest.mark.parametrize(
+    "op,np_red",
+    [
+        (m4t.SUM, np.sum),
+        (m4t.MAX, np.max),
+        (m4t.MIN, np.min),
+        (m4t.PROD, np.prod),
+    ],
+)
+def test_allreduce_ops(run_spmd, per_rank, op, np_red):
+    arr = per_rank(lambda r: np.arange(1, 5, dtype=np.float32) + r)
+    out = run_spmd(lambda x: m4t.allreduce(x, op=op), arr)
+    expected = np_red(arr, axis=0)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], expected, rtol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "op,oracle",
+    [
+        (m4t.LAND, lambda a: np.all(a != 0, axis=0)),
+        (m4t.LOR, lambda a: np.any(a != 0, axis=0)),
+        (m4t.BAND, lambda a: np.bitwise_and.reduce(a, axis=0)),
+        (m4t.BOR, lambda a: np.bitwise_or.reduce(a, axis=0)),
+        (m4t.BXOR, lambda a: np.bitwise_xor.reduce(a, axis=0)),
+    ],
+)
+def test_allreduce_logical_ops(run_spmd, per_rank, op, oracle):
+    arr = per_rank(lambda r: (np.arange(6) + r) % 3).astype(np.int32)
+    out = run_spmd(lambda x: m4t.allreduce(x, op=op), arr)
+    expected = oracle(arr).astype(out.dtype)
+    for r in range(N):
+        np.testing.assert_array_equal(out[r], expected)
+
+
+def test_allreduce_int_and_bool(run_spmd, per_rank):
+    arr_i = per_rank(lambda r: np.arange(4, dtype=np.int32) + r)
+    out = run_spmd(lambda x: m4t.allreduce(x, op=m4t.SUM), arr_i)
+    np.testing.assert_array_equal(out[0], arr_i.sum(axis=0))
+
+    arr_b = per_rank(lambda r: np.array([r % 2 == 0, False, True]))
+    out = run_spmd(lambda x: m4t.allreduce(x, op=m4t.SUM), arr_b)
+    np.testing.assert_array_equal(out[0], arr_b.any(axis=0))
+
+
+def test_allreduce_vmap(run_spmd, per_rank):
+    arr = per_rank(lambda r: np.arange(12, dtype=np.float32).reshape(4, 3) + r)
+    out = run_spmd(
+        lambda x: jax.vmap(lambda y: m4t.allreduce(y, op=m4t.SUM))(x), arr
+    )
+    expected = arr.sum(axis=0)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], expected)
+
+
+def test_allreduce_grad(run_spmd, per_rank):
+    # Reference: grad of sum(allreduce(x)) is ones (test_allreduce.py:141-193
+    # — the transpose-is-identity convention).
+    arr = per_rank(base_arr)
+    out = run_spmd(
+        lambda x: jax.grad(lambda y: m4t.allreduce(y, op=m4t.SUM).sum())(x), arr
+    )
+    np.testing.assert_allclose(out, np.ones_like(arr))
+
+
+def test_allreduce_value_and_grad(run_spmd, per_rank):
+    arr = per_rank(base_arr)
+
+    def f(x):
+        v, g = jax.value_and_grad(lambda y: m4t.allreduce(y, op=m4t.SUM).sum())(x)
+        return v * jnp.ones(()), g
+
+    val, grad = run_spmd(f, arr)
+    np.testing.assert_allclose(val, np.full(N, arr.sum(axis=0).sum()))
+    np.testing.assert_allclose(grad, np.ones_like(arr))
+
+
+def test_allreduce_jvp(run_spmd, per_rank):
+    # JVP = allreduce of the tangents (reference allreduce.py:138-149).
+    arr = per_rank(base_arr)
+
+    def f(x):
+        p, t = jax.jvp(lambda y: m4t.allreduce(y, op=m4t.SUM), (x,), (x,))
+        return p, t
+
+    p, t = run_spmd(f, arr)
+    expected = arr.sum(axis=0)
+    for r in range(N):
+        np.testing.assert_allclose(p[r], expected)
+        np.testing.assert_allclose(t[r], expected)
+
+
+def test_allreduce_vjp(run_spmd, per_rank):
+    # VJP pullback of replicated cotangent = identity per rank
+    # (reference transpose convention, allreduce.py:152-159).
+    arr = per_rank(base_arr)
+
+    def f(x):
+        p, vjp_fun = jax.vjp(lambda y: m4t.allreduce(y, op=m4t.SUM), x)
+        (ct,) = vjp_fun(jnp.ones_like(p))
+        return p, ct
+
+    p, ct = run_spmd(f, arr)
+    np.testing.assert_allclose(ct, np.ones_like(arr))
+
+
+def test_allreduce_transpose_identity(run_spmd, per_rank):
+    # linear_transpose(allreduce)(ct) == ct (reference
+    # test_allreduce.py:105-138).
+    arr = per_rank(base_arr)
+
+    def f(x):
+        g = lambda y: m4t.allreduce(y, op=m4t.SUM)
+        (t,) = jax.linear_transpose(g, x)(x)
+        return t
+
+    out = run_spmd(f, arr)
+    np.testing.assert_allclose(out, arr)
+
+
+def test_allreduce_double_transpose(run_spmd, per_rank):
+    # transpose(transpose(allreduce)) == allreduce.
+    arr = per_rank(base_arr)
+
+    def f(x):
+        g = lambda y: m4t.allreduce(y, op=m4t.SUM)
+        gt = lambda y: jax.linear_transpose(g, y)(y)[0]
+        (t2,) = jax.linear_transpose(gt, x)(x)
+        return t2
+
+    out = run_spmd(f, arr)
+    expected = arr.sum(axis=0)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], expected)
+
+
+def test_allreduce_triple_transpose(run_spmd, per_rank):
+    # Reference matvec ladder goes to 3 transposes
+    # (test_allreduce_matvec.py:122-179).
+    arr = per_rank(base_arr)
+
+    def f(x):
+        g = lambda y: m4t.allreduce(y, op=m4t.SUM)
+        gt = lambda y: jax.linear_transpose(g, y)(y)[0]
+        gtt = lambda y: jax.linear_transpose(gt, y)(y)[0]
+        (t3,) = jax.linear_transpose(gtt, x)(x)
+        return t3
+
+    out = run_spmd(f, arr)
+    np.testing.assert_allclose(out, arr)
+
+
+def test_allreduce_grad_requires_sum(run_spmd, per_rank):
+    # Parity: differentiable only for SUM (reference allreduce.py:142-145).
+    arr = per_rank(base_arr)
+    with pytest.raises(NotImplementedError):
+        run_spmd(
+            lambda x: jax.grad(lambda y: m4t.allreduce(y, op=m4t.MAX).sum())(x),
+            arr,
+        )
+
+
+# --- single-rank (eager / plain-jit) paths: the reference suite's
+# --- 1-process run (SURVEY.md §4 execution model) ---
+
+
+def test_allreduce_size1_eager():
+    arr = jnp.arange(6.0)
+    out = m4t.allreduce(arr, op=m4t.SUM)
+    np.testing.assert_allclose(out, arr)
+
+
+def test_allreduce_size1_jit():
+    arr = jnp.arange(6.0)
+    out = jax.jit(lambda x: m4t.allreduce(x, op=m4t.SUM))(arr)
+    np.testing.assert_allclose(out, arr)
+
+
+def test_allreduce_size1_grad():
+    arr = jnp.arange(6.0)
+    g = jax.grad(lambda x: m4t.allreduce(x, op=m4t.SUM).sum())(arr)
+    np.testing.assert_allclose(g, np.ones(6))
+
+
+def test_allreduce_rejects_bad_op():
+    with pytest.raises(TypeError):
+        m4t.allreduce(jnp.zeros(3), op="SUM")
+
+
+def test_allreduce_rejects_token():
+    with pytest.raises(TypeError):
+        m4t.allreduce(jnp.zeros(3), op=m4t.SUM, token=jnp.zeros(()))

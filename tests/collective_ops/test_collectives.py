@@ -1,0 +1,227 @@
+"""Analytic-oracle tests for allgather/alltoall/bcast/gather/reduce/
+scan/scatter/barrier, mirroring the reference per-op test files
+(``tests/collective_ops/test_*.py``: plain + jit + scalar variants with
+rank/size-derived expected values)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as m4t
+
+N = 8
+
+
+# --- allgather (reference test_allgather.py) ---
+
+
+def test_allgather(run_spmd, per_rank):
+    arr = per_rank(lambda r: np.arange(4, dtype=np.float32) + r)
+    out = run_spmd(lambda x: m4t.allgather(x), arr)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], arr)
+
+
+def test_allgather_scalar(run_spmd, per_rank):
+    arr = per_rank(lambda r: np.float32(r))
+    out = run_spmd(lambda x: m4t.allgather(x), arr)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], np.arange(N, dtype=np.float32))
+
+
+def test_allgather_size1():
+    out = m4t.allgather(jnp.arange(3.0))
+    assert out.shape == (1, 3)
+    np.testing.assert_allclose(out[0], np.arange(3.0))
+
+
+# --- alltoall (reference test_alltoall.py) ---
+
+
+def test_alltoall(run_spmd, per_rank):
+    arr = per_rank(lambda r: np.arange(N * 3, dtype=np.float32).reshape(N, 3) + 100 * r)
+    out = run_spmd(lambda x: m4t.alltoall(x), arr)
+    for r in range(N):
+        for j in range(N):
+            np.testing.assert_allclose(out[r, j], arr[j, r])
+
+
+def test_alltoall_transposed_layout(run_spmd, per_rank):
+    # Regression analog of mpi4jax#176 (reference test_alltoall.py:44-65):
+    # non-contiguous input from consecutive transposes must still
+    # exchange correctly.
+    arr = per_rank(
+        lambda r: (np.arange(N * 4, dtype=np.float32).reshape(4, N) + 10 * r)
+    )
+
+    def f(x):
+        xt = jnp.transpose(x, (1, 0))
+        return m4t.alltoall(xt)
+
+    out = run_spmd(f, arr)
+    for r in range(N):
+        for j in range(N):
+            np.testing.assert_allclose(out[r, j], arr[j].T[r])
+
+
+def test_alltoall_wrong_leading_axis(run_spmd, per_rank):
+    arr = per_rank(lambda r: np.zeros((3, 2), np.float32))
+    with pytest.raises(ValueError):
+        run_spmd(lambda x: m4t.alltoall(x), arr)
+
+
+def test_alltoall_size1():
+    x = jnp.arange(3.0).reshape(1, 3)
+    np.testing.assert_allclose(m4t.alltoall(x), x)
+
+
+# --- bcast (reference test_bcast.py) ---
+
+
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_bcast(run_spmd, per_rank, root):
+    arr = per_rank(lambda r: np.arange(5, dtype=np.float32) * (r + 1))
+    out = run_spmd(lambda x: m4t.bcast(x, root), arr)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], arr[root])
+
+
+def test_bcast_bool(run_spmd, per_rank):
+    arr = per_rank(lambda r: np.array([r % 2 == 0, True, False]))
+    out = run_spmd(lambda x: m4t.bcast(x, 3), arr)
+    for r in range(N):
+        np.testing.assert_array_equal(out[r], arr[3])
+
+
+def test_bcast_complex(run_spmd, per_rank):
+    arr = per_rank(lambda r: (np.arange(3) + 1j * r).astype(np.complex64))
+    out = run_spmd(lambda x: m4t.bcast(x, 5), arr)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], arr[5])
+
+
+def test_bcast_bad_root():
+    with pytest.raises(ValueError):
+        m4t.bcast(jnp.zeros(3), 1)  # size-1 world: only root 0 valid
+
+
+# --- gather (reference test_gather.py; TPU superset: all ranks get it) ---
+
+
+@pytest.mark.parametrize("root", [0, 2])
+def test_gather(run_spmd, per_rank, root):
+    arr = per_rank(lambda r: np.arange(3, dtype=np.float32) + 10 * r)
+    out = run_spmd(lambda x: m4t.gather(x, root), arr)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], arr)
+
+
+def test_gather_size1():
+    out = m4t.gather(jnp.arange(3.0), 0)
+    assert out.shape == (1, 3)
+
+
+# --- reduce (reference test_reduce.py) ---
+
+
+@pytest.mark.parametrize("root", [0, 4])
+def test_reduce(run_spmd, per_rank, root):
+    arr = per_rank(lambda r: np.arange(4, dtype=np.float32) + r)
+    out = run_spmd(lambda x: m4t.reduce(x, m4t.SUM, root), arr)
+    for r in range(N):
+        if r == root:
+            np.testing.assert_allclose(out[r], arr.sum(axis=0))
+        else:
+            # Non-root ranks get their input back (reference
+            # reduce.py:64-73).
+            np.testing.assert_allclose(out[r], arr[r])
+
+
+def test_reduce_max(run_spmd, per_rank):
+    arr = per_rank(lambda r: np.float32(r * (-1) ** r))
+    out = run_spmd(lambda x: m4t.reduce(x, m4t.MAX, 0), arr)
+    np.testing.assert_allclose(out[0], arr.max())
+
+
+# --- scan (reference test_scan.py: oracle sum(range(rank+1))) ---
+
+
+def test_scan_sum(run_spmd, per_rank):
+    arr = per_rank(lambda r: np.float32(r))
+    out = run_spmd(lambda x: m4t.scan(x, m4t.SUM), arr)
+    expected = np.cumsum(arr)
+    np.testing.assert_allclose(out, expected)
+
+
+def test_scan_array(run_spmd, per_rank):
+    arr = per_rank(lambda r: np.arange(4, dtype=np.float32) + r)
+    out = run_spmd(lambda x: m4t.scan(x, m4t.SUM), arr)
+    np.testing.assert_allclose(out, np.cumsum(arr, axis=0))
+
+
+@pytest.mark.parametrize(
+    "op,np_scan",
+    [
+        (m4t.MAX, np.maximum.accumulate),
+        (m4t.MIN, np.minimum.accumulate),
+        (m4t.PROD, np.multiply.accumulate),
+    ],
+)
+def test_scan_ops(run_spmd, per_rank, op, np_scan):
+    rng = np.random.RandomState(0)
+    arr = np.asarray(rng.uniform(0.5, 1.5, size=(N, 3)), np.float32)
+    out = run_spmd(lambda x: m4t.scan(x, op), jnp.asarray(arr))
+    np.testing.assert_allclose(out, np_scan(arr, axis=0), rtol=1e-6)
+
+
+def test_scan_size1():
+    x = jnp.arange(3.0)
+    np.testing.assert_allclose(m4t.scan(x, m4t.SUM), x)
+
+
+# --- scatter (reference test_scatter.py) ---
+
+
+@pytest.mark.parametrize("root", [0, 6])
+def test_scatter(run_spmd, per_rank, root):
+    arr = per_rank(
+        lambda r: np.arange(N * 3, dtype=np.float32).reshape(N, 3) * (r + 1)
+    )
+    out = run_spmd(lambda x: m4t.scatter(x, root), arr)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], arr[root, r])
+
+
+def test_scatter_int(run_spmd, per_rank):
+    arr = per_rank(lambda r: np.arange(N, dtype=np.int32) * (r + 1))
+    out = run_spmd(lambda x: m4t.scatter(x, 2), arr)
+    np.testing.assert_array_equal(out.ravel(), arr[2])
+
+
+def test_scatter_wrong_shape():
+    with pytest.raises(ValueError):
+        m4t.scatter(jnp.zeros((3, 2)), 0)  # size-1 world wants leading 1
+
+
+def test_scatter_size1():
+    x = jnp.arange(3.0).reshape(1, 3)
+    np.testing.assert_allclose(m4t.scatter(x, 0), x[0])
+
+
+# --- barrier (reference test_barrier.py) ---
+
+
+def test_barrier(run_spmd, per_rank):
+    arr = per_rank(lambda r: np.float32(r))
+
+    def f(x):
+        m4t.barrier()
+        return m4t.allreduce(x, op=m4t.SUM)
+
+    out = run_spmd(f, arr)
+    np.testing.assert_allclose(out, np.full(N, arr.sum()))
+
+
+def test_barrier_size1():
+    assert m4t.barrier() is None

@@ -1,0 +1,72 @@
+"""Test harness: 8 virtual CPU devices standing in for an 8-chip mesh.
+
+The reference runs its whole pytest suite twice — single-process and
+under ``mpirun -np 2`` (``docs/developers.rst:18-27``). The TPU-native
+analog (SURVEY.md §4 closing note): the same suite runs single-rank
+(eager, world size 1) and over an
+``--xla_force_host_platform_device_count=8`` CPU mesh via ``shard_map``.
+"""
+
+import os
+
+# Must happen before the first backend initialization. The container's
+# sitecustomize registers the axon TPU plugin and forces
+# jax_platforms="axon,cpu"; re-force cpu below after import.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from mpi4jax_tpu.parallel import spmd, world_mesh  # noqa: E402
+
+N_RANKS = 8
+
+
+def pytest_report_header(config):
+    # Analog of the reference's vendor/rank/size header
+    # (tests/conftest.py:1-9 in the reference).
+    return (
+        f"mpi4jax_tpu harness: {len(jax.devices())} virtual CPU devices, "
+        f"world size {N_RANKS}"
+    )
+
+
+@pytest.fixture(scope="session")
+def mesh():
+    m = world_mesh()
+    assert m.devices.size == N_RANKS
+    return m
+
+
+@pytest.fixture()
+def run_spmd(mesh):
+    """Run a per-rank function over the 8-rank mesh.
+
+    ``run_spmd(fn, *args)``: each arg has leading axis 8 (per-rank
+    blocks); returns stacked per-rank outputs as numpy arrays.
+    """
+
+    def runner(fn, *args):
+        out = spmd(fn, mesh=mesh)(*args)
+        return jax.tree.map(np.asarray, out)
+
+    return runner
+
+
+@pytest.fixture()
+def per_rank():
+    """Build a stacked per-rank input: per_rank(fn) with fn(rank)->arr."""
+
+    def build(fn):
+        return np.stack([np.asarray(fn(r)) for r in range(N_RANKS)])
+
+    return build
