@@ -1,0 +1,12 @@
+"""Reference workloads built on the communication primitives.
+
+The reference ships one flagship application — the SPMD halo-exchange
+shallow-water solver (``examples/shallow_water.py``, also its only
+published benchmark, ``docs/shallow-water.rst``) — plus test workloads
+for distributed linear algebra and data-parallel gradient sums
+(``tests/test_allreduce_matvec.py``, ``tests/test_jax_transforms.py``).
+This package rebuilds those TPU-first and adds the distributed-training
+workloads the primitives exist to serve (DP/TP MLP, ring attention).
+"""
+
+from .shallow_water import ShallowWaterConfig, ShallowWaterModel  # noqa: F401

@@ -1,0 +1,174 @@
+"""Sequence-parallel causal transformer LM — the long-context model family.
+
+Composes the framework's parallelism subsystems into a trainable
+model (SURVEY.md §5 "long-context" + §2.5 patterns):
+
+- **Sequence parallelism (sp)**: activations are sharded over the
+  sequence; attention runs as :func:`mpi4jax_tpu.parallel.ring_attention`
+  (CollectivePermute ring) or
+  :func:`~mpi4jax_tpu.parallel.ulysses_attention` (AllToAll head
+  resharding) — both exact.
+- **Tensor parallelism (tp)**: the MLP uses the Megatron column/row
+  pairing from :mod:`mpi4jax_tpu.models.mlp` (allreduce activations,
+  f-operator backward sync).
+- **Data parallelism (dp)**: gradient averaging through the
+  differentiable allreduce.
+
+The model is deliberately small and explicit (plain pytrees, no flax)
+so every collective is visible; it is the training-step workload used
+by ``__graft_entry__.dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..comm import Comm, SUM
+from ..ops import allreduce
+from ..ops.allreduce import identity_with_allreduce_grad
+from ..parallel.ring import ring_attention
+from ..parallel.ulysses import ulysses_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 128
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 128
+    dtype: Any = jnp.float32
+    sp_axis: Optional[str] = None   # sequence parallelism
+    tp_axis: Optional[str] = None   # tensor parallelism (MLP)
+    dp_axis: Optional[str] = None   # data parallelism
+    sp_size: int = 1
+    tp_size: int = 1
+    attention: str = "ring"         # "ring" | "ulysses"
+    learning_rate: float = 1e-2
+
+    @property
+    def d_head(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    @property
+    def d_ff_local(self) -> int:
+        assert self.d_ff % self.tp_size == 0
+        return self.d_ff // self.tp_size
+
+
+def init_params(config: TransformerConfig, key):
+    c = config
+
+    def dense(key, m, n):
+        return jax.random.normal(key, (m, n), c.dtype) / np.sqrt(m)
+
+    keys = iter(jax.random.split(key, 4 + 6 * c.n_layers))
+    params = {
+        "embed": jax.random.normal(next(keys), (c.vocab, c.d_model), c.dtype)
+        * 0.02,
+        "head": dense(next(keys), c.d_model, c.vocab),
+        "layers": [],
+    }
+    for _ in range(c.n_layers):
+        params["layers"].append(
+            {
+                "qkv": dense(next(keys), c.d_model, 3 * c.d_model),
+                "proj": dense(next(keys), c.d_model, c.d_model),
+                "ln1": jnp.ones((c.d_model,), c.dtype),
+                "ln2": jnp.ones((c.d_model,), c.dtype),
+                # tp-sharded MLP blocks (column then row partition)
+                "w_up": dense(next(keys), c.d_model, c.d_ff_local),
+                "w_down": dense(next(keys), c.d_ff_local, c.d_model),
+            }
+        )
+    return params
+
+
+def _layernorm(x, g):
+    mu = x.mean(axis=-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(axis=-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + 1e-6) * g
+
+
+def forward(config: TransformerConfig, params, tokens):
+    """``tokens``: (T_local,) int32 -> logits (T_local, vocab)."""
+    c = config
+    sp = Comm(c.sp_axis) if c.sp_axis and c.sp_size > 1 else None
+    tp = Comm(c.tp_axis) if c.tp_axis and c.tp_size > 1 else None
+
+    h = params["embed"][tokens]  # (T_local, d_model)
+    for layer in params["layers"]:
+        # --- attention (sequence parallel) ---
+        x = _layernorm(h, layer["ln1"])
+        qkv = x @ layer["qkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        t_loc = q.shape[0]
+
+        def heads(a):
+            return a.reshape(t_loc, c.n_heads, c.d_head)
+
+        if c.attention == "ulysses":
+            attn = ulysses_attention(
+                heads(q), heads(k), heads(v), comm=sp, causal=True
+            )
+        else:
+            # ring attention over (H, T_local, D) blocks
+            qh = heads(q).transpose(1, 0, 2)
+            kh = heads(k).transpose(1, 0, 2)
+            vh = heads(v).transpose(1, 0, 2)
+            attn = ring_attention(qh, kh, vh, comm=sp, causal=True)
+            attn = attn.transpose(1, 0, 2)
+        attn = attn.reshape(t_loc, c.d_model)
+        h = h + attn @ layer["proj"]
+
+        # --- MLP (tensor parallel, Megatron pairing) ---
+        x = _layernorm(h, layer["ln2"])
+        if tp is not None:
+            x = identity_with_allreduce_grad(x, comm=tp)
+        a = jax.nn.gelu(x @ layer["w_up"])
+        mlp_out = a @ layer["w_down"]
+        if tp is not None:
+            mlp_out = allreduce(mlp_out, op=SUM, comm=tp)
+        h = h + mlp_out
+
+    return h @ params["head"]
+
+
+def loss_fn(config: TransformerConfig, params, tokens, targets):
+    """Mean next-token cross-entropy over the *global* sequence."""
+    logits = forward(config, params, tokens)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    local = -jnp.take_along_axis(logp, targets[:, None], axis=-1).sum()
+    count = jnp.asarray(targets.shape[0], jnp.float32)
+    if config.sp_axis and config.sp_size > 1:
+        sp = Comm(config.sp_axis)
+        local = allreduce(local, op=SUM, comm=sp)
+        count = count * config.sp_size
+    return local / count
+
+
+def train_step(config: TransformerConfig, params, tokens, targets, n_dp: int = 1):
+    loss, grads = jax.value_and_grad(
+        lambda p: loss_fn(config, p, tokens, targets)
+    )(params)
+    if config.sp_axis and config.sp_size > 1:
+        # Parameters are replicated over sp while activations are
+        # sequence-sharded, so each rank's grads cover only its tokens:
+        # sum them (the loss already divides by the global token count).
+        sp = Comm(config.sp_axis)
+        grads = jax.tree.map(lambda g: allreduce(g, op=SUM, comm=sp), grads)
+    if config.dp_axis and n_dp > 1:
+        dp = Comm(config.dp_axis)
+        grads = jax.tree.map(lambda g: allreduce(g, op=SUM, comm=dp) / n_dp, grads)
+        loss = allreduce(loss, op=SUM, comm=dp) / n_dp
+    new_params = jax.tree.map(
+        lambda p, g: p - config.learning_rate * g, params, grads
+    )
+    return new_params, loss

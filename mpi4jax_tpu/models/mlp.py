@@ -1,0 +1,130 @@
+"""Data-parallel / tensor-parallel MLP training on the primitives.
+
+The reference's gradient-sync workload is implicit in its test suite:
+differentiable ``allreduce(op=SUM)`` with the netket-style
+``custom_vjp`` expectation pattern
+(``tests/collective_ops/test_allreduce.py:252-322``) and the
+column-partitioned mat-vec (``tests/test_allreduce_matvec.py``).
+``BASELINE.json`` config 5 names the target explicitly:
+"jax.grad-through-allreduce: data-parallel MLP grad-sync on 32 chips".
+
+This module is that workload as a real model over a 2-D ``(dp, tp)``
+mesh:
+
+- **Tensor parallelism** (Megatron-style pairing): each block is a
+  column-parallel matmul ``(d, h/tp)`` followed by a row-parallel
+  matmul ``(h/tp, d)`` whose partial products are summed with
+  :func:`mpi4jax_tpu.allreduce` over the ``tp`` axis — one collective
+  per block, the distributed operator of ``test_allreduce_matvec.py``
+  as a neural layer. The transpose-is-identity AD convention makes
+  ``jax.grad`` through it produce per-rank-correct local weight
+  gradients with no extra collectives.
+- **Data parallelism**: each ``dp`` rank computes gradients on its
+  batch shard; gradients are averaged with ``allreduce(g)/n_dp``.
+
+Everything is plain jittable code; matmuls stay large and batched for
+the MXU and run in the parameter dtype (bfloat16-ready).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..comm import Comm, SUM
+from ..ops import allreduce
+from ..ops.allreduce import identity_with_allreduce_grad
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 64
+    hidden_dim: int = 256
+    out_dim: int = 16
+    n_blocks: int = 2
+    dtype: Any = jnp.float32
+    #: mesh axis names; None disables that parallelism dimension
+    tp_axis: Optional[str] = "tp"
+    dp_axis: Optional[str] = "dp"
+    tp_size: int = 1
+    learning_rate: float = 1e-2
+
+    @property
+    def hidden_local(self) -> int:
+        assert self.hidden_dim % self.tp_size == 0
+        return self.hidden_dim // self.tp_size
+
+
+def init_params(config: MLPConfig, key):
+    """Per-rank parameter pytree: list of TP blocks plus a replicated
+    output head. Block weights are this rank's shards."""
+    params = {"blocks": [], "head": None}
+    d = config.in_dim
+    for _ in range(config.n_blocks):
+        key, k1, k2 = jax.random.split(key, 3)
+        w_col = jax.random.normal(k1, (d, config.hidden_local), config.dtype)
+        w_col = w_col / np.sqrt(d)
+        w_row = jax.random.normal(k2, (config.hidden_local, d), config.dtype)
+        w_row = w_row / np.sqrt(config.hidden_dim)
+        b = jnp.zeros((d,), config.dtype)
+        params["blocks"].append((w_col, w_row, b))
+    key, kh = jax.random.split(key)
+    params["head"] = (
+        jax.random.normal(kh, (d, config.out_dim), config.dtype) / np.sqrt(d),
+        jnp.zeros((config.out_dim,), config.dtype),
+    )
+    return params
+
+
+def forward(config: MLPConfig, params, x):
+    """``x``: (batch_local, in_dim) -> logits (batch_local, out_dim)."""
+    tp = Comm(config.tp_axis) if config.tp_axis and config.tp_size > 1 else None
+    h = x
+    for w_col, w_row, b in params["blocks"]:
+        if tp is not None:
+            # Megatron "f": identity forward, allreduce backward, so
+            # each rank's dL/dh contribution is summed over tp.
+            h_in = identity_with_allreduce_grad(h, comm=tp)
+        else:
+            h_in = h
+        a = jax.nn.relu(h_in @ w_col)       # column-parallel, no comm
+        partial = a @ w_row                 # row-parallel partial sum
+        if tp is not None:
+            partial = allreduce(partial, op=SUM, comm=tp)
+        h = h + partial + b                 # residual keeps depth useful
+    w_out, b_out = params["head"]
+    return h @ w_out + b_out
+
+
+def loss_fn(config: MLPConfig, params, batch):
+    x, y = batch
+    logits = forward(config, params, x)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    return -jnp.mean(jnp.sum(logp * y, axis=-1))
+
+
+def grad_sync(config: MLPConfig, grads, n_dp: int):
+    """Data-parallel gradient averaging through the differentiable
+    allreduce (grad-through-psum semantics)."""
+    if config.dp_axis is None or n_dp <= 1:
+        return grads
+    dp = Comm(config.dp_axis)
+    return jax.tree.map(lambda g: allreduce(g, op=SUM, comm=dp) / n_dp, grads)
+
+
+def train_step(config: MLPConfig, params, batch, n_dp: int = 1):
+    """One SGD step: local grads -> dp allreduce-average -> update.
+    Returns (new_params, synced mean loss)."""
+    loss, grads = jax.value_and_grad(lambda p: loss_fn(config, p, batch))(params)
+    grads = grad_sync(config, grads, n_dp)
+    if config.dp_axis is not None and n_dp > 1:
+        loss = allreduce(loss, op=SUM, comm=Comm(config.dp_axis)) / n_dp
+    new_params = jax.tree.map(
+        lambda p, g: p - config.learning_rate * g, params, grads
+    )
+    return new_params, loss

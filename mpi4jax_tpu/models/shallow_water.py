@@ -1,0 +1,401 @@
+"""Nonlinear shallow-water solver — the flagship SPMD workload.
+
+TPU-first rebuild of the reference's demo application
+(``examples/shallow_water.py``, itself adapted from the public
+``dionhaefner/shallow-water`` solver): same physics — C-grid
+finite-difference shallow-water equations with Adams–Bashforth 2
+time-stepping, a geostrophically balanced jet initial condition,
+periodic-x / closed-y boundaries, lateral viscosity — so the published
+benchmark numbers (``docs/shallow-water.rst:47-94``, mirrored in
+``BASELINE.md``) are directly comparable.
+
+Architectural differences from the reference (by design, SURVEY.md §7):
+
+- **Single-program SPMD instead of one process per rank.** The
+  reference derives per-process neighbor ranks and code paths from
+  ``mpi_rank`` (``shallow_water.py:57-67,180-232``). Here the domain
+  decomposition is a :class:`mpi4jax_tpu.CartComm` over a mesh axis;
+  the per-rank neighbor decisions become static shift tables and the
+  boundary-rank special cases become traced ``where`` selects on the
+  rank index.
+- **Halo exchange = 4 fused CollectivePermutes.** The reference's
+  ``enforce_boundaries`` issues a clockwise sequence of
+  send/recv/sendrecv whose deadlock-freedom rests on token ordering
+  (``shallow_water.py:224-256``). Each directional exchange here is a
+  single ``sendrecv`` over the full shift table — one HLO
+  CollectivePermute riding ICI neighbor links, deadlock-free by
+  construction, with closed-boundary ranks keeping their ghost values
+  through PROC_NULL semantics.
+- **Rank-dependent constant fields are computed from the traced
+  rank** (Coriolis parameter varies with latitude → with the rank's
+  row in the process grid), keeping one compiled program for all
+  ranks.
+- Initial conditions are built globally with host numpy (setup, not
+  hot path — the reference does the same global construction,
+  ``shallow_water.py:138-169``) and returned as stacked per-rank
+  blocks ready for ``parallel.spmd``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..comm import CartComm, WORLD_AXIS
+from ..ops import sendrecv
+
+
+class ModelState(NamedTuple):
+    h: jax.Array
+    u: jax.Array
+    v: jax.Array
+    dh: jax.Array
+    du: jax.Array
+    dv: jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class ShallowWaterConfig:
+    """Physical and numerical parameters (reference values:
+    ``shallow_water.py:110-135``)."""
+
+    #: interior grid points, global (x, y); reference default (360, 180)
+    nx: int = 360
+    ny: int = 180
+    #: process grid (nproc_y, nproc_x); reference layout rule
+    #: ``shallow_water.py:62-64``
+    dims: Tuple[int, int] = (1, 1)
+    dx: float = 5e3
+    dy: float = 5e3
+    gravity: float = 9.81
+    depth: float = 100.0
+    coriolis_f: float = 2e-4
+    coriolis_beta: float = 2e-11
+    lateral_viscosity: Optional[float] = None  # default derived below
+    adams_bashforth_a: float = 1.6
+    adams_bashforth_b: float = -0.6
+    periodic_x: bool = True
+    dtype: np.dtype = np.float32
+
+    @property
+    def viscosity(self) -> float:
+        if self.lateral_viscosity is not None:
+            return self.lateral_viscosity
+        return 1e-3 * self.coriolis_f * self.dx**2
+
+    @property
+    def dt(self) -> float:
+        # CFL condition, reference shallow_water.py:135.
+        return 0.125 * min(self.dx, self.dy) / math.sqrt(self.gravity * self.depth)
+
+    @property
+    def nx_global(self) -> int:
+        return self.nx + 2
+
+    @property
+    def ny_global(self) -> int:
+        return self.ny + 2
+
+    @property
+    def nx_local(self) -> int:
+        npy, npx = self.dims
+        assert self.nx % npx == 0, "nx must divide evenly over nproc_x"
+        return self.nx // npx + 2
+
+    @property
+    def ny_local(self) -> int:
+        npy, npx = self.dims
+        assert self.ny % npy == 0, "ny must divide evenly over nproc_y"
+        return self.ny // npy + 2
+
+    @property
+    def n_ranks(self) -> int:
+        return self.dims[0] * self.dims[1]
+
+
+DAY_IN_SECONDS = 86_400
+
+
+class ShallowWaterModel:
+    """The solver. ``step``/``multistep`` are pure jittable functions
+    usable single-chip (no mesh) or inside ``parallel.spmd`` over a
+    mesh whose axis size equals ``config.n_ranks``."""
+
+    def __init__(self, config: ShallowWaterConfig, axis: str = WORLD_AXIS):
+        self.config = config
+        npy, npx = config.dims
+        self.cart = CartComm(dims=(npy, npx), periods=(False, config.periodic_x), axis=axis)
+        # The four halo transfers of the reference's clockwise
+        # exchange (shallow_water.py:180-232), as shift tables:
+        #   westward:  send col 1    -> west  neighbor's col -1
+        #   northward: send row -2   -> north neighbor's row 0
+        #   eastward:  send col -2   -> east  neighbor's col 0
+        #   southward: send row 1    -> south neighbor's row -1
+        self._west = self.cart.shift(1, -1)
+        self._east = self.cart.shift(1, +1)
+        self._north = self.cart.shift(0, +1)
+        self._south = self.cart.shift(0, -1)
+
+    # -- rank geometry (traced) -----------------------------------------
+
+    def _proc_coords(self):
+        npy, npx = self.config.dims
+        if self.config.n_ranks == 1:
+            z = jnp.zeros((), jnp.int32)
+            return z, z
+        rank = self.cart.Get_rank()
+        return rank // npx, rank % npx
+
+    def _local_y(self, proc_row):
+        """Local y coordinates (m), derived from the traced rank's row
+        offset in the global grid (reference computes these with host
+        numpy per process, shallow_water.py:96-107)."""
+        c = self.config
+        row0 = (c.ny_local - 2) * proc_row
+        iy = jnp.arange(c.ny_local, dtype=c.dtype) - 1.0
+        return (iy + row0) * c.dy
+
+    def coriolis(self, proc_row):
+        c = self.config
+        y = self._local_y(proc_row)
+        f = c.coriolis_f + y * c.coriolis_beta
+        return f[:, None] * jnp.ones((1, c.nx_local), c.dtype)
+
+    # -- halo exchange ---------------------------------------------------
+
+    def enforce_boundaries(self, arr, grid: str, proc_row=None):
+        """Exchange ghost cells with grid neighbors and apply physical
+        boundary conditions (reference ``enforce_boundaries``,
+        ``shallow_water.py:172-264``)."""
+        assert grid in ("h", "u", "v")
+        c = self.config
+        cart = self.cart
+        npy, npx = c.dims
+
+        if c.n_ranks == 1:
+            # Pure local: periodic wrap in x (reference with 1 process
+            # self-sends via MPI; here it is a local copy).
+            if c.periodic_x:
+                arr = arr.at[:, -1].set(arr[:, 1])
+                arr = arr.at[:, 0].set(arr[:, -2])
+        else:
+            src, dst = self._west
+            arr = arr.at[:, -1].set(
+                sendrecv(arr[:, 1], arr[:, -1], src, dst, sendtag=10, comm=cart)
+            )
+            src, dst = self._north
+            arr = arr.at[0, :].set(
+                sendrecv(arr[-2, :], arr[0, :], src, dst, sendtag=11, comm=cart)
+            )
+            src, dst = self._east
+            arr = arr.at[:, 0].set(
+                sendrecv(arr[:, -2], arr[:, 0], src, dst, sendtag=12, comm=cart)
+            )
+            src, dst = self._south
+            arr = arr.at[-1, :].set(
+                sendrecv(arr[1, :], arr[-1, :], src, dst, sendtag=13, comm=cart)
+            )
+
+        if not c.periodic_x and grid == "u":
+            # u = 0 on the eastern wall (reference shallow_water.py:258-259).
+            _, proc_col = self._proc_coords()
+            walled = arr.at[:, -2].set(0.0)
+            arr = jnp.where(proc_col == npx - 1, walled, arr)
+
+        if grid == "v":
+            # v = 0 on the northern wall (reference shallow_water.py:261-262).
+            if proc_row is None:
+                proc_row, _ = self._proc_coords()
+            walled = arr.at[-2, :].set(0.0)
+            arr = jnp.where(proc_row == npy - 1, walled, arr)
+
+        return arr
+
+    # -- dynamics --------------------------------------------------------
+
+    def step(self, state: ModelState, first_step: bool = False) -> ModelState:
+        """One model step (reference ``shallow_water_step``,
+        ``shallow_water.py:270-403``): continuity + nonlinear momentum
+        (potential-vorticity form) + AB2 + lateral friction."""
+        c = self.config
+        dt, dx, dy, g = c.dt, c.dx, c.dy, c.gravity
+        h, u, v, dh, du, dv = state
+        proc_row, _ = self._proc_coords()
+        coriolis = self.coriolis(proc_row)
+
+        def interior(a):
+            return a[1:-1, 1:-1]
+
+        def with_interior(base, inner):
+            return base.at[1:-1, 1:-1].set(inner)
+
+        hc = jnp.pad(interior(h), 1, "edge")
+        hc = self.enforce_boundaries(hc, "h", proc_row)
+
+        # volume fluxes at cell faces
+        fe = jnp.zeros_like(u)
+        fn = jnp.zeros_like(v)
+        fe = with_interior(fe, 0.5 * (hc[1:-1, 1:-1] + hc[1:-1, 2:]) * interior(u))
+        fn = with_interior(fn, 0.5 * (hc[1:-1, 1:-1] + hc[2:, 1:-1]) * interior(v))
+        fe = self.enforce_boundaries(fe, "u", proc_row)
+        fn = self.enforce_boundaries(fn, "v", proc_row)
+
+        dh_new = jnp.zeros_like(dh)
+        dh_new = with_interior(
+            dh_new,
+            -(fe[1:-1, 1:-1] - fe[1:-1, :-2]) / dx
+            - (fn[1:-1, 1:-1] - fn[:-2, 1:-1]) / dy,
+        )
+
+        # potential vorticity (planetary + relative, over face height)
+        q = jnp.zeros_like(u)
+        rel_vort = (v[1:-1, 2:] - v[1:-1, 1:-1]) / dx - (
+            u[2:, 1:-1] - u[1:-1, 1:-1]
+        ) / dy
+        face_h = 0.25 * (hc[1:-1, 1:-1] + hc[1:-1, 2:] + hc[2:, 1:-1] + hc[2:, 2:])
+        q = with_interior(q, (interior(coriolis) + rel_vort) / face_h)
+        q = self.enforce_boundaries(q, "h", proc_row)
+
+        du_new = jnp.zeros_like(du)
+        du_new = with_interior(
+            du_new,
+            -g * (h[1:-1, 2:] - h[1:-1, 1:-1]) / dx
+            + 0.5
+            * (
+                q[1:-1, 1:-1] * 0.5 * (fn[1:-1, 1:-1] + fn[1:-1, 2:])
+                + q[:-2, 1:-1] * 0.5 * (fn[:-2, 1:-1] + fn[:-2, 2:])
+            ),
+        )
+        dv_new = jnp.zeros_like(dv)
+        dv_new = with_interior(
+            dv_new,
+            -g * (h[2:, 1:-1] - h[1:-1, 1:-1]) / dy
+            - 0.5
+            * (
+                q[1:-1, 1:-1] * 0.5 * (fe[1:-1, 1:-1] + fe[2:, 1:-1])
+                + q[1:-1, :-2] * 0.5 * (fe[1:-1, :-2] + fe[2:, :-2])
+            ),
+        )
+
+        ke = jnp.zeros_like(u)
+        ke = with_interior(
+            ke,
+            0.5
+            * (
+                0.5 * (u[1:-1, 1:-1] ** 2 + u[1:-1, :-2] ** 2)
+                + 0.5 * (v[1:-1, 1:-1] ** 2 + v[:-2, 1:-1] ** 2)
+            ),
+        )
+        ke = self.enforce_boundaries(ke, "h", proc_row)
+
+        du_new = du_new.at[1:-1, 1:-1].add(-(ke[1:-1, 2:] - ke[1:-1, 1:-1]) / dx)
+        dv_new = dv_new.at[1:-1, 1:-1].add(-(ke[2:, 1:-1] - ke[1:-1, 1:-1]) / dy)
+
+        if first_step:
+            u = u.at[1:-1, 1:-1].add(dt * interior(du_new))
+            v = v.at[1:-1, 1:-1].add(dt * interior(dv_new))
+            h = h.at[1:-1, 1:-1].add(dt * interior(dh_new))
+        else:
+            a, b = c.adams_bashforth_a, c.adams_bashforth_b
+            u = u.at[1:-1, 1:-1].add(dt * (a * interior(du_new) + b * interior(du)))
+            v = v.at[1:-1, 1:-1].add(dt * (a * interior(dv_new) + b * interior(dv)))
+            h = h.at[1:-1, 1:-1].add(dt * (a * interior(dh_new) + b * interior(dh)))
+
+        h = self.enforce_boundaries(h, "h", proc_row)
+        u = self.enforce_boundaries(u, "u", proc_row)
+        v = self.enforce_boundaries(v, "v", proc_row)
+
+        if c.viscosity > 0:
+            nu = c.viscosity
+            for comp in ("u", "v"):
+                f = u if comp == "u" else v
+                ge = jnp.zeros_like(f)
+                gn = jnp.zeros_like(f)
+                ge = with_interior(ge, nu * (f[1:-1, 2:] - f[1:-1, 1:-1]) / dx)
+                gn = with_interior(gn, nu * (f[2:, 1:-1] - f[1:-1, 1:-1]) / dy)
+                ge = self.enforce_boundaries(ge, "u", proc_row)
+                gn = self.enforce_boundaries(gn, "v", proc_row)
+                upd = dt * (
+                    (ge[1:-1, 1:-1] - ge[1:-1, :-2]) / dx
+                    + (gn[1:-1, 1:-1] - gn[:-2, 1:-1]) / dy
+                )
+                if comp == "u":
+                    u = u.at[1:-1, 1:-1].add(upd)
+                else:
+                    v = v.at[1:-1, 1:-1].add(upd)
+
+        return ModelState(h, u, v, dh_new, du_new, dv_new)
+
+    def multistep(self, state: ModelState, num_steps: int) -> ModelState:
+        """``num_steps`` back-to-back steps under ``lax.fori_loop``
+        (reference ``do_multistep``, ``shallow_water.py:406-411``)."""
+        return lax.fori_loop(
+            0, num_steps, lambda _, s: self.step(s, first_step=False), state
+        )
+
+    # -- initial conditions (host-side, global) -------------------------
+
+    def initial_state_blocks(self) -> ModelState:
+        """Geostrophically balanced jet (reference
+        ``get_initial_conditions``, ``shallow_water.py:138-169``),
+        returned as stacked per-rank blocks ``(n_ranks, ny_l, nx_l)``
+        ready for ``parallel.spmd`` (squeeze axis 0 for single-rank)."""
+        c = self.config
+        npy, npx = c.dims
+        x_g = (np.arange(c.nx_global) - 1.0) * c.dx
+        y_g = (np.arange(c.ny_global) - 1.0) * c.dy
+        yy, xx = np.meshgrid(y_g, x_g, indexing="ij")
+        length_x = x_g[-2] - x_g[1]
+        length_y = y_g[-2] - y_g[1]
+
+        u0 = 10 * np.exp(-((yy - 0.5 * length_y) ** 2) / (0.02 * length_x) ** 2)
+        v0 = np.zeros_like(u0)
+        coriolis = c.coriolis_f + yy * c.coriolis_beta
+        h_geo = np.cumsum(-c.dy * u0 * coriolis / c.gravity, axis=0)
+        h0 = (
+            c.depth
+            + h_geo
+            - h_geo.mean()
+            + 0.2
+            * np.sin(xx / length_x * 10 * np.pi)
+            * np.cos(yy / length_y * 8 * np.pi)
+        )
+
+        def block(a, r):
+            pr, pc = divmod(r, npx)
+            ry, rx = c.ny_local - 2, c.nx_local - 2
+            return a[pr * ry : pr * ry + c.ny_local, pc * rx : pc * rx + c.nx_local]
+
+        def stack(a):
+            return np.stack(
+                [block(a, r) for r in range(c.n_ranks)]
+            ).astype(c.dtype)
+
+        zeros = np.zeros((c.n_ranks, c.ny_local, c.nx_local), c.dtype)
+        return ModelState(
+            h=stack(h0), u=stack(u0), v=stack(v0), dh=zeros, du=zeros.copy(),
+            dv=zeros.copy(),
+        )
+
+    @staticmethod
+    def reassemble(blocks: np.ndarray, dims: Tuple[int, int]) -> np.ndarray:
+        """Stitch per-rank blocks (with ghost rims) back into the
+        global field (reference ``reassemble_array``,
+        ``shallow_water.py:466-489``)."""
+        npy, npx = dims
+        n, ny_l, nx_l = blocks.shape
+        assert n == npy * npx
+        rows = []
+        for pr in range(npy):
+            row = [
+                blocks[pr * npx + pc][1:-1, 1:-1] for pc in range(npx)
+            ]
+            rows.append(np.concatenate(row, axis=1))
+        return np.concatenate(rows, axis=0)

@@ -54,10 +54,28 @@ def _generic_reduce(x, op: Op, axes):
     return op.reduce_along_axis(gathered, axis=0).astype(x.dtype)
 
 
+def _shm_reduction_dtype_check(x):
+    from ..runtime.shm import OP_CODES  # noqa: F401  (backend presence)
+
+    if x.dtype not in (
+        jnp.float32, jnp.float64, jnp.int8, jnp.int16, jnp.int32,
+        jnp.int64, jnp.uint8, jnp.uint16, jnp.uint32, jnp.uint64, jnp.bool_,
+    ):
+        raise NotImplementedError(
+            f"dtype {x.dtype} is not supported by the native shm backend "
+            "reductions (reference dtype table: _src/utils.py:101-128)"
+        )
+
+
 def _allreduce_spmd(x, *, op, comm: BoundComm, transpose):
     if transpose:
         # Identity, no communication (reference allreduce.py:78-80).
         return x
+    if comm.backend == "shm":
+        from ..runtime import shm as _shm
+
+        _shm_reduction_dtype_check(x)
+        return _shm.allreduce(x, op)
     if not comm.axes or comm.size == 1:
         # World size 1: reduction over a single rank is the identity.
         return x
@@ -102,6 +120,26 @@ def _transpose_rule(ct, x, *, op, comm, transpose):
 ad.primitive_jvps[mpi_allreduce_p] = _jvp_rule
 ad.primitive_transposes[mpi_allreduce_p] = _transpose_rule
 register_passthrough_batcher(mpi_allreduce_p)
+
+
+@enforce_types(comm=(type(None), Comm))
+def identity_with_allreduce_grad(x, *, comm=None):
+    """Forward identity whose *gradient* is a SUM-allreduce — the dual
+    of :func:`allreduce` under the reference's transpose convention,
+    i.e. a bind with ``transpose=True`` (reference lowers that to a
+    plain identity with no communication, ``allreduce.py:78-80``; its
+    transpose flips back to the real allreduce,
+    ``allreduce.py:152-159``).
+
+    This is Megatron's ``f`` operator for tensor parallelism: place it
+    where an activation is consumed by rank-local sharded computation
+    so that backward contributions from all ranks are summed. Not part
+    of the reference API (it has no TP models), but it is the natural
+    completion of its AD algebra.
+    """
+    bound = resolve_comm(comm)
+    x = jnp.asarray(x)
+    return mpi_allreduce_p.bind(x, op=SUM, comm=bound, transpose=True)
 
 
 @enforce_types(op=Op, comm=(type(None), Comm))

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 from jax import lax
+from jax.interpreters import ad
 
 from ..comm import BoundComm, Comm, resolve_comm
 from ..token import NOTSET, raise_if_token_is_set
@@ -25,6 +26,10 @@ def _alltoall_abstract_eval(x, *, comm: BoundComm):
 
 
 def _alltoall_spmd(x, *, comm: BoundComm):
+    if comm.backend == "shm":
+        from ..runtime import shm as _shm
+
+        return _shm.alltoall(x)
     if not comm.axes or comm.size == 1:
         return x
     axis = comm.require_single_axis("alltoall")
@@ -36,6 +41,30 @@ mpi_alltoall_p = define_primitive(
     abstract_eval=_alltoall_abstract_eval,
     spmd_impl=_alltoall_spmd,
 )
+
+
+# AD (improvement over the reference, which has no alltoall AD rules):
+# the exchange y_r[j] = x_j[r] is a linear involution-like permutation
+# of the global block matrix whose transpose is again an alltoall —
+# cotangent block ct_r[j] flows back to rank j, slot r. Needed to
+# train through Ulysses sequence-parallel attention
+# (mpi4jax_tpu/parallel/ulysses.py).
+def _alltoall_jvp(primals, tangents, *, comm):
+    (x,), (t,) = primals, tangents
+    out = mpi_alltoall_p.bind(x, comm=comm)
+    if isinstance(t, ad.Zero):
+        return out, ad.Zero.from_primal_value(out)
+    return out, mpi_alltoall_p.bind(t, comm=comm)
+
+
+def _alltoall_transpose(ct, x, *, comm):
+    if isinstance(ct, ad.Zero):
+        return (ct,)
+    return (mpi_alltoall_p.bind(ct, comm=comm),)
+
+
+ad.primitive_jvps[mpi_alltoall_p] = _alltoall_jvp
+ad.primitive_transposes[mpi_alltoall_p] = _alltoall_transpose
 
 
 @enforce_types(comm=(type(None), Comm))

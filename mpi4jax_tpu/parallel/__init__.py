@@ -14,3 +14,5 @@ from .mesh import (  # noqa: F401
     world_mesh,
 )
 from .halo import HaloExchange2D  # noqa: F401
+from .ring import ring_attention  # noqa: F401
+from .ulysses import heads_to_seq, seq_to_heads, ulysses_attention  # noqa: F401

@@ -1,0 +1,125 @@
+"""Ring attention: sequence/context parallelism over CollectivePermute.
+
+The reference has no attention code, but SURVEY.md §5 ("long-context /
+sequence parallelism") identifies its primitives as exactly the
+building blocks: ``sendrecv`` ring pipelines
+(``examples/shallow_water.py:249-256``) and token-ordered exchanges.
+This module is that construction: blockwise (flash-style) attention
+where each rank holds a sequence block and key/value blocks rotate
+around the ring — one ICI-neighbor CollectivePermute per step, compute
+overlapping with the rotation, O(seq/n) memory per chip. The online
+softmax accumulation follows the public blockwise/ring-attention
+formulation (Liu et al., RingAttention; see PAPERS.md retrieval
+context).
+
+Works inside any ``shard_map`` whose axis carries the sequence shards;
+at world size 1 it degrades to ordinary (blockwise) attention.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..comm import Comm, resolve_comm
+from ..ops import sendrecv
+
+
+def _ring_tables(n: int):
+    dest = tuple((r + 1) % n for r in range(n))
+    source = tuple((r - 1) % n for r in range(n))
+    return source, dest
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    *,
+    comm: Optional[Comm] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+):
+    """Blockwise attention over sequence shards.
+
+    Args:
+        q, k, v: per-rank blocks of shape ``(..., T_local, D)`` (any
+            leading batch/head dims).
+        comm: communicator whose axis shards the sequence (default:
+            world axis).
+        causal: apply a causal mask consistent with the *global*
+            sequence order (rank r holds tokens
+            ``[r*T_local, (r+1)*T_local)``).
+        scale: attention scale (default ``D ** -0.5``).
+
+    Returns:
+        Attention output of q's shape.
+    """
+    bound = resolve_comm(comm)
+    n = bound.size
+    d = q.shape[-1]
+    t_local = q.shape[-2]
+    if scale is None:
+        scale = d ** -0.5
+    q = q * scale
+
+    neg_inf = jnp.array(-jnp.inf, jnp.float32)
+
+    def block_scores(kblk, kv_rank):
+        # (..., Tq, Tk) in f32 for a stable softmax accumulator.
+        s = jnp.einsum("...qd,...kd->...qk", q, kblk).astype(jnp.float32)
+        if causal:
+            my_rank = bound.rank()
+            q_pos = my_rank * t_local + jnp.arange(t_local)
+            k_pos = kv_rank * t_local + jnp.arange(t_local)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask, s, neg_inf)
+        return s
+
+    def accumulate(carry, kblk, vblk, kv_rank):
+        m, l, o = carry
+        s = block_scores(kblk, kv_rank)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # guard fully-masked rows (max = -inf)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        correction = jnp.where(
+            jnp.isfinite(m), jnp.exp(m - m_safe), jnp.zeros_like(m)
+        )
+        l_new = l * correction + p.sum(axis=-1)
+        o_new = o * correction[..., None] + jnp.einsum(
+            "...qk,...kd->...qd", p, vblk.astype(jnp.float32)
+        )
+        return m_new, l_new, o_new
+
+    m0 = jnp.full(q.shape[:-1], -jnp.inf, jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1], jnp.float32)
+    o0 = jnp.zeros(q.shape[:-1] + (d,), jnp.float32)
+
+    if n == 1:
+        m, l, o = accumulate((m0, l0, o0), k, v, jnp.zeros((), jnp.int32))
+    else:
+        source, dest = _ring_tables(n)
+        my_rank = bound.rank()
+
+        def body(step, carry):
+            kblk, vblk, acc = carry
+            # kv block currently held came from rank (my_rank - step).
+            kv_rank = (my_rank - step) % n
+            acc = accumulate(acc, kblk, vblk, kv_rank)
+            # rotate kv one step around the ring (ICI neighbor hop);
+            # the transfer is skipped content-wise on the last
+            # iteration's result but keeping it unconditional keeps the
+            # loop body uniform for XLA.
+            kblk = sendrecv(kblk, kblk, source, dest, sendtag=20, comm=comm)
+            vblk = sendrecv(vblk, vblk, source, dest, sendtag=21, comm=comm)
+            return kblk, vblk, acc
+
+        _, _, (m, l, o) = lax.fori_loop(0, n, body, (k, v, (m0, l0, o0)))
+
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zero output
+    return (o / l[..., None]).astype(q.dtype)

@@ -1,0 +1,77 @@
+"""Ulysses-style sequence parallelism: alltoall head/sequence resharding.
+
+SURVEY.md §2.5 identifies the reference's ``alltoall`` distributed
+transpose (``alltoall.py:43-74``, regression
+``test_alltoall.py:44-65``) as the core of "array redistribution /
+Ulysses-style resharding". This module is that pattern for attention:
+
+    sequence-sharded (T/n, H, D)  --alltoall-->  head-sharded (T, H/n, D)
+
+Each rank then runs *full-sequence* attention on its head subset —
+exact attention, one AllToAll each way, the standard alternative to
+ring attention when heads >= ranks (DeepSpeed-Ulysses; PAPERS.md
+"Memory-efficient array redistribution" covers the collective
+formulation).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..comm import Comm, resolve_comm
+from ..ops import alltoall
+
+
+def seq_to_heads(x, *, comm: Optional[Comm] = None):
+    """(T_local, H, D) -> (T_global, H_local, D) via one AllToAll.
+
+    ``H`` must be divisible by the communicator size.
+    """
+    bound = resolve_comm(comm)
+    n = bound.size
+    if n == 1:
+        return x
+    t_loc, h, d = x.shape
+    if h % n:
+        raise ValueError(f"head count {h} not divisible by comm size {n}")
+    h_loc = h // n
+    # block j of the alltoall input = our T_local rows of head-group j
+    blocks = x.reshape(t_loc, n, h_loc, d).transpose(1, 0, 2, 3)
+    exchanged = alltoall(blocks, comm=comm)  # (n, T_local, H_local, D)
+    return exchanged.reshape(n * t_loc, h_loc, d)
+
+
+def heads_to_seq(x, *, comm: Optional[Comm] = None):
+    """(T_global, H_local, D) -> (T_local, H, D): inverse AllToAll."""
+    bound = resolve_comm(comm)
+    n = bound.size
+    if n == 1:
+        return x
+    t, h_loc, d = x.shape
+    if t % n:
+        raise ValueError(f"sequence length {t} not divisible by comm size {n}")
+    t_loc = t // n
+    blocks = x.reshape(n, t_loc, h_loc, d)
+    exchanged = alltoall(blocks, comm=comm)  # (n, T_local, H_local, D)
+    return exchanged.transpose(1, 0, 2, 3).reshape(t_loc, n * h_loc, d)
+
+
+def ulysses_attention(q, k, v, *, comm: Optional[Comm] = None, causal=False):
+    """Exact multi-head attention with sequence-sharded inputs/outputs
+    of shape (T_local, H, D)."""
+    qh = seq_to_heads(q, comm=comm)
+    kh = seq_to_heads(k, comm=comm)
+    vh = seq_to_heads(v, comm=comm)
+    # full attention per local head group: (T, h_loc, D)
+    d = qh.shape[-1]
+    s = jnp.einsum("qhd,khd->hqk", qh, kh).astype(jnp.float32) * d**-0.5
+    if causal:
+        t = s.shape[-1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None], s, -jnp.inf)
+    p = jnp.exp(s - s.max(axis=-1, keepdims=True))
+    p = p / p.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("hqk,khd->qhd", p.astype(qh.dtype), vh)
+    return heads_to_seq(out, comm=comm)

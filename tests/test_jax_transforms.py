@@ -1,0 +1,93 @@
+"""Communication primitives inside jax control flow and library
+solvers — analog of the reference's ``tests/test_jax_transforms.py:6-22``
+(CG solve through an allreduce operator, exercising effects inside
+``jax.scipy`` / ``lax`` control flow) plus scan/while_loop coverage."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import mpi4jax_tpu as m4t
+
+N = 8
+DIM = N * 2
+
+
+def test_cg_solve_through_allreduce(run_spmd):
+    # SPD system solved by jax.scipy CG where the operator contains a
+    # collective.
+    rng = np.random.RandomState(0)
+    M = rng.rand(DIM, DIM).astype(np.float32)
+    A = M @ M.T + DIM * np.eye(DIM, dtype=np.float32)
+    b = rng.rand(DIM).astype(np.float32)
+    k = DIM // N
+    A_cols = np.stack([A[:, r * k : (r + 1) * k] for r in range(N)])
+    b_rows = np.stack([b[r * k : (r + 1) * k] for r in range(N)])
+
+    def solve(A_loc, b_loc):
+        rank = m4t.get_default_comm().Get_rank()
+
+        def matvec(x_full):
+            x_loc = jax.lax.dynamic_slice(x_full, (rank * k,), (k,))
+            return m4t.allreduce(A_loc @ x_loc, op=m4t.SUM)
+
+        b_full = m4t.allgather(b_loc).reshape(-1)
+        x, _ = jax.scipy.sparse.linalg.cg(matvec, b_full, tol=1e-6, maxiter=200)
+        return x
+
+    out = run_spmd(solve, A_cols, b_rows)
+    expected = np.linalg.solve(A, b)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], expected, rtol=1e-2, atol=1e-3)
+
+
+def test_collectives_inside_lax_scan(run_spmd, per_rank):
+    arr = per_rank(lambda r: np.float32(r + 1))
+
+    def f(x):
+        def body(carry, _):
+            carry = m4t.allreduce(carry, op=m4t.SUM) / N
+            return carry, carry
+
+        final, hist = jax.lax.scan(body, x, None, length=4)
+        return final, hist
+
+    final, hist = run_spmd(f, arr)
+    # average is a fixed point after the first application
+    mean = arr.mean()
+    np.testing.assert_allclose(final, np.full(N, mean), rtol=1e-5)
+    assert hist.shape == (N, 4)
+
+
+def test_collectives_inside_while_loop(run_spmd, per_rank):
+    arr = per_rank(lambda r: np.float32(r))
+
+    def f(x):
+        def cond(carry):
+            i, _ = carry
+            return i < 3
+
+        def body(carry):
+            i, v = carry
+            return i + 1, m4t.allreduce(v, op=m4t.MAX)
+
+        _, v = jax.lax.while_loop(cond, body, (0, x))
+        return v
+
+    out = run_spmd(f, arr)
+    np.testing.assert_allclose(out, np.full(N, arr.max()))
+
+
+def test_sendrecv_inside_fori_loop(run_spmd, per_rank):
+    # ring rotation N times returns each value home
+    arr = per_rank(lambda r: np.float32(r * 10))
+    dst = tuple((r + 1) % N for r in range(N))
+    src = tuple((r - 1) % N for r in range(N))
+
+    def f(x):
+        return jax.lax.fori_loop(
+            0, N, lambda _, v: m4t.sendrecv(v, v, src, dst), x
+        )
+
+    out = run_spmd(f, arr)
+    np.testing.assert_allclose(out, arr)

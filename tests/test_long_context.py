@@ -1,0 +1,122 @@
+"""Ring attention and Ulysses sequence parallelism: distributed exact
+attention must match single-device full attention (the correctness
+oracle for the long-context subsystem; SURVEY.md §5)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mpi4jax_tpu.parallel import ring_attention, ulysses_attention
+
+N = 8
+T_LOCAL = 4
+T = N * T_LOCAL
+D = 16
+H = 8
+
+
+def reference_attention(q, k, v, causal=False):
+    s = (q @ k.T).astype(np.float32) * D**-0.5
+    if causal:
+        mask = np.tril(np.ones(s.shape, bool))
+        s = np.where(mask, s, -np.inf)
+    p = np.exp(s - s.max(axis=-1, keepdims=True))
+    p /= p.sum(axis=-1, keepdims=True)
+    return p @ v
+
+
+def reference_mha(q, k, v, causal=False):
+    # q,k,v: (T, H, D)
+    outs = [
+        reference_attention(q[:, h], k[:, h], v[:, h], causal) for h in range(H)
+    ]
+    return np.stack(outs, axis=1)
+
+
+@pytest.fixture()
+def qkv():
+    rng = np.random.RandomState(3)
+    q = rng.randn(T, D).astype(np.float32)
+    k = rng.randn(T, D).astype(np.float32)
+    v = rng.randn(T, D).astype(np.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(run_spmd, qkv, causal):
+    q, k, v = qkv
+    expected = reference_attention(q, k, v, causal)
+
+    def shard(a):
+        return a.reshape(N, T_LOCAL, D)
+
+    out = run_spmd(
+        lambda ql, kl, vl: ring_attention(ql, kl, vl, causal=causal),
+        shard(q), shard(k), shard(v),
+    )
+    np.testing.assert_allclose(out.reshape(T, D), expected, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_single_device(qkv):
+    q, k, v = qkv
+    out = jax.jit(lambda a, b, c: ring_attention(a, b, c))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), reference_attention(q, k, v), rtol=2e-4, atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_full(run_spmd, causal):
+    rng = np.random.RandomState(7)
+    q = rng.randn(T, H, D).astype(np.float32)
+    k = rng.randn(T, H, D).astype(np.float32)
+    v = rng.randn(T, H, D).astype(np.float32)
+    expected = reference_mha(q, k, v, causal)
+
+    def shard(a):
+        return a.reshape(N, T_LOCAL, H, D)
+
+    out = run_spmd(
+        lambda ql, kl, vl: ulysses_attention(ql, kl, vl, causal=causal),
+        shard(q), shard(k), shard(v),
+    )
+    np.testing.assert_allclose(
+        out.reshape(T, H, D), expected, rtol=2e-4, atol=2e-5
+    )
+
+
+def test_ring_attention_grad(run_spmd, qkv):
+    """Differentiability through the ring (the sendrecv JVP/transpose
+    rules composed under fori_loop)."""
+    q, k, v = qkv
+
+    def shard(a):
+        return a.reshape(N, T_LOCAL, D)
+
+    def f(ql, kl, vl):
+        return jax.grad(
+            lambda qq: (ring_attention(qq, kl, vl) ** 2).sum()
+        )(ql)
+
+    out = run_spmd(f, shard(q), shard(k), shard(v))
+
+    jq = jnp.asarray(q)
+    expected = jax.grad(
+        lambda qq: (
+            jnp.asarray(reference_attention_jnp(qq, jnp.asarray(k), jnp.asarray(v)))
+            ** 2
+        ).sum()
+    )(jq)
+    np.testing.assert_allclose(
+        out.reshape(T, D), np.asarray(expected), rtol=5e-3, atol=5e-4
+    )
+
+
+def reference_attention_jnp(q, k, v):
+    s = (q @ k.T).astype(jnp.float32) * D**-0.5
+    p = jax.nn.softmax(s, axis=-1)
+    return p @ v

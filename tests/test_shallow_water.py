@@ -1,0 +1,72 @@
+"""Shallow-water integration tests (analog of the reference's
+``tests/test_examples.py`` which runs the demo for a model day and of
+the implicit guarantee that domain decomposition does not change the
+solution)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mpi4jax_tpu.models.shallow_water import (
+    ModelState,
+    ShallowWaterConfig,
+    ShallowWaterModel,
+)
+from mpi4jax_tpu.parallel import spmd
+
+
+def run_model(dims, n_steps, nx=48, ny=24, mesh=None):
+    config = ShallowWaterConfig(nx=nx, ny=ny, dims=dims)
+    model = ShallowWaterModel(config)
+    blocks = model.initial_state_blocks()
+    n = config.n_ranks
+    if n == 1:
+        state = ModelState(*(jnp.asarray(b[0]) for b in blocks))
+        state = jax.jit(lambda s: model.step(s, first_step=True))(state)
+        state = jax.jit(lambda s: model.multistep(s, n_steps))(state)
+        h = np.asarray(state.h)[None]
+    else:
+        state = ModelState(*(jnp.asarray(b) for b in blocks))
+        state = spmd(lambda s: model.step(s, first_step=True), mesh=mesh)(state)
+        state = spmd(lambda s: model.multistep(s, n_steps), mesh=mesh)(state)
+        h = np.asarray(state.h)
+    return model.reassemble(h, dims) if True else h
+
+
+def test_single_rank_runs_and_stays_finite():
+    h = run_model((1, 1), 20)
+    assert np.all(np.isfinite(h))
+    # the jet should still be near the resting depth
+    assert 90 < h.mean() < 110
+
+
+@pytest.mark.parametrize("dims", [(2, 4), (1, 8), (2, 1)])
+def test_decomposition_invariance(mesh, dims):
+    """The headline correctness property: the decomposed solve equals
+    the single-rank solve (validates every halo-exchange path:
+    periodic x wrap, closed y walls, interior exchanges)."""
+    if dims[0] * dims[1] != 8 and dims != (2, 1):
+        pytest.skip("mesh is 8-wide")
+    n_steps = 12
+    h_ref = run_model((1, 1), n_steps)
+    if dims == (2, 1):
+        from mpi4jax_tpu.parallel import world_mesh
+
+        sub = world_mesh(2)
+        h_dist = run_model(dims, n_steps, mesh=sub)
+    else:
+        h_dist = run_model(dims, n_steps, mesh=mesh)
+    np.testing.assert_allclose(h_dist, h_ref, rtol=2e-4, atol=2e-4)
+
+
+def test_energy_sanity():
+    """Momentum/height fields evolve (the model is not frozen)."""
+    config = ShallowWaterConfig(nx=48, ny=24, dims=(1, 1))
+    model = ShallowWaterModel(config)
+    blocks = model.initial_state_blocks()
+    state = ModelState(*(jnp.asarray(b[0]) for b in blocks))
+    s1 = jax.jit(lambda s: model.step(s, first_step=True))(state)
+    s2 = jax.jit(lambda s: model.multistep(s, 10))(s1)
+    assert not np.allclose(np.asarray(s1.h), np.asarray(s2.h))
