@@ -1,0 +1,91 @@
+"""Headline benchmark: shallow-water solve on the published config.
+
+Replicates the reference's benchmark setup (``docs/shallow-water.rst:47-94``,
+mirrored in ``BASELINE.md``): 100x domain (interior grid 1800 x 3600),
+0.1 simulated model days (~434 steps, dt ~19.95 s from the CFL
+condition), multistep chunks of 100, compile excluded. Baseline for
+``vs_baseline`` is the reference's best single-device number: 6.28 s on
+an NVIDIA Tesla P100 (``docs/shallow-water.rst:81-83``); values > 1
+mean this framework on one TPU chip beats the reference on the P100.
+
+Prints exactly one JSON line:
+    {"metric": "...", "value": N, "unit": "s", "vs_baseline": N}
+"""
+
+import json
+import math
+import sys
+import time
+
+BASELINE_1GPU_S = 6.28  # reference P100, docs/shallow-water.rst:81-83
+
+
+def main():
+    import os
+
+    import jax
+
+    # Debug/smoke escapes: M4T_BENCH_PLATFORM=cpu forces the platform
+    # (the axon sitecustomize overrides JAX_PLATFORMS env);
+    # M4T_BENCH_SCALE shrinks the domain for smoke runs.
+    if os.environ.get("M4T_BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["M4T_BENCH_PLATFORM"])
+    import jax.numpy as jnp
+
+    from mpi4jax_tpu.models.shallow_water import (
+        DAY_IN_SECONDS,
+        ModelState,
+        ShallowWaterConfig,
+        ShallowWaterModel,
+    )
+
+    n_dev = len(jax.devices())
+    scale = int(os.environ.get("M4T_BENCH_SCALE", "10"))  # 10 = 100x domain (1800, 3600)
+    config = ShallowWaterConfig(nx=360 * scale, ny=180 * scale, dims=(1, 1))
+    model = ShallowWaterModel(config)
+
+    dt = config.dt
+    t1 = 0.1 * DAY_IN_SECONDS
+    multistep = 100
+    num_steps = math.ceil(t1 / dt)
+    n_calls = math.ceil(num_steps / multistep)
+
+    blocks = model.initial_state_blocks()
+    state = ModelState(*(jnp.asarray(b[0]) for b in blocks))
+
+    first = jax.jit(lambda s: model.step(s, first_step=True))
+    multi = jax.jit(lambda s: model.multistep(s, multistep))
+
+    state = first(state)
+    multi(state)[0].block_until_ready()  # compile warm-up (excluded)
+
+    start = time.perf_counter()
+    for _ in range(n_calls):
+        state = multi(state)
+    state[0].block_until_ready()
+    elapsed = time.perf_counter() - start
+
+    assert bool(jnp.isfinite(state.h).all()), "solver diverged"
+
+    print(
+        f"# shallow-water scale-{scale} domain ({config.ny}x{config.nx}), "
+        f"{num_steps} steps on {jax.devices()[0].platform}, {n_dev} device(s): "
+        f"{elapsed:.2f}s ({num_steps/elapsed:.1f} steps/s)",
+        file=sys.stderr,
+    )
+    # vs_baseline only makes sense on the published config (scale 10)
+    vs = round(BASELINE_1GPU_S / elapsed, 3) if scale == 10 else None
+    print(
+        json.dumps(
+            {
+                "metric": "shallow_water_100x_solve",
+                "value": round(elapsed, 3),
+                "unit": "s",
+                "vs_baseline": vs,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

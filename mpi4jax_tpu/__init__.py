@@ -23,6 +23,8 @@ swapping source and destination (``collective_ops/sendrecv.py:278-293``).
 
 __version__ = "0.1.0"
 
+import os as _os
+
 from .comm import (  # noqa: F401
     ANY_TAG,
     BAND,
@@ -57,6 +59,20 @@ from .ops import (  # noqa: F401
     sendrecv,
 )
 from .debug import get_logging, set_logging  # noqa: F401
+
+# Join the native shm world when launched by `python -m
+# mpi4jax_tpu.launch` — import-time analog of the reference's
+# mpi4py-first import triggering MPI_Init (_src/__init__.py:1-3).
+if _os.environ.get("M4T_SHM_NAME"):
+    from .runtime import shm as _shm_runtime
+
+    _shm_runtime.init_from_env()
+    ShmComm = _shm_runtime.ShmComm
+else:
+    def ShmComm():  # type: ignore
+        raise RuntimeError(
+            "no shm world active; run under `python -m mpi4jax_tpu.launch`"
+        )
 
 
 def has_tpu_support() -> bool:

@@ -254,16 +254,24 @@ class CartComm(Comm):
 class BoundComm:
     """A communicator resolved against the current trace's axis env.
 
-    ``axes == ()`` encodes the world-size-1 case: op implementations
-    then use local (single-rank) semantics, which makes the whole
-    single-rank reference test matrix (§4 of SURVEY.md: the pytest run
-    without mpirun) work eagerly with no mesh at all.
+    ``axes == ()`` with ``backend == "xla"`` encodes the world-size-1
+    case: op implementations then use local (single-rank) semantics,
+    which makes the whole single-rank reference test matrix (§4 of
+    SURVEY.md: the pytest run without mpirun) work eagerly with no mesh
+    at all. ``backend == "shm"`` routes the op to the native
+    shared-memory multi-process backend (``runtime/shmcc.cpp``), the
+    rebuild of the reference's CPU/MPI bridge; ``shm_rank`` is then the
+    process's static rank (the reference's multi-controller model).
     """
 
     axes: AxisNames
     size: int
+    backend: str = "xla"
+    shm_rank: int = 0
 
     def rank(self):
+        if self.backend == "shm":
+            return jnp.asarray(self.shm_rank, jnp.int32)
         if not self.axes:
             return jnp.zeros((), jnp.int32)
         # Row-major linear rank over the axes (matches the reference
@@ -311,6 +319,18 @@ def resolve_comm(comm: Optional[Comm]) -> BoundComm:
         raise TypeError(f"expected a Comm, got {type(comm)}")
     bound = [a for a in comm.axes if _axis_is_bound(a)]
     if not bound:
+        # Outside any mesh: route to the native shm world when one is
+        # active (i.e. under `python -m mpi4jax_tpu.launch`) — the
+        # analog of the reference's default COMM_WORLD clone resolving
+        # to the mpirun world (_src/utils.py:16-27).
+        try:
+            from .runtime import shm as _shm
+        except Exception:
+            _shm = None
+        if _shm is not None and _shm.active():
+            return BoundComm(
+                axes=(), size=_shm.size(), backend="shm", shm_rank=_shm.rank()
+            )
         return BoundComm(axes=(), size=1)
     if len(bound) != len(comm.axes):
         missing = [a for a in comm.axes if a not in bound]

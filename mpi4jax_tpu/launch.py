@@ -1,0 +1,107 @@
+"""Multi-process launcher: the framework's ``mpirun``.
+
+The reference's CPU workflow is ``mpirun -n N python script.py``
+(``README.rst:83-88``) with libmpi doing rendezvous and transport.
+This launcher reproduces that workflow on the native shared-memory
+backend:
+
+    python -m mpi4jax_tpu.launch -n 4 script.py [args...]
+    python -m mpi4jax_tpu.launch -n 2 -m pytest tests/
+
+Each child process imports ``mpi4jax_tpu``, joins the shm world named
+in its environment (``runtime/shm.py:init_from_env``, the analog of
+mpi4py's import-time ``MPI_Init``), and runs the script unchanged.
+Fail-fast parity with the reference's ``MPI_Abort``
+(``mpi_ops_common.h:60-78``): if any rank exits nonzero, the launcher
+terminates the whole world and propagates the exit code.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+import uuid
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi4jax_tpu.launch", description=__doc__
+    )
+    parser.add_argument("-n", "--nproc", type=int, required=True)
+    parser.add_argument(
+        "-m", dest="module", default=None,
+        help="run a module (like python -m) instead of a script",
+    )
+    parser.add_argument("cmd", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    if args.nproc < 1:
+        parser.error("-n must be >= 1")
+    if args.nproc > 16:
+        # kMaxRanks in runtime/shmcc.cpp; checked here so a too-large
+        # world fails immediately instead of after the join timeout.
+        parser.error("-n must be <= 16 (shm backend kMaxRanks)")
+    if not args.cmd and not args.module:
+        parser.error("missing script")
+
+    shm_name = f"/m4t_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+    procs = []
+    try:
+        for rank in range(args.nproc):
+            env = dict(os.environ)
+            env.update(
+                M4T_SHM_NAME=shm_name,
+                M4T_RANK=str(rank),
+                M4T_SIZE=str(args.nproc),
+                JAX_PLATFORMS="cpu",
+            )
+            cmd = [sys.executable]
+            if args.module:
+                cmd += ["-m", args.module]
+            cmd += args.cmd
+            procs.append(subprocess.Popen(cmd, env=env))
+
+        exit_code = 0
+        done = [False] * len(procs)
+        while not all(done):
+            for i, p in enumerate(procs):
+                if done[i]:
+                    continue
+                rc = p.poll()
+                if rc is None:
+                    continue
+                done[i] = True
+                if rc != 0 and exit_code == 0:
+                    exit_code = rc
+                    sys.stderr.write(
+                        f"mpi4jax_tpu.launch: rank {i} exited with code "
+                        f"{rc}; terminating world\n"
+                    )
+                    for q in procs:
+                        if q.poll() is None:
+                            q.terminate()
+            time.sleep(0.02)
+        return exit_code
+    except KeyboardInterrupt:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        for p in procs:
+            p.wait()
+        return 130
+    finally:
+        # shm_unlink parity: rank 0's atexit unlinks; sweep in case it
+        # died before doing so.
+        path = "/dev/shm" + shm_name
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,10 @@ def _allgather_abstract_eval(x, *, comm: BoundComm):
 
 
 def _allgather_spmd(x, *, comm: BoundComm):
+    if comm.backend == "shm":
+        from ..runtime import shm as _shm
+
+        return _shm.allgather(x)
     if not comm.axes or comm.size == 1:
         return x[None]
     return lax.all_gather(x, comm.axes, tiled=False)

@@ -25,6 +25,10 @@ def _barrier_abstract_eval(tok, *, comm: BoundComm):
 
 
 def _barrier_spmd(tok, *, comm: BoundComm):
+    if comm.backend == "shm":
+        from ..runtime import shm as _shm
+
+        return _shm.barrier(tok)
     if not comm.axes or comm.size == 1:
         return tok
     return lax.psum(tok, comm.axes)

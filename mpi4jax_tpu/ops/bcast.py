@@ -32,6 +32,10 @@ def _bcast_abstract_eval(x, *, root, comm: BoundComm):
 
 
 def _bcast_spmd(x, *, root, comm: BoundComm):
+    if comm.backend == "shm":
+        from ..runtime import shm as _shm
+
+        return _shm.bcast(x, root)
     if not comm.axes or comm.size == 1:
         return x
     rank = comm.rank()

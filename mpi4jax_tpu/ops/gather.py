@@ -33,6 +33,10 @@ def _gather_abstract_eval(x, *, root, comm: BoundComm):
 
 
 def _gather_spmd(x, *, root, comm: BoundComm):
+    if comm.backend == "shm":
+        from ..runtime import shm as _shm
+
+        return _shm.allgather(x)
     if not comm.axes or comm.size == 1:
         return x[None]
     return lax.all_gather(x, comm.axes, tiled=False)

@@ -211,6 +211,37 @@ def _check_tables_mirror(
 
 
 # ---------------------------------------------------------------------------
+# native shm backend path (multi-process, reference execution model):
+# per-process scalar source/dest like the reference's own API
+# (send.py:44-80, recv.py:47-84) — rank-divergent programs are legal
+# here, so no trace-time matching is needed.
+# ---------------------------------------------------------------------------
+
+
+def _shm_partner(value: TableLike, bound: BoundComm, what: str) -> int:
+    if isinstance(value, (int, np.integer)):
+        partner = int(value)
+    else:
+        table = tuple(int(v) for v in value)
+        if len(table) != bound.size:
+            raise ValueError(
+                f"{what} table has length {len(table)}, expected {bound.size}"
+            )
+        partner = table[bound.shm_rank]
+    if partner >= bound.size:
+        raise ValueError(f"{what} {partner} out of range for size {bound.size}")
+    return partner
+
+
+def _shm_ordered(fn, inputs, opname, details, bound):
+    from ..token import ordered_call
+
+    ident = debug.log_emission(opname, details)
+    debug.log_runtime(bound, ident, opname, details)
+    return ordered_call(fn, inputs)
+
+
+# ---------------------------------------------------------------------------
 # sendrecv
 # ---------------------------------------------------------------------------
 
@@ -244,6 +275,33 @@ def sendrecv(
             "(SURVEY.md §7 hard-parts); the TPU path does not support it"
         )
     bound = resolve_comm(comm)
+    if bound.backend == "shm":
+        sendbuf = jnp.asarray(sendbuf)
+        recvbuf = jnp.asarray(recvbuf)
+        src = _shm_partner(source, bound, "source")
+        dst = _shm_partner(dest, bound, "dest")
+        if src == PROC_NULL and dst == PROC_NULL:
+            return recvbuf
+        from ..runtime import shm as _shm
+
+        if dst == PROC_NULL:
+            (out,) = _shm_ordered(
+                lambda t: (_shm.recv(t, src, recvtag),), (recvbuf,),
+                "Sendrecv", f"[recv-only from {src}]", bound,
+            )
+            return out
+        if src == PROC_NULL:
+            _shm_ordered(
+                lambda x_: (_shm.send(x_, dst, sendtag),), (sendbuf,),
+                "Sendrecv", f"[send-only to {dst}]", bound,
+            )
+            return recvbuf
+        (out,) = _shm_ordered(
+            lambda s, r: (_shm.sendrecv(s, r, src, dst, sendtag, recvtag),),
+            (sendbuf, recvbuf),
+            "Sendrecv", f"[{sendbuf.size} items, src={src}, dst={dst}]", bound,
+        )
+        return out
     if recvtag != ANY_TAG and recvtag != sendtag:
         # In the fused SPMD transfer the sender and receiver are the
         # same call, so the tags must agree (the reference's separate
@@ -289,9 +347,20 @@ def send(x, dest: TableLike, *, tag: int = 0, comm=None, token=NOTSET):
     the matching :func:`recv` appears later in the same trace."""
     raise_if_token_is_set(token)
     bound = resolve_comm(comm)
+    x = jnp.asarray(x)
+    if bound.backend == "shm":
+        dst = _shm_partner(dest, bound, "dest")
+        if dst == PROC_NULL:
+            return None
+        from ..runtime import shm as _shm
+
+        _shm_ordered(
+            lambda x_: (_shm.send(x_, dst, tag),), (x,),
+            "Send", f"[{x.size} items, dst={dst}, tag={tag}]", bound,
+        )
+        return None
     dest_t = _normalize_table(dest, bound.size, "dest")
     edges = _edges_from_dest(dest_t)
-    x = jnp.asarray(x)
     debug.log_emission(
         "Send", f"[{x.size} items, {len(edges)} edges, tag={tag}, n={bound.size}]"
     )
@@ -332,9 +401,20 @@ def recv(
             "(SURVEY.md §7 hard-parts); the TPU path does not support it"
         )
     bound = resolve_comm(comm)
+    x = jnp.asarray(x)
+    if bound.backend == "shm":
+        src = _shm_partner(source, bound, "source")
+        if src == PROC_NULL:
+            return x
+        from ..runtime import shm as _shm
+
+        (out,) = _shm_ordered(
+            lambda t: (_shm.recv(t, src, tag),), (x,),
+            "Recv", f"[{x.size} items, src={src}, tag={tag}]", bound,
+        )
+        return out
     source_t = _normalize_table(source, bound.size, "source")
     recv_edges = _edges_from_source(source_t)
-    x = jnp.asarray(x)
 
     queue = pending_sends()
     match_idx: Optional[int] = None

@@ -27,6 +27,12 @@ def _reduce_abstract_eval(x, *, op, root, comm: BoundComm):
 
 
 def _reduce_spmd(x, *, op, root, comm: BoundComm):
+    if comm.backend == "shm":
+        from ..runtime import shm as _shm
+        from .allreduce import _shm_reduction_dtype_check
+
+        _shm_reduction_dtype_check(x)
+        return _shm.reduce(x, op, root)
     if not comm.axes or comm.size == 1:
         return x
     reduced = _allreduce_spmd(x, op=op, comm=comm, transpose=False)

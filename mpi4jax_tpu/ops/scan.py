@@ -30,6 +30,12 @@ def _scan_abstract_eval(x, *, op, comm: BoundComm):
 
 
 def _scan_spmd(x, *, op: Op, comm: BoundComm):
+    if comm.backend == "shm":
+        from ..runtime import shm as _shm
+        from .allreduce import _shm_reduction_dtype_check
+
+        _shm_reduction_dtype_check(x)
+        return _shm.scan(x, op)
     if not comm.axes or comm.size == 1:
         return x
     axis = comm.require_single_axis("scan")

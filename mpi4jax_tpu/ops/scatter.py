@@ -33,6 +33,10 @@ def _scatter_abstract_eval(x, *, root, comm: BoundComm):
 
 
 def _scatter_spmd(x, *, root, comm: BoundComm):
+    if comm.backend == "shm":
+        from ..runtime import shm as _shm
+
+        return _shm.scatter(x, root)
     if not comm.axes or comm.size == 1:
         return x[0]
     axis = comm.require_single_axis("scatter")

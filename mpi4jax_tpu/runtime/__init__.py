@@ -1,0 +1,3 @@
+"""Native runtime: shared-memory CPU backend (see ``shmcc.cpp``)."""
+
+from . import shm  # noqa: F401

@@ -1,0 +1,62 @@
+"""Build the native shm backend extension with plain g++.
+
+The reference compiles its bridge with mpicc-driven setuptools
+(``setup.py:81-108``); there is no MPI here, so a direct g++ invocation
+against the CPython and XLA FFI headers suffices. Invoked lazily on
+first use (``runtime/__init__.py``) or explicitly:
+
+    python -m mpi4jax_tpu.runtime.build
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "shmcc.cpp")
+OUT = os.path.join(HERE, "_shmcc.so")
+
+
+def build(verbose: bool = False) -> str:
+    import jax.ffi
+
+    # Build to a unique temp path and atomically rename: all launched
+    # ranks may race to (re)build concurrently, and a partially-written
+    # .so must never be visible to another rank's dlopen.
+    tmp = f"{OUT}.{os.getpid()}.tmp"
+    cmd = [
+        "g++",
+        "-O2",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        "-fvisibility=hidden",
+        f"-I{sysconfig.get_paths()['include']}",
+        f"-I{jax.ffi.include_dir()}",
+        SRC,
+        "-o",
+        tmp,
+        "-lrt",
+    ]
+    if verbose:
+        print(" ".join(cmd))
+    try:
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+        os.replace(tmp, OUT)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return OUT
+
+
+def ensure_built() -> str:
+    if os.path.exists(OUT) and os.path.getmtime(OUT) >= os.path.getmtime(SRC):
+        return OUT
+    return build()
+
+
+if __name__ == "__main__":
+    build(verbose=True)
+    print(f"built {OUT}")

@@ -1,0 +1,253 @@
+"""Python side of the native shared-memory backend.
+
+Mirrors the role of the reference's ``xla_bridge/__init__.py``: load
+the native extension, register its XLA FFI targets, expose
+logging/ABI-info hooks (``xla_bridge/__init__.py:110-174``), plus the
+world bootstrap the reference gets from mpi4py's import-time
+``MPI_Init`` (``_src/__init__.py:1-3``) — here driven by the
+``M4T_SHM_NAME`` / ``M4T_RANK`` / ``M4T_SIZE`` environment set by
+``python -m mpi4jax_tpu.launch``.
+
+The shm backend is CPU-only by design: it exists to reproduce the
+reference's multi-process ``mpirun`` workflow for development and CI.
+The TPU path never touches it (pure HLO collectives).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..comm import Comm
+from .. import config
+
+_ext = None
+_active = False
+_RANK = 0
+_SIZE = 1
+
+#: op name -> code, matching enum OpCode in shmcc.cpp
+OP_CODES = {
+    "SUM": 0, "PROD": 1, "MAX": 2, "MIN": 3, "LAND": 4,
+    "LOR": 5, "LXOR": 6, "BAND": 7, "BOR": 8, "BXOR": 9,
+}
+
+
+def _load_ext():
+    global _ext
+    if _ext is None:
+        from .build import ensure_built
+
+        ensure_built()
+        from . import _shmcc  # type: ignore
+
+        _ext = _shmcc
+    return _ext
+
+
+def available() -> bool:
+    try:
+        _load_ext()
+        return True
+    except Exception:
+        return False
+
+
+def active() -> bool:
+    return _active
+
+
+def rank() -> int:
+    return _RANK
+
+
+def size() -> int:
+    return _SIZE
+
+
+def abi_info() -> dict:
+    return _load_ext().abi_info()
+
+
+def set_logging(enabled: bool) -> None:
+    if _ext is not None:
+        _ext.set_debug(bool(enabled))
+
+
+def init_from_env() -> bool:
+    """Initialize the world if launched by ``mpi4jax_tpu.launch``.
+
+    Import-time analog of the reference's mpi4py-first import
+    (``_src/__init__.py:1-3``). Returns True if a world was joined.
+    """
+    global _active, _RANK, _SIZE
+    name = os.environ.get("M4T_SHM_NAME")
+    if not name or _active:
+        return _active
+    ext = _load_ext()
+    rank_ = int(os.environ["M4T_RANK"])
+    size_ = int(os.environ["M4T_SIZE"])
+
+    import jax
+
+    # shm backend is CPU-only; pin the platform before any backend use.
+    jax.config.update("jax_platforms", "cpu")
+
+    deadline = time.time() + 30.0
+    while True:
+        try:
+            ext.init(name, rank_, size_, 1 if rank_ == 0 else 0)
+            break
+        except RuntimeError as e:
+            # only (code -2) — creator hasn't created/sized the segment
+            # yet — is retryable; anything else is permanent.
+            if rank_ == 0 or "(code -2)" not in str(e) or time.time() > deadline:
+                raise
+            time.sleep(0.02)
+    _RANK, _SIZE = rank_, size_
+    _active = True
+    ext.set_debug(config.DEBUG_LOGGING)
+
+    for name_, cap in ext.targets().items():
+        jax.ffi.register_ffi_target(name_, cap, platform="cpu")
+
+    # Reference parity: atexit flush + finalize
+    # (_src/__init__.py:14-24 registers jax.effects_barrier before
+    # mpi4py's MPI_Finalize).
+    def _cleanup():
+        try:
+            jax.effects_barrier()
+        except Exception:
+            pass
+        ext.finalize()
+
+    atexit.register(_cleanup)
+    return True
+
+
+class ShmComm(Comm):
+    """Communicator on the native shared-memory world (multi-process,
+    one rank per process — the reference's execution model)."""
+
+    def __init__(self):
+        super().__init__(axis="shm_world")
+        if not _active:
+            raise RuntimeError(
+                "no shm world active; run under `python -m mpi4jax_tpu.launch`"
+            )
+
+    def Get_rank(self) -> int:  # static int, unlike the mesh Comm
+        return _RANK
+
+    def Get_size(self) -> int:
+        return _SIZE
+
+    def __hash__(self):
+        return hash((type(self).__name__,))
+
+    def __eq__(self, other):
+        return type(other) is type(self)
+
+
+# ---------------------------------------------------------------------------
+# op implementations (jax.ffi.ffi_call against the native handlers)
+# ---------------------------------------------------------------------------
+
+
+def _ffi(name, result, *args, **attrs):
+    import jax
+
+    call = jax.ffi.ffi_call(name, result, has_side_effect=True)
+    return call(*args, **attrs)
+
+
+def _result_like(x):
+    import jax
+
+    return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+
+def _debool(x):
+    """bool arrays ride as int32 so native byte-wise accumulation cannot
+    produce non-canonical bool bytes (e.g. 1+1=2 in a PRED buffer);
+    mirrors the XLA path's bool handling (ops/allreduce.py)."""
+    if x.dtype == np.bool_:
+        return x.astype(np.int32), True
+    return x, False
+
+
+def allreduce(x, op):
+    x, was_bool = _debool(x)
+    out = _ffi(
+        "m4t_shm_allreduce", _result_like(x), x, op=np.int64(OP_CODES[op.name])
+    )
+    return out.astype(np.bool_) if was_bool else out
+
+
+def scan(x, op):
+    x, was_bool = _debool(x)
+    out = _ffi("m4t_shm_scan", _result_like(x), x, op=np.int64(OP_CODES[op.name]))
+    return out.astype(np.bool_) if was_bool else out
+
+
+def reduce(x, op, root):
+    x, was_bool = _debool(x)
+    out = _ffi(
+        "m4t_shm_reduce", _result_like(x), x,
+        op=np.int64(OP_CODES[op.name]), root=np.int64(root),
+    )
+    return out.astype(np.bool_) if was_bool else out
+
+
+def allgather(x):
+    import jax
+
+    res = jax.ShapeDtypeStruct((_SIZE,) + x.shape, x.dtype)
+    return _ffi("m4t_shm_allgather", res, x)
+
+
+def bcast(x, root):
+    return _ffi("m4t_shm_bcast", _result_like(x), x, root=np.int64(root))
+
+
+def scatter(x, root):
+    import jax
+
+    res = jax.ShapeDtypeStruct(x.shape[1:], x.dtype)
+    return _ffi("m4t_shm_scatter", res, x, root=np.int64(root))
+
+
+def alltoall(x):
+    return _ffi("m4t_shm_alltoall", _result_like(x), x)
+
+
+def barrier(tok):
+    return _ffi("m4t_shm_barrier", _result_like(tok))
+
+
+def send(x, dest: int, tag: int):
+    import jax
+
+    return _ffi(
+        "m4t_shm_send", jax.ShapeDtypeStruct((), np.dtype(np.int32)), x,
+        dest=np.int64(dest), tag=np.int64(tag),
+    )
+
+
+def recv(template, source: int, tag: int):
+    return _ffi(
+        "m4t_shm_recv", _result_like(template),
+        source=np.int64(source), tag=np.int64(tag),
+    )
+
+
+def sendrecv(sendbuf, recvbuf, source: int, dest: int, sendtag: int, recvtag: int):
+    return _ffi(
+        "m4t_shm_sendrecv", _result_like(recvbuf), sendbuf,
+        source=np.int64(source), dest=np.int64(dest),
+        sendtag=np.int64(sendtag), recvtag=np.int64(recvtag),
+    )

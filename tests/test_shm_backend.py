@@ -1,0 +1,163 @@
+"""Native shared-memory backend tests, run through the real launcher
+in subprocesses — the reference's crash-path/subprocess technique
+(``tests/collective_ops/test_common.py:13-57`` run_in_subprocess) plus
+its ``mpirun -np N pytest`` execution model, with
+``python -m mpi4jax_tpu.launch`` in mpirun's role."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def launch(n, script, env_extra=None, timeout=120):
+    path = os.path.join(os.environ.get("TMPDIR", "/tmp"), f"m4t_case_{os.getpid()}.py")
+    with open(path, "w") as f:
+        f.write(f"import sys; sys.path.insert(0, {REPO!r})\n")
+        f.write(textwrap.dedent(script))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # children don't need the 8-device trick
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.launch", "-n", str(n), path],
+        env=env, capture_output=True, text=True, timeout=timeout, cwd=REPO,
+    )
+
+
+needs_native = pytest.mark.skipif(
+    subprocess.run(["which", "g++"], capture_output=True).returncode != 0,
+    reason="no C++ toolchain",
+)
+
+
+@needs_native
+def test_world_collectives():
+    res = launch(
+        4,
+        """
+        import numpy as np, jax.numpy as jnp
+        import mpi4jax_tpu as m4t
+        from mpi4jax_tpu.runtime import shm
+        r, n = shm.rank(), shm.size()
+        x = jnp.arange(4.0) + r
+        assert np.allclose(m4t.allreduce(x, op=m4t.SUM),
+                           np.arange(4.0) * n + sum(range(n)))
+        assert np.allclose(m4t.allgather(jnp.float32(r)), np.arange(n))
+        assert float(m4t.scan(jnp.float32(r), op=m4t.SUM)) == sum(range(r + 1))
+        m4t.barrier()
+        print(f"OK{r}")
+        """,
+    )
+    assert res.returncode == 0, res.stderr
+    for r in range(4):
+        assert f"OK{r}" in res.stdout
+
+
+@needs_native
+def test_rank_divergent_send_recv():
+    # The reference's deadlock-ordering pattern
+    # (test_send_and_recv.py:91-110): asymmetric send/recv order across
+    # ranks — expressible here because the shm backend is
+    # multi-controller like the reference.
+    res = launch(
+        2,
+        """
+        import numpy as np, jax.numpy as jnp
+        import mpi4jax_tpu as m4t
+        from mpi4jax_tpu.runtime import shm
+        r = shm.rank()
+        x = jnp.full(3, float(r))
+        if r == 0:
+            m4t.send(x, dest=1, tag=1)
+            got = m4t.recv(jnp.zeros(3), source=1, tag=2)
+            assert np.allclose(got, 1.0)
+        else:
+            got = m4t.recv(jnp.zeros(3), source=0, tag=1)
+            m4t.send(x, dest=0, tag=2)
+            assert np.allclose(got, 0.0)
+        print(f"P2P_OK{r}")
+        """,
+    )
+    assert res.returncode == 0, res.stderr
+    assert "P2P_OK0" in res.stdout and "P2P_OK1" in res.stdout
+
+
+@needs_native
+def test_large_message_chunking():
+    # > 4 MiB collective slot and > 256 KiB p2p entry force the chunked
+    # protocols.
+    res = launch(
+        2,
+        """
+        import numpy as np, jax.numpy as jnp
+        import mpi4jax_tpu as m4t
+        from mpi4jax_tpu.runtime import shm
+        r = shm.rank()
+        big = jnp.arange(3_000_000, dtype=jnp.float32) + r  # ~12 MB
+        out = m4t.allreduce(big, op=m4t.SUM)
+        assert np.allclose(out[:5], 2 * np.arange(5) + 1)
+        partner = 1 - r
+        sw = m4t.sendrecv(big, jnp.zeros_like(big), source=partner, dest=partner)
+        assert float(sw[0]) == float(partner)
+        print(f"BIG_OK{r}")
+        """,
+    )
+    assert res.returncode == 0, res.stderr
+    assert "BIG_OK0" in res.stdout and "BIG_OK1" in res.stdout
+
+
+@needs_native
+def test_abort_propagates():
+    # Fail-fast parity (reference abort_on_error -> MPI_Abort,
+    # tested via subprocess at test_common.py:60-88): one rank dying
+    # must take the world down with a nonzero exit.
+    res = launch(
+        2,
+        """
+        import mpi4jax_tpu as m4t
+        from mpi4jax_tpu.runtime import shm
+        if shm.rank() == 1:
+            raise SystemExit(7)
+        import jax.numpy as jnp
+        m4t.barrier()  # would hang forever without abort detection
+        """,
+        timeout=180,
+    )
+    assert res.returncode != 0
+    assert "terminating world" in res.stderr
+
+
+@needs_native
+def test_debug_log_format():
+    # Debug-log contract (reference test_common.py:118-146): rank
+    # prefix, 8-char correlation id, op name, "done" with timing.
+    res = launch(
+        2,
+        """
+        import jax.numpy as jnp
+        import mpi4jax_tpu as m4t
+        m4t.allreduce(jnp.ones(4), op=m4t.SUM)
+        """,
+        env_extra={"MPI4JAX_TPU_DEBUG": "1"},
+    )
+    assert res.returncode == 0, res.stderr
+    import re
+
+    assert re.search(
+        r"shmcc r[01] \| [a-z0-9]{8} \| Allreduce done \(\d\.\d{2}e[+-]\d+ s\)",
+        res.stderr,
+    ), res.stderr
+
+
+@needs_native
+def test_abi_info():
+    from mpi4jax_tpu.runtime import shm
+
+    info = shm.abi_info()
+    assert info["max_ranks"] >= 2
+    assert info["coll_chunk_bytes"] >= 1 << 20
