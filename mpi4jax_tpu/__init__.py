@@ -25,6 +25,10 @@ __version__ = "0.1.0"
 
 import os as _os
 
+from .jax_compat import check_jax_version as _check_jax_version
+
+_check_jax_version()  # reference parity: _src/__init__.py:6-8
+
 from .comm import (  # noqa: F401
     ANY_TAG,
     BAND,
@@ -32,6 +36,7 @@ from .comm import (  # noqa: F401
     BXOR,
     CartComm,
     Comm,
+    GroupComm,
     LAND,
     LOR,
     LXOR,
@@ -123,6 +128,7 @@ __all__ = [
     "sendrecv",
     "Comm",
     "CartComm",
+    "GroupComm",
     "Op",
     "SUM",
     "PROD",

@@ -167,10 +167,29 @@ class Comm:
         """Reference clones COMM_WORLD to isolate its traffic
         (``_src/utils.py:16-27``). XLA collectives are matched by
         channel id assigned per-op by the compiler, so namespace
-        isolation is automatic; Clone returns an equivalent Comm."""
-        return self.__class__(self._axes)
+        isolation is automatic; Clone returns an equivalent Comm
+        (a shallow copy, valid for every Comm subclass)."""
+        import copy
+
+        return copy.copy(self)
 
     Dup = Clone
+
+    def Split(self, colors: Sequence[int]) -> "GroupComm":
+        """Partition the communicator (``MPI_Comm_split`` analog).
+
+        ``colors`` is a static per-rank table (length = world size);
+        ranks sharing a color form a sub-communicator, ordered by
+        global rank. All groups must be the same size (single-program
+        SPMD needs uniform shapes). Collectives on the result lower to
+        HLO collectives with ``replica_groups`` — each group's traffic
+        stays inside its ICI subset.
+        """
+        buckets = {}
+        for r, c in enumerate(colors):
+            buckets.setdefault(int(c), []).append(r)
+        groups = tuple(tuple(b) for b in buckets.values())
+        return GroupComm(groups, axis=self._axes)
 
     def __hash__(self):
         return hash((type(self).__name__, self._axes))
@@ -180,6 +199,48 @@ class Comm:
 
     def __repr__(self):
         return f"Comm(axes={self._axes})"
+
+
+class GroupComm(Comm):
+    """A sub-communicator: disjoint same-size groups of global ranks.
+
+    The analog of an ``MPI_Comm_split`` result. Ranks are *group
+    ranks* (0..group_size-1); collectives lower with
+    ``axis_index_groups`` so each group is an independent
+    ``replica_group`` in the HLO collective.
+    """
+
+    def __init__(self, groups, axis: Union[str, Sequence[str]] = WORLD_AXIS):
+        super().__init__(axis)
+        groups = tuple(tuple(int(r) for r in grp) for grp in groups)
+        if not groups:
+            raise ValueError("GroupComm needs at least one group")
+        gsize = len(groups[0])
+        if any(len(grp) != gsize for grp in groups):
+            raise ValueError(
+                "all groups must have equal size under SPMD (got sizes "
+                f"{[len(g) for g in groups]})"
+            )
+        flat = sorted(r for grp in groups for r in grp)
+        if flat != list(range(len(flat))):
+            raise ValueError(
+                "groups must partition the global rank space 0..n-1 "
+                f"(got {groups})"
+            )
+        self.groups = groups
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._axes, self.groups))
+
+    def __eq__(self, other):
+        return (
+            type(other) is type(self)
+            and other._axes == self._axes
+            and other.groups == self.groups
+        )
+
+    def __repr__(self):
+        return f"GroupComm(groups={self.groups}, axes={self._axes})"
 
 
 class CartComm(Comm):
@@ -268,18 +329,63 @@ class BoundComm:
     size: int
     backend: str = "xla"
     shm_rank: int = 0
+    #: axis_index_groups for sub-communicators (None = whole axis);
+    #: ``size`` is then the *group* size and ``rank()`` the group rank.
+    groups: Optional[Tuple[Tuple[int, ...], ...]] = None
 
-    def rank(self):
+    def global_rank(self):
+        """Linear rank over the mesh axes (row-major)."""
         if self.backend == "shm":
             return jnp.asarray(self.shm_rank, jnp.int32)
         if not self.axes:
             return jnp.zeros((), jnp.int32)
-        # Row-major linear rank over the axes (matches the reference
-        # world where COMM_WORLD ranks are a flat 0..n-1 space).
         r = jnp.zeros((), jnp.int32)
         for name in self.axes:
             r = r * lax.axis_size(name) + lax.axis_index(name)
         return r
+
+    def rank(self):
+        """Rank within the communicator (group rank for Split comms)."""
+        g = self.global_rank()
+        if self.groups is None:
+            return g
+        n_total = sum(len(grp) for grp in self.groups)
+        table = np.zeros((n_total,), np.int32)
+        for grp in self.groups:
+            for i, r in enumerate(grp):
+                table[r] = i
+        return jnp.take(jnp.asarray(table), g)
+
+    def to_global_edges(self, perm):
+        """Translate comm-rank edges to global-rank edges, replicated
+        into every group (for ppermute-based lowerings)."""
+        if self.groups is None:
+            return tuple(perm)
+        out = []
+        for grp in self.groups:
+            for s, d in perm:
+                out.append((grp[s], grp[d]))
+        return tuple(out)
+
+    def recv_mask_table(self, perm) -> np.ndarray:
+        """Boolean per-global-rank table: does this rank receive?"""
+        n_total = (
+            sum(len(grp) for grp in self.groups)
+            if self.groups is not None
+            else self.size
+        )
+        table = np.zeros((n_total,), bool)
+        for _, d in self.to_global_edges(perm):
+            table[d] = True
+        return table
+
+    def collective_kwargs(self):
+        """(axis target, extra kwargs) for lax collectives: single axis
+        + ``axis_index_groups`` for Split comms, the axis tuple
+        otherwise."""
+        if self.groups is not None:
+            return self.axes[0], dict(axis_index_groups=list(self.groups))
+        return self.axes, {}
 
     def require_single_axis(self, opname: str) -> str:
         if len(self.axes) != 1:
@@ -328,6 +434,11 @@ def resolve_comm(comm: Optional[Comm]) -> BoundComm:
         except Exception:
             _shm = None
         if _shm is not None and _shm.active():
+            if isinstance(comm, GroupComm):
+                raise NotImplementedError(
+                    "sub-communicators (Comm.Split) are not supported on "
+                    "the native shm backend yet; use the XLA mesh path"
+                )
             return BoundComm(
                 axes=(), size=_shm.size(), backend="shm", shm_rank=_shm.rank()
             )
@@ -342,6 +453,21 @@ def resolve_comm(comm: Optional[Comm]) -> BoundComm:
     size = 1
     for a in comm.axes:
         size *= lax.axis_size(a)
-    return BoundComm(axes=comm.axes, size=int(size))
+    size = int(size)
+    if isinstance(comm, GroupComm):
+        total = sum(len(g) for g in comm.groups)
+        if total != size:
+            raise ValueError(
+                f"GroupComm groups cover {total} ranks but the mesh axes "
+                f"{comm.axes} have size {size}"
+            )
+        if len(comm.axes) != 1:
+            raise NotImplementedError(
+                "sub-communicators require a single mesh axis"
+            )
+        return BoundComm(
+            axes=comm.axes, size=len(comm.groups[0]), groups=comm.groups
+        )
+    return BoundComm(axes=comm.axes, size=size)
 
 

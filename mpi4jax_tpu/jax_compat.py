@@ -1,0 +1,57 @@
+"""JAX version compatibility gate.
+
+Analog of the reference's ``_src/jax_compat.py:25-48`` +
+``_latest_jax_version.txt``: warn (once) when the installed jax is
+newer than the last version this package was tested against, silenced
+by ``MPI4JAX_TPU_NO_WARN_JAX_VERSION``. Unlike the reference we need
+no effect-registration or token shims — ordering is value-token based
+(``token.py``) — so this module is just the gate plus the version
+parser.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Tuple
+
+#: newest jax version this package has been tested with
+LATEST_TESTED_JAX = "0.9.0"
+#: oldest jax version expected to work (shard_map + lax.axis_size +
+#: jax.ffi are required)
+MINIMUM_JAX = "0.6.0"
+
+
+def versiontuple(version: str) -> Tuple[int, ...]:
+    """Parse 'X.Y.Z[suffix]' into a comparable tuple (reference
+    ``jax_compat.py`` versiontuple)."""
+    parts = []
+    for field in version.split(".")[:3]:
+        digits = ""
+        for ch in field:
+            if not ch.isdigit():
+                break
+            digits += ch
+        parts.append(int(digits or 0))
+    return tuple(parts)
+
+
+def check_jax_version(jax_version: str | None = None) -> None:
+    if jax_version is None:
+        import jax
+
+        jax_version = jax.__version__
+    if versiontuple(jax_version) < versiontuple(MINIMUM_JAX):
+        raise RuntimeError(
+            f"mpi4jax_tpu requires jax>={MINIMUM_JAX}, found {jax_version}"
+        )
+    if versiontuple(jax_version) > versiontuple(LATEST_TESTED_JAX):
+        if os.environ.get("MPI4JAX_TPU_NO_WARN_JAX_VERSION", ""):
+            return
+        warnings.warn(
+            f"jax {jax_version} is newer than the latest version "
+            f"mpi4jax_tpu has been tested with ({LATEST_TESTED_JAX}); "
+            "if you run into problems, pin jax or set "
+            "MPI4JAX_TPU_NO_WARN_JAX_VERSION=1 to silence this warning.",
+            stacklevel=3,
+        )

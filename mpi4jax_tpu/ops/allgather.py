@@ -29,7 +29,8 @@ def _allgather_spmd(x, *, comm: BoundComm):
         return _shm.allgather(x)
     if not comm.axes or comm.size == 1:
         return x[None]
-    return lax.all_gather(x, comm.axes, tiled=False)
+    axes, kw = comm.collective_kwargs()
+    return lax.all_gather(x, axes, tiled=False, **kw)
 
 
 mpi_allgather_p = define_primitive(

@@ -35,22 +35,24 @@ def _allreduce_abstract_eval(x, *, op, comm, transpose):
     return x
 
 
-def _native_reduce(x, op: Op, axes):
+def _native_reduce(x, op: Op, comm: BoundComm):
+    axes, kw = comm.collective_kwargs()
     if op is SUM:
         if x.dtype == jnp.bool_:
-            return lax.psum(x.astype(jnp.int32), axes).astype(jnp.bool_)
-        return lax.psum(x, axes)
+            return lax.psum(x.astype(jnp.int32), axes, **kw).astype(jnp.bool_)
+        return lax.psum(x, axes, **kw)
     if op is MAX:
-        return lax.pmax(x, axes)
+        return lax.pmax(x, axes, **kw)
     if op is MIN:
-        return lax.pmin(x, axes)
+        return lax.pmin(x, axes, **kw)
     raise AssertionError(op)
 
 
-def _generic_reduce(x, op: Op, axes):
+def _generic_reduce(x, op: Op, comm: BoundComm):
     # Exact fallback: AllGather + local reduction along the gathered
     # axis. Associative+commutative ops don't care about rank order.
-    gathered = lax.all_gather(x, axes, tiled=False)
+    axes, kw = comm.collective_kwargs()
+    gathered = lax.all_gather(x, axes, tiled=False, **kw)
     return op.reduce_along_axis(gathered, axis=0).astype(x.dtype)
 
 
@@ -80,8 +82,8 @@ def _allreduce_spmd(x, *, op, comm: BoundComm, transpose):
         # World size 1: reduction over a single rank is the identity.
         return x
     if op.native is not None:
-        return _native_reduce(x, op, comm.axes)
-    return _generic_reduce(x, op, comm.axes)
+        return _native_reduce(x, op, comm)
+    return _generic_reduce(x, op, comm)
 
 
 mpi_allreduce_p = define_primitive(

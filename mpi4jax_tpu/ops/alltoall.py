@@ -33,7 +33,8 @@ def _alltoall_spmd(x, *, comm: BoundComm):
     if not comm.axes or comm.size == 1:
         return x
     axis = comm.require_single_axis("alltoall")
-    return lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=False)
+    _, kw = comm.collective_kwargs()
+    return lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=False, **kw)
 
 
 mpi_alltoall_p = define_primitive(

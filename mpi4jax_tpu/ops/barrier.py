@@ -31,7 +31,8 @@ def _barrier_spmd(tok, *, comm: BoundComm):
         return _shm.barrier(tok)
     if not comm.axes or comm.size == 1:
         return tok
-    return lax.psum(tok, comm.axes)
+    axes, kw = comm.collective_kwargs()
+    return lax.psum(tok, axes, **kw)
 
 
 mpi_barrier_p = define_primitive(

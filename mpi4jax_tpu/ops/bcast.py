@@ -38,14 +38,15 @@ def _bcast_spmd(x, *, root, comm: BoundComm):
         return _shm.bcast(x, root)
     if not comm.axes or comm.size == 1:
         return x
+    axes, kw = comm.collective_kwargs()
     rank = comm.rank()
     if x.dtype == jnp.bool_:
         masked = jnp.where(rank == root, x, jnp.zeros_like(x)).astype(jnp.int32)
-        return lax.psum(masked, comm.axes).astype(jnp.bool_)
+        return lax.psum(masked, axes, **kw).astype(jnp.bool_)
     if jnp.issubdtype(x.dtype, jnp.number):
         masked = jnp.where(rank == root, x, jnp.zeros_like(x))
-        return lax.psum(masked, comm.axes)
-    gathered = lax.all_gather(x, comm.axes, tiled=False)
+        return lax.psum(masked, axes, **kw)
+    gathered = lax.all_gather(x, axes, tiled=False, **kw)
     return gathered[root]
 
 

@@ -39,7 +39,8 @@ def _gather_spmd(x, *, root, comm: BoundComm):
         return _shm.allgather(x)
     if not comm.axes or comm.size == 1:
         return x[None]
-    return lax.all_gather(x, comm.axes, tiled=False)
+    axes, kw = comm.collective_kwargs()
+    return lax.all_gather(x, axes, tiled=False, **kw)
 
 
 mpi_gather_p = define_primitive(

@@ -66,10 +66,8 @@ Edge = Tuple[int, int]
 
 
 def _recv_mask(perm: Tuple[Edge, ...], comm: BoundComm):
-    table = np.zeros((comm.size,), bool)
-    for _, d in perm:
-        table[d] = True
-    return jnp.take(jnp.asarray(table), comm.rank())
+    table = comm.recv_mask_table(perm)
+    return jnp.take(jnp.asarray(table), comm.global_rank())
 
 
 def _p2p_abstract_eval(x, template, *, perm, comm: BoundComm):
@@ -83,7 +81,7 @@ def _p2p_spmd(x, template, *, perm: Tuple[Edge, ...], comm: BoundComm):
         # Only possible edge at size 1 is the self-edge (0, 0).
         return x if perm == ((0, 0),) else template
     axis = comm.require_single_axis("send/recv")
-    moved = lax.ppermute(x, axis, list(perm))
+    moved = lax.ppermute(x, axis, list(comm.to_global_edges(perm)))
     m = _recv_mask(perm, comm)
     return jnp.where(m, moved, template)
 
@@ -369,7 +367,7 @@ def send(x, dest: TableLike, *, tag: int = 0, comm=None, token=NOTSET):
             x=x,
             edges=edges,
             tag=int(tag),
-            axes=bound.axes,
+            comm=bound,  # full BoundComm: groups included in matching
             shape=x.shape,
             dtype=x.dtype,
         )
@@ -419,7 +417,7 @@ def recv(
     queue = pending_sends()
     match_idx: Optional[int] = None
     for i, rec in enumerate(queue):
-        if rec["axes"] != bound.axes:
+        if rec["comm"] != bound:
             continue
         if tag != ANY_TAG and rec["tag"] != tag:
             continue

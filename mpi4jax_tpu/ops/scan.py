@@ -40,12 +40,12 @@ def _scan_spmd(x, *, op: Op, comm: BoundComm):
         return x
     axis = comm.require_single_axis("scan")
     n = comm.size
-    rank = lax.axis_index(axis)
+    rank = comm.rank()  # group rank for Split comms
     y = x
     d = 1
     while d < n:
         perm = [(i, i + d) for i in range(n - d)]
-        shifted = lax.ppermute(y, axis, perm)
+        shifted = lax.ppermute(y, axis, comm.to_global_edges(perm))
         y = jnp.where(rank >= d, op.combine(y, shifted), y)
         d *= 2
     return y

@@ -40,17 +40,18 @@ def _scatter_spmd(x, *, root, comm: BoundComm):
     if not comm.axes or comm.size == 1:
         return x[0]
     axis = comm.require_single_axis("scatter")
-    rank = lax.axis_index(axis)
+    _, kw = comm.collective_kwargs()
+    rank = comm.rank()
     if x.dtype == jnp.bool_:
         masked = jnp.where(rank == root, x, jnp.zeros_like(x)).astype(jnp.int32)
         return lax.psum_scatter(
-            masked, axis, scatter_dimension=0, tiled=False
+            masked, axis, scatter_dimension=0, tiled=False, **kw
         ).astype(jnp.bool_)
     if jnp.issubdtype(x.dtype, jnp.number):
         masked = jnp.where(rank == root, x, jnp.zeros_like(x))
-        return lax.psum_scatter(masked, axis, scatter_dimension=0, tiled=False)
+        return lax.psum_scatter(masked, axis, scatter_dimension=0, tiled=False, **kw)
     # Generic dtype fallback: broadcast root's array, take own block.
-    gathered = lax.all_gather(x, axis, tiled=False)
+    gathered = lax.all_gather(x, axis, tiled=False, **kw)
     return lax.dynamic_index_in_dim(gathered[root], rank, 0, keepdims=False)
 
 

@@ -1,0 +1,154 @@
+"""Sub-communicator (Comm.Split / MPI_Comm_split analog) tests: every
+collective restricted to a group must see only that group's data. The
+reference supports arbitrary MPI communicators as the ``comm``
+argument; groups are the SPMD equivalent, lowering to HLO
+``replica_groups``."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m4t
+
+N = 8
+
+
+def even_odd():
+    # interleaved split: ranks {0,2,4,6} and {1,3,5,7}
+    return m4t.Comm("ranks").Split([r % 2 for r in range(N)])
+
+
+def halves():
+    # contiguous split: {0..3} and {4..7}
+    return m4t.Comm("ranks").Split([r // 4 for r in range(N)])
+
+
+def test_split_allreduce_halves(run_spmd, per_rank):
+    comm = halves()
+    arr = per_rank(lambda r: np.float32(r))
+    out = run_spmd(lambda x: m4t.allreduce(x, op=m4t.SUM, comm=comm), arr)
+    np.testing.assert_allclose(out[:4], np.full(4, 0 + 1 + 2 + 3))
+    np.testing.assert_allclose(out[4:], np.full(4, 4 + 5 + 6 + 7))
+
+
+def test_split_allreduce_interleaved(run_spmd, per_rank):
+    comm = even_odd()
+    arr = per_rank(lambda r: np.float32(r))
+    out = run_spmd(lambda x: m4t.allreduce(x, op=m4t.SUM, comm=comm), arr)
+    for r in range(N):
+        expected = sum(q for q in range(N) if q % 2 == r % 2)
+        assert out[r] == expected
+
+
+def test_split_rank_and_size(run_spmd, per_rank):
+    comm = halves()
+    arr = per_rank(lambda r: np.float32(0))
+    out = run_spmd(
+        lambda x: x + comm.Get_rank().astype(jnp.float32) + 10 * comm.Get_size(),
+        arr,
+    )
+    np.testing.assert_allclose(out, np.array([40, 41, 42, 43, 40, 41, 42, 43.0]))
+
+
+def test_split_bcast(run_spmd, per_rank):
+    comm = halves()
+    arr = per_rank(lambda r: np.arange(3, dtype=np.float32) + 10 * r)
+    # root=2 is group rank 2: global rank 2 in group 0, rank 6 in group 1
+    out = run_spmd(lambda x: m4t.bcast(x, 2, comm=comm), arr)
+    for r in range(4):
+        np.testing.assert_allclose(out[r], arr[2])
+    for r in range(4, 8):
+        np.testing.assert_allclose(out[r], arr[6])
+
+
+def test_split_allgather(run_spmd, per_rank):
+    comm = even_odd()
+    arr = per_rank(lambda r: np.float32(r))
+    out = run_spmd(lambda x: m4t.allgather(x, comm=comm), arr)
+    np.testing.assert_allclose(out[0], [0, 2, 4, 6])
+    np.testing.assert_allclose(out[1], [1, 3, 5, 7])
+
+
+def test_split_scan(run_spmd, per_rank):
+    comm = halves()
+    arr = per_rank(lambda r: np.float32(r))
+    out = run_spmd(lambda x: m4t.scan(x, m4t.SUM, comm=comm), arr)
+    np.testing.assert_allclose(out[:4], np.cumsum(np.arange(4.0)))
+    np.testing.assert_allclose(out[4:], np.cumsum(np.arange(4.0, 8.0)))
+
+
+def test_split_scatter(run_spmd, per_rank):
+    comm = halves()
+    arr = per_rank(
+        lambda r: (np.arange(4, dtype=np.float32) + 100 * r).reshape(4, 1)
+    )
+    out = run_spmd(lambda x: m4t.scatter(x, 0, comm=comm), arr)
+    # group 0 root = global 0; group 1 root = global 4
+    for r in range(4):
+        np.testing.assert_allclose(out[r], arr[0][r])
+    for r in range(4, 8):
+        np.testing.assert_allclose(out[r], arr[4][r - 4])
+
+
+def test_split_alltoall(run_spmd, per_rank):
+    comm = halves()
+    arr = per_rank(lambda r: np.arange(4, dtype=np.float32).reshape(4, 1) + 10 * r)
+    out = run_spmd(lambda x: m4t.alltoall(x, comm=comm), arr)
+    # within group 0: out[r][j] == arr[j][r']
+    for r in range(4):
+        for j in range(4):
+            np.testing.assert_allclose(out[r, j], arr[j, r])
+    for r in range(4, 8):
+        for j in range(4):
+            np.testing.assert_allclose(out[r, j], arr[4 + j, r - 4])
+
+
+def test_split_sendrecv_ring(run_spmd, per_rank):
+    comm = halves()
+    # ring within each group, expressed in group-rank space
+    dst = tuple((r + 1) % 4 for r in range(4))
+    src = tuple((r - 1) % 4 for r in range(4))
+    arr = per_rank(lambda r: np.float32(r))
+    out = run_spmd(lambda x: m4t.sendrecv(x, x, src, dst, comm=comm), arr)
+    np.testing.assert_allclose(out[:4], [3, 0, 1, 2])
+    np.testing.assert_allclose(out[4:], [7, 4, 5, 6])
+
+
+def test_split_grad(run_spmd, per_rank):
+    import jax
+
+    comm = even_odd()
+    arr = per_rank(lambda r: np.float32(r + 1))
+    out = run_spmd(
+        lambda x: jax.grad(lambda y: m4t.allreduce(y, op=m4t.SUM, comm=comm).sum())(x),
+        arr,
+    )
+    np.testing.assert_allclose(out, np.ones(N))
+
+
+def test_split_validation():
+    with pytest.raises(ValueError, match="equal size"):
+        m4t.GroupComm(((0, 1, 2), (3,)))
+    with pytest.raises(ValueError, match="partition"):
+        m4t.GroupComm(((0, 1), (1, 2)))
+
+
+def test_cart_row_col_comms(run_spmd, per_rank):
+    # classic pattern: row/column communicators of a 2x4 grid
+    world = m4t.Comm("ranks")
+    row_comm = world.Split([r // 4 for r in range(N)])
+    col_comm = world.Split([r % 4 for r in range(N)])
+    arr = per_rank(lambda r: np.float32(r))
+
+    def f(x):
+        return (
+            m4t.allreduce(x, op=m4t.SUM, comm=row_comm),
+            m4t.allreduce(x, op=m4t.SUM, comm=col_comm),
+        )
+
+    rows, cols = run_spmd(f, arr)
+    np.testing.assert_allclose(rows[:4], np.full(4, 6.0))
+    np.testing.assert_allclose(rows[4:], np.full(4, 22.0))
+    for r in range(N):
+        np.testing.assert_allclose(cols[r], (r % 4) * 2 + 4.0)

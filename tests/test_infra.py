@@ -1,0 +1,214 @@
+"""Infrastructure unit tests: validation, communicators, version gate,
+config parsing, debug-log contract, capability queries — the analog of
+the reference's ``test_validation.py`` / ``test_decorators.py`` /
+``test_jax_compat.py`` / ``test_has_cuda.py`` (SURVEY.md §4 item 9)."""
+
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m4t
+from mpi4jax_tpu import config, debug, jax_compat
+from mpi4jax_tpu.comm import CartComm, Comm, resolve_comm
+from mpi4jax_tpu.validation import enforce_types
+
+
+# --- enforce_types (reference test_validation.py) ---
+
+
+def test_enforce_types_accepts():
+    @enforce_types(a=int, b=(str, type(None)))
+    def f(a, b=None):
+        return a
+
+    assert f(1) == 1
+    assert f(1, "x") == 1
+
+
+def test_enforce_types_rejects():
+    @enforce_types(a=int)
+    def f(a):
+        return a
+
+    with pytest.raises(TypeError, match="must be of type int"):
+        f("nope")
+
+
+def test_enforce_types_tracer_error():
+    # the dedicated jit-misuse message (reference validation.py:77-88)
+    @enforce_types(a=int)
+    def f(x, a):
+        return x * a
+
+    with pytest.raises(TypeError, match="static"):
+        jax.jit(f)(jnp.ones(2), 3)
+
+
+def test_enforce_types_unknown_arg():
+    with pytest.raises(ValueError):
+        enforce_types(nope=int)(lambda a: a)
+
+
+# --- communicators ---
+
+
+def test_comm_hashable_and_eq():
+    assert Comm("x") == Comm("x")
+    assert Comm("x") != Comm("y")
+    assert hash(Comm(("a", "b"))) == hash(Comm(("a", "b")))
+    assert Comm("x").Clone() == Comm("x")
+
+
+def test_cartcomm_topology():
+    cart = CartComm(dims=(2, 4), periods=(False, True))
+    assert cart.nranks == 8
+    assert cart.coords(5) == (1, 1)
+    assert cart.rank_at((1, 1)) == 5
+    # periodic x wrap
+    assert cart.neighbor(4, 1, -1) == 7
+    # closed y boundary
+    assert cart.neighbor(1, 0, -1) == m4t.PROC_NULL
+    src, dst = cart.shift(1, +1)
+    assert dst[0] == 1 and src[0] == 3  # ring within row 0
+
+
+def test_cartcomm_shift_mirror():
+    cart = CartComm(dims=(2, 2), periods=(True, True))
+    src, dst = cart.shift(0, 1)
+    for r in range(4):
+        if dst[r] >= 0:
+            assert src[dst[r]] == r
+
+
+def test_resolve_comm_outside_mesh_is_size1():
+    bound = resolve_comm(None)
+    assert bound.size == 1 and bound.axes == ()
+
+
+def test_resolve_comm_type_error():
+    with pytest.raises(TypeError):
+        resolve_comm("world")
+
+
+def test_comm_rank_inside_mesh(run_spmd, per_rank):
+    arr = per_rank(lambda r: np.float32(0))
+    out = run_spmd(
+        lambda x: x + m4t.get_default_comm().Get_rank().astype(jnp.float32), arr
+    )
+    np.testing.assert_allclose(out, np.arange(8.0))
+
+
+# --- version gate (reference test_jax_compat.py) ---
+
+
+def test_versiontuple():
+    assert jax_compat.versiontuple("0.9.0") == (0, 9, 0)
+    assert jax_compat.versiontuple("0.10.1.dev3") == (0, 10, 1)
+    assert jax_compat.versiontuple("1.2") == (1, 2)
+
+
+def test_version_gate_warns(monkeypatch):
+    monkeypatch.delenv("MPI4JAX_TPU_NO_WARN_JAX_VERSION", raising=False)
+    with pytest.warns(UserWarning, match="newer than the latest"):
+        jax_compat.check_jax_version("99.0.0")
+
+
+def test_version_gate_silenced(monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_NO_WARN_JAX_VERSION", "1")
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        jax_compat.check_jax_version("99.0.0")
+
+
+def test_version_gate_minimum():
+    with pytest.raises(RuntimeError, match="requires jax"):
+        jax_compat.check_jax_version("0.4.0")
+
+
+# --- config parsing (reference test_decorators.py truthy/falsy) ---
+
+
+def test_truthy_falsy():
+    assert config.is_truthy("1") and config.is_truthy("ON") and config.is_truthy("true")
+    assert config.is_falsy("0") and config.is_falsy("OFF") and config.is_falsy("false")
+    assert not config.is_truthy("banana")
+
+
+def test_env_flag(monkeypatch):
+    monkeypatch.setenv("M4T_TEST_FLAG", "on")
+    assert config.env_flag("M4T_TEST_FLAG") is True
+    monkeypatch.setenv("M4T_TEST_FLAG", "garbage")
+    assert config.env_flag("M4T_TEST_FLAG", default=False) is False
+
+
+# --- debug-log contract (reference test_common.py:118-146) ---
+
+
+def test_emission_log_format(capsys):
+    m4t.set_logging(True)
+    try:
+        m4t.allreduce(jnp.ones(4), op=m4t.SUM)
+    finally:
+        m4t.set_logging(False)
+    out = capsys.readouterr().out
+    assert re.search(
+        r"emit \| [a-z0-9]{8} \| AllReduce \[4 items, op=SUM, n=1\]", out
+    ), out
+
+
+def test_set_get_logging():
+    m4t.set_logging(True)
+    assert m4t.get_logging() is True
+    m4t.set_logging(False)
+    assert m4t.get_logging() is False
+
+
+# --- capability queries (reference test_has_cuda.py / test_has_sycl.py) ---
+
+
+def test_capability_queries():
+    assert m4t.has_cuda_support() is False
+    assert m4t.has_sycl_support() is False
+    assert isinstance(m4t.has_tpu_support(), bool)
+    assert isinstance(m4t.has_shm_support(), bool)
+
+
+def test_shmcomm_outside_world():
+    with pytest.raises(RuntimeError, match="launch"):
+        m4t.ShmComm()
+
+
+# --- ordering token ---
+
+
+def test_opt_barrier_chain_in_hlo(mesh):
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    sm = partial(
+        shard_map, mesh=mesh, in_specs=P("ranks"), out_specs=P("ranks"),
+        check_vma=False,
+    )
+
+    def f(x):
+        a = m4t.allreduce(x, op=m4t.SUM)
+        b = m4t.allreduce(a * 2, op=m4t.MAX)
+        return b
+
+    txt = jax.jit(sm(f)).lower(jnp.arange(8.0).reshape(8, 1)).as_text()
+    assert txt.count("optimization_barrier") >= 4
+
+
+def test_no_ordering_env(monkeypatch, run_spmd, per_rank):
+    monkeypatch.setattr(config, "NO_ORDERING", True)
+    arr = per_rank(lambda r: np.float32(r))
+    out = run_spmd(lambda x: m4t.allreduce(x, op=m4t.SUM), arr)
+    np.testing.assert_allclose(out, np.full(8, arr.sum()))
