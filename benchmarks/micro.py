@@ -1,0 +1,171 @@
+"""Micro-benchmarks for the five BASELINE.json eval configs.
+
+    python benchmarks/micro.py [--nproc 8] [--platform cpu] [--size-mb 1]
+
+Prints one JSON line per config:
+
+1. README 4-rank allreduce(SUM) on 3x3 zeros (latency);
+2. shallow-water 2x2 halo-exchange step rate;
+3. bcast + scatter/gather fan-out, 1 MB buffers;
+4. alltoall + sendrecv token-ordered pipeline inside one jit;
+5. grad-through-allreduce data-parallel MLP step.
+
+Also reports allreduce bus bandwidth (GB/s/chip) for 1 MB payloads —
+the north-star metric (``BASELINE.json``): bus bytes for a ring
+allreduce are ``2 * (n-1)/n * payload`` per chip.
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timeit(fn, *args, warmup=2, iters=20):
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--nproc", type=int, default=None)
+    p.add_argument("--platform", default=None)
+    p.add_argument("--size-mb", type=float, default=1.0)
+    args = p.parse_args()
+
+    if args.platform == "cpu" and (args.nproc or 0) > 1:
+        # multi-rank CPU needs virtual devices, and the flag must be
+        # set before the backend initializes (cf. tests/conftest.py)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={args.nproc}"
+            ).strip()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mpi4jax_tpu as m4t
+    from mpi4jax_tpu.models import mlp
+    from mpi4jax_tpu.models.shallow_water import (
+        ModelState,
+        ShallowWaterConfig,
+        ShallowWaterModel,
+    )
+    from mpi4jax_tpu.parallel import spmd, world_mesh
+
+    n = args.nproc or len(jax.devices())
+    if n > len(jax.devices()):
+        print(
+            f"# requested --nproc {n} but only {len(jax.devices())} devices; "
+            "clamping",
+            file=sys.stderr,
+        )
+        n = len(jax.devices())
+    mesh = world_mesh(n)
+    results = []
+
+    def report(name, seconds, **extra):
+        rec = {"config": name, "seconds": round(seconds, 6), "nproc": n, **extra}
+        results.append(rec)
+        print(json.dumps(rec))
+
+    # --- config 1: README allreduce latency -----------------------------
+    f1 = spmd(lambda x: m4t.allreduce(x, op=m4t.SUM), mesh=mesh)
+    x1 = jnp.zeros((n, 3, 3))
+    report("readme_allreduce_3x3", timeit(f1, x1))
+
+    # --- bus bandwidth: 1 MB allreduce ----------------------------------
+    count = int(args.size_mb * (1 << 20) / 4)
+    fbw = spmd(lambda x: m4t.allreduce(x, op=m4t.SUM), mesh=mesh)
+    xbw = jnp.ones((n, count), jnp.float32)
+    t = timeit(fbw, xbw)
+    payload = count * 4
+    bus_bytes = 2 * (n - 1) / max(n, 1) * payload
+    report(
+        "allreduce_bus_bandwidth",
+        t,
+        payload_mb=round(payload / (1 << 20), 3),
+        gb_per_s_per_chip=round(bus_bytes / t / 1e9, 3),
+    )
+
+    # --- config 2: shallow-water 2x2 ------------------------------------
+    if n >= 4:
+        cfg = ShallowWaterConfig(nx=360, ny=180, dims=(2, 2))
+        model = ShallowWaterModel(cfg)
+        state = ModelState(
+            *(jnp.asarray(b[: 4]) for b in model.initial_state_blocks())
+        )
+        sub = world_mesh(4)
+        step = spmd(lambda s: model.multistep(s, 10), mesh=sub)
+        t = timeit(step, state, warmup=1, iters=5)
+        report("shallow_water_2x2_step", t / 10, steps_per_s=round(10 / t, 1))
+
+    # --- config 3: bcast + scatter/gather 1 MB --------------------------
+    def fanout(x, blocks):
+        b = m4t.bcast(x, 0)
+        s = m4t.scatter(blocks, 0)
+        g = m4t.gather(s, 0)
+        return b.sum() + g.sum()
+
+    f3 = spmd(fanout, mesh=mesh)
+    x3 = jnp.ones((n, count), jnp.float32)
+    blocks3 = jnp.ones((n, n, max(count // n, 1)), jnp.float32)
+    report("bcast_scatter_gather_1mb", timeit(f3, x3, blocks3))
+
+    # --- config 4: alltoall + sendrecv pipeline in one jit --------------
+    ring_dst = tuple((r + 1) % n for r in range(n))
+    ring_src = tuple((r - 1) % n for r in range(n))
+
+    def pipeline(x):
+        y = m4t.alltoall(x)
+        y = m4t.sendrecv(y, y, ring_src, ring_dst)
+        y = m4t.alltoall(y)
+        return m4t.sendrecv(y, y, ring_dst, ring_src)
+
+    f4 = spmd(pipeline, mesh=mesh)
+    x4 = jnp.ones((n, n, max(count // n, 1)), jnp.float32)
+    report("alltoall_sendrecv_pipeline", timeit(f4, x4))
+
+    # --- config 5: grad-through-allreduce DP MLP ------------------------
+    cfg5 = mlp.MLPConfig(
+        in_dim=256, hidden_dim=1024, out_dim=32, n_blocks=2,
+        tp_axis=None, dp_axis="ranks", tp_size=1,
+    )
+    key = jax.random.PRNGKey(0)
+    params = mlp.init_params(cfg5, key)
+    stack = lambda a: jnp.broadcast_to(a, (n,) + a.shape)
+    params_n = jax.tree.map(stack, params)
+    xb = jnp.ones((n, 32, 256), jnp.float32)
+    yb = jnp.tile(jnp.eye(32, dtype=jnp.float32)[None, :, :], (n, 1, 1))
+
+    def train(p, bx, by):
+        new_p, loss = mlp.train_step(cfg5, p, (bx, by), n_dp=n)
+        # fold an updated-parameter leaf into the output so the
+        # backward pass + gradient allreduces cannot be DCE'd
+        touched = new_p["head"][0][0, 0]
+        return (loss + 0.0 * touched) * jnp.ones(())
+
+    f5 = spmd(train, mesh=mesh)
+    report("dp_mlp_grad_allreduce", timeit(f5, params_n, xb, yb))
+
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -229,6 +229,13 @@ class GroupComm(Comm):
             )
         self.groups = groups
 
+    def Split(self, colors):
+        raise NotImplementedError(
+            "splitting a sub-communicator is not supported yet; Split the "
+            "world Comm with composite colors instead (e.g. "
+            "color = parent_color * k + sub_color)"
+        )
+
     def __hash__(self):
         return hash((type(self).__name__, self._axes, self.groups))
 

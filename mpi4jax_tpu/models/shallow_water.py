@@ -174,49 +174,76 @@ class ShallowWaterModel:
         """Exchange ghost cells with grid neighbors and apply physical
         boundary conditions (reference ``enforce_boundaries``,
         ``shallow_water.py:172-264``)."""
-        assert grid in ("h", "u", "v")
+        (out,) = self.enforce_boundaries_multi((arr,), (grid,), proc_row)
+        return out
+
+    def enforce_boundaries_multi(self, arrs, grids, proc_row=None):
+        """Halo-exchange several fields with **one** CollectivePermute
+        per direction (fields stacked along a leading axis).
+
+        TPU-first optimization over the reference, which exchanges
+        each field separately (``shallow_water.py:270-403`` calls
+        ``enforce_boundaries`` ~10x per step): batching multiplies the
+        per-collective payload and divides the collective count, so
+        the fixed ICI latency is paid once per direction per group of
+        fields. Physical wall conditions still apply per field.
+        """
+        for g in grids:
+            assert g in ("h", "u", "v")
         c = self.config
         cart = self.cart
         npy, npx = c.dims
 
         if c.n_ranks == 1:
-            # Pure local: periodic wrap in x (reference with 1 process
-            # self-sends via MPI; here it is a local copy).
             if c.periodic_x:
-                arr = arr.at[:, -1].set(arr[:, 1])
-                arr = arr.at[:, 0].set(arr[:, -2])
+                arrs = tuple(
+                    a.at[:, -1].set(a[:, 1]).at[:, 0].set(a[:, -2]) for a in arrs
+                )
         else:
+            stack = jnp.stack(arrs)  # (F, ny, nx)
+
             src, dst = self._west
-            arr = arr.at[:, -1].set(
-                sendrecv(arr[:, 1], arr[:, -1], src, dst, sendtag=10, comm=cart)
+            stack = stack.at[:, :, -1].set(
+                sendrecv(stack[:, :, 1], stack[:, :, -1], src, dst,
+                         sendtag=10, comm=cart)
             )
             src, dst = self._north
-            arr = arr.at[0, :].set(
-                sendrecv(arr[-2, :], arr[0, :], src, dst, sendtag=11, comm=cart)
+            stack = stack.at[:, 0, :].set(
+                sendrecv(stack[:, -2, :], stack[:, 0, :], src, dst,
+                         sendtag=11, comm=cart)
             )
             src, dst = self._east
-            arr = arr.at[:, 0].set(
-                sendrecv(arr[:, -2], arr[:, 0], src, dst, sendtag=12, comm=cart)
+            stack = stack.at[:, :, 0].set(
+                sendrecv(stack[:, :, -2], stack[:, :, 0], src, dst,
+                         sendtag=12, comm=cart)
             )
             src, dst = self._south
-            arr = arr.at[-1, :].set(
-                sendrecv(arr[1, :], arr[-1, :], src, dst, sendtag=13, comm=cart)
+            stack = stack.at[:, -1, :].set(
+                sendrecv(stack[:, 1, :], stack[:, -1, :], src, dst,
+                         sendtag=13, comm=cart)
             )
+            arrs = tuple(stack[i] for i in range(len(arrs)))
 
-        if not c.periodic_x and grid == "u":
-            # u = 0 on the eastern wall (reference shallow_water.py:258-259).
-            _, proc_col = self._proc_coords()
-            walled = arr.at[:, -2].set(0.0)
-            arr = jnp.where(proc_col == npx - 1, walled, arr)
+        if proc_row is None and (
+            "v" in grids or (not c.periodic_x and "u" in grids)
+        ):
+            proc_row, _ = self._proc_coords()
 
-        if grid == "v":
-            # v = 0 on the northern wall (reference shallow_water.py:261-262).
-            if proc_row is None:
-                proc_row, _ = self._proc_coords()
-            walled = arr.at[-2, :].set(0.0)
-            arr = jnp.where(proc_row == npy - 1, walled, arr)
-
-        return arr
+        out = []
+        for a, grid in zip(arrs, grids):
+            if not c.periodic_x and grid == "u":
+                # u = 0 on the eastern wall (reference
+                # shallow_water.py:258-259).
+                _, proc_col = self._proc_coords()
+                walled = a.at[:, -2].set(0.0)
+                a = jnp.where(proc_col == npx - 1, walled, a)
+            if grid == "v":
+                # v = 0 on the northern wall (reference
+                # shallow_water.py:261-262).
+                walled = a.at[-2, :].set(0.0)
+                a = jnp.where(proc_row == npy - 1, walled, a)
+            out.append(a)
+        return tuple(out)
 
     # -- dynamics --------------------------------------------------------
 
@@ -244,8 +271,7 @@ class ShallowWaterModel:
         fn = jnp.zeros_like(v)
         fe = with_interior(fe, 0.5 * (hc[1:-1, 1:-1] + hc[1:-1, 2:]) * interior(u))
         fn = with_interior(fn, 0.5 * (hc[1:-1, 1:-1] + hc[2:, 1:-1]) * interior(v))
-        fe = self.enforce_boundaries(fe, "u", proc_row)
-        fn = self.enforce_boundaries(fn, "v", proc_row)
+        fe, fn = self.enforce_boundaries_multi((fe, fn), ("u", "v"), proc_row)
 
         dh_new = jnp.zeros_like(dh)
         dh_new = with_interior(
@@ -308,9 +334,9 @@ class ShallowWaterModel:
             v = v.at[1:-1, 1:-1].add(dt * (a * interior(dv_new) + b * interior(dv)))
             h = h.at[1:-1, 1:-1].add(dt * (a * interior(dh_new) + b * interior(dh)))
 
-        h = self.enforce_boundaries(h, "h", proc_row)
-        u = self.enforce_boundaries(u, "u", proc_row)
-        v = self.enforce_boundaries(v, "v", proc_row)
+        h, u, v = self.enforce_boundaries_multi(
+            (h, u, v), ("h", "u", "v"), proc_row
+        )
 
         if c.viscosity > 0:
             nu = c.viscosity
@@ -320,8 +346,9 @@ class ShallowWaterModel:
                 gn = jnp.zeros_like(f)
                 ge = with_interior(ge, nu * (f[1:-1, 2:] - f[1:-1, 1:-1]) / dx)
                 gn = with_interior(gn, nu * (f[2:, 1:-1] - f[1:-1, 1:-1]) / dy)
-                ge = self.enforce_boundaries(ge, "u", proc_row)
-                gn = self.enforce_boundaries(gn, "v", proc_row)
+                ge, gn = self.enforce_boundaries_multi(
+                    (ge, gn), ("u", "v"), proc_row
+                )
                 upd = dt * (
                     (ge[1:-1, 1:-1] - ge[1:-1, :-2]) / dx
                     + (gn[1:-1, 1:-1] - gn[:-2, 1:-1]) / dy

@@ -57,12 +57,9 @@ def _generic_reduce(x, op: Op, comm: BoundComm):
 
 
 def _shm_reduction_dtype_check(x):
-    from ..runtime.shm import OP_CODES  # noqa: F401  (backend presence)
+    from ..utils.dtypes import is_shm_reduction_dtype
 
-    if x.dtype not in (
-        jnp.float32, jnp.float64, jnp.int8, jnp.int16, jnp.int32,
-        jnp.int64, jnp.uint8, jnp.uint16, jnp.uint32, jnp.uint64, jnp.bool_,
-    ):
+    if not is_shm_reduction_dtype(x.dtype):
         raise NotImplementedError(
             f"dtype {x.dtype} is not supported by the native shm backend "
             "reductions (reference dtype table: _src/utils.py:101-128)"

@@ -1,0 +1,10 @@
+"""Shared utilities (reference ``_src/utils.py`` / ``validation.py``
+analog surface, re-exported for convenience)."""
+
+from .dtypes import (  # noqa: F401
+    SHM_REDUCTION_DTYPES,
+    is_shm_reduction_dtype,
+)
+from ..validation import enforce_types  # noqa: F401
+from ..config import env_flag, is_falsy, is_truthy  # noqa: F401
+from ..token import NOTSET, raise_if_token_is_set  # noqa: F401

@@ -1,0 +1,33 @@
+"""Dtype support tables.
+
+The reference maps numpy dtype names to MPI datatype handles
+(``_src/utils.py:101-128``: f32/f64/f128, c64/c128, i8–i64, u8–u64,
+bool). On the XLA path no marshalling is needed — any dtype XLA can
+AllReduce works — so the tables here describe *reduction* support:
+
+The XLA path needs no dtype table — the native/generic dispatch is by
+operator (``ops/allreduce.py``: psum/pmax/pmin exist for SUM/MAX/MIN,
+anything XLA can add/compare works). The native shm backend's C++
+reductions (``runtime/shmcc.cpp:accumulate_dtype``) cover the
+reference's integer/float set minus ``float128`` (no TPU/XLA meaning)
+and complex; copy ops accept any dtype byte-wise.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: dtypes the native shm backend reduces in C++
+SHM_REDUCTION_DTYPES = frozenset(
+    np.dtype(d)
+    for d in (
+        np.float32, np.float64,
+        np.int8, np.int16, np.int32, np.int64,
+        np.uint8, np.uint16, np.uint32, np.uint64,
+        np.bool_,
+    )
+)
+
+
+def is_shm_reduction_dtype(dtype) -> bool:
+    return np.dtype(dtype) in SHM_REDUCTION_DTYPES

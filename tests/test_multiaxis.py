@@ -1,0 +1,84 @@
+"""Multi-axis communicators: psum-family ops over a Comm spanning a
+2-D mesh's axes (the flat COMM_WORLD view of a (dp, tp) mesh)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import mpi4jax_tpu as m4t
+
+
+@pytest.fixture(scope="module")
+def mesh2d():
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    return Mesh(devs, ("a", "b"))
+
+
+def run2d(mesh2d, fn, stacked):
+    body = lambda x: jax.tree.map(
+        lambda o: o.reshape((1, 1) + o.shape), fn(x.reshape(x.shape[2:]))
+    )
+    out = jax.jit(
+        shard_map(
+            body, mesh=mesh2d, in_specs=P("a", "b"), out_specs=P("a", "b"),
+            check_vma=False,
+        )
+    )(stacked)
+    return jax.tree.map(np.asarray, out)
+
+
+def test_multiaxis_allreduce(mesh2d):
+    comm = m4t.Comm(("a", "b"))
+    arr = np.arange(8.0, dtype=np.float32).reshape(2, 4, 1)
+    out = run2d(mesh2d, lambda x: m4t.allreduce(x, op=m4t.SUM, comm=comm), jnp.asarray(arr))
+    np.testing.assert_allclose(out.ravel(), np.full(8, 28.0))
+
+
+def test_multiaxis_rank_and_size(mesh2d):
+    comm = m4t.Comm(("a", "b"))
+    arr = np.zeros((2, 4, 1), np.float32)
+    out = run2d(
+        mesh2d,
+        lambda x: x + comm.Get_rank().astype(jnp.float32) + 10.0 * comm.Get_size(),
+        jnp.asarray(arr),
+    )
+    np.testing.assert_allclose(out.ravel(), 80.0 + np.arange(8.0))
+
+
+def test_multiaxis_bcast_and_reduce(mesh2d):
+    comm = m4t.Comm(("a", "b"))
+    arr = np.arange(8.0, dtype=np.float32).reshape(2, 4, 1) + 1
+
+    def f(x):
+        b = m4t.bcast(x, 5, comm=comm)
+        r = m4t.reduce(x, m4t.SUM, 0, comm=comm)
+        return b, r
+
+    b, r = run2d(mesh2d, f, jnp.asarray(arr))
+    np.testing.assert_allclose(b.ravel(), np.full(8, 6.0))
+    assert r.ravel()[0] == 36.0  # root gets the sum
+    np.testing.assert_allclose(r.ravel()[1:], arr.ravel()[1:])  # others keep input
+
+
+def test_multiaxis_allgather_generic_op(mesh2d):
+    comm = m4t.Comm(("a", "b"))
+    arr = np.arange(8.0, dtype=np.float32).reshape(2, 4, 1) + 1
+    out = run2d(mesh2d, lambda x: m4t.allreduce(x, op=m4t.PROD, comm=comm), jnp.asarray(arr))
+    np.testing.assert_allclose(out.ravel(), np.full(8, np.prod(np.arange(1.0, 9.0))))
+
+
+def test_multiaxis_p2p_rejected(mesh2d):
+    comm = m4t.Comm(("a", "b"))
+    arr = jnp.zeros((2, 4, 1))
+    with pytest.raises(NotImplementedError, match="single"):
+        run2d(
+            mesh2d,
+            lambda x: m4t.sendrecv(
+                x, x, tuple(range(8)), tuple(range(8)), comm=comm
+            ),
+            arr,
+        )
