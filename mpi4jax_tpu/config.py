@@ -43,3 +43,6 @@ def env_flag(name: str, default: bool = False) -> bool:
 DEBUG_LOGGING = env_flag("MPI4JAX_TPU_DEBUG")
 DEBUG_RUNTIME = env_flag("MPI4JAX_TPU_DEBUG_RUNTIME")
 NO_ORDERING = env_flag("MPI4JAX_TPU_NO_ORDERING")
+#: route large SUM-allreduces through the hand-written Pallas RDMA
+#: ring kernel (ops/pallas_ring.py) instead of HLO AllReduce
+PALLAS_RING = env_flag("MPI4JAX_TPU_PALLAS_RING")

@@ -224,17 +224,17 @@ class ShallowWaterModel:
             )
             arrs = tuple(stack[i] for i in range(len(arrs)))
 
-        if proc_row is None and (
-            "v" in grids or (not c.periodic_x and "u" in grids)
-        ):
+        if proc_row is None and "v" in grids:
             proc_row, _ = self._proc_coords()
+        proc_col = None
+        if not c.periodic_x and "u" in grids:
+            _, proc_col = self._proc_coords()
 
         out = []
         for a, grid in zip(arrs, grids):
             if not c.periodic_x and grid == "u":
                 # u = 0 on the eastern wall (reference
                 # shallow_water.py:258-259).
-                _, proc_col = self._proc_coords()
                 walled = a.at[:, -2].set(0.0)
                 a = jnp.where(proc_col == npx - 1, walled, a)
             if grid == "v":

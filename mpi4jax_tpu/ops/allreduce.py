@@ -78,9 +78,40 @@ def _allreduce_spmd(x, *, op, comm: BoundComm, transpose):
     if not comm.axes or comm.size == 1:
         # World size 1: reduction over a single rank is the identity.
         return x
+    if _use_pallas_ring(x, op, comm):
+        import jax
+
+        from .pallas_ring import ring_allreduce
+
+        return ring_allreduce(
+            x,
+            comm.axes[0],
+            comm.size,
+            interpret=jax.default_backend() != "tpu",
+        )
     if op.native is not None:
         return _native_reduce(x, op, comm)
     return _generic_reduce(x, op, comm)
+
+
+def _use_pallas_ring(x, op, comm: BoundComm) -> bool:
+    """Opt-in (MPI4JAX_TPU_PALLAS_RING=1) hand-scheduled RDMA ring for
+    large float SUM payloads on a plain single-axis communicator."""
+    from .. import config
+
+    nbytes = x.size * x.dtype.itemsize
+    return (
+        config.PALLAS_RING
+        and op is SUM
+        and comm.groups is None
+        and len(comm.axes) == 1
+        and x.dtype in (jnp.float32, jnp.bfloat16)
+        # lower bound: latency-bound payloads stay on HLO AllReduce;
+        # upper bound: the kernel pins input + output + 4 transfer
+        # buffers in ~16 MB VMEM, so cap the resident footprint (larger
+        # payloads need a grid-streamed variant)
+        and (1 << 20) <= nbytes <= (1 << 22)
+    )
 
 
 mpi_allreduce_p = define_primitive(
