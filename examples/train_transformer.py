@@ -1,0 +1,108 @@
+"""Sequence-parallel transformer training demo — no mpirun.
+
+Trains the causal LM from ``mpi4jax_tpu.models.attention`` on a
+synthetic copy task, with the sequence sharded over the device mesh
+(ring attention or Ulysses AllToAll resharding) and gradients synced
+through the framework's differentiable allreduce. The long-context
+counterpart of the shallow-water demo: it exercises
+CollectivePermute rings / AllToAll instead of halo exchanges.
+
+    python examples/train_transformer.py --nproc 8 --steps 20 --platform cpu
+    python examples/train_transformer.py --attention ulysses
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--nproc", type=int, default=None)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--seq-per-rank", type=int, default=16)
+    p.add_argument("--attention", choices=["ring", "ulysses"], default="ring")
+    p.add_argument("--platform", default=None)
+    args = p.parse_args()
+
+    if args.platform == "cpu" and (args.nproc or 0) > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={args.nproc}"
+            ).strip()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi4jax_tpu.models import attention as tfm
+    from mpi4jax_tpu.parallel import spmd, world_mesh
+
+    n = args.nproc or len(jax.devices())
+    n = min(n, len(jax.devices()))
+    mesh = world_mesh(n)
+    t_local = args.seq_per_rank
+    t = n * t_local
+
+    cfg = tfm.TransformerConfig(
+        vocab=64, d_model=64, n_heads=8, n_layers=2, d_ff=128,
+        sp_axis="ranks" if n > 1 else None, sp_size=n,
+        attention=args.attention, learning_rate=0.05,
+    )
+    print(
+        f"training {cfg.n_layers}-layer LM, seq {t} over {n} rank(s), "
+        f"{args.attention} attention",
+        file=sys.stderr,
+    )
+
+    key = jax.random.PRNGKey(0)
+    params = tfm.init_params(cfg, key)
+
+    # synthetic copy task: predict the previous token
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab, size=(t,)), jnp.int32)
+    targets = jnp.roll(tokens, -1)
+
+    if n == 1:
+        step = jax.jit(lambda p: tfm.train_step(cfg, p, tokens, targets))
+        get_loss = lambda out: float(out[1])
+    else:
+        stack = lambda a: jnp.broadcast_to(a, (n,) + a.shape)
+        params = jax.tree.map(stack, params)
+        tok_sp = tokens.reshape(n, t_local)
+        tgt_sp = targets.reshape(n, t_local)
+        step = spmd(
+            lambda pp, tk, tg: tfm.train_step(cfg, pp, tk, tg), mesh=mesh
+        )
+        step = (lambda f: (lambda p: f(p, tok_sp, tgt_sp)))(step)
+        get_loss = lambda out: float(np.asarray(out[1])[0])
+
+    start = time.perf_counter()
+    first = last = None
+    for i in range(args.steps):
+        params, loss = step(params)
+        lval = get_loss((params, loss))
+        if i == 0:
+            first = lval
+        last = lval
+        if i % 5 == 0 or i == args.steps - 1:
+            print(f"step {i:3d}  loss {lval:.4f}", file=sys.stderr)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - start
+    print(
+        f"{args.steps} steps in {elapsed:.2f}s "
+        f"({args.steps / elapsed:.1f} steps/s); loss {first:.4f} -> {last:.4f}",
+        file=sys.stderr,
+    )
+    assert last < first, "loss did not decrease"
+
+
+if __name__ == "__main__":
+    main()

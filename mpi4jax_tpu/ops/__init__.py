@@ -11,8 +11,10 @@ from .reduce import reduce  # noqa: F401
 from .scan import scan  # noqa: F401
 from .scatter import scatter  # noqa: F401
 from .p2p import recv, send, sendrecv  # noqa: F401
+from .reduce_scatter import reduce_scatter  # noqa: F401
 
 __all__ = [
+    "reduce_scatter",
     "allgather",
     "allreduce",
     "alltoall",

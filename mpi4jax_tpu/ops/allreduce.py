@@ -99,8 +99,10 @@ def _use_pallas_ring(x, op, comm: BoundComm) -> bool:
     large float SUM payloads on a plain single-axis communicator."""
     from .. import config
 
+    import jax
+
     nbytes = x.size * x.dtype.itemsize
-    return (
+    if not (
         config.PALLAS_RING
         and op is SUM
         and comm.groups is None
@@ -111,7 +113,16 @@ def _use_pallas_ring(x, op, comm: BoundComm) -> bool:
         # buffers in ~16 MB VMEM, so cap the resident footprint (larger
         # payloads need a grid-streamed variant)
         and (1 << 20) <= nbytes <= (1 << 22)
-    )
+    ):
+        return False
+    # The kernel addresses ring neighbors by LOGICAL device id ==
+    # axis_index, which only holds when the comm axis spans the entire
+    # mesh (a 1-D mesh). On a multi-axis mesh the ids would hit other
+    # rows' devices and deadlock — stay on HLO AllReduce there.
+    try:
+        return lax.axis_size(comm.axes[0]) == jax.device_count()
+    except Exception:
+        return False
 
 
 mpi_allreduce_p = define_primitive(

@@ -11,8 +11,10 @@ ring-collective pattern of the Pallas TPU guide. It is the
 C++ layer — except the transport here is the TPU ICI itself.
 
 Opt-in via ``MPI4JAX_TPU_PALLAS_RING=1`` (routes SUM-allreduce of
-float32/bfloat16 payloads >= 1 MiB through this kernel) or call
-:func:`ring_allreduce` directly. Correctness is validated in Pallas
+float32/bfloat16 payloads in the 1–4 MiB VMEM-resident window, on a
+communicator spanning a 1-D mesh, through this kernel — see
+``_use_pallas_ring`` in ``ops/allreduce.py`` for the exact predicate)
+or call :func:`ring_allreduce` directly. Correctness is validated in Pallas
 interpret mode on the virtual CPU mesh (``tests/test_pallas_ring.py``);
 the compiled path targets real multi-chip ICI.
 """

@@ -1,0 +1,111 @@
+"""reduce_scatter — reduction + block distribution in one collective.
+
+**Superset op** (not in the reference's twelve): ``MPI_Reduce_scatter_block``
+semantics. It exists because it is a *primitive* of the TPU fabric —
+HLO ReduceScatter (``lax.psum_scatter``) is one of XLA's four native
+collectives and the bandwidth-optimal half of every ring allreduce —
+and because sharded-optimizer data parallelism (ZeRO) is built on it.
+Keeping it an explicit op lets users write
+``reduce_scatter`` + ``allgather`` instead of ``allreduce`` when the
+result is consumed sharded.
+
+Semantics: input ``(size, *block)`` per rank; rank r receives
+``sum_over_ranks(x[:, r])`` — i.e. block r of the elementwise
+reduction. SUM only on the native path (MAX/MIN fall back to
+allreduce + slice).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+from jax.core import ShapedArray
+from jax.interpreters import ad
+
+from ..comm import BoundComm, Comm, Op, SUM, resolve_comm
+from ..token import NOTSET, raise_if_token_is_set
+from ..validation import enforce_types
+from ._core import define_primitive, emit
+
+
+def _reduce_scatter_abstract_eval(x, *, op, comm: BoundComm):
+    return ShapedArray(x.shape[1:], x.dtype)
+
+
+def _reduce_scatter_spmd(x, *, op: Op, comm: BoundComm):
+    if comm.backend == "shm":
+        from ..runtime import shm as _shm
+        from .allreduce import _shm_reduction_dtype_check
+
+        _shm_reduction_dtype_check(x)
+        reduced = _shm.allreduce(x, op)
+        return reduced[comm.shm_rank]
+    if not comm.axes or comm.size == 1:
+        return x[0]
+    axis = comm.require_single_axis("reduce_scatter")
+    _, kw = comm.collective_kwargs()
+    if op is SUM and jnp.issubdtype(x.dtype, jnp.number):
+        return lax.psum_scatter(x, axis, scatter_dimension=0, tiled=False, **kw)
+    from .allreduce import _allreduce_spmd
+
+    reduced = _allreduce_spmd(x, op=op, comm=comm, transpose=False)
+    return lax.dynamic_index_in_dim(reduced, comm.rank(), 0, keepdims=False)
+
+
+mpi_reduce_scatter_p = define_primitive(
+    "tpu_reduce_scatter",
+    abstract_eval=_reduce_scatter_abstract_eval,
+    spmd_impl=_reduce_scatter_spmd,
+)
+
+
+# AD: reduce_scatter(SUM) is linear; its transpose under the
+# reference's replicated-cotangent convention is the all-gather of the
+# per-rank cotangent blocks (the exact dual of allgather, mirroring
+# allreduce <-> identity).
+def _rs_jvp(primals, tangents, *, op, comm):
+    if op is not SUM:
+        raise NotImplementedError("reduce_scatter AD requires op=SUM")
+    (x,), (t,) = primals, tangents
+    out = mpi_reduce_scatter_p.bind(x, op=op, comm=comm)
+    if isinstance(t, ad.Zero):
+        return out, ad.Zero.from_primal_value(out)
+    return out, mpi_reduce_scatter_p.bind(t, op=op, comm=comm)
+
+
+def _rs_transpose(ct, x, *, op, comm):
+    if op is not SUM:
+        raise NotImplementedError("reduce_scatter AD requires op=SUM")
+    if isinstance(ct, ad.Zero):
+        return (ct,)
+    from .allgather import mpi_allgather_p
+
+    return (mpi_allgather_p.bind(ct, comm=comm),)
+
+
+ad.primitive_jvps[mpi_reduce_scatter_p] = _rs_jvp
+ad.primitive_transposes[mpi_reduce_scatter_p] = _rs_transpose
+
+
+@enforce_types(op=Op, comm=(type(None), Comm))
+def reduce_scatter(x, op=SUM, *, comm=None, token=NOTSET):
+    """Reduce elementwise across ranks and scatter the blocks: rank r
+    gets block r of the reduction. Input leading axis must equal the
+    communicator size."""
+    raise_if_token_is_set(token)
+    bound = resolve_comm(comm)
+    x = jnp.asarray(x)
+    if x.ndim < 1 or x.shape[0] != bound.size:
+        raise ValueError(
+            f"reduce_scatter input must have leading axis of size "
+            f"{bound.size} (the communicator size), got shape {x.shape}"
+        )
+    (out,) = emit(
+        mpi_reduce_scatter_p,
+        (x,),
+        dict(op=op, comm=bound),
+        opname="ReduceScatter",
+        details=f"[{x.size} items, op={op.name}, n={bound.size}]",
+        bound_comm=bound,
+    )
+    return out

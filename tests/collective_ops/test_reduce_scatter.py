@@ -1,0 +1,73 @@
+"""reduce_scatter (superset op) tests: oracle, AD duality with
+allgather, shm backend, split comms."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m4t
+
+N = 8
+
+
+def test_reduce_scatter_sum(run_spmd, per_rank):
+    arr = per_rank(lambda r: np.arange(N * 3, dtype=np.float32).reshape(N, 3) + r)
+    out = run_spmd(lambda x: m4t.reduce_scatter(x, op=m4t.SUM), arr)
+    total = arr.sum(axis=0)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], total[r])
+
+
+def test_reduce_scatter_max(run_spmd, per_rank):
+    arr = per_rank(lambda r: np.arange(N, dtype=np.float32) * (-1.0) ** r)
+    out = run_spmd(lambda x: m4t.reduce_scatter(x[:, None], op=m4t.MAX), arr)
+    expected = np.abs(arr[0])
+    np.testing.assert_allclose(out.ravel(), expected)
+
+
+def test_reduce_scatter_allgather_roundtrip(run_spmd, per_rank):
+    # reduce_scatter then allgather == allreduce (the ring identity)
+    arr = per_rank(lambda r: np.arange(N * 2, dtype=np.float32).reshape(N, 2) * (r + 1))
+
+    def f(x):
+        return m4t.allgather(m4t.reduce_scatter(x, op=m4t.SUM))
+
+    out = run_spmd(f, arr)
+    total = arr.sum(axis=0)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], total)
+
+
+def test_reduce_scatter_grad(run_spmd, per_rank):
+    # transpose(reduce_scatter) = allgather: grad of sum(rs(x)) gives
+    # ones in the rank's own block position on every rank
+    arr = per_rank(lambda r: np.ones((N, 2), np.float32) * (r + 1))
+
+    def f(x):
+        return jax.grad(lambda y: m4t.reduce_scatter(y, op=m4t.SUM).sum())(x)
+
+    out = run_spmd(f, arr)
+    np.testing.assert_allclose(out, np.ones_like(arr))
+
+
+def test_reduce_scatter_wrong_shape():
+    with pytest.raises(ValueError, match="leading axis"):
+        m4t.reduce_scatter(jnp.zeros((3, 2)))
+
+
+def test_reduce_scatter_split(run_spmd, per_rank):
+    comm = m4t.Comm("ranks").Split([r // 4 for r in range(N)])
+    arr = per_rank(lambda r: np.arange(4.0, dtype=np.float32) + r)
+    out = run_spmd(lambda x: m4t.reduce_scatter(x[:, None], op=m4t.SUM, comm=comm), arr)
+    for r in range(N):
+        grp = range(4) if r < 4 else range(4, 8)
+        gr = r % 4
+        expected = sum(arr[q][gr] for q in grp)
+        np.testing.assert_allclose(out[r].ravel(), [expected])
+
+
+def test_reduce_scatter_size1():
+    x = jnp.arange(3.0).reshape(1, 3)
+    np.testing.assert_allclose(m4t.reduce_scatter(x), x[0])
