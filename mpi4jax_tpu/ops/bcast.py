@@ -20,6 +20,7 @@ import numpy as np
 
 import jax.numpy as jnp
 from jax import lax
+from jax.interpreters import ad
 
 from ..comm import BoundComm, Comm, resolve_comm
 from ..token import NOTSET, raise_if_token_is_set
@@ -56,6 +57,34 @@ mpi_bcast_p = define_primitive(
     spmd_impl=_bcast_spmd,
 )
 register_passthrough_batcher(mpi_bcast_p)
+
+
+# AD (superset over the reference, which leaves bcast
+# non-differentiable): under the replicated-cotangent convention that
+# makes transpose(SUM-allreduce) the identity (allreduce.py), the dual
+# of "replicate the root's value" is "keep the root's cotangent":
+# non-root ranks contributed nothing to the broadcast value, and the
+# replicated copies of the cotangent are one logical cotangent, not n.
+def _bcast_jvp(primals, tangents, *, root, comm):
+    (x,), (t,) = primals, tangents
+    out = mpi_bcast_p.bind(x, root=root, comm=comm)
+    if isinstance(t, ad.Zero):
+        return out, ad.Zero.from_primal_value(out)
+    return out, mpi_bcast_p.bind(t, root=root, comm=comm)
+
+
+def _bcast_transpose(ct, x, *, root, comm):
+    if isinstance(ct, ad.Zero):
+        return (ct,)
+    if comm.size == 1:
+        return (ct,)
+    # comm.rank() is valid on both backends (static shm_rank on shm).
+    rank = comm.rank()
+    return (jnp.where(rank == root, ct, jnp.zeros_like(ct)),)
+
+
+ad.primitive_jvps[mpi_bcast_p] = _bcast_jvp
+ad.primitive_transposes[mpi_bcast_p] = _bcast_transpose
 
 
 @enforce_types(root=(int, np.integer), comm=(type(None), Comm))

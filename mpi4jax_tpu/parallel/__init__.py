@@ -14,5 +14,7 @@ from .mesh import (  # noqa: F401
     world_mesh,
 )
 from .halo import HaloExchange2D  # noqa: F401
+from .moe import moe_ffn  # noqa: F401
+from .pipeline import gpipe  # noqa: F401
 from .ring import ring_attention  # noqa: F401
 from .ulysses import heads_to_seq, seq_to_heads, ulysses_attention  # noqa: F401

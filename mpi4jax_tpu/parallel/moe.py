@@ -1,0 +1,99 @@
+"""Expert parallelism: Mixture-of-Experts dispatch/combine on alltoall.
+
+The classic expert-parallel pattern (Switch/GShard): each rank hosts
+one expert; tokens are routed top-1, packed into fixed-capacity
+buffers, exchanged with a single AllToAll (:func:`mpi4jax_tpu.alltoall`
+— the same "distributed transpose" the reference exercises,
+``alltoall.py:43-74``), processed by the local expert, and combined
+with the inverse AllToAll. Everything is static-shaped (capacity
+dropping) and differentiable end-to-end through the alltoall AD rules.
+
+This is the ``ep`` member of the parallelism families (dp/tp/sp/ep)
+exercised by ``__graft_entry__.dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..comm import Comm, resolve_comm
+from ..ops import alltoall
+
+
+class RoutingInfo(NamedTuple):
+    expert: jax.Array     # (T,) int32: chosen expert per token
+    gate: jax.Array       # (T,) float: gate weight of the chosen expert
+    slot: jax.Array       # (T,) int32: position within the expert buffer
+    kept: jax.Array       # (T,) bool: token survived the capacity limit
+
+
+def route_top1(router_logits, capacity: int) -> RoutingInfo:
+    """Top-1 routing with per-expert capacity (tokens beyond capacity
+    are dropped, Switch-Transformer style)."""
+    n_experts = router_logits.shape[-1]
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+    gate = jnp.max(probs, axis=-1)
+    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.int32)
+    slot = (jnp.cumsum(onehot, axis=0) - 1)  # (T, E)
+    slot = jnp.take_along_axis(slot, expert[:, None], axis=1)[:, 0]
+    kept = slot < capacity
+    return RoutingInfo(expert, gate, slot, kept)
+
+
+def dispatch(x, info: RoutingInfo, n_experts: int, capacity: int,
+             *, comm: Optional[Comm] = None):
+    """Pack tokens into (n_experts, capacity, d) buffers and exchange:
+    returns (n_ranks, capacity, d) — every source rank's tokens for
+    *this* rank's expert."""
+    d = x.shape[-1]
+    buf = jnp.zeros((n_experts, capacity, d), x.dtype)
+    contrib = jnp.where(info.kept[:, None], x, jnp.zeros_like(x))
+    slot = jnp.where(info.kept, info.slot, 0)
+    buf = buf.at[info.expert, slot].add(contrib)
+    return alltoall(buf, comm=comm)
+
+
+def combine(expert_out, info: RoutingInfo, n_experts: int, capacity: int,
+            *, comm: Optional[Comm] = None):
+    """Inverse of :func:`dispatch`: exchange back and unpack each
+    token's expert output, weighted by its gate (dropped tokens get
+    zeros)."""
+    returned = alltoall(expert_out, comm=comm)  # (n_experts, capacity, d)
+    slot = jnp.where(info.kept, info.slot, 0)
+    gathered = returned[info.expert, slot]
+    gathered = jnp.where(info.kept[:, None], gathered, jnp.zeros_like(gathered))
+    return gathered * info.gate[:, None].astype(gathered.dtype)
+
+
+def moe_ffn(x, router_w, w_up, w_down, *, capacity_factor: float = 2.0,
+            comm: Optional[Comm] = None):
+    """One expert-parallel FFN layer: each rank hosts one expert
+    (``w_up``: (d, ff), ``w_down``: (ff, d) are the *local* expert's
+    weights; ``router_w``: (d, n_ranks) is replicated).
+
+    Returns (T_local, d) with dropped-token zeros, plus the fraction of
+    tokens kept (for load-balance monitoring).
+    """
+    bound = resolve_comm(comm)
+    n = bound.size
+    if router_w.shape[-1] != n:
+        raise ValueError(
+            f"router has {router_w.shape[-1]} expert columns but the "
+            f"communicator has {n} ranks (one expert per rank); routed "
+            "tokens for nonexistent experts would be silently dropped"
+        )
+    t = x.shape[0]
+    capacity = max(int(capacity_factor * t / max(n, 1)), 1)
+
+    info = route_top1(x @ router_w, capacity)
+    expert_in = dispatch(x, info, n, capacity, comm=comm)  # (n, C, d)
+    flat = expert_in.reshape(-1, x.shape[-1])
+    act = jax.nn.gelu(flat @ w_up)
+    out = (act @ w_down).reshape(n, capacity, -1)
+    y = combine(out, info, n, capacity, comm=comm)
+    kept_frac = info.kept.mean()
+    return y, kept_frac

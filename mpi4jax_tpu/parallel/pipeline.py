@@ -1,0 +1,79 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule on the ring.
+
+Each rank hosts one pipeline stage; activations flow rank → rank+1
+through :func:`mpi4jax_tpu.sendrecv` (one CollectivePermute per tick —
+ICI-neighbor traffic only). With M microbatches and n stages the
+schedule runs ``M + n - 1`` ticks; every rank applies its stage each
+tick and forwards the result, so the pipeline fills, streams, and
+drains exactly like GPipe. Because ``sendrecv`` is differentiable with
+edge-reversing transpose, ``jax.grad`` through the schedule *is* the
+backward pipeline — no hand-written reverse schedule needed.
+
+This is the ``pp`` member of the parallelism families exercised by
+``__graft_entry__.dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..comm import Comm, resolve_comm
+from ..ops import bcast, sendrecv
+
+
+def gpipe(
+    stage_fn: Callable,
+    stage_params,
+    microbatches,
+    *,
+    comm: Optional[Comm] = None,
+):
+    """Run ``stage_fn(stage_params, h)`` as this rank's pipeline stage.
+
+    Args:
+        stage_fn: the per-stage computation; activations keep one
+            shape ``(B, ...)`` across stages.
+        stage_params: this rank's stage parameters.
+        microbatches: ``(M, B, ...)`` — the *input* microbatches; only
+            rank 0 reads them (pass the same array on every rank).
+        comm: communicator whose axis orders the stages.
+
+    Returns:
+        ``(M, B, ...)`` outputs of the final stage (valid on every
+        rank; garbage elsewhere is masked out).
+    """
+    bound = resolve_comm(comm)
+    n = bound.size
+    m = microbatches.shape[0]
+    rank = bound.rank()
+
+    if n == 1:
+        return jax.vmap(lambda h: stage_fn(stage_params, h))(microbatches)
+
+    fwd_dst = tuple((r + 1) if r + 1 < n else -1 for r in range(n))
+    fwd_src = tuple((r - 1) if r >= 1 else -1 for r in range(n))
+
+    buf = jnp.zeros_like(microbatches[0])
+    outputs = jnp.zeros_like(microbatches)
+
+    for t in range(m + n - 1):
+        # stage input: rank 0 injects microbatch t while filling
+        feed = buf
+        if t < m:
+            feed = jnp.where(rank == 0, microbatches[t], buf)
+        h = stage_fn(stage_params, feed)
+        # the last stage emits microbatch t - (n - 1)
+        out_idx = t - (n - 1)
+        if 0 <= out_idx < m:
+            updated = outputs.at[out_idx].set(h)
+            outputs = jnp.where(rank == n - 1, updated, outputs)
+        # forward the activation one stage down the pipe
+        buf = sendrecv(h, buf, fwd_src, fwd_dst, sendtag=30 + (t % 2), comm=comm)
+
+    # final-stage outputs are only on rank n-1; broadcast so every
+    # rank returns the same result (callers often need it replicated —
+    # e.g. the loss); callers that don't can slice rank n-1's copy.
+    return bcast(outputs, n - 1, comm=comm)

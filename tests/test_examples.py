@@ -1,0 +1,56 @@
+"""Integration tests running the bundled examples end-to-end as real
+CLI programs (reference ``tests/test_examples.py:20-24`` runs the
+shallow-water demo for a model day)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_example(script, *args, timeout=280):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script), *args],
+        env=env, capture_output=True, text=True, timeout=timeout, cwd=REPO,
+    )
+
+
+@pytest.mark.parametrize("nproc", ["1", "8"])
+def test_shallow_water_example(nproc):
+    res = run_example(
+        "shallow_water.py",
+        "--benchmark", "--nproc", nproc, "--days", "0.02", "--platform", "cpu",
+    )
+    assert res.returncode == 0, res.stderr
+    assert "Solution took" in res.stderr
+    assert "steps/s" in res.stderr
+
+
+def test_transformer_example_ring():
+    res = run_example(
+        "train_transformer.py",
+        "--nproc", "4", "--steps", "8", "--platform", "cpu",
+    )
+    assert res.returncode == 0, res.stderr
+    assert "steps/s" in res.stderr
+
+
+def test_bench_smoke():
+    env = dict(os.environ)
+    env.update(M4T_BENCH_PLATFORM="cpu", M4T_BENCH_SCALE="1")
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=280, cwd=REPO,
+    )
+    assert res.returncode == 0, res.stderr
+    import json
+
+    line = [l for l in res.stdout.splitlines() if l.startswith("{")][-1]
+    rec = json.loads(line)
+    assert rec["metric"] == "shallow_water_100x_solve"
+    assert rec["unit"] == "s" and rec["value"] > 0

@@ -1,0 +1,83 @@
+"""Expert-parallel MoE: the distributed dispatch/combine over alltoall
+must reproduce the single-device computation (each token processed by
+its routed expert, gate-weighted), and train end-to-end."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mpi4jax_tpu.parallel import moe
+
+N = 8
+T = 16   # tokens per rank
+D = 8
+FF = 16
+
+
+@pytest.fixture()
+def weights():
+    rng = np.random.RandomState(0)
+    router = rng.randn(D, N).astype(np.float32) * 0.5
+    w_up = rng.randn(N, D, FF).astype(np.float32) / np.sqrt(D)
+    w_down = rng.randn(N, FF, D).astype(np.float32) / np.sqrt(FF)
+    return router, w_up, w_down
+
+
+def reference_moe(x, router, w_up, w_down, capacity):
+    """Single-process oracle with the same routing + capacity rules."""
+    probs = jax.nn.softmax(jnp.asarray(x) @ router, axis=-1)
+    probs = np.asarray(probs)
+    expert = probs.argmax(-1)
+    gate = probs.max(-1)
+    out = np.zeros_like(x)
+    counts = {e: 0 for e in range(N)}
+    for i, (e, g) in enumerate(zip(expert, gate)):
+        if counts[e] >= capacity:
+            continue
+        counts[e] += 1
+        h = np.asarray(jax.nn.gelu(jnp.asarray(x[i] @ w_up[e])))
+        out[i] = (h @ w_down[e]) * g
+    return out
+
+
+def test_moe_matches_single_device(run_spmd, weights):
+    router, w_up, w_down = weights
+    rng = np.random.RandomState(1)
+    x_all = rng.randn(N, T, D).astype(np.float32)
+    capacity = max(int(2.0 * T / N), 1)
+
+    def f(x, wu, wd):
+        y, kept = moe.moe_ffn(
+            x, jnp.asarray(router), wu, wd, capacity_factor=2.0
+        )
+        return y, kept * jnp.ones(())
+
+    out, kept = run_spmd(f, jnp.asarray(x_all), jnp.asarray(w_up), jnp.asarray(w_down))
+
+    # oracle: per source rank, tokens routed independently but capacity
+    # applies per (rank, expert) pair locally before dispatch
+    for r in range(N):
+        expected = reference_moe(x_all[r], jnp.asarray(router), w_up, w_down, capacity)
+        np.testing.assert_allclose(out[r], expected, rtol=2e-4, atol=2e-5)
+    assert kept.min() > 0.3  # sane routing, not all dropped
+
+
+def test_moe_differentiable(run_spmd, weights):
+    router, w_up, w_down = weights
+    rng = np.random.RandomState(2)
+    x_all = rng.randn(N, T, D).astype(np.float32)
+
+    def f(x, wu, wd):
+        def loss(wu_):
+            y, _ = moe.moe_ffn(x, jnp.asarray(router), wu_, wd)
+            return (y ** 2).sum()
+
+        g = jax.grad(loss)(wu)
+        return g
+
+    grads = run_spmd(f, jnp.asarray(x_all), jnp.asarray(w_up), jnp.asarray(w_down))
+    assert np.isfinite(grads).all()
+    # the gradient must be nonzero for experts that received tokens
+    assert np.abs(grads).sum() > 0

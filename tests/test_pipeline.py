@@ -1,0 +1,91 @@
+"""Pipeline parallelism: the GPipe schedule over the ring must equal
+the sequential composition of all stages, forward and backward."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mpi4jax_tpu.parallel.pipeline import gpipe
+
+N = 8
+M = 4   # microbatches
+B = 3   # microbatch size
+D = 5
+
+
+def stage_fn(params, h):
+    w, b = params
+    return jnp.tanh(h @ w + b)
+
+
+@pytest.fixture()
+def stage_weights():
+    rng = np.random.RandomState(0)
+    w = rng.randn(N, D, D).astype(np.float32) / np.sqrt(D)
+    b = rng.randn(N, D).astype(np.float32) * 0.1
+    return w, b
+
+
+def sequential(w, b, x):
+    h = x
+    for s in range(N):
+        h = np.tanh(h @ w[s] + b[s])
+    return h
+
+
+def test_gpipe_forward(run_spmd, stage_weights):
+    w, b = stage_weights
+    rng = np.random.RandomState(1)
+    x = rng.randn(M, B, D).astype(np.float32)
+
+    def f(wl, bl, mb):
+        return gpipe(stage_fn, (wl, bl), mb)
+
+    mb_stack = np.tile(x, (N, 1, 1, 1))
+    out = run_spmd(f, jnp.asarray(w), jnp.asarray(b), jnp.asarray(mb_stack))
+
+    expected = np.stack([sequential(w, b, x[i]) for i in range(M)])
+    for r in range(N):
+        np.testing.assert_allclose(out[r], expected, rtol=2e-4, atol=1e-5)
+
+
+def test_gpipe_backward(run_spmd, stage_weights):
+    """jax.grad through the schedule = the backward pipeline; per-stage
+    weight grads must match the sequential model's."""
+    w, b = stage_weights
+    rng = np.random.RandomState(2)
+    x = rng.randn(M, B, D).astype(np.float32)
+
+    def f(wl, bl, mb):
+        def loss(wl_):
+            out = gpipe(stage_fn, (wl_, bl), mb)
+            return (out ** 2).sum()
+
+        return jax.grad(loss)(wl)
+
+    mb_stack = np.tile(x, (N, 1, 1, 1))
+    grads = run_spmd(f, jnp.asarray(w), jnp.asarray(b), jnp.asarray(mb_stack))
+
+    # sequential ground truth: grad w.r.t. each stage's weights
+    def seq_loss(w_all):
+        total = 0.0
+        for i in range(M):
+            h = jnp.asarray(x[i])
+            for s in range(N):
+                h = jnp.tanh(h @ w_all[s] + jnp.asarray(b[s]))
+            total = total + (h ** 2).sum()
+        return total
+
+    g_ref = np.asarray(jax.grad(seq_loss)(jnp.asarray(w)))
+    for r in range(N):
+        np.testing.assert_allclose(grads[r], g_ref[r], rtol=2e-3, atol=1e-4)
+
+
+def test_gpipe_single_rank(stage_weights):
+    w, b = stage_weights
+    x = np.ones((M, B, D), np.float32)
+    out = gpipe(stage_fn, (jnp.asarray(w[0]), jnp.asarray(b[0])), jnp.asarray(x))
+    expected = np.tanh(x @ w[0] + b[0])
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5)
