@@ -54,16 +54,21 @@ def main():
     state = ModelState(*(jnp.asarray(b[0]) for b in blocks))
 
     first = jax.jit(lambda s: model.step(s, first_step=True))
-    multi = jax.jit(lambda s: model.multistep(s, multistep))
+    # donate the state: the hot loop updates in place in HBM
+    multi = jax.jit(lambda s: model.multistep(s, multistep), donate_argnums=0)
 
     state = first(state)
-    multi(state)[0].block_until_ready()  # compile warm-up (excluded)
+    # compile warm-up (excluded from timing); the state is donated, so
+    # keep the advanced result and time one call fewer
+    state = multi(state)
+    state[0].block_until_ready()
 
     start = time.perf_counter()
-    for _ in range(n_calls):
+    for _ in range(max(n_calls - 1, 1)):
         state = multi(state)
     state[0].block_until_ready()
     elapsed = time.perf_counter() - start
+    elapsed = elapsed * n_calls / max(n_calls - 1, 1)  # normalize to full span
 
     assert bool(jnp.isfinite(state.h).all()), "solver diverged"
 

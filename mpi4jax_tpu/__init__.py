@@ -49,6 +49,7 @@ from .comm import (  # noqa: F401
     get_default_comm,
     resolve_comm,
 )
+from .ops.quantized import quantized_allreduce  # noqa: F401
 from .ops import (  # noqa: F401
     allgather,
     allreduce,
@@ -124,6 +125,7 @@ __all__ = [
     "recv",
     "reduce",
     "reduce_scatter",
+    "quantized_allreduce",
     "scan",
     "scatter",
     "send",

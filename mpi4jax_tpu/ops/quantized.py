@@ -1,0 +1,114 @@
+"""Quantized ring all-reduce: int8 transfers, float32 accumulation.
+
+Inspired by EQuARX ("Efficient Quantized AllReduce in XLA",
+arXiv:2506.17615 — retrieved context, PAPERS.md): on bandwidth-bound
+interconnects, quantizing the *wire format* of an allreduce to int8
+cuts transferred bytes ~4x at a small, bounded accuracy cost. XLA's
+own AllReduce cannot change its wire format, so this implements the
+collective explicitly as a reduce-scatter + all-gather ring of
+CollectivePermutes whose payloads are block-wise int8 (absmax scale
+per 256-value block):
+
+- reduce-scatter hops: dequantize incoming partial, accumulate in
+  f32, requantize before forwarding (n-1 requantizations — the EQuARX
+  error model);
+- all-gather hops: the final reduced chunk is quantized once and then
+  forwarded verbatim (no further loss).
+
+Exposed as :func:`quantized_allreduce`; forward-only (gradients should
+use the exact allreduce). Works on any backend since it is pure
+lax/jnp — the int8 CollectivePermutes ride ICI on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..comm import Comm, resolve_comm
+from ..token import NOTSET, raise_if_token_is_set
+from ..validation import enforce_types
+
+_BLOCK = 256
+
+
+def _quantize(x):
+    """Block-wise absmax int8 quantization. x: (c,) f32, c % _BLOCK == 0.
+    Returns (q int8 (c,), scales f32 (c/_BLOCK,))."""
+    blocks = x.reshape(-1, _BLOCK)
+    absmax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(blocks / scale), -127, 127).astype(jnp.int8)
+    return q.reshape(-1), scale.reshape(-1)
+
+
+def _dequantize(q, scales):
+    blocks = q.reshape(-1, _BLOCK).astype(jnp.float32)
+    return (blocks * scales[:, None]).reshape(-1)
+
+
+@enforce_types(comm=(type(None), Comm))
+def quantized_allreduce(x, *, comm=None, token=NOTSET):
+    """SUM all-reduce with int8 wire format (~4x fewer bytes moved).
+
+    Accuracy: relative error ~1e-2 scaling mildly with world size (the
+    reduce-scatter phase requantizes at each of the n-1 hops). Use for
+    bandwidth-bound, precision-tolerant reductions (gradient
+    compression); the exact :func:`~mpi4jax_tpu.allreduce` remains the
+    default everywhere else.
+    """
+    raise_if_token_is_set(token)
+    bound = resolve_comm(comm)
+    n = bound.size
+    if n == 1:
+        return x
+    axis = bound.require_single_axis("quantized_allreduce")
+    if bound.backend == "shm":
+        raise NotImplementedError(
+            "quantized_allreduce is an ICI wire-format optimization; on "
+            "the shm backend use the exact allreduce"
+        )
+
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.astype(jnp.float32).reshape(-1)
+    total = flat.shape[0]
+    chunk = -(-total // n)
+    chunk = -(-chunk // _BLOCK) * _BLOCK  # per-rank chunk, block-aligned
+    flat = jnp.pad(flat, (0, n * chunk - total))
+    chunks = flat.reshape(n, chunk)
+
+    rank = bound.rank()
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    fwd = list(bound.to_global_edges(fwd))
+
+    def take_chunk(idx):
+        return lax.dynamic_index_in_dim(chunks, idx, 0, keepdims=False)
+
+    # --- reduce-scatter ring: int8 partials, f32 accumulation ---------
+    carry = take_chunk(rank)  # own contribution of chunk `rank`
+    for s in range(n - 1):
+        q, scales = _quantize(carry)
+        q_in = lax.ppermute(q, axis, fwd)
+        sc_in = lax.ppermute(scales, axis, fwd)
+        recv_idx = lax.rem(rank - s - 1 + n, n)
+        carry = _dequantize(q_in, sc_in) + take_chunk(recv_idx)
+
+    # carry = full sum of chunk (rank + 1) % n
+    # --- all-gather ring: quantize once, forward verbatim -------------
+    q, scales = _quantize(carry)
+    out = jnp.zeros((n, chunk), jnp.float32)
+    own_idx = lax.rem(rank + 1, n)
+    out = lax.dynamic_update_index_in_dim(
+        out, _dequantize(q, scales), own_idx, 0
+    )
+    for s in range(n - 1):
+        q = lax.ppermute(q, axis, fwd)
+        scales = lax.ppermute(scales, axis, fwd)
+        idx = lax.rem(rank - s + n, n)
+        out = lax.dynamic_update_index_in_dim(
+            out, _dequantize(q, scales), idx, 0
+        )
+
+    return out.reshape(-1)[:total].reshape(orig_shape).astype(orig_dtype)

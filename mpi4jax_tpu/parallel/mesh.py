@@ -58,7 +58,13 @@ def world_mesh(n: Optional[int] = None, axis: str = WORLD_AXIS) -> Mesh:
     return Mesh(dev_array, (axis,))
 
 
-def spmd(fn=None, *, mesh: Optional[Mesh] = None, axis: str = WORLD_AXIS):
+def spmd(
+    fn=None,
+    *,
+    mesh: Optional[Mesh] = None,
+    axis: str = WORLD_AXIS,
+    donate_argnums=(),
+):
     """Run ``fn`` as an SPMD per-rank program over the world mesh.
 
     Every array argument must have a leading axis equal to the mesh
@@ -68,7 +74,7 @@ def spmd(fn=None, *, mesh: Optional[Mesh] = None, axis: str = WORLD_AXIS):
     communicator against ``axis``.
     """
     if fn is None:
-        return partial(spmd, mesh=mesh, axis=axis)
+        return partial(spmd, mesh=mesh, axis=axis, donate_argnums=donate_argnums)
 
     # One jitted wrapper per mesh, built lazily and cached so repeat
     # calls are jit-cache hits instead of fresh retraces.
@@ -92,7 +98,7 @@ def spmd(fn=None, *, mesh: Optional[Mesh] = None, axis: str = WORLD_AXIS):
                 out_specs=P(m.axis_names[0]),
                 check_vma=False,
             )
-            _compiled[m] = jax.jit(wrapped)
+            _compiled[m] = jax.jit(wrapped, donate_argnums=donate_argnums)
         return _compiled[m]
 
     def run(*args):
