@@ -1,0 +1,44 @@
+"""Checkpoint/restore for distributed training state.
+
+The reference has no checkpointing at all (SURVEY.md §5: "Checkpoint /
+resume: None anywhere in the tree"), so this is a superset subsystem:
+a thin wrapper over `orbax.checkpoint` that saves/restores the pytrees
+our models train (params, solver states), preserving shardings on
+restore when a mesh is supplied.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save(path: str, state: Any) -> None:
+    """Save a pytree of arrays to ``path`` (a directory)."""
+    path = os.path.abspath(path)
+    ckpt = _checkpointer()
+    ckpt.save(path, state, force=True)
+    ckpt.wait_until_finished()
+
+
+def restore(path: str, template: Any) -> Any:
+    """Restore a pytree saved by :func:`save`. ``template`` provides
+    structure/shape/dtype (and sharding, if its leaves are sharded
+    arrays — restored leaves then land on the same mesh layout)."""
+    path = os.path.abspath(path)
+    ckpt = _checkpointer()
+    abstract = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(
+            a.shape, a.dtype, sharding=getattr(a, "sharding", None)
+        ),
+        template,
+    )
+    return ckpt.restore(path, abstract)

@@ -169,6 +169,20 @@ def test_set_get_logging():
     assert m4t.get_logging() is False
 
 
+def test_runtime_log_per_rank(capfd, run_spmd, per_rank):
+    # device-side callback log: r{rank} | {id} | {Op} ... done
+    # (reference DebugTimer format, test_common.py:118-146)
+    m4t.set_logging(True, runtime=True)
+    try:
+        arr = per_rank(lambda r: np.float32(r))
+        run_spmd(lambda x: m4t.allreduce(x, op=m4t.SUM), arr)
+        jax.effects_barrier()  # drain pending async callbacks
+    finally:
+        m4t.set_logging(False, runtime=False)
+    out = capfd.readouterr().out
+    assert re.search(r"r\d \| [a-z0-9]{8} \| AllReduce .* done", out), out
+
+
 # --- capability queries (reference test_has_cuda.py / test_has_sycl.py) ---
 
 
