@@ -14,15 +14,70 @@ Prints exactly one JSON line:
 
 import json
 import math
+import os
 import sys
 import time
 
 BASELINE_1GPU_S = 6.28  # reference P100, docs/shallow-water.rst:81-83
 
+#: wall-clock budget for the real benchmark child process; a wedged
+#: accelerator runtime (e.g. the axon tunnel hanging in PJRT init,
+#: where not even SIGALRM handlers run because the GIL is held in
+#: native code) is detected by the parent and retried on CPU
+TIMEOUT_S = int(os.environ.get("M4T_BENCH_TIMEOUT", "1500"))
+
+
+def _run_child(cmd, env):
+    """Run the benchmark child in its own session so a wedged child
+    (and anything it spawned) can be killed as a group — otherwise an
+    outer harness killing the supervisor would orphan the process that
+    actually holds the accelerator tunnel."""
+    import signal
+    import subprocess
+
+    proc = subprocess.Popen(cmd, env=env, start_new_session=True)
+    try:
+        return proc.wait(timeout=TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        proc.wait()
+        return None  # timed out
+
+
+def supervise():
+    """Run the benchmark in a child; on hang/failure retry on CPU."""
+    env = dict(os.environ)
+    env["M4T_BENCH_CHILD"] = "1"
+    cmd = [sys.executable, os.path.abspath(__file__)]
+    rc = _run_child(cmd, env)
+    if rc == 0:
+        return 0
+    reason = (
+        f"no result within {TIMEOUT_S}s (accelerator runtime wedged?)"
+        if rc is None
+        else f"exit code {rc}"
+    )
+    if os.environ.get("M4T_BENCH_PLATFORM") == "cpu":
+        # already on CPU: a retry would fail identically — surface it
+        print(f"# benchmark failed on CPU ({reason})", file=sys.stderr)
+        return 1 if rc is None else rc
+    print(
+        f"# benchmark failed on the default platform ({reason}); "
+        "re-running on CPU (vs_baseline suppressed)",
+        file=sys.stderr,
+    )
+    env["M4T_BENCH_PLATFORM"] = "cpu"
+    rc = _run_child(cmd, env)
+    if rc is None:
+        print(f"# CPU retry also exceeded {TIMEOUT_S}s", file=sys.stderr)
+        return 1
+    return rc
+
 
 def main():
-    import os
-
     import jax
 
     # Debug/smoke escapes: M4T_BENCH_PLATFORM=cpu forces the platform
@@ -79,7 +134,14 @@ def main():
         file=sys.stderr,
     )
     # vs_baseline only makes sense on the published config (scale 10)
-    vs = round(BASELINE_1GPU_S / elapsed, 3) if scale == 10 else None
+    # and on real accelerator hardware — never compare a CPU run
+    # (wedge fallback or debug escape) against the P100 baseline
+    on_cpu = jax.devices()[0].platform == "cpu"
+    vs = (
+        round(BASELINE_1GPU_S / elapsed, 3)
+        if scale == 10 and not on_cpu
+        else None
+    )
     print(
         json.dumps(
             {
@@ -93,4 +155,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("M4T_BENCH_CHILD") == "1":
+        main()
+    else:
+        sys.exit(supervise())

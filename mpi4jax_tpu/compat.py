@@ -1,0 +1,93 @@
+"""Source-compatibility shim for mpi4jax users.
+
+Lets reference code port with two line changes::
+
+    # from mpi4py import MPI          ->  from mpi4jax_tpu.compat import MPI
+    # import mpi4jax                  ->  import mpi4jax_tpu.compat as mpi4jax
+
+after which ``mpi4jax.allreduce(x, op=MPI.SUM, comm=MPI.COMM_WORLD)``
+and friends run unchanged on the TPU path (or the native shm backend
+under the launcher). The :class:`MPI` namespace mirrors the subset of
+``mpi4py.MPI`` the reference's public API touches: the reduction
+operators (``utils.py:101-128``), ``COMM_WORLD``, ``PROC_NULL``,
+``ANY_TAG``.
+
+SPMD caveats still apply (per-rank tables for point-to-point, uniform
+gather/scatter shapes — ``docs/sharp-bits.md``).
+"""
+
+from . import (  # noqa: F401
+    allgather,
+    allreduce,
+    alltoall,
+    barrier,
+    bcast,
+    gather,
+    has_cuda_support,
+    has_sycl_support,
+    has_tpu_support,
+    recv,
+    reduce,
+    scan,
+    scatter,
+    send,
+    sendrecv,
+)
+from .comm import (
+    ANY_TAG as _ANY_TAG,
+    BAND,
+    BOR,
+    BXOR,
+    LAND,
+    LOR,
+    LXOR,
+    MAX,
+    MIN,
+    PROC_NULL as _PROC_NULL,
+    PROD,
+    SUM,
+    get_default_comm,
+)
+
+
+class _MPINamespace:
+    """The ``mpi4py.MPI`` lookalike."""
+
+    SUM = SUM
+    PROD = PROD
+    MAX = MAX
+    MIN = MIN
+    LAND = LAND
+    LOR = LOR
+    LXOR = LXOR
+    BAND = BAND
+    BOR = BOR
+    BXOR = BXOR
+    PROC_NULL = _PROC_NULL
+    ANY_TAG = _ANY_TAG
+
+    @property
+    def COMM_WORLD(self):
+        return get_default_comm()
+
+
+MPI = _MPINamespace()
+
+__all__ = [
+    "MPI",
+    "allgather",
+    "allreduce",
+    "alltoall",
+    "barrier",
+    "bcast",
+    "gather",
+    "recv",
+    "reduce",
+    "scan",
+    "scatter",
+    "send",
+    "sendrecv",
+    "has_cuda_support",
+    "has_sycl_support",
+    "has_tpu_support",
+]
