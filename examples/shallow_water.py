@@ -173,8 +173,19 @@ def save_animation(model, config, snapshots, n):
         return (im,)
 
     ani = animation.FuncAnimation(fig, update, frames=len(frames), blit=True)
-    ani.save("shallow-water.mp4", fps=10)
-    print("saved shallow-water.mp4", file=sys.stderr)
+    try:
+        ani.save("shallow-water.mp4", fps=10)
+        print("saved shallow-water.mp4", file=sys.stderr)
+    except (ValueError, RuntimeError) as e:
+        # no ffmpeg writer available — fall back to GIF via pillow;
+        # drop any partial mp4 so nobody picks up a corrupt file
+        if os.path.exists("shallow-water.mp4"):
+            os.unlink("shallow-water.mp4")
+        print(f"mp4 writer unavailable ({e}); writing GIF", file=sys.stderr)
+        ani.save(
+            "shallow-water.gif", writer=animation.PillowWriter(fps=10)
+        )
+        print("saved shallow-water.gif", file=sys.stderr)
 
 
 if __name__ == "__main__":

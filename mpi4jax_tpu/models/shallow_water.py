@@ -287,7 +287,19 @@ class ShallowWaterModel:
         ) / dy
         face_h = 0.25 * (hc[1:-1, 1:-1] + hc[1:-1, 2:] + hc[2:, 1:-1] + hc[2:, 2:])
         q = with_interior(q, (interior(coriolis) + rel_vort) / face_h)
-        q = self.enforce_boundaries(q, "h", proc_row)
+
+        # kinetic energy depends only on (u, v), still unchanged here:
+        # compute it now so q and ke share one halo-exchange group
+        ke = jnp.zeros_like(u)
+        ke = with_interior(
+            ke,
+            0.5
+            * (
+                0.5 * (u[1:-1, 1:-1] ** 2 + u[1:-1, :-2] ** 2)
+                + 0.5 * (v[1:-1, 1:-1] ** 2 + v[:-2, 1:-1] ** 2)
+            ),
+        )
+        q, ke = self.enforce_boundaries_multi((q, ke), ("h", "h"), proc_row)
 
         du_new = jnp.zeros_like(du)
         du_new = with_interior(
@@ -310,17 +322,6 @@ class ShallowWaterModel:
             ),
         )
 
-        ke = jnp.zeros_like(u)
-        ke = with_interior(
-            ke,
-            0.5
-            * (
-                0.5 * (u[1:-1, 1:-1] ** 2 + u[1:-1, :-2] ** 2)
-                + 0.5 * (v[1:-1, 1:-1] ** 2 + v[:-2, 1:-1] ** 2)
-            ),
-        )
-        ke = self.enforce_boundaries(ke, "h", proc_row)
-
         du_new = du_new.at[1:-1, 1:-1].add(-(ke[1:-1, 2:] - ke[1:-1, 1:-1]) / dx)
         dv_new = dv_new.at[1:-1, 1:-1].add(-(ke[2:, 1:-1] - ke[1:-1, 1:-1]) / dy)
 
@@ -339,24 +340,31 @@ class ShallowWaterModel:
         )
 
         if c.viscosity > 0:
+            # both components' friction fluxes read the same (u, v)
+            # state, so all four exchange in a single halo group
             nu = c.viscosity
-            for comp in ("u", "v"):
-                f = u if comp == "u" else v
+
+            def fluxes(f):
                 ge = jnp.zeros_like(f)
                 gn = jnp.zeros_like(f)
                 ge = with_interior(ge, nu * (f[1:-1, 2:] - f[1:-1, 1:-1]) / dx)
                 gn = with_interior(gn, nu * (f[2:, 1:-1] - f[1:-1, 1:-1]) / dy)
-                ge, gn = self.enforce_boundaries_multi(
-                    (ge, gn), ("u", "v"), proc_row
-                )
-                upd = dt * (
+                return ge, gn
+
+            ge_u, gn_u = fluxes(u)
+            ge_v, gn_v = fluxes(v)
+            ge_u, gn_u, ge_v, gn_v = self.enforce_boundaries_multi(
+                (ge_u, gn_u, ge_v, gn_v), ("u", "v", "u", "v"), proc_row
+            )
+
+            def friction(ge, gn):
+                return dt * (
                     (ge[1:-1, 1:-1] - ge[1:-1, :-2]) / dx
                     + (gn[1:-1, 1:-1] - gn[:-2, 1:-1]) / dy
                 )
-                if comp == "u":
-                    u = u.at[1:-1, 1:-1].add(upd)
-                else:
-                    v = v.at[1:-1, 1:-1].add(upd)
+
+            u = u.at[1:-1, 1:-1].add(friction(ge_u, gn_u))
+            v = v.at[1:-1, 1:-1].add(friction(ge_v, gn_v))
 
         return ModelState(h, u, v, dh_new, du_new, dv_new)
 
