@@ -24,7 +24,7 @@ BASELINE_1GPU_S = 6.28  # reference P100, docs/shallow-water.rst:81-83
 #: accelerator runtime (e.g. the axon tunnel hanging in PJRT init,
 #: where not even SIGALRM handlers run because the GIL is held in
 #: native code) is detected by the parent and retried on CPU
-TIMEOUT_S = int(os.environ.get("M4T_BENCH_TIMEOUT", "1500"))
+TIMEOUT_S = int(os.environ.get("M4T_BENCH_TIMEOUT", "900"))
 
 
 def _run_child(cmd, env):
