@@ -27,6 +27,65 @@ BASELINE_1GPU_S = 6.28  # reference P100, docs/shallow-water.rst:81-83
 TIMEOUT_S = int(os.environ.get("M4T_BENCH_TIMEOUT", "900"))
 
 
+#: wall-clock budget for one accelerator canary probe (PJRT init +
+#: tiny jit); a healthy chip answers in ~5-20 s, a wedged tunnel never
+CANARY_TIMEOUT_S = int(os.environ.get("M4T_BENCH_CANARY_TIMEOUT", "75"))
+CANARY_ATTEMPTS = int(os.environ.get("M4T_BENCH_CANARY_ATTEMPTS", "3"))
+
+_CANARY_SRC = """
+import jax, jax.numpy as jnp
+d = jax.devices()
+assert d and d[0].platform != "cpu", f"no accelerator: {d}"
+x = jax.jit(lambda a: (a @ a).sum())(jnp.ones((256, 256)))
+x.block_until_ready()
+print(f"canary ok: {d[0]}", flush=True)
+"""
+
+
+def _probe_accelerator(env):
+    """Cheap pre-flight: is the accelerator runtime answering at all?
+
+    The axon TPU tunnel can wedge inside PJRT init where no Python
+    signal handler runs; only a process-level kill works. Probing with
+    a short-timeout child before committing to the full ``TIMEOUT_S``
+    benchmark run turns a 900 s hang into a ~75 s detour per attempt.
+    """
+    import signal
+    import subprocess
+    import time as _time
+
+    for attempt in range(1, CANARY_ATTEMPTS + 1):
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _CANARY_SRC],
+            env=env,
+            start_new_session=True,
+        )
+        try:
+            rc = proc.wait(timeout=CANARY_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            proc.wait()
+            rc = None
+        if rc == 0:
+            return True
+        if rc is not None:
+            # deterministic failure (e.g. no accelerator at all):
+            # retrying would fail identically — fall back immediately
+            print(f"# accelerator canary: exit {rc}", file=sys.stderr)
+            return False
+        print(
+            f"# accelerator canary {attempt}/{CANARY_ATTEMPTS}: "
+            "wedged (timeout)",
+            file=sys.stderr,
+        )
+        if attempt < CANARY_ATTEMPTS:
+            _time.sleep(5)
+    return False
+
+
 def _run_child(cmd, env):
     """Run the benchmark child in its own session so a wedged child
     (and anything it spawned) can be killed as a group — otherwise an
@@ -52,6 +111,14 @@ def supervise():
     env = dict(os.environ)
     env["M4T_BENCH_CHILD"] = "1"
     cmd = [sys.executable, os.path.abspath(__file__)]
+    if env.get("M4T_BENCH_PLATFORM") != "cpu" and not _probe_accelerator(env):
+        # dead/wedged accelerator: skip the doomed TIMEOUT_S attempt
+        print(
+            "# accelerator canary failed; benchmarking on CPU "
+            "(vs_baseline suppressed)",
+            file=sys.stderr,
+        )
+        env["M4T_BENCH_PLATFORM"] = "cpu"
     rc = _run_child(cmd, env)
     if rc == 0:
         return 0
@@ -60,7 +127,7 @@ def supervise():
         if rc is None
         else f"exit code {rc}"
     )
-    if os.environ.get("M4T_BENCH_PLATFORM") == "cpu":
+    if env.get("M4T_BENCH_PLATFORM") == "cpu":
         # already on CPU: a retry would fail identically — surface it
         print(f"# benchmark failed on CPU ({reason})", file=sys.stderr)
         return 1 if rc is None else rc

@@ -394,13 +394,21 @@ class BoundComm:
             return self.axes[0], dict(axis_index_groups=list(self.groups))
         return self.axes, {}
 
-    def require_single_axis(self, opname: str) -> str:
-        if len(self.axes) != 1:
-            raise NotImplementedError(
-                f"{opname} over a multi-axis communicator is not supported "
-                f"yet; use a single flattened mesh axis (got {self.axes})."
-            )
-        return self.axes[0]
+    def axis_target(self):
+        """The ``axis_name`` argument for lax collectives.
+
+        Multi-axis communicators pass the axis-name *tuple* straight
+        through: every lax collective (``ppermute``, ``all_to_all``,
+        ``psum_scatter``, ...) linearizes a tuple of axes row-major —
+        the same order as :meth:`global_rank` — so per-rank tables,
+        permutation edges, and chunk indices line up with no manual
+        flattening. Split comms resolve to a single axis (enforced in
+        :func:`resolve_comm`) plus ``axis_index_groups`` where the op
+        supports it.
+        """
+        if self.groups is not None:
+            return self.axes[0]
+        return self.axes
 
 
 def _axis_is_bound(name: str) -> bool:
@@ -409,6 +417,26 @@ def _axis_is_bound(name: str) -> bool:
         return True
     except (NameError, KeyError):
         return False
+
+
+def _current_mesh_axes() -> AxisNames:
+    """Mesh axis names the current trace is manual over (shard_map).
+
+    Used to catch axis-name typos: if the trace *is* inside a shard_map
+    but none of the communicator's axes are bound there, resolving to a
+    size-1 world would make every collective a silent identity — the
+    reference instead fails loudly on an invalid communicator
+    (``_src/utils.py:60-97`` type checks). Batching (``vmap``) axes are
+    deliberately excluded: collectives over vmap axis names at world
+    size 1 are legitimate. Best-effort: returns ``()`` if the private
+    introspection API moves.
+    """
+    try:
+        from jax._src import mesh as _mesh_lib
+
+        return tuple(_mesh_lib.get_abstract_mesh().manual_axes)
+    except Exception:
+        return ()
 
 
 def get_default_comm() -> Comm:
@@ -432,6 +460,18 @@ def resolve_comm(comm: Optional[Comm]) -> BoundComm:
         raise TypeError(f"expected a Comm, got {type(comm)}")
     bound = [a for a in comm.axes if _axis_is_bound(a)]
     if not bound:
+        mesh_axes = _current_mesh_axes()
+        if mesh_axes:
+            # Inside a shard_map, but none of the comm's axes exist
+            # there: almost certainly an axis-name typo. Resolving to a
+            # size-1 world would silently turn every collective into an
+            # identity — fail loudly instead.
+            raise NameError(
+                f"communicator axes {comm.axes} are not bound in the "
+                f"current trace, but the trace is inside a shard_map "
+                f"over mesh axes {mesh_axes} — axis-name typo? Use a "
+                f"Comm over (a subset of) the mesh axes."
+            )
         # Outside any mesh: route to the native shm world when one is
         # active (i.e. under `python -m mpi4jax_tpu.launch`) — the
         # analog of the reference's default COMM_WORLD clone resolving

@@ -32,7 +32,7 @@ def _alltoall_spmd(x, *, comm: BoundComm):
         return _shm.alltoall(x)
     if not comm.axes or comm.size == 1:
         return x
-    axis = comm.require_single_axis("alltoall")
+    axis = comm.axis_target()
     _, kw = comm.collective_kwargs()
     return lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=False, **kw)
 

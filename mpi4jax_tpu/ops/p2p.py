@@ -30,7 +30,7 @@ one-process-per-rank model:
    cannot be expressed on the TPU path (documented sharp bit):
    ``parallel.spmd`` raises at trace end if unmatched sends remain
    (``token.check_no_pending_sends``); raw ``shard_map`` users get a
-   warning when the trace's channel state is eventually evicted.
+   RuntimeError when the trace's channel state is eventually evicted.
 
 AD parity: the transpose of a point-to-point transfer reverses every
 edge — the reference's "transpose swaps source and dest"
@@ -80,7 +80,7 @@ def _p2p_spmd(x, template, *, perm: Tuple[Edge, ...], comm: BoundComm):
     if not comm.axes or comm.size == 1:
         # Only possible edge at size 1 is the self-edge (0, 0).
         return x if perm == ((0, 0),) else template
-    axis = comm.require_single_axis("send/recv")
+    axis = comm.axis_target()
     moved = lax.ppermute(x, axis, list(comm.to_global_edges(perm)))
     m = _recv_mask(perm, comm)
     return jnp.where(m, moved, template)
@@ -228,6 +228,12 @@ def _shm_partner(value: TableLike, bound: BoundComm, what: str) -> int:
         partner = table[bound.shm_rank]
     if partner >= bound.size:
         raise ValueError(f"{what} {partner} out of range for size {bound.size}")
+    if partner < 0:
+        # Any negative partner means "no partner" (documented contract,
+        # comm.py PROC_NULL note; mpi4py's own MPI.PROC_NULL is -2) —
+        # normalize so downstream `== PROC_NULL` checks match and a
+        # ported script passing -2 doesn't abort the shm world.
+        return PROC_NULL
     return partner
 
 

@@ -64,7 +64,7 @@ def quantized_allreduce(x, *, comm=None, token=NOTSET):
     n = bound.size
     if n == 1:
         return x
-    axis = bound.require_single_axis("quantized_allreduce")
+    axis = bound.axis_target()
     if bound.backend == "shm":
         raise NotImplementedError(
             "quantized_allreduce is an ICI wire-format optimization; on "

@@ -42,7 +42,7 @@ def _reduce_scatter_spmd(x, *, op: Op, comm: BoundComm):
         return reduced[comm.shm_rank]
     if not comm.axes or comm.size == 1:
         return x[0]
-    axis = comm.require_single_axis("reduce_scatter")
+    axis = comm.axis_target()
     _, kw = comm.collective_kwargs()
     if op is SUM and jnp.issubdtype(x.dtype, jnp.number):
         return lax.psum_scatter(x, axis, scatter_dimension=0, tiled=False, **kw)

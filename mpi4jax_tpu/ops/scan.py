@@ -38,7 +38,7 @@ def _scan_spmd(x, *, op: Op, comm: BoundComm):
         return _shm.scan(x, op)
     if not comm.axes or comm.size == 1:
         return x
-    axis = comm.require_single_axis("scan")
+    axis = comm.axis_target()
     n = comm.size
     rank = comm.rank()  # group rank for Split comms
     y = x

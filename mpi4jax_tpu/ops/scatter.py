@@ -39,7 +39,7 @@ def _scatter_spmd(x, *, root, comm: BoundComm):
         return _shm.scatter(x, root)
     if not comm.axes or comm.size == 1:
         return x[0]
-    axis = comm.require_single_axis("scatter")
+    axis = comm.axis_target()
     _, kw = comm.collective_kwargs()
     rank = comm.rank()
     if x.dtype == jnp.bool_:

@@ -35,7 +35,6 @@ also hosts the point-to-point *channel matcher* used by
 from __future__ import annotations
 
 import collections
-import warnings
 from typing import Any, Deque, Dict, List, Tuple
 
 import jax
@@ -44,7 +43,7 @@ from jax import lax
 
 from . import config
 
-_MAX_TRACE_STATES = 16
+_MAX_TRACE_STATES = 64
 
 
 class _TraceState:
@@ -65,18 +64,27 @@ def _current_state() -> _TraceState:
     for st in _states:
         if st.key == key:
             return st
+    evicted = None
     if len(_states) == _states.maxlen:
-        old = _states[0]
-        if old.pending_sends:
-            warnings.warn(
-                f"mpi4jax_tpu: {len(old.pending_sends)} send(s) were never "
-                "matched by a recv in the same traced program; they were "
-                "dropped. On the TPU backend a send must be paired with a "
-                "recv inside the same jit/shard_map trace.",
-                stacklevel=2,
-            )
+        evicted = _states.popleft()
     st = _TraceState(key)
     _states.append(st)
+    if evicted is not None and evicted.pending_sends:
+        # Evicting a state with unmatched sends means a transfer would
+        # be silently dropped — that program is wrong whether or not
+        # its trace is still live, so fail loudly (a warning could
+        # scroll past unnoticed while results were quietly corrupt).
+        # The stale state is already evicted and the new one
+        # registered, so this raises exactly once; later traces are
+        # unaffected.
+        tags = [rec["tag"] for rec in evicted.pending_sends]
+        raise RuntimeError(
+            f"mpi4jax_tpu: {len(evicted.pending_sends)} send(s) (tags "
+            f"{tags}) were never matched by a recv in their traced "
+            "program and their trace state was evicted. On the TPU "
+            "backend a send must be paired with a recv inside the same "
+            "jit/shard_map trace."
+        )
     return st
 
 
@@ -84,8 +92,8 @@ def check_no_pending_sends() -> None:
     """Raise if the current trace holds sends that were never matched
     by a recv — called at the end of ``parallel.spmd`` bodies so the
     primary entry point fails loudly instead of silently dropping a
-    transfer. (Raw ``shard_map`` users get a warning at state eviction
-    instead; see ``_current_state``.)"""
+    transfer. (Raw ``shard_map`` users get a RuntimeError at state
+    eviction instead; see ``_current_state``.)"""
     st = _current_state()
     if st.pending_sends:
         tags = [rec["tag"] for rec in st.pending_sends]

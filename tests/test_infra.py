@@ -94,6 +94,37 @@ def test_resolve_comm_type_error():
         resolve_comm("world")
 
 
+def test_resolve_comm_typo_inside_mesh_raises(mesh, per_rank):
+    # An axis-name typo inside a shard_map must fail loudly, not
+    # silently resolve to a size-1 world where every collective is an
+    # identity (round-1 VERDICT "silent-wrong-answer hole").
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    arr = per_rank(lambda r: np.float32(r))
+
+    def f(x):
+        return m4t.allreduce(x, op=m4t.SUM, comm=Comm("rank"))  # typo
+
+    sm = partial(
+        shard_map, mesh=mesh, in_specs=P("ranks"), out_specs=P("ranks"),
+        check_vma=False,
+    )
+    with pytest.raises(NameError, match="typo"):
+        jax.jit(sm(f))(jnp.asarray(arr))
+
+
+def test_resolve_comm_vmap_axis_still_works():
+    # vmap axis names are not mesh axes; collectives over them (or over
+    # the default world comm at size 1) must keep working.
+    out = jax.vmap(
+        lambda x: m4t.allreduce(x, op=m4t.SUM), axis_name="batch"
+    )(jnp.arange(4.0))
+    np.testing.assert_allclose(np.asarray(out), np.arange(4.0))
+
+
 def test_comm_rank_inside_mesh(run_spmd, per_rank):
     arr = per_rank(lambda r: np.float32(0))
     out = run_spmd(

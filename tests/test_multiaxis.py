@@ -71,14 +71,66 @@ def test_multiaxis_allgather_generic_op(mesh2d):
     np.testing.assert_allclose(out.ravel(), np.full(8, np.prod(np.arange(1.0, 9.0))))
 
 
-def test_multiaxis_p2p_rejected(mesh2d):
+def test_multiaxis_sendrecv_ring(mesh2d):
+    # p2p over the linearized (a, b) rank space: ring shift by +1.
     comm = m4t.Comm(("a", "b"))
-    arr = jnp.zeros((2, 4, 1))
-    with pytest.raises(NotImplementedError, match="single"):
-        run2d(
-            mesh2d,
-            lambda x: m4t.sendrecv(
-                x, x, tuple(range(8)), tuple(range(8)), comm=comm
-            ),
-            arr,
-        )
+    n = 8
+    dest = tuple((r + 1) % n for r in range(n))
+    source = tuple((r - 1) % n for r in range(n))
+    arr = np.arange(8.0, dtype=np.float32).reshape(2, 4, 1)
+    out = run2d(
+        mesh2d,
+        lambda x: m4t.sendrecv(x, x, source, dest, comm=comm),
+        jnp.asarray(arr),
+    )
+    np.testing.assert_allclose(out.ravel(), (np.arange(8.0) - 1) % 8)
+
+
+def test_multiaxis_alltoall(mesh2d):
+    comm = m4t.Comm(("a", "b"))
+    # rank r's block j = 10*r + j; after alltoall rank r's block j = 10*j + r
+    arr = np.asarray(
+        [[10.0 * r + j for j in range(8)] for r in range(8)], np.float32
+    ).reshape(2, 4, 8, 1)
+    out = run2d(mesh2d, lambda x: m4t.alltoall(x, comm=comm), jnp.asarray(arr))
+    expect = np.asarray([[10.0 * j + r for j in range(8)] for r in range(8)])
+    np.testing.assert_allclose(out.reshape(8, 8), expect)
+
+
+def test_multiaxis_scan(mesh2d):
+    comm = m4t.Comm(("a", "b"))
+    arr = np.arange(8.0, dtype=np.float32).reshape(2, 4, 1)
+    out = run2d(mesh2d, lambda x: m4t.scan(x, m4t.SUM, comm=comm), jnp.asarray(arr))
+    np.testing.assert_allclose(out.ravel(), np.cumsum(np.arange(8.0)))
+
+
+def test_multiaxis_scatter(mesh2d):
+    comm = m4t.Comm(("a", "b"))
+    root = 3
+    # per-rank (8,) inputs; only root's values matter
+    arr = np.asarray(
+        [100.0 * r + np.arange(8.0) for r in range(8)], np.float32
+    ).reshape(2, 4, 8)
+    out = run2d(
+        mesh2d, lambda x: m4t.scatter(x, root, comm=comm), jnp.asarray(arr)
+    )
+    np.testing.assert_allclose(out.ravel(), 100.0 * root + np.arange(8.0))
+
+
+def test_multiaxis_reduce_scatter(mesh2d):
+    comm = m4t.Comm(("a", "b"))
+    arr = np.asarray(
+        [r + np.arange(8.0) for r in range(8)], np.float32
+    ).reshape(2, 4, 8)
+    out = run2d(
+        mesh2d, lambda x: m4t.reduce_scatter(x, m4t.SUM, comm=comm), jnp.asarray(arr)
+    )
+    # rank r gets sum_ranks (rank + r) = 28 + 8r
+    np.testing.assert_allclose(out.ravel(), 28.0 + 8.0 * np.arange(8.0))
+
+
+def test_multiaxis_allgather(mesh2d):
+    comm = m4t.Comm(("a", "b"))
+    arr = np.arange(8.0, dtype=np.float32).reshape(2, 4, 1)
+    out = run2d(mesh2d, lambda x: m4t.allgather(x, comm=comm), jnp.asarray(arr))
+    np.testing.assert_allclose(out.reshape(8, 8), np.tile(np.arange(8.0)[None, :, None], (8, 1, 1)).reshape(8, 8))
