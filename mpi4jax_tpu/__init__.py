@@ -30,6 +30,7 @@ from .jax_compat import check_jax_version as _check_jax_version
 _check_jax_version()  # reference parity: _src/__init__.py:6-8
 
 from .comm import (  # noqa: F401
+    ANY_SOURCE,
     ANY_TAG,
     BAND,
     BOR,
@@ -45,6 +46,7 @@ from .comm import (  # noqa: F401
     Op,
     PROC_NULL,
     PROD,
+    Status,
     SUM,
     get_default_comm,
     resolve_comm,
@@ -146,6 +148,8 @@ __all__ = [
     "BXOR",
     "PROC_NULL",
     "ANY_TAG",
+    "ANY_SOURCE",
+    "Status",
     "get_default_comm",
     "resolve_comm",
     "has_tpu_support",

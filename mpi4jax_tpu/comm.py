@@ -46,6 +46,99 @@ from jax import lax
 PROC_NULL = -1
 ANY_TAG = -1
 
+
+class _AnySource:
+    """Wildcard-source sentinel (``MPI.ANY_SOURCE`` analog).
+
+    A distinct singleton rather than a negative int so it can never be
+    confused with a PROC_NULL table entry (any negative *partner* means
+    "no partner"). Only meaningful for ``recv``/``sendrecv`` on the
+    multi-controller shm backend — static HLO collectives cannot
+    express wildcards (SURVEY.md §7 hard-parts; reference
+    ``recv.py:49-54``)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "ANY_SOURCE"
+
+
+ANY_SOURCE = _AnySource()
+
+
+class Status:
+    """Receive-status capture (``mpi4py.MPI.Status`` analog).
+
+    Pass as ``status=`` to :func:`~mpi4jax_tpu.recv` /
+    :func:`~mpi4jax_tpu.sendrecv` on the shm backend; after the call
+    (and, under ``jit``, after the computation has executed) the fields
+    describe the matched message. Implementation mirrors the reference,
+    which passes ``_addressof(status)`` into the native handler so the
+    runtime writes the struct directly (``recv.py:100-103``): here the
+    handler writes ``(source, tag, count_bytes)`` into a persistent
+    int64[3] buffer owned by this object.
+    """
+
+    def __init__(self):
+        self._buf = np.zeros(3, np.int64)
+        #: global ranks of the communicator the last call ran on (set
+        #: by recv/sendrecv for Split comms) — MPI reports the source
+        #: as a *communicator* rank, the native layer writes the
+        #: global rank; translate on read.
+        self._group: Optional[Tuple[int, ...]] = None
+
+    @property
+    def _addr(self) -> int:
+        return self._buf.ctypes.data
+
+    @property
+    def source(self) -> int:
+        src = int(self._buf[0])
+        if self._group is not None and src in self._group:
+            return self._group.index(src)
+        return src
+
+    @property
+    def tag(self) -> int:
+        return int(self._buf[1])
+
+    @property
+    def count_bytes(self) -> int:
+        return int(self._buf[2])
+
+    # mpi4py-style accessors
+    def Get_source(self) -> int:
+        return self.source
+
+    def Get_tag(self) -> int:
+        return self.tag
+
+    def Get_count(self, dtype=None) -> int:
+        """Element count of the received message (bytes if dtype None)."""
+        if dtype is None:
+            return self.count_bytes
+        return self.count_bytes // np.dtype(dtype).itemsize
+
+    def _set_proc_null(self) -> None:
+        """Record a PROC_NULL receive (MPI: source=PROC_NULL,
+        tag=ANY_TAG, count=0) so a reused Status never shows a stale
+        previous message."""
+        self._buf[0] = PROC_NULL
+        self._buf[1] = ANY_TAG
+        self._buf[2] = 0
+        self._group = None
+
+    def __repr__(self):
+        return (
+            f"Status(source={self.source}, tag={self.tag}, "
+            f"count_bytes={self.count_bytes})"
+        )
+
 #: Conventional world axis name used by mpi4jax_tpu mesh helpers.
 WORLD_AXIS = "ranks"
 
@@ -229,12 +322,23 @@ class GroupComm(Comm):
             )
         self.groups = groups
 
-    def Split(self, colors):
-        raise NotImplementedError(
-            "splitting a sub-communicator is not supported yet; Split the "
-            "world Comm with composite colors instead (e.g. "
-            "color = parent_color * k + sub_color)"
-        )
+    def Split(self, colors: Sequence[int]) -> "GroupComm":
+        """Split a sub-communicator (nested ``MPI_Comm_split``).
+
+        ``colors`` is indexed by *global* rank (every process supplies
+        one entry, like :meth:`Comm.Split`). Each existing group is
+        partitioned independently by color — ranks sharing a color
+        *within the same parent group* form a new sub-communicator,
+        ordered by global rank (MPI's key=rank default). All resulting
+        groups must have equal size (SPMD shape uniformity).
+        """
+        new_groups = []
+        for grp in self.groups:
+            sub = {}
+            for r in grp:
+                sub.setdefault(int(colors[r]), []).append(r)
+            new_groups.extend(tuple(m) for _, m in sorted(sub.items()))
+        return GroupComm(tuple(new_groups), axis=self._axes)
 
     def __hash__(self):
         return hash((type(self).__name__, self._axes, self.groups))
@@ -339,11 +443,22 @@ class BoundComm:
     #: axis_index_groups for sub-communicators (None = whole axis);
     #: ``size`` is then the *group* size and ``rank()`` the group rank.
     groups: Optional[Tuple[Tuple[int, ...], ...]] = None
+    #: shm backend only: the global ranks of this process's group for a
+    #: Split sub-communicator (None = the whole shm world); ``size`` is
+    #: then the group size and ``shm_group_rank`` the rank within it.
+    shm_group: Optional[Tuple[int, ...]] = None
+
+    @property
+    def shm_group_rank(self) -> int:
+        """This process's rank within the communicator (shm backend)."""
+        if self.shm_group is None:
+            return self.shm_rank
+        return self.shm_group.index(self.shm_rank)
 
     def global_rank(self):
         """Linear rank over the mesh axes (row-major)."""
         if self.backend == "shm":
-            return jnp.asarray(self.shm_rank, jnp.int32)
+            return jnp.asarray(self.shm_group_rank, jnp.int32)
         if not self.axes:
             return jnp.zeros((), jnp.int32)
         r = jnp.zeros((), jnp.int32)
@@ -482,9 +597,17 @@ def resolve_comm(comm: Optional[Comm]) -> BoundComm:
             _shm = None
         if _shm is not None and _shm.active():
             if isinstance(comm, GroupComm):
-                raise NotImplementedError(
-                    "sub-communicators (Comm.Split) are not supported on "
-                    "the native shm backend yet; use the XLA mesh path"
+                total = sum(len(g) for g in comm.groups)
+                if total != _shm.size():
+                    raise ValueError(
+                        f"GroupComm groups cover {total} ranks but the shm "
+                        f"world has {_shm.size()}"
+                    )
+                me = _shm.rank()
+                grp = next(g for g in comm.groups if me in g)
+                return BoundComm(
+                    axes=(), size=len(grp), backend="shm", shm_rank=me,
+                    shm_group=tuple(grp),
                 )
             return BoundComm(
                 axes=(), size=_shm.size(), backend="shm", shm_rank=_shm.rank()

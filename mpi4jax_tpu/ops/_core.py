@@ -68,6 +68,17 @@ def register_passthrough_batcher(prim, n_operands: int = 1):
     batching.primitive_batchers[prim] = rule
 
 
+def emit_shm(fn, inputs: Tuple, *, opname: str, details: str, bound_comm):
+    """Run a native shm-backend op under the ambient ordering token.
+
+    Used by op wrappers whose shm path cannot go through the primitive
+    (rank-dependent output shapes — gather/scatter root-only semantics —
+    or per-process scalar arguments, reference execution model)."""
+    ident = debug.log_emission(opname, details)
+    debug.log_runtime(bound_comm, ident, opname, details)
+    return ordered_call(fn, tuple(inputs))
+
+
 def emit(
     prim,
     inputs: Tuple,

@@ -26,6 +26,10 @@ def _allgather_spmd(x, *, comm: BoundComm):
     if comm.backend == "shm":
         from ..runtime import shm as _shm
 
+        if comm.shm_group is not None:
+            from ..runtime import shm_group as _grp
+
+            return _grp.allgather(x, comm.shm_group)
         return _shm.allgather(x)
     if not comm.axes or comm.size == 1:
         return x[None]

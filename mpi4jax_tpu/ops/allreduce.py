@@ -56,13 +56,27 @@ def _generic_reduce(x, op: Op, comm: BoundComm):
     return op.reduce_along_axis(gathered, axis=0).astype(x.dtype)
 
 
-def _shm_reduction_dtype_check(x):
+def _shm_reduction_dtype_check(x, op=None):
     from ..utils.dtypes import is_shm_reduction_dtype
 
     if not is_shm_reduction_dtype(x.dtype):
         raise NotImplementedError(
             f"dtype {x.dtype} is not supported by the native shm backend "
             "reductions (reference dtype table: _src/utils.py:101-128)"
+        )
+    import numpy as np
+
+    if (
+        op is not None
+        and np.issubdtype(np.dtype(x.dtype), np.complexfloating)
+        and op.name not in ("SUM", "PROD")
+    ):
+        # Raise here rather than letting the native layer fatal() and
+        # tear the whole world down (MPI likewise rejects MAX/MIN on
+        # complex types).
+        raise NotImplementedError(
+            f"op {op.name} is not defined for complex dtypes "
+            "(SUM/PROD only, matching MPI)"
         )
 
 
@@ -73,7 +87,11 @@ def _allreduce_spmd(x, *, op, comm: BoundComm, transpose):
     if comm.backend == "shm":
         from ..runtime import shm as _shm
 
-        _shm_reduction_dtype_check(x)
+        _shm_reduction_dtype_check(x, op)
+        if comm.shm_group is not None:
+            from ..runtime import shm_group as _grp
+
+            return _grp.allreduce(x, op, comm.shm_group)
         return _shm.allreduce(x, op)
     if not comm.axes or comm.size == 1:
         # World size 1: reduction over a single rank is the identity.
@@ -108,11 +126,11 @@ def _use_pallas_ring(x, op, comm: BoundComm) -> bool:
         and comm.groups is None
         and len(comm.axes) == 1
         and x.dtype in (jnp.float32, jnp.bfloat16)
-        # lower bound: latency-bound payloads stay on HLO AllReduce;
-        # upper bound: the kernel pins input + output + 4 transfer
-        # buffers in ~16 MB VMEM, so cap the resident footprint (larger
-        # payloads need a grid-streamed variant)
-        and (1 << 20) <= nbytes <= (1 << 22)
+        # lower bound: latency-bound payloads stay on HLO AllReduce.
+        # No upper bound needed since the grid-streamed variant keeps
+        # arbitrarily large payloads in HBM (validated at 64 MiB in
+        # interpret mode); cap generously as a sanity guard.
+        and (1 << 20) <= nbytes <= (1 << 30)
     ):
         return False
     # The kernel addresses ring neighbors by LOGICAL device id ==

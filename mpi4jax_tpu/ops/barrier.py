@@ -28,6 +28,11 @@ def _barrier_spmd(tok, *, comm: BoundComm):
     if comm.backend == "shm":
         from ..runtime import shm as _shm
 
+        if comm.shm_group is not None:
+            from ..runtime import shm_group as _grp
+
+            _grp.barrier(comm.shm_group)
+            return tok
         return _shm.barrier(tok)
     if not comm.axes or comm.size == 1:
         return tok

@@ -36,6 +36,10 @@ def _bcast_spmd(x, *, root, comm: BoundComm):
     if comm.backend == "shm":
         from ..runtime import shm as _shm
 
+        if comm.shm_group is not None:
+            from ..runtime import shm_group as _grp
+
+            return _grp.bcast(x, root, comm.shm_group)
         return _shm.bcast(x, root)
     if not comm.axes or comm.size == 1:
         return x

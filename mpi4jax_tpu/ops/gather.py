@@ -6,12 +6,17 @@ hands non-root ranks their input back via a size-0 aval trick
 (``gather.py:80-89,140-150``) — rank-dependent shapes that cannot exist
 in a single-program SPMD trace.
 
-**Documented TPU deviation (superset):** every rank receives the
-gathered ``(size, *x.shape)`` array. On TPU hardware there is no
-root-only HLO gather — XLA's collective set is AllGather /
+**Documented TPU deviation (superset), XLA path only:** every rank
+receives the gathered ``(size, *x.shape)`` array. On TPU hardware
+there is no root-only HLO gather — XLA's collective set is AllGather /
 AllReduce / ReduceScatter / CollectivePermute — so a faithful
 root-only gather would cost the same AllGather plus masking. The
 ``root`` argument is validated and kept for source compatibility.
+
+On the native shm backend (multi-controller, one process per rank —
+the reference's own execution model) the reference contract holds
+*exactly*: the root returns the stacked array, every other rank
+returns its input unchanged (``gather.py:80-89``).
 """
 
 from __future__ import annotations
@@ -34,9 +39,10 @@ def _gather_abstract_eval(x, *, root, comm: BoundComm):
 
 def _gather_spmd(x, *, root, comm: BoundComm):
     if comm.backend == "shm":
-        from ..runtime import shm as _shm
-
-        return _shm.allgather(x)
+        raise RuntimeError(
+            "internal: shm gather is handled in the wrapper (root-"
+            "dependent output shapes cannot pass through the primitive)"
+        )
     if not comm.axes or comm.size == 1:
         return x[None]
     axes, kw = comm.collective_kwargs()
@@ -54,9 +60,10 @@ mpi_gather_p = define_primitive(
 def gather(x, root, *, comm=None, token=NOTSET):
     """Gather ``x`` from all ranks (reference ``gather.py:47-89``).
 
-    Returns the stacked ``(size, *x.shape)`` array. Unlike the
-    reference (root-only result), every rank receives it — see module
-    docstring for why this is the TPU-native contract.
+    XLA path: every rank receives the stacked ``(size, *x.shape)``
+    array (see module docstring for why this is the TPU-native
+    contract). shm backend: exact reference semantics — the root
+    returns the stacked array, other ranks return ``x`` unchanged.
     """
     raise_if_token_is_set(token)
     bound = resolve_comm(comm)
@@ -64,6 +71,23 @@ def gather(x, root, *, comm=None, token=NOTSET):
     if not 0 <= root < bound.size:
         raise ValueError(f"root {root} out of range for size {bound.size}")
     x = jnp.asarray(x)
+    if bound.backend == "shm":
+        from ..runtime import shm as _shm
+        from ._core import emit_shm
+
+        if bound.shm_group is not None:
+            from ..runtime import shm_group as _grp
+
+            fn = lambda t: (_grp.gather(t, root, bound.shm_group),)  # noqa: E731
+        else:
+            fn = lambda t: (_shm.gather(t, root),)  # noqa: E731
+        (out,) = emit_shm(
+            fn, (x,),
+            opname="Gather",
+            details=f"[{x.size} items, root={root}, n={bound.size}]",
+            bound_comm=bound,
+        )
+        return out
     (out,) = emit(
         mpi_gather_p,
         (x,),

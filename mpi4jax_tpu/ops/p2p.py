@@ -51,7 +51,15 @@ import jax.numpy as jnp
 from jax import lax
 from jax.interpreters import ad, batching
 
-from ..comm import ANY_TAG, PROC_NULL, BoundComm, Comm, resolve_comm
+from ..comm import (
+    ANY_SOURCE,
+    ANY_TAG,
+    PROC_NULL,
+    BoundComm,
+    Comm,
+    Status,
+    resolve_comm,
+)
 from ..token import NOTSET, pending_sends, raise_if_token_is_set
 from ..validation import enforce_types
 from .. import debug
@@ -216,7 +224,52 @@ def _check_tables_mirror(
 # ---------------------------------------------------------------------------
 
 
+def _shm_source(value, bound: BoundComm):
+    """Resolve a recv-side source: the ANY_SOURCE wildcard maps to the
+    native code, anything else goes through the partner table."""
+    from ..runtime import shm as _shm
+
+    if value is ANY_SOURCE:
+        if bound.shm_group is not None:
+            raise NotImplementedError(
+                "recv(ANY_SOURCE) on a Split sub-communicator is not "
+                "supported (the native wildcard poll scans all world "
+                "channels); use an explicit source"
+            )
+        return _shm.ANY_SOURCE_CODE
+    return _shm_partner(value, bound, "source")
+
+
+def _status_checked(status, bound: BoundComm, opname: str) -> int:
+    """Validate a ``status=`` argument; returns the native pointer attr
+    (0 = ignore). Only the multi-controller shm backend can introspect
+    message metadata (reference ``recv.py:100-103``); HLO collectives
+    cannot."""
+    if status is None:
+        return 0
+    if not isinstance(status, Status):
+        raise TypeError(
+            f"status must be a mpi4jax_tpu.Status (got {type(status)})"
+        )
+    if bound.backend != "shm":
+        raise NotImplementedError(
+            f"{opname}: MPI.Status introspection has no analog for HLO "
+            "collectives (SURVEY.md §7 hard-parts); supported on the "
+            "native shm backend (`python -m mpi4jax_tpu.launch`)"
+        )
+    # the native layer writes global ranks; Status translates back to
+    # communicator ranks for Split comms (MPI semantics)
+    status._group = bound.shm_group
+    return status._addr
+
+
 def _shm_partner(value: TableLike, bound: BoundComm, what: str) -> int:
+    if bound.shm_group is not None:
+        # Split sub-communicator: the table is group-rank indexed and
+        # entries are group ranks — translate to global ranks.
+        from ..runtime.shm_group import to_global_partner
+
+        return to_global_partner(value, bound.shm_group, what)
     if isinstance(value, (int, np.integer)):
         partner = int(value)
     else:
@@ -238,11 +291,9 @@ def _shm_partner(value: TableLike, bound: BoundComm, what: str) -> int:
 
 
 def _shm_ordered(fn, inputs, opname, details, bound):
-    from ..token import ordered_call
+    from ._core import emit_shm
 
-    ident = debug.log_emission(opname, details)
-    debug.log_runtime(bound, ident, opname, details)
-    return ordered_call(fn, inputs)
+    return emit_shm(fn, inputs, opname=opname, details=details, bound_comm=bound)
 
 
 # ---------------------------------------------------------------------------
@@ -273,24 +324,22 @@ def sendrecv(
     ``CartComm.shift`` produces matched pairs for grid shifts.
     """
     raise_if_token_is_set(token)
-    if status is not None:
-        raise NotImplementedError(
-            "MPI.Status introspection has no analog for HLO collectives "
-            "(SURVEY.md §7 hard-parts); the TPU path does not support it"
-        )
     bound = resolve_comm(comm)
+    status_ptr = _status_checked(status, bound, "sendrecv")
     if bound.backend == "shm":
         sendbuf = jnp.asarray(sendbuf)
         recvbuf = jnp.asarray(recvbuf)
-        src = _shm_partner(source, bound, "source")
+        src = _shm_source(source, bound)
         dst = _shm_partner(dest, bound, "dest")
+        if src == PROC_NULL and status is not None:
+            status._set_proc_null()
         if src == PROC_NULL and dst == PROC_NULL:
             return recvbuf
         from ..runtime import shm as _shm
 
         if dst == PROC_NULL:
             (out,) = _shm_ordered(
-                lambda t: (_shm.recv(t, src, recvtag),), (recvbuf,),
+                lambda t: (_shm.recv(t, src, recvtag, status_ptr),), (recvbuf,),
                 "Sendrecv", f"[recv-only from {src}]", bound,
             )
             return out
@@ -301,11 +350,19 @@ def sendrecv(
             )
             return recvbuf
         (out,) = _shm_ordered(
-            lambda s, r: (_shm.sendrecv(s, r, src, dst, sendtag, recvtag),),
+            lambda s, r: (
+                _shm.sendrecv(s, r, src, dst, sendtag, recvtag, status_ptr),
+            ),
             (sendbuf, recvbuf),
             "Sendrecv", f"[{sendbuf.size} items, src={src}, dst={dst}]", bound,
         )
         return out
+    if source is ANY_SOURCE:
+        raise NotImplementedError(
+            "sendrecv(ANY_SOURCE): wildcard sources cannot be expressed in "
+            "a static HLO collective (SURVEY.md §7 hard-parts); supported "
+            "on the native shm backend (`python -m mpi4jax_tpu.launch`)"
+        )
     if recvtag != ANY_TAG and recvtag != sendtag:
         # In the fused SPMD transfer the sender and receiver are the
         # same call, so the tags must agree (the reference's separate
@@ -399,24 +456,28 @@ def recv(
     The matching :func:`send` must have been issued earlier in the same
     traced program (see module docstring)."""
     raise_if_token_is_set(token)
-    if status is not None:
-        raise NotImplementedError(
-            "MPI.Status introspection has no analog for HLO collectives "
-            "(SURVEY.md §7 hard-parts); the TPU path does not support it"
-        )
     bound = resolve_comm(comm)
+    status_ptr = _status_checked(status, bound, "recv")
     x = jnp.asarray(x)
     if bound.backend == "shm":
-        src = _shm_partner(source, bound, "source")
+        src = _shm_source(source, bound)
         if src == PROC_NULL:
+            if status is not None:
+                status._set_proc_null()
             return x
         from ..runtime import shm as _shm
 
         (out,) = _shm_ordered(
-            lambda t: (_shm.recv(t, src, tag),), (x,),
+            lambda t: (_shm.recv(t, src, tag, status_ptr),), (x,),
             "Recv", f"[{x.size} items, src={src}, tag={tag}]", bound,
         )
         return out
+    if source is ANY_SOURCE:
+        raise NotImplementedError(
+            "recv(ANY_SOURCE): wildcard sources cannot be expressed in a "
+            "static HLO collective (SURVEY.md §7 hard-parts); supported "
+            "on the native shm backend (`python -m mpi4jax_tpu.launch`)"
+        )
     source_t = _normalize_table(source, bound.size, "source")
     recv_edges = _edges_from_source(source_t)
 

@@ -10,18 +10,55 @@ ring-collective pattern of the Pallas TPU guide. It is the
 ``mpi4jax_tpu`` analog of the reference's "bring your own transport"
 C++ layer — except the transport here is the TPU ICI itself.
 
+Two execution shapes, chosen automatically by payload size:
+
+- **VMEM-resident** (payloads up to ~4 MiB): the whole array lives in
+  VMEM for the duration of the kernel; one ring per call.
+- **Grid-streamed** (large payloads, tested to >= 64 MiB): the array
+  stays in HBM; Pallas streams ``(n, block_rows, 128)`` macro-blocks
+  through VMEM on a 1-D grid and the kernel runs one full ring per
+  block, with the neighbor barrier on the first block only and the
+  flow-control credits threaded across blocks.
+
+Numerics: bfloat16 payloads ride the wire in bf16 (half the ICI
+bytes) but fold into a float32 accumulator — each hop rounds the
+forwarded partial to bf16 once, which is strictly better than
+accumulating in bf16 at the same wire cost. All other dtypes (f32,
+f64) keep their own precision for both wire and accumulator.
+
+Flow control (the part the guide's sketch leaves implicit):
+
+- staging and landing are **separate** buffers — a neighbor's RDMA can
+  never clobber data this device is about to send;
+- a slot's staging buffer is reused only after ``rdma.wait()``
+  confirmed the previous send from it completed;
+- a slot's **landing** buffer on the right neighbor is reused only
+  after that neighbor consumed it: the consumer signals a capacity
+  credit to its left neighbor after reading, and the sender waits for
+  the credit before re-targeting the slot (global steps >= 2). The
+  final two credits are drained at kernel end so every regular
+  semaphore is zero on exit (Mosaic checks this in compiled mode).
+
 Opt-in via ``MPI4JAX_TPU_PALLAS_RING=1`` (routes SUM-allreduce of
-float32/bfloat16 payloads in the 1–4 MiB VMEM-resident window, on a
-communicator spanning a 1-D mesh, through this kernel — see
-``_use_pallas_ring`` in ``ops/allreduce.py`` for the exact predicate)
-or call :func:`ring_allreduce` directly. Correctness is validated in Pallas
-interpret mode on the virtual CPU mesh (``tests/test_pallas_ring.py``);
-the compiled path targets real multi-chip ICI.
+float32/bfloat16 payloads >= 1 MiB on a communicator spanning a 1-D
+mesh through this kernel — see ``_use_pallas_ring`` in
+``ops/allreduce.py``) or call :func:`ring_allreduce` directly.
+Correctness is validated in Pallas interpret mode on the virtual CPU
+mesh (``tests/test_pallas_ring.py``, incl. a 64 MiB streamed payload);
+the compiled path targets real multi-chip ICI and is compile-checked
+for the TPU target via cross-platform export (same test file).
+
+The collective id is derived from the axis name with a stable hash
+(identical across processes, never colliding for rings over the *same*
+axis; rings over two differently-named axes collide with probability
+~1/15) — pass ``collective_id=`` explicitly to guarantee separation or
+to coexist with user Pallas collectives using the same id space.
 """
 
 from __future__ import annotations
 
 import functools
+import zlib
 
 import numpy as np
 
@@ -35,56 +72,65 @@ from jax.experimental.pallas import tpu as pltpu
 _LANES = 128
 _SUBLANES = 8
 
+#: resident-footprint target for the streamed variant (bytes of VMEM
+#: across accumulator + input + 4 transfer buffers)
+_VMEM_BUDGET = 6 << 20
 
-def _ring_allreduce_kernel(
+
+def _derive_collective_id(axis_name: str) -> int:
+    # Deterministic across processes (zlib.crc32, not hash()) and
+    # identical on every device since the axis name is; avoid 0 which
+    # user kernels commonly default to.
+    return 1 + (zlib.crc32(str(axis_name).encode()) % 15)
+
+
+def _ring_kernel(
     n: int,
     axis_name: str,
     interpret: bool,
-    local_ref,      # (n, c, 128) VMEM: local contribution, chunked
-    out_ref,        # (n, c, 128) VMEM: result
-    send_buf,       # (2, c, 128) VMEM: local staging (RDMA source)
-    recv_buf,       # (2, c, 128) VMEM: landing zone (RDMA target)
+    wire_dtype,
+    acc_dtype,
+    local_ref,      # (n, rows_b, 128) VMEM: this block's contribution
+    out_ref,        # (n, rows_b, 128) VMEM f32: accumulator/result
+    send_buf,       # (2, rows_b, 128) wire dtype: staging (RDMA source)
+    recv_buf,       # (2, rows_b, 128) wire dtype: landing (RDMA target)
     send_sem,       # (2,) DMA semaphores (local send completion)
     recv_sem,       # (2,) DMA semaphores (remote data arrival)
     capacity_sem,   # (2,) regular semaphores (consumer credits)
 ):
-    """2n-2 ring steps (reduce-scatter then all-gather).
-
-    Flow control (the part the guide's sketch leaves implicit):
-
-    - staging and landing are **separate** buffers — a neighbor's RDMA
-      can never clobber data this device is about to send;
-    - a slot's staging buffer is reused only after ``rdma.wait()``
-      confirmed the previous send from it completed (slots alternate,
-      and waits are in-step, so this holds by construction);
-    - a slot's **landing** buffer on the right neighbor is reused only
-      after that neighbor consumed it: the consumer signals a capacity
-      credit to its left neighbor after reading, and the sender waits
-      for the credit before re-targeting the slot (steps s >= 2).
-
-    The HLO interpreter simulates RDMA synchronously in program order,
-    so the semaphore protocol is compiled-mode only.
-    """
+    """One full ring (2n-2 steps) over the current grid block."""
     my = lax.axis_index(axis_name)
     right = lax.rem(my + 1, n)
     left = lax.rem(my + n - 1, n)
+    block = pl.program_id(0)
+    num_blocks = pl.num_programs(0)
 
     if not interpret:
         # Entry barrier with both neighbors (guide pattern): nobody
-        # RDMAs into a device that hasn't entered the kernel.
-        barrier = pltpu.get_barrier_semaphore()
-        pltpu.semaphore_signal(barrier, inc=1, device_id=left)
-        pltpu.semaphore_signal(barrier, inc=1, device_id=right)
-        pltpu.semaphore_wait(barrier, 2)
+        # RDMAs into a device that hasn't entered the kernel. First
+        # block only — later blocks are already synchronized by the
+        # credit protocol.
+        @pl.when(block == 0)
+        def _entry_barrier():
+            barrier = pltpu.get_barrier_semaphore()
+            pltpu.semaphore_signal(barrier, inc=1, device_id=left)
+            pltpu.semaphore_signal(barrier, inc=1, device_id=right)
+            pltpu.semaphore_wait(barrier, 2)
 
-    out_ref[...] = local_ref[...]
+    out_ref[...] = local_ref[...].astype(acc_dtype)
 
     def ring_step(s, send_idx, accumulate):
         slot = s % 2
-        if not interpret and s >= 2:
-            # wait for the right neighbor's credit that slot is free
-            pltpu.semaphore_wait(capacity_sem.at[slot], 1)
-        send_buf[slot] = out_ref[send_idx]
+        if not interpret:
+            if s >= 2:
+                pltpu.semaphore_wait(capacity_sem.at[slot], 1)
+            else:
+                # steps 0 and 1 of later blocks reuse slots whose
+                # credits were granted during the previous block
+                @pl.when(block > 0)
+                def _wait_carry():
+                    pltpu.semaphore_wait(capacity_sem.at[slot], 1)
+        send_buf[slot] = out_ref[send_idx].astype(wire_dtype)
         rdma = pltpu.make_async_remote_copy(
             src_ref=send_buf.at[slot],
             dst_ref=recv_buf.at[slot],
@@ -110,7 +156,7 @@ def _ring_allreduce_kernel(
         recv_idx = lax.rem(my + n - s - 1, n)
 
         def acc_rs(slot, recv_idx=recv_idx):
-            out_ref[recv_idx] += recv_buf[slot]
+            out_ref[recv_idx] += recv_buf[slot].astype(acc_dtype)
 
         ring_step(s, send_idx, acc_rs)
 
@@ -122,46 +168,103 @@ def _ring_allreduce_kernel(
         recv_idx = lax.rem(my + n - s, n)
 
         def acc_ag(slot, recv_idx=recv_idx):
-            out_ref[recv_idx] = recv_buf[slot]
+            out_ref[recv_idx] = recv_buf[slot].astype(acc_dtype)
 
         ring_step(step, send_idx, acc_ag)
 
+    if not interpret:
+        # Drain the two never-awaited closing credits so all regular
+        # semaphores are zero at kernel exit (Mosaic invariant). Only
+        # on the final block — intermediate blocks' closing credits are
+        # consumed by the next block's steps 0/1.
+        @pl.when(block == num_blocks - 1)
+        def _drain():
+            pltpu.semaphore_wait(capacity_sem.at[0], 1)
+            pltpu.semaphore_wait(capacity_sem.at[1], 1)
 
-def ring_allreduce(x, axis_name: str, n: int, *, interpret: bool = False):
+
+def ring_allreduce(
+    x,
+    axis_name: str,
+    n: int,
+    *,
+    interpret: bool = False,
+    collective_id: int | None = None,
+):
     """SUM all-reduce of ``x`` over ``axis_name`` via a Pallas RDMA
     ring. Must be called inside shard_map with ``axis_name`` bound and
-    the axis laid out as a (logical) ring; any float dtype/shape
-    (padded internally to (n, c, 128) f32-tile chunks)."""
+    the axis laid out as a (logical) ring; any float dtype/shape.
+    Payloads whose VMEM-resident footprint would exceed the budget are
+    grid-streamed from HBM in macro-blocks automatically."""
     if n == 1:
         return x
     orig_shape, orig_dtype = x.shape, x.dtype
+    # bf16 rides the wire in bf16 (half the ICI bytes) but accumulates
+    # in f32; every other dtype keeps its own precision end-to-end
+    # (f64 must not be silently rounded through an f32 accumulator).
+    if x.dtype == jnp.bfloat16:
+        wire_dtype, acc_dtype = jnp.bfloat16, jnp.float32
+    else:
+        wire_dtype = acc_dtype = x.dtype
     flat = x.reshape(-1)
     total = flat.shape[0]
     chunk_elems = -(-total // n)  # ceil
-    # round chunk rows up to a full tile: (8, 128) for 4-byte dtypes,
-    # (16, 128) for 2-byte dtypes (bf16 packing)
     sublanes = _SUBLANES * (4 // max(flat.dtype.itemsize, 1))
     sublanes = max(sublanes, _SUBLANES)
     rows = -(-chunk_elems // _LANES)
     rows = -(-rows // sublanes) * sublanes
+
+    # Resident bytes per row across accumulator (f32), input, and the
+    # four wire buffers; choose a block-row count within the budget.
+    wire_itemsize = jnp.dtype(wire_dtype).itemsize
+    acc_itemsize = jnp.dtype(acc_dtype).itemsize
+    per_row = _LANES * (
+        n * acc_itemsize + n * flat.dtype.itemsize + 4 * wire_itemsize
+    )
+    max_rows = max(_VMEM_BUDGET // per_row, 1)
+    # floor to a whole number of tiles (minimum one tile)
+    max_rows = max((max_rows // sublanes) * sublanes, sublanes)
+    if rows > max_rows:
+        block_rows = max_rows
+        rows = -(-rows // block_rows) * block_rows  # pad to whole blocks
+    else:
+        block_rows = rows
+    num_blocks = rows // block_rows
+
     padded = n * rows * _LANES
     flat = jnp.pad(flat, (0, padded - total))
     chunked = flat.reshape(n, rows, _LANES)
 
-    kernel = functools.partial(_ring_allreduce_kernel, n, axis_name, interpret)
+    if collective_id is None:
+        collective_id = _derive_collective_id(axis_name)
+
+    kernel = functools.partial(
+        _ring_kernel, n, axis_name, interpret, wire_dtype, acc_dtype
+    )
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((n, rows, _LANES), chunked.dtype),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        grid=(num_blocks,),
+        out_shape=jax.ShapeDtypeStruct((n, rows, _LANES), acc_dtype),
+        in_specs=[
+            pl.BlockSpec(
+                (n, block_rows, _LANES),
+                lambda i: (0, i, 0),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (n, block_rows, _LANES),
+            lambda i: (0, i, 0),
+            memory_space=pltpu.VMEM,
+        ),
         scratch_shapes=[
-            pltpu.VMEM((2, rows, _LANES), chunked.dtype),
-            pltpu.VMEM((2, rows, _LANES), chunked.dtype),
+            pltpu.VMEM((2, block_rows, _LANES), wire_dtype),
+            pltpu.VMEM((2, block_rows, _LANES), wire_dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR((2,)),
         ],
-        compiler_params=pltpu.CompilerParams(collective_id=7),
+        compiler_params=pltpu.CompilerParams(collective_id=collective_id),
         interpret=interpret,
     )(chunked)
     return out.reshape(-1)[:total].reshape(orig_shape).astype(orig_dtype)

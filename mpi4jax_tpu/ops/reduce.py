@@ -31,7 +31,11 @@ def _reduce_spmd(x, *, op, root, comm: BoundComm):
         from ..runtime import shm as _shm
         from .allreduce import _shm_reduction_dtype_check
 
-        _shm_reduction_dtype_check(x)
+        _shm_reduction_dtype_check(x, op)
+        if comm.shm_group is not None:
+            from ..runtime import shm_group as _grp
+
+            return _grp.reduce(x, op, root, comm.shm_group)
         return _shm.reduce(x, op, root)
     if not comm.axes or comm.size == 1:
         return x

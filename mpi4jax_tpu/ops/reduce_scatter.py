@@ -37,9 +37,14 @@ def _reduce_scatter_spmd(x, *, op: Op, comm: BoundComm):
         from ..runtime import shm as _shm
         from .allreduce import _shm_reduction_dtype_check
 
-        _shm_reduction_dtype_check(x)
-        reduced = _shm.allreduce(x, op)
-        return reduced[comm.shm_rank]
+        _shm_reduction_dtype_check(x, op)
+        if comm.shm_group is not None:
+            from ..runtime import shm_group as _grp
+
+            reduced = _grp.allreduce(x, op, comm.shm_group)
+        else:
+            reduced = _shm.allreduce(x, op)
+        return reduced[comm.shm_group_rank]
     if not comm.axes or comm.size == 1:
         return x[0]
     axis = comm.axis_target()

@@ -34,7 +34,11 @@ def _scan_spmd(x, *, op: Op, comm: BoundComm):
         from ..runtime import shm as _shm
         from .allreduce import _shm_reduction_dtype_check
 
-        _shm_reduction_dtype_check(x)
+        _shm_reduction_dtype_check(x, op)
+        if comm.shm_group is not None:
+            from ..runtime import shm_group as _grp
+
+            return _grp.scan(x, op, comm.shm_group)
         return _shm.scan(x, op)
     if not comm.axes or comm.size == 1:
         return x

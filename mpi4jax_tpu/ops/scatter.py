@@ -4,10 +4,13 @@ Rebuild of reference ``_src/collective_ops/scatter.py``: the root's
 input must have leading axis ``size`` and rank ``i`` receives block
 ``i`` (reference ``scatter.py:80-84,145-153``).
 
-**Documented TPU deviation:** the reference lets non-root ranks pass an
-input shaped like the *output* (their input is ignored); under SPMD all
-ranks pass the ``(size, *block)``-shaped input (only the root's values
-matter). The output is ``x.shape[1:]`` on every rank.
+**Documented TPU deviation, XLA path only:** the reference lets
+non-root ranks pass an input shaped like the *output* (their input is
+ignored); under SPMD all ranks pass the ``(size, *block)``-shaped input
+(only the root's values matter). The output is ``x.shape[1:]`` on every
+rank. On the native shm backend (multi-controller) the reference
+contract holds exactly: non-root ranks pass a block-shaped template
+(``scatter.py:145-153``).
 
 Lowering: a root-masked HLO ReduceScatter
 (``psum_scatter(where(rank == root, x, 0))``) — a single collective at
@@ -34,9 +37,10 @@ def _scatter_abstract_eval(x, *, root, comm: BoundComm):
 
 def _scatter_spmd(x, *, root, comm: BoundComm):
     if comm.backend == "shm":
-        from ..runtime import shm as _shm
-
-        return _shm.scatter(x, root)
+        raise RuntimeError(
+            "internal: shm scatter is handled in the wrapper (root-"
+            "dependent input shapes cannot pass through the primitive)"
+        )
     if not comm.axes or comm.size == 1:
         return x[0]
     axis = comm.axis_target()
@@ -72,6 +76,34 @@ def scatter(x, root=0, *, comm=None, token=NOTSET):
     if not 0 <= root < bound.size:
         raise ValueError(f"root {root} out of range for size {bound.size}")
     x = jnp.asarray(x)
+    if bound.backend == "shm":
+        # Exact reference contract (scatter.py:145-153): the root
+        # passes (size, *block) and receives block x.shape[1:]; other
+        # ranks pass a block-shaped template (values ignored).
+        if bound.shm_group_rank == root and (
+            x.ndim < 1 or x.shape[0] != bound.size
+        ):
+            raise ValueError(
+                f"scatter root input must have leading axis of size "
+                f"{bound.size} (the communicator size), got shape "
+                f"{x.shape}; reference parity: scatter.py:80-84"
+            )
+        from ..runtime import shm as _shm
+        from ._core import emit_shm
+
+        if bound.shm_group is not None:
+            from ..runtime import shm_group as _grp
+
+            fn = lambda t: (_grp.scatter(t, root, bound.shm_group),)  # noqa: E731
+        else:
+            fn = lambda t: (_shm.scatter(t, root),)  # noqa: E731
+        (out,) = emit_shm(
+            fn, (x,),
+            opname="Scatter",
+            details=f"[{x.size} items, root={root}, n={bound.size}]",
+            bound_comm=bound,
+        )
+        return out
     if x.ndim < 1 or x.shape[0] != bound.size:
         raise ValueError(
             f"scatter input must have leading axis of size {bound.size} "

@@ -159,10 +159,28 @@ class ShmComm(Comm):
 
 
 def _ffi(name, result, *args, **attrs):
+    """Invoke a native handler with program-order wire threading.
+
+    Appends the previous native call's output as a trailing operand
+    (handlers bind ``RemainingArgs`` and ignore it) and records this
+    call's output as the next wire — real producer/consumer edges that
+    no XLA pass can reorder, the moral equivalent of the reference's
+    XLA-token threading (``_src/jax_compat.py:74-77``). Without this,
+    XLA's CPU pipeline can delete ``optimization_barrier`` ties and
+    schedule e.g. a rank's recv before its own send — a deadlock in a
+    blocking runtime (observed; see ``token.shm_wire``).
+    """
     import jax
 
+    from ..token import set_shm_wire, shm_wire
+
+    wire = shm_wire()
+    if wire is not None:
+        args = args + (wire,)
     call = jax.ffi.ffi_call(name, result, has_side_effect=True)
-    return call(*args, **attrs)
+    out = call(*args, **attrs)
+    set_shm_wire(out[0] if isinstance(out, (tuple, list)) else out)
+    return out
 
 
 def _result_like(x):
@@ -217,8 +235,22 @@ def bcast(x, root):
 def scatter(x, root):
     import jax
 
-    res = jax.ShapeDtypeStruct(x.shape[1:], x.dtype)
+    # Reference parity (scatter.py:145-153): the root passes the full
+    # (size, *block) input and gets a block back; non-root ranks pass a
+    # block-shaped template (ignored) and get a same-shaped block.
+    shape = x.shape[1:] if _RANK == root else x.shape
+    res = jax.ShapeDtypeStruct(shape, x.dtype)
     return _ffi("m4t_shm_scatter", res, x, root=np.int64(root))
+
+
+def gather(x, root):
+    import jax
+
+    # Root-only result (reference gather.py:80-89): root gets the
+    # stacked (size, *shape) array, non-root ranks get x back.
+    shape = (_SIZE,) + x.shape if _RANK == root else x.shape
+    res = jax.ShapeDtypeStruct(shape, x.dtype)
+    return _ffi("m4t_shm_gather", res, x, root=np.int64(root))
 
 
 def alltoall(x):
@@ -226,7 +258,9 @@ def alltoall(x):
 
 
 def barrier(tok):
-    return _ffi("m4t_shm_barrier", _result_like(tok))
+    # tok rides as a carrier operand so the ordering-token tie creates
+    # a real data dependency (see shmcc.cpp carrier note).
+    return _ffi("m4t_shm_barrier", _result_like(tok), tok)
 
 
 def send(x, dest: int, tag: int):
@@ -238,16 +272,30 @@ def send(x, dest: int, tag: int):
     )
 
 
-def recv(template, source: int, tag: int):
+#: native wildcard-source code (shmcc.cpp kAnySource)
+ANY_SOURCE_CODE = -2
+
+
+def recv(template, source: int, tag: int, status_ptr: int = 0):
+    # the template rides as a carrier operand: its contents are ignored
+    # but the ordering-token tie binds to it, giving the recv a real
+    # data dependency on every earlier op (see shmcc.cpp carrier note —
+    # without it XLA may schedule the recv before this rank's own send,
+    # deadlocking both sides).
     return _ffi(
-        "m4t_shm_recv", _result_like(template),
+        "m4t_shm_recv", _result_like(template), template,
         source=np.int64(source), tag=np.int64(tag),
+        status_ptr=np.int64(status_ptr),
     )
 
 
-def sendrecv(sendbuf, recvbuf, source: int, dest: int, sendtag: int, recvtag: int):
+def sendrecv(
+    sendbuf, recvbuf, source: int, dest: int, sendtag: int, recvtag: int,
+    status_ptr: int = 0,
+):
     return _ffi(
         "m4t_shm_sendrecv", _result_like(recvbuf), sendbuf,
         source=np.int64(source), dest=np.int64(dest),
         sendtag=np.int64(sendtag), recvtag=np.int64(recvtag),
+        status_ptr=np.int64(status_ptr),
     )

@@ -1,0 +1,230 @@
+"""Group (sub-communicator) collectives on the native shm backend.
+
+``MPI_Comm_split`` reachability for the multi-process CPU world
+(reference: any op works on any communicator, ``_src/utils.py:60-97``).
+The native layer's collective slots and barriers are world-wide
+(``shmcc.cpp``), so sub-group collectives are composed here from the
+point-to-point rendezvous channels instead: a leader-based
+gather/compute/distribute per group. Exactness over speed — this is the
+CPU parity path, not the ICI path; each group's traffic rides its own
+per-pair channels, so distinct groups progress independently.
+
+All group traffic uses tags in a reserved namespace (``_TAG_BASE``) so
+it can never match user-issued p2p tags.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from . import shm as _shm
+from ..token import ordered_call
+
+#: reserved tag namespace for group-collective internals
+_TAG_BASE = 1 << 20
+_T_GATHER = _TAG_BASE + 1
+_T_DIST = _TAG_BASE + 2
+_T_BARRIER = _TAG_BASE + 3
+_T_ACK = _TAG_BASE + 4
+
+
+def _me(group: Tuple[int, ...]) -> int:
+    return group.index(_shm.rank())
+
+
+# Every native call is individually tied into the ambient ordering
+# token chain: a group collective is *several* FFI calls in one
+# program, and XLA gives no execution-order guarantee between
+# independent side-effecting custom calls — without the chain a
+# member's recv could be scheduled before its own send, deadlocking
+# the whole group (each call blocks in native code).
+
+
+def _send(x, dst_global: int, tag: int) -> None:
+    ordered_call(lambda v: (_shm.send(v, dst_global, tag),), (jnp.asarray(x),))
+
+
+def _recv(template, src_global: int, tag: int):
+    (out,) = ordered_call(
+        lambda t: (_shm.recv(t, src_global, tag),), (jnp.asarray(template),)
+    )
+    return out
+
+
+def _gather_at(x, group, at_global: int):
+    """Collect every member's ``x`` at global rank ``at_global``;
+    returns the ``(gsize, *x.shape)`` stack there, None elsewhere."""
+    if _shm.rank() == at_global:
+        parts = []
+        for m in group:
+            if m == at_global:
+                parts.append(jnp.asarray(x))
+            else:
+                parts.append(_recv(x, m, _T_GATHER))
+        return jnp.stack(parts)
+    _send(x, at_global, _T_GATHER)
+    return None
+
+
+def _distribute_from(template, group, from_global: int, per_member=None):
+    """Send ``per_member[i]`` to member i from ``from_global`` (or a
+    shared ``template``-shaped value when ``per_member`` is a single
+    array); returns this member's value."""
+    me = _shm.rank()
+    if me == from_global:
+        mine = None
+        for i, m in enumerate(group):
+            val = per_member[i] if isinstance(per_member, list) else per_member
+            if m == me:
+                mine = val
+            else:
+                _send(val, m, _T_DIST)
+        return mine
+    return _recv(template, from_global, _T_DIST)
+
+
+def allreduce(x, op, group):
+    x = jnp.asarray(x)
+    if len(group) == 1:
+        return x
+    leader = group[0]
+    stacked = _gather_at(x, group, leader)
+    if stacked is not None:
+        red = op.reduce_along_axis(stacked, axis=0).astype(x.dtype)
+        return _distribute_from(x, group, leader, red)
+    return _distribute_from(x, group, leader)
+
+
+def scan(x, op, group):
+    x = jnp.asarray(x)
+    if len(group) == 1:
+        return x
+    leader = group[0]
+    stacked = _gather_at(x, group, leader)
+    if stacked is not None:
+        prefixes = [
+            op.reduce_along_axis(stacked[: i + 1], axis=0).astype(x.dtype)
+            for i in range(len(group))
+        ]
+        return _distribute_from(x, group, leader, prefixes)
+    return _distribute_from(x, group, leader)
+
+
+def reduce(x, op, root_group_rank: int, group):
+    """Root-only result: the group root gets the reduction, every other
+    member gets ``x`` back (reference ``reduce.py:64-73``)."""
+    x = jnp.asarray(x)
+    if len(group) == 1:
+        return x
+    root = group[root_group_rank]
+    stacked = _gather_at(x, group, root)
+    if stacked is not None:
+        return op.reduce_along_axis(stacked, axis=0).astype(x.dtype)
+    return x
+
+
+def allgather(x, group):
+    x = jnp.asarray(x)
+    if len(group) == 1:
+        return x[None]
+    leader = group[0]
+    stacked = _gather_at(x, group, leader)
+    template = jnp.broadcast_to(x[None], (len(group),) + x.shape)
+    if stacked is not None:
+        return _distribute_from(template, group, leader, stacked)
+    return _distribute_from(template, group, leader)
+
+
+def gather(x, root_group_rank: int, group):
+    """Root-only gather: the group root returns the stack, other
+    members return ``x`` (reference ``gather.py:80-89``)."""
+    x = jnp.asarray(x)
+    if len(group) == 1:
+        return x[None]
+    root = group[root_group_rank]
+    stacked = _gather_at(x, group, root)
+    return stacked if stacked is not None else x
+
+
+def bcast(x, root_group_rank: int, group):
+    x = jnp.asarray(x)
+    if len(group) == 1:
+        return x
+    root = group[root_group_rank]
+    if _shm.rank() == root:
+        return _distribute_from(x, group, root, x)
+    return _distribute_from(x, group, root)
+
+
+def scatter(x, root_group_rank: int, group):
+    """Root passes ``(gsize, *block)`` and receives block
+    ``root_group_rank``; non-root members pass a block template."""
+    x = jnp.asarray(x)
+    if len(group) == 1:
+        return x[0]
+    root = group[root_group_rank]
+    if _shm.rank() == root:
+        blocks = [x[i] for i in range(len(group))]
+        return _distribute_from(x[0], group, root, blocks)
+    return _distribute_from(x, group, root)
+
+
+def alltoall(x, group):
+    """``x`` is ``(gsize, *block)`` per member; member r's output block
+    j is member j's input block r."""
+    x = jnp.asarray(x)
+    n = len(group)
+    if n == 1:
+        return x
+    leader = group[0]
+    stacked = _gather_at(x, group, leader)  # (n, n, *block)
+    if stacked is not None:
+        outs = [stacked[:, r] for r in range(n)]
+        return _distribute_from(x, group, leader, outs)
+    return _distribute_from(x, group, leader)
+
+
+def barrier(group):
+    """Leader collects a token from every member, then acks all."""
+    if len(group) == 1:
+        return
+    leader = group[0]
+    tok = jnp.zeros((1,), jnp.int32)
+    if _shm.rank() == leader:
+        for m in group[1:]:
+            _recv(tok, m, _T_BARRIER)
+        for m in group[1:]:
+            _send(tok, m, _T_ACK)
+    else:
+        _send(tok, leader, _T_BARRIER)
+        _recv(tok, leader, _T_ACK)
+
+
+def to_global_partner(value, group: Tuple[int, ...], what: str) -> int:
+    """Translate a group-rank partner table/scalar to the global rank.
+
+    Mirrors ``ops.p2p._shm_partner`` but indexes the table by *group*
+    rank and maps the entry through the group (PROC_NULL passes
+    through)."""
+    gr = _me(group)
+    if isinstance(value, (int, np.integer)):
+        partner = int(value)
+    else:
+        table = tuple(int(v) for v in value)
+        if len(table) != len(group):
+            raise ValueError(
+                f"{what} table has length {len(table)}, expected "
+                f"{len(group)} (the communicator size)"
+            )
+        partner = table[gr]
+    if partner < 0:
+        return -1  # PROC_NULL (any negative means "no partner")
+    if partner >= len(group):
+        raise ValueError(
+            f"{what} {partner} out of range for size {len(group)}"
+        )
+    return group[partner]

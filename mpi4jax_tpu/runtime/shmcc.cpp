@@ -26,10 +26,12 @@
 
 #include <atomic>
 #include <cinttypes>
+#include <complex>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <string>
+#include <utility>
 
 #include <fcntl.h>
 #include <sched.h>
@@ -48,6 +50,7 @@ constexpr int kMaxRanks = 16;
 constexpr size_t kCollChunk = size_t{1} << 22;  // 4 MiB per-rank slot
 constexpr size_t kP2PChunk = size_t{1} << 18;   // 256 KiB channel entry
 constexpr int64_t kAnyTag = -1;
+constexpr int64_t kAnySource = -2;  // MPI_ANY_SOURCE analog (recv wildcard)
 constexpr long kSpinTimeoutUs = 120L * 1000 * 1000;  // 2 min -> abort
 
 // Reduction op codes (mirrors mpi4jax_tpu.comm Op order).
@@ -218,6 +221,24 @@ static void accumulate(int64_t op, T* acc, const T* in, size_t n) {
   fatal("unsupported reduction op for dtype");
 }
 
+// Complex reductions: only SUM/PROD are defined (MPI likewise rejects
+// MAX/MIN on complex types — reference dtype table _src/utils.py:101-128
+// pairs c64/c128 with the value-combining ops only).
+template <typename T>
+static void accumulate_complex(int64_t op, std::complex<T>* acc,
+                               const std::complex<T>* in, size_t n) {
+  switch (op) {
+    case kSum:
+      for (size_t i = 0; i < n; ++i) acc[i] += in[i];
+      return;
+    case kProd:
+      for (size_t i = 0; i < n; ++i) acc[i] *= in[i];
+      return;
+    default:
+      fatal("unsupported reduction op for complex dtype (SUM/PROD only)");
+  }
+}
+
 // Accumulate `in` into `acc` interpreting bytes per DataType.
 static void accumulate_dtype(ffi::DataType dt, int64_t op, void* acc,
                              const void* in, size_t nbytes) {
@@ -252,6 +273,14 @@ static void accumulate_dtype(ffi::DataType dt, int64_t op, void* acc,
       return;
     case ffi::DataType::U64:
       accumulate<uint64_t>(op, (uint64_t*)acc, (const uint64_t*)in, nbytes / 8);
+      return;
+    case ffi::DataType::C64:
+      accumulate_complex<float>(op, (std::complex<float>*)acc,
+                                (const std::complex<float>*)in, nbytes / 8);
+      return;
+    case ffi::DataType::C128:
+      accumulate_complex<double>(op, (std::complex<double>*)acc,
+                                 (const std::complex<double>*)in, nbytes / 16);
       return;
     default:
       fatal("unsupported dtype on shm backend");
@@ -313,6 +342,7 @@ struct RecvCursor {
   int64_t tag;
   size_t off = 0;
   bool first = true;
+  int64_t seen_tag = kAnyTag;  // actual tag of the matched message
   bool done() const { return off >= nbytes; }
   bool try_step() {
     if (done()) return false;
@@ -323,6 +353,7 @@ struct RecvCursor {
         fatal("recv tag mismatch (shm channels deliver in order; "
               "out-of-order tag matching is not supported)");
       if (ch->msg_bytes != nbytes) fatal("recv size mismatch");
+      seen_tag = ch->tag;
       first = false;
     }
     size_t len = ch->chunk_bytes;
@@ -333,6 +364,43 @@ struct RecvCursor {
     return true;
   }
 };
+
+// MPI_Status analog: the Python wrapper passes the address of a
+// persistent int64[3] buffer owned by a Status object (the reference
+// passes _addressof(MPI.Status) the same way, recv.py:100-103);
+// 0 means MPI_STATUS_IGNORE.
+static void write_status(int64_t status_ptr, int64_t source, int64_t tag,
+                         size_t nbytes) {
+  if (status_ptr == 0) return;
+  int64_t* s = reinterpret_cast<int64_t*>(static_cast<intptr_t>(status_ptr));
+  s[0] = source;
+  s[1] = tag;
+  s[2] = static_cast<int64_t>(nbytes);
+}
+
+// Wildcard-source matching: poll every inbound channel until one has a
+// published message, then receive from it. Only expressible in the
+// multi-controller shm world (reference recv.py:49-54 supports
+// MPI.ANY_SOURCE; the static single-program XLA path cannot).
+static int p2p_wait_any_source(int64_t tag) {
+  int found = -1;
+  spin_until(
+      [&found, tag] {
+        for (int s = 0; s < g.size; ++s) {
+          if (s == g.rank) continue;
+          Channel* ch = &g.sh->channels[s][g.rank];
+          if (ch->head.load(std::memory_order_acquire) !=
+              ch->tail.load(std::memory_order_relaxed)) {
+            if (tag != kAnyTag && ch->tag != tag) continue;
+            found = s;
+            return true;
+          }
+        }
+        return false;
+      },
+      "recv(ANY_SOURCE) timeout (no matching send?)");
+  return found;
+}
 
 template <typename A, typename B>
 static void drive(A* a, B* b, const char* what) {
@@ -362,10 +430,14 @@ static void p2p_send(const void* data, size_t nbytes, int dest, int64_t tag) {
   drive(&s, (RecvCursor*)nullptr, "send timeout (no matching recv?)");
 }
 
-static void p2p_recv(void* data, size_t nbytes, int source, int64_t tag) {
+// Returns the actual (source, tag) pair for status capture.
+static std::pair<int, int64_t> p2p_recv(void* data, size_t nbytes, int source,
+                                        int64_t tag) {
+  if (source == kAnySource) source = p2p_wait_any_source(tag);
   if (source < 0 || source >= g.size) fatal("recv source out of range");
   RecvCursor r{&g.sh->channels[source][g.rank], (char*)data, nbytes, tag};
   drive((SendCursor*)nullptr, &r, "recv timeout (no matching send?)");
+  return {source, r.seen_tag};
 }
 
 // ---------------------------------------------------------------------------
@@ -380,15 +452,27 @@ static ffi::Error not_init() {
                     "`python -m mpi4jax_tpu.launch`)");
 }
 
-static ffi::Error BarrierImpl(ffi::Result<ffi::AnyBuffer> out) {
+// Note on the `carrier` operands below: XLA gives no execution-order
+// guarantee between independent side-effecting custom calls in one
+// program. The Python layer threads its ordering token through every
+// op with optimization_barrier ties — but that only works if each
+// custom call *consumes an operand* the tie can bind to. Ops with no
+// natural input (recv, barrier) therefore take a small ignored-content
+// carrier buffer (the recv template / the token scalar).
+
+static ffi::Error BarrierImpl(ffi::AnyBuffer carrier,
+                              ffi::RemainingArgs wire,
+                              ffi::Result<ffi::AnyBuffer> out) {
   if (g.sh == nullptr) return not_init();
   DebugTimer t("Barrier", 0);
+  (void)carrier;
   barrier();
   std::memset(out->untyped_data(), 0, out->size_bytes());
   return ok();
 }
 
 static ffi::Error AllreduceImpl(int64_t op, ffi::AnyBuffer x,
+                                ffi::RemainingArgs wire,
                                 ffi::Result<ffi::AnyBuffer> out) {
   if (g.sh == nullptr) return not_init();
   size_t nbytes = x.size_bytes();
@@ -404,6 +488,7 @@ static ffi::Error AllreduceImpl(int64_t op, ffi::AnyBuffer x,
 }
 
 static ffi::Error ScanImpl(int64_t op, ffi::AnyBuffer x,
+                           ffi::RemainingArgs wire,
                            ffi::Result<ffi::AnyBuffer> out) {
   if (g.sh == nullptr) return not_init();
   size_t nbytes = x.size_bytes();
@@ -419,6 +504,7 @@ static ffi::Error ScanImpl(int64_t op, ffi::AnyBuffer x,
 }
 
 static ffi::Error ReduceImpl(int64_t op, int64_t root, ffi::AnyBuffer x,
+                             ffi::RemainingArgs wire,
                              ffi::Result<ffi::AnyBuffer> out) {
   if (g.sh == nullptr) return not_init();
   size_t nbytes = x.size_bytes();
@@ -437,7 +523,7 @@ static ffi::Error ReduceImpl(int64_t op, int64_t root, ffi::AnyBuffer x,
   return ok();
 }
 
-static ffi::Error AllgatherImpl(ffi::AnyBuffer x,
+static ffi::Error AllgatherImpl(ffi::AnyBuffer x, ffi::RemainingArgs wire,
                                 ffi::Result<ffi::AnyBuffer> out) {
   if (g.sh == nullptr) return not_init();
   size_t nbytes = x.size_bytes();
@@ -451,6 +537,7 @@ static ffi::Error AllgatherImpl(ffi::AnyBuffer x,
 }
 
 static ffi::Error BcastImpl(int64_t root, ffi::AnyBuffer x,
+                            ffi::RemainingArgs wire,
                             ffi::Result<ffi::AnyBuffer> out) {
   if (g.sh == nullptr) return not_init();
   size_t nbytes = x.size_bytes();
@@ -464,10 +551,17 @@ static ffi::Error BcastImpl(int64_t root, ffi::AnyBuffer x,
 }
 
 static ffi::Error ScatterImpl(int64_t root, ffi::AnyBuffer x,
+                              ffi::RemainingArgs wire,
                               ffi::Result<ffi::AnyBuffer> out) {
   if (g.sh == nullptr) return not_init();
-  size_t total = x.size_bytes();
+  // Reference parity (scatter.py:80-84,145-153): only the root's input
+  // is the full (size, *block) array; non-root ranks may pass a
+  // block-shaped template (ignored), so the round span is derived from
+  // the *output* block size, never from a non-root input.
   size_t block = out->size_bytes();
+  size_t total = block * g.size;
+  if (g.rank == root && x.size_bytes() != total)
+    fatal("scatter: root input bytes != size * output block bytes");
   DebugTimer t("Scatter", block);
   char* dst = (char*)out->untyped_data();
   const void* mine = g.rank == root ? x.untyped_data() : nullptr;
@@ -481,7 +575,29 @@ static ffi::Error ScatterImpl(int64_t root, ffi::AnyBuffer x,
   return ok();
 }
 
-static ffi::Error AlltoallImpl(ffi::AnyBuffer x,
+static ffi::Error GatherImpl(int64_t root, ffi::AnyBuffer x,
+                             ffi::RemainingArgs wire,
+                             ffi::Result<ffi::AnyBuffer> out) {
+  if (g.sh == nullptr) return not_init();
+  // Root-only result (reference gather.py:80-89): the root's output is
+  // the stacked (size, *shape) array; non-root outputs are their input
+  // passed through unchanged (their out buffer is x-shaped).
+  size_t nbytes = x.size_bytes();
+  DebugTimer t("Gather", nbytes);
+  char* dst = (char*)out->untyped_data();
+  bool is_root = g.rank == root;
+  collective_rounds(x.untyped_data(), nbytes, [&](size_t off, size_t len) {
+    if (is_root) {
+      for (int r = 0; r < g.size; ++r)
+        std::memcpy(dst + r * nbytes + off, g.sh->coll[r], len);
+    } else {
+      std::memcpy(dst + off, (const char*)x.untyped_data() + off, len);
+    }
+  });
+  return ok();
+}
+
+static ffi::Error AlltoallImpl(ffi::AnyBuffer x, ffi::RemainingArgs wire,
                                ffi::Result<ffi::AnyBuffer> out) {
   if (g.sh == nullptr) return not_init();
   size_t total = x.size_bytes();
@@ -501,27 +617,79 @@ static ffi::Error AlltoallImpl(ffi::AnyBuffer x,
 }
 
 static ffi::Error SendImpl(int64_t dest, int64_t tag, ffi::AnyBuffer x,
+                           ffi::RemainingArgs wire,
                            ffi::Result<ffi::AnyBuffer> out) {
   if (g.sh == nullptr) return not_init();
   DebugTimer t("Send", x.size_bytes());
+  if (g.debug)
+    std::fprintf(stderr, "shmcc r%d |   send dst=%" PRId64 " tag=%" PRId64 "\n",
+                 g.rank, dest, tag);
   p2p_send(x.untyped_data(), x.size_bytes(), (int)dest, tag);
   std::memset(out->untyped_data(), 0, out->size_bytes());
   return ok();
 }
 
-static ffi::Error RecvImpl(int64_t source, int64_t tag,
+static ffi::Error RecvImpl(int64_t source, int64_t tag, int64_t status_ptr,
+                           ffi::AnyBuffer carrier, ffi::RemainingArgs wire,
                            ffi::Result<ffi::AnyBuffer> out) {
   if (g.sh == nullptr) return not_init();
   DebugTimer t("Recv", out->size_bytes());
-  p2p_recv(out->untyped_data(), out->size_bytes(), (int)source, tag);
+  if (g.debug)
+    std::fprintf(stderr, "shmcc r%d |   recv src=%" PRId64 " tag=%" PRId64 "\n",
+                 g.rank, source, tag);
+  (void)carrier;
+  auto [src, seen_tag] =
+      p2p_recv(out->untyped_data(), out->size_bytes(), (int)source, tag);
+  write_status(status_ptr, src, seen_tag, out->size_bytes());
   return ok();
 }
 
 static ffi::Error SendrecvImpl(int64_t source, int64_t dest, int64_t sendtag,
-                               int64_t recvtag, ffi::AnyBuffer x,
+                               int64_t recvtag, int64_t status_ptr,
+                               ffi::AnyBuffer x, ffi::RemainingArgs wire,
                                ffi::Result<ffi::AnyBuffer> out) {
   if (g.sh == nullptr) return not_init();
   DebugTimer t("Sendrecv", x.size_bytes());
+  if (dest < 0 || dest >= g.size) fatal("sendrecv dest out of range");
+  if (source == kAnySource) {
+    // Wildcard source: the recv channel is unknown until a sender
+    // publishes, so progress the send *while* polling for a source —
+    // draining the send first would deadlock two peers doing a
+    // symmetric > kP2PChunk exchange (each blocked publishing chunk 2
+    // until the other consumes chunk 1).
+    SendCursor s{&g.sh->channels[g.rank][dest],
+                 (const char*)x.untyped_data(), x.size_bytes(), sendtag};
+    int found = -1;
+    long deadline = now_us() + kSpinTimeoutUs;
+    int idle = 0;
+    while (found < 0) {
+      bool progress = s.try_step();
+      for (int c = 0; c < g.size && found < 0; ++c) {
+        if (c == g.rank) continue;
+        Channel* ch = &g.sh->channels[c][g.rank];
+        if (ch->head.load(std::memory_order_acquire) !=
+            ch->tail.load(std::memory_order_relaxed)) {
+          if (recvtag != kAnyTag && ch->tag != recvtag) continue;
+          found = c;
+        }
+      }
+      if (progress) {
+        deadline = now_us() + kSpinTimeoutUs;
+        idle = 0;
+      } else if (found < 0 && ++idle >= 256) {
+        idle = 0;
+        check_abort();
+        if (now_us() > deadline)
+          fatal("sendrecv(ANY_SOURCE) timeout (no matching send?)");
+        spin_pause();
+      }
+    }
+    RecvCursor r{&g.sh->channels[found][g.rank], (char*)out->untyped_data(),
+                 out->size_bytes(), recvtag};
+    drive(&s, &r, "sendrecv timeout");
+    write_status(status_ptr, found, r.seen_tag, out->size_bytes());
+    return ok();
+  }
   // Interleaved progress on both cursors: deadlock-free pairwise
   // exchange like MPI_Sendrecv (reference mpi_ops_common.h sendrecv
   // wrapper), without requiring channel capacity >= message size.
@@ -529,58 +697,78 @@ static ffi::Error SendrecvImpl(int64_t source, int64_t dest, int64_t sendtag,
                x.size_bytes(), sendtag};
   RecvCursor r{&g.sh->channels[source][g.rank], (char*)out->untyped_data(),
                out->size_bytes(), recvtag};
-  if (dest < 0 || dest >= g.size) fatal("sendrecv dest out of range");
   if (source < 0 || source >= g.size) fatal("sendrecv source out of range");
   drive(&s, &r, "sendrecv timeout");
+  write_status(status_ptr, source, r.seen_tag, out->size_bytes());
   return ok();
 }
 
 XLA_FFI_DEFINE_HANDLER_SYMBOL(kBarrier, BarrierImpl,
-                              ffi::Ffi::Bind().Ret<ffi::AnyBuffer>());
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::AnyBuffer>()
+                                  .RemainingArgs()
+                                  .Ret<ffi::AnyBuffer>());
 XLA_FFI_DEFINE_HANDLER_SYMBOL(kAllreduce, AllreduceImpl,
                               ffi::Ffi::Bind()
                                   .Attr<int64_t>("op")
                                   .Arg<ffi::AnyBuffer>()
+                                  .RemainingArgs()
                                   .Ret<ffi::AnyBuffer>());
 XLA_FFI_DEFINE_HANDLER_SYMBOL(kScan, ScanImpl,
                               ffi::Ffi::Bind()
                                   .Attr<int64_t>("op")
                                   .Arg<ffi::AnyBuffer>()
+                                  .RemainingArgs()
                                   .Ret<ffi::AnyBuffer>());
 XLA_FFI_DEFINE_HANDLER_SYMBOL(kReduce, ReduceImpl,
                               ffi::Ffi::Bind()
                                   .Attr<int64_t>("op")
                                   .Attr<int64_t>("root")
                                   .Arg<ffi::AnyBuffer>()
+                                  .RemainingArgs()
                                   .Ret<ffi::AnyBuffer>());
 XLA_FFI_DEFINE_HANDLER_SYMBOL(kAllgather, AllgatherImpl,
                               ffi::Ffi::Bind()
                                   .Arg<ffi::AnyBuffer>()
+                                  .RemainingArgs()
                                   .Ret<ffi::AnyBuffer>());
 XLA_FFI_DEFINE_HANDLER_SYMBOL(kBcast, BcastImpl,
                               ffi::Ffi::Bind()
                                   .Attr<int64_t>("root")
                                   .Arg<ffi::AnyBuffer>()
+                                  .RemainingArgs()
                                   .Ret<ffi::AnyBuffer>());
 XLA_FFI_DEFINE_HANDLER_SYMBOL(kScatter, ScatterImpl,
                               ffi::Ffi::Bind()
                                   .Attr<int64_t>("root")
                                   .Arg<ffi::AnyBuffer>()
+                                  .RemainingArgs()
+                                  .Ret<ffi::AnyBuffer>());
+XLA_FFI_DEFINE_HANDLER_SYMBOL(kGather, GatherImpl,
+                              ffi::Ffi::Bind()
+                                  .Attr<int64_t>("root")
+                                  .Arg<ffi::AnyBuffer>()
+                                  .RemainingArgs()
                                   .Ret<ffi::AnyBuffer>());
 XLA_FFI_DEFINE_HANDLER_SYMBOL(kAlltoall, AlltoallImpl,
                               ffi::Ffi::Bind()
                                   .Arg<ffi::AnyBuffer>()
+                                  .RemainingArgs()
                                   .Ret<ffi::AnyBuffer>());
 XLA_FFI_DEFINE_HANDLER_SYMBOL(kSend, SendImpl,
                               ffi::Ffi::Bind()
                                   .Attr<int64_t>("dest")
                                   .Attr<int64_t>("tag")
                                   .Arg<ffi::AnyBuffer>()
+                                  .RemainingArgs()
                                   .Ret<ffi::AnyBuffer>());
 XLA_FFI_DEFINE_HANDLER_SYMBOL(kRecv, RecvImpl,
                               ffi::Ffi::Bind()
                                   .Attr<int64_t>("source")
                                   .Attr<int64_t>("tag")
+                                  .Attr<int64_t>("status_ptr")
+                                  .Arg<ffi::AnyBuffer>()
+                                  .RemainingArgs()
                                   .Ret<ffi::AnyBuffer>());
 XLA_FFI_DEFINE_HANDLER_SYMBOL(kSendrecv, SendrecvImpl,
                               ffi::Ffi::Bind()
@@ -588,7 +776,9 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(kSendrecv, SendrecvImpl,
                                   .Attr<int64_t>("dest")
                                   .Attr<int64_t>("sendtag")
                                   .Attr<int64_t>("recvtag")
+                                  .Attr<int64_t>("status_ptr")
                                   .Arg<ffi::AnyBuffer>()
+                                  .RemainingArgs()
                                   .Ret<ffi::AnyBuffer>());
 
 // ---------------------------------------------------------------------------
@@ -709,6 +899,7 @@ static PyObject* py_targets(PyObject*, PyObject*) {
   PyDict_SetItemString(d, "m4t_shm_allgather", capsule(shmcc::kAllgather));
   PyDict_SetItemString(d, "m4t_shm_bcast", capsule(shmcc::kBcast));
   PyDict_SetItemString(d, "m4t_shm_scatter", capsule(shmcc::kScatter));
+  PyDict_SetItemString(d, "m4t_shm_gather", capsule(shmcc::kGather));
   PyDict_SetItemString(d, "m4t_shm_alltoall", capsule(shmcc::kAlltoall));
   PyDict_SetItemString(d, "m4t_shm_send", capsule(shmcc::kSend));
   PyDict_SetItemString(d, "m4t_shm_recv", capsule(shmcc::kRecv));

@@ -47,13 +47,16 @@ _MAX_TRACE_STATES = 64
 
 
 class _TraceState:
-    __slots__ = ("key", "token", "pending_sends")
+    __slots__ = ("key", "token", "pending_sends", "shm_wire")
 
     def __init__(self, key):
         self.key = key
         self.token = jnp.zeros((), jnp.uint32)
         # FIFO of pending sends for the trace-time send/recv matcher.
         self.pending_sends: List[Dict[str, Any]] = []
+        # shm backend wire: an output of the previous native call,
+        # threaded as a real operand into the next one (see shm_wire()).
+        self.shm_wire = None
 
 
 _states: Deque[_TraceState] = collections.deque(maxlen=_MAX_TRACE_STATES)
@@ -130,6 +133,28 @@ def ordered_call(fn, inputs: Tuple):
 
 def pending_sends() -> List[Dict[str, Any]]:
     return _current_state().pending_sends
+
+
+def shm_wire():
+    """Current shm-backend wire value for this trace (or None).
+
+    The ``optimization_barrier`` value-token chain above is *advisory*:
+    XLA (the CPU pipeline in particular) may delete the barriers, after
+    which two independent side-effecting custom calls can be scheduled
+    in either order — for the blocking shm runtime that reorder is a
+    deadlock (a rank's recv scheduled before its own send). The shm
+    path therefore also threads a **real operand**: every native call
+    consumes the previous call's output (ignored by the handler) and
+    publishes one of its own outputs as the next wire — producer/
+    consumer edges no compiler pass may break. This is the reference's
+    XLA-token threading (``_src/jax_compat.py:74-77``) realized with
+    value tokens.
+    """
+    return _current_state().shm_wire
+
+
+def set_shm_wire(value) -> None:
+    _current_state().shm_wire = value
 
 
 class NOTSET:

@@ -9,15 +9,17 @@ The XLA path needs no dtype table — the native/generic dispatch is by
 operator (``ops/allreduce.py``: psum/pmax/pmin exist for SUM/MAX/MIN,
 anything XLA can add/compare works). The native shm backend's C++
 reductions (``runtime/shmcc.cpp:accumulate_dtype``) cover the
-reference's integer/float set minus ``float128`` (no TPU/XLA meaning)
-and complex; copy ops accept any dtype byte-wise.
+reference's integer/float set minus ``float128`` (no TPU/XLA meaning);
+complex64/128 reduce with SUM/PROD only (matching MPI); copy ops accept
+any dtype byte-wise.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-#: dtypes the native shm backend reduces in C++
+#: dtypes the native shm backend reduces in C++ (complex64/complex128
+#: support SUM/PROD only, matching MPI and the reference dtype table)
 SHM_REDUCTION_DTYPES = frozenset(
     np.dtype(d)
     for d in (
@@ -25,6 +27,7 @@ SHM_REDUCTION_DTYPES = frozenset(
         np.int8, np.int16, np.int32, np.int64,
         np.uint8, np.uint16, np.uint32, np.uint64,
         np.bool_,
+        np.complex64, np.complex128,
     )
 )
 

@@ -169,11 +169,22 @@ def test_sendrecv_mismatched_tables(run_spmd, per_rank):
         run_spmd(lambda x: m4t.sendrecv(x, x, bad_src, RING_DEST), arr)
 
 
-def test_sendrecv_status_unsupported():
-    with pytest.raises(NotImplementedError):
+def test_sendrecv_status_contract():
+    # wrong type is a TypeError; a real Status raises on the XLA path
+    # (no HLO analog — supported on the shm backend only, see
+    # tests/test_shm_backend.py::test_status_and_any_source)
+    with pytest.raises(TypeError, match="Status"):
         m4t.sendrecv(
             jnp.zeros(3), jnp.zeros(3), (0,), (0,), status=object()
         )
+    with pytest.raises(NotImplementedError, match="shm"):
+        m4t.sendrecv(
+            jnp.zeros(3), jnp.zeros(3), (0,), (0,), status=m4t.Status()
+        )
+    with pytest.raises(NotImplementedError, match="shm"):
+        m4t.recv(jnp.zeros(3), (0,), status=m4t.Status())
+    with pytest.raises(NotImplementedError, match="ANY_SOURCE"):
+        m4t.recv(jnp.zeros(3), m4t.ANY_SOURCE)
 
 
 def test_sendrecv_size1_self():

@@ -152,3 +152,31 @@ def test_cart_row_col_comms(run_spmd, per_rank):
     np.testing.assert_allclose(rows[4:], np.full(4, 22.0))
     for r in range(N):
         np.testing.assert_allclose(cols[r], (r % 4) * 2 + 4.0)
+
+
+def test_nested_split(run_spmd, per_rank):
+    # GroupComm.Split: split the halves again into quarters — nested
+    # MPI_Comm_split reachability (each parent group partitioned
+    # independently by the global color table).
+    parent = halves()                      # {0..3}, {4..7}
+    child = parent.Split([r % 2 for r in range(N)])
+    assert child.groups == ((0, 2), (1, 3), (4, 6), (5, 7))
+    arr = per_rank(lambda r: np.float32(r))
+    out = run_spmd(lambda x: m4t.allreduce(x, op=m4t.SUM, comm=child), arr)
+    expected = {0: 2, 2: 2, 1: 4, 3: 4, 4: 10, 6: 10, 5: 12, 7: 12}
+    for r in range(N):
+        assert out[r] == expected[r], (r, out[r])
+
+
+def test_nested_split_rank_size(run_spmd, per_rank):
+    child = halves().Split([r % 2 for r in range(N)])
+    arr = per_rank(lambda r: np.float32(0))
+    out = run_spmd(
+        lambda x: x
+        + child.Get_rank().astype(jnp.float32)
+        + 10.0 * child.Get_size(),
+        arr,
+    )
+    # group rank: first member 0, second member 1; size 2 everywhere
+    expected = [20, 20, 21, 21, 20, 20, 21, 21]
+    np.testing.assert_allclose(out.ravel(), expected)

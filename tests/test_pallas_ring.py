@@ -33,3 +33,70 @@ def test_ring_allreduce_size1():
     np.testing.assert_allclose(
         ring_allreduce(x, "ranks", 1, interpret=True), x
     )
+
+
+def test_ring_allreduce_streamed_64mib(run_spmd):
+    # 64 MiB payload exceeds the VMEM-resident budget -> grid-streamed
+    # variant (multiple macro-blocks). Values chosen so the f32 sum is
+    # exact; compare block boundaries and a random sample.
+    total = (64 << 20) // 4  # 16M f32 elements
+    base = np.arange(total, dtype=np.float32) % 1024
+    arr = np.stack([base + r for r in range(N)])
+
+    out = run_spmd(
+        lambda x: ring_allreduce(x, "ranks", N, interpret=True),
+        jnp.asarray(arr),
+    )
+    expected = base * N + sum(range(N))
+    idx = np.concatenate(
+        [np.arange(2048), np.arange(total - 2048, total),
+         np.random.RandomState(1).randint(0, total, 4096)]
+    )
+    for r in range(N):
+        np.testing.assert_allclose(out[r][idx], expected[idx], rtol=1e-6)
+
+
+def test_ring_allreduce_bf16_f32_accumulation(run_spmd):
+    # bf16 payloads accumulate in f32: summing 8 copies of 1/256 stays
+    # exact (bf16 accumulation would lose low bits against big values).
+    arr = np.stack(
+        [np.full(2048, 1.0 / 256, np.float32) + (512.0 if r == 0 else 0.0)
+         for r in range(N)]
+    ).astype(jnp.bfloat16)
+
+    out = run_spmd(
+        lambda x: ring_allreduce(x, "ranks", N, interpret=True),
+        jnp.asarray(arr),
+    )
+    # f32 accumulation: 512 + 8/256 = 512.03125; each hop rounds the
+    # partial to bf16, so tolerance is bf16 ulp at 512 (= 2.0)
+    expected = 512.0 + N / 256
+    assert abs(float(np.asarray(out[0].astype(np.float32))[0]) - expected) <= 2.0
+
+
+def test_ring_allreduce_tpu_compile_check():
+    # Cross-platform export validates the Mosaic TPU lowering of the
+    # compiled-mode path (semaphore protocol included) without a chip.
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from mpi4jax_tpu.parallel import world_mesh
+
+    mesh = world_mesh()
+
+    fn = jax.jit(
+        shard_map(
+            lambda x: ring_allreduce(
+                x.reshape(x.shape[1:]), "ranks", N, interpret=False
+            )[None],
+            mesh=mesh, in_specs=P("ranks"), out_specs=P("ranks"),
+            check_vma=False,
+        )
+    )
+    x = jnp.ones((N, 512 * 128), jnp.float32)
+    try:
+        exported = jax.export.export(fn, platforms=["tpu"])(x)
+    except Exception as e:  # pragma: no cover - surface the real error
+        pytest.fail(f"TPU lowering of the compiled ring failed: {e}")
+    assert "tpu_custom_call" in exported.mlir_module()

@@ -161,3 +161,207 @@ def test_abi_info():
     info = shm.abi_info()
     assert info["max_ranks"] >= 2
     assert info["coll_chunk_bytes"] >= 1 << 20
+
+
+@needs_native
+def test_status_and_any_source():
+    # MPI.Status capture + ANY_SOURCE wildcard (reference
+    # recv.py:49-54,100-103) — expressible only in the multi-controller
+    # shm world.
+    res = launch(
+        3,
+        """
+        import numpy as np, jax.numpy as jnp
+        import mpi4jax_tpu as m4t
+        from mpi4jax_tpu.runtime import shm
+        r, n = shm.rank(), shm.size()
+        if r == 0:
+            seen = set()
+            for _ in range(2):
+                st = m4t.Status()
+                got = m4t.recv(jnp.zeros(4), m4t.ANY_SOURCE, status=st)
+                assert st.Get_source() in (1, 2), st
+                assert st.Get_tag() == 40 + st.Get_source(), st
+                assert st.Get_count(np.float32) == 4, st
+                assert float(got[0]) == float(st.Get_source())
+                seen.add(st.Get_source())
+            assert seen == {1, 2}, seen
+            # explicit-source recv also fills the status
+            st2 = m4t.Status()
+            got = m4t.recv(jnp.zeros(2), 1, tag=77, status=st2)
+            assert (st2.source, st2.tag) == (1, 77), st2
+        elif r in (1, 2):
+            m4t.send(jnp.full(4, float(r)), dest=0, tag=40 + r)
+            if r == 1:
+                m4t.send(jnp.ones(2), dest=0, tag=77)
+        m4t.barrier()
+        print(f"STATUS_OK{r}")
+        """,
+    )
+    assert res.returncode == 0, res.stderr
+    for r in range(3):
+        assert f"STATUS_OK{r}" in res.stdout
+
+
+@needs_native
+def test_root_only_gather_scatter():
+    # Exact reference shapes (gather.py:80-89, scatter.py:145-153):
+    # root gets/passes the stacked array, non-root ranks work with
+    # block-shaped arrays and gather returns their input unchanged.
+    res = launch(
+        4,
+        """
+        import numpy as np, jax.numpy as jnp
+        import mpi4jax_tpu as m4t
+        from mpi4jax_tpu.runtime import shm
+        r, n = shm.rank(), shm.size()
+        x = jnp.arange(3.0) + 10 * r
+        g = m4t.gather(x, root=1)
+        if r == 1:
+            assert g.shape == (n, 3), g.shape
+            assert np.allclose(np.asarray(g), np.arange(3.0) + 10 * np.arange(n)[:, None])
+        else:
+            assert g.shape == (3,), g.shape
+            assert np.allclose(np.asarray(g), np.asarray(x))
+        # scatter: root passes (n, block), others pass a block template
+        if r == 2:
+            full = jnp.arange(float(n * 2)).reshape(n, 2)
+            s = m4t.scatter(full, root=2)
+        else:
+            s = m4t.scatter(jnp.zeros(2), root=2)
+        assert s.shape == (2,), s.shape
+        assert np.allclose(np.asarray(s), [2.0 * r, 2.0 * r + 1])
+        print(f"ROOTONLY_OK{r}")
+        """,
+    )
+    assert res.returncode == 0, res.stderr
+    for r in range(4):
+        assert f"ROOTONLY_OK{r}" in res.stdout
+
+
+@needs_native
+def test_complex_reductions():
+    # c64/c128 SUM/PROD on the native reduction path (reference dtype
+    # table _src/utils.py:101-128); MAX raises in Python before the
+    # native layer can abort.
+    res = launch(
+        2,
+        """
+        import numpy as np, jax.numpy as jnp
+        import mpi4jax_tpu as m4t
+        from mpi4jax_tpu.runtime import shm
+        r, n = shm.rank(), shm.size()
+        z64 = jnp.asarray([1 + 1j * r, 2 - 1j * r], jnp.complex64)
+        s = m4t.allreduce(z64, op=m4t.SUM)
+        assert np.allclose(np.asarray(s), [2 + 1j, 4 - 1j]), s
+        z128 = jnp.asarray([1 + 1j * (r + 1)], jnp.complex128)
+        p = m4t.allreduce(z128, op=m4t.PROD)
+        assert np.allclose(np.asarray(p), [(1 + 1j) * (1 + 2j)]), p
+        try:
+            m4t.allreduce(z64, op=m4t.MAX)
+            raise SystemExit("complex MAX should have raised")
+        except NotImplementedError:
+            pass
+        print(f"COMPLEX_OK{r}")
+        """,
+    )
+    assert res.returncode == 0, res.stderr
+    assert "COMPLEX_OK0" in res.stdout and "COMPLEX_OK1" in res.stdout
+
+
+@needs_native
+def test_comm_split_on_launcher_world():
+    # MPI_Comm_split reachability on the shm backend: collectives and
+    # p2p on each sub-communicator stay inside the group.
+    res = launch(
+        4,
+        """
+        import numpy as np, jax.numpy as jnp
+        import mpi4jax_tpu as m4t
+        from mpi4jax_tpu.runtime import shm
+        r, n = shm.rank(), shm.size()
+        comm = m4t.Comm().Split([0, 0, 1, 1])  # {0,1} and {2,3}
+        gr = r % 2               # rank within the group
+        base = (r // 2) * 2      # group leader's global rank
+        # allreduce stays inside the group
+        s = m4t.allreduce(jnp.float32(r), op=m4t.SUM, comm=comm)
+        assert float(s) == (base) + (base + 1), (r, float(s))
+        # bcast from group root 1
+        b = m4t.bcast(jnp.float32(r), 1, comm=comm)
+        assert float(b) == base + 1, (r, float(b))
+        # allgather within the group
+        ag = m4t.allgather(jnp.float32(r), comm=comm)
+        assert np.allclose(np.asarray(ag), [base, base + 1]), (r, ag)
+        # scan within the group
+        sc = m4t.scan(jnp.float32(r), op=m4t.SUM, comm=comm)
+        assert float(sc) == (base if gr == 0 else 2 * base + 1), (r, float(sc))
+        # p2p ring inside the group (group-rank tables)
+        sw = m4t.sendrecv(jnp.float32(r), jnp.float32(0),
+                          source=[1, 0], dest=[1, 0], comm=comm)
+        assert float(sw) == base + (1 - gr), (r, float(sw))
+        # root-only gather on the sub-communicator
+        g = m4t.gather(jnp.float32(r), root=0, comm=comm)
+        if gr == 0:
+            assert np.allclose(np.asarray(g), [base, base + 1]), (r, g)
+        m4t.barrier(comm=comm)
+        print(f"SPLIT_OK{r}")
+        """,
+    )
+    assert res.returncode == 0, res.stderr
+    for r in range(4):
+        assert f"SPLIT_OK{r}" in res.stdout
+
+
+@needs_native
+def test_sendrecv_any_source_large_symmetric():
+    # Symmetric > 256 KiB (channel entry) exchange with ANY_SOURCE on
+    # both sides: the native layer must progress the send while polling
+    # for a source (draining the send first would deadlock both peers).
+    res = launch(
+        2,
+        """
+        import numpy as np, jax.numpy as jnp
+        import mpi4jax_tpu as m4t
+        from mpi4jax_tpu.runtime import shm
+        r = shm.rank()
+        big = jnp.arange(200_000, dtype=jnp.float32) + r  # ~800 KB
+        st = m4t.Status()
+        got = m4t.sendrecv(big, jnp.zeros_like(big),
+                           source=m4t.ANY_SOURCE, dest=1 - r, status=st)
+        assert float(got[0]) == float(1 - r)
+        assert st.source == 1 - r and st.Get_count(np.float32) == 200_000
+        print(f"ANYSRC_BIG_OK{r}")
+        """,
+    )
+    assert res.returncode == 0, res.stderr
+    assert "ANYSRC_BIG_OK0" in res.stdout and "ANYSRC_BIG_OK1" in res.stdout
+
+
+@needs_native
+def test_split_status_comm_rank_and_proc_null():
+    # Status on a Split comm reports the *communicator* rank (MPI
+    # semantics), and a PROC_NULL receive resets a reused Status.
+    res = launch(
+        4,
+        """
+        import numpy as np, jax.numpy as jnp
+        import mpi4jax_tpu as m4t
+        from mpi4jax_tpu.runtime import shm
+        r = shm.rank()
+        comm = m4t.Comm().Split([0, 0, 1, 1])  # {0,1}, {2,3}
+        gr = r % 2
+        st = m4t.Status()
+        # group ring exchange: each member sends to the other
+        got = m4t.sendrecv(jnp.float32(r), jnp.float32(0),
+                           source=[1, 0], dest=[1, 0], comm=comm, status=st)
+        assert st.source == 1 - gr, (r, st.source)  # comm rank, not global
+        # PROC_NULL recv resets the status
+        got2 = m4t.recv(jnp.float32(5), m4t.PROC_NULL, comm=comm, status=st)
+        assert st.source == m4t.PROC_NULL and st.Get_count() == 0, st
+        assert float(got2) == 5.0
+        print(f"SPLITSTAT_OK{r}")
+        """,
+    )
+    assert res.returncode == 0, res.stderr
+    for r in range(4):
+        assert f"SPLITSTAT_OK{r}" in res.stdout
