@@ -42,7 +42,19 @@ def main():
     p.add_argument("--nproc", type=int, default=None)
     p.add_argument("--platform", default=None)
     p.add_argument("--size-mb", type=float, default=1.0)
+    p.add_argument(
+        "--output",
+        default=None,
+        help="also write results (with platform/device metadata) to this "
+        "JSON file — used for the round-over-round artifacts "
+        "(benchmarks/results_r*.json)",
+    )
     args = p.parse_args()
+
+    if args.output:
+        # fail fast on an unwritable path, not after minutes of timing
+        with open(args.output, "a"):
+            pass
 
     if args.platform == "cpu" and (args.nproc or 0) > 1:
         # multi-rank CPU needs virtual devices, and the flag must be
@@ -163,6 +175,18 @@ def main():
 
     f5 = spmd(train, mesh=mesh)
     report("dp_mlp_grad_allreduce", timeit(f5, params_n, xb, yb))
+
+    if args.output:
+        doc = {
+            "platform": jax.devices()[0].platform,
+            "device": str(jax.devices()[0]),
+            "nproc": n,
+            "size_mb": args.size_mb,
+            "results": results,
+        }
+        with open(args.output, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"# wrote {args.output}", file=sys.stderr)
 
     return results
 
