@@ -60,6 +60,13 @@ def main(argv=None):
                 JAX_PLATFORMS="cpu",
             )
             cmd = [sys.executable]
+            if os.environ.get("M4T_LAUNCH_COVERAGE"):
+                # Run each rank under parallel-mode coverage so CI can
+                # `coverage combine` the per-rank data files with the
+                # single-process run (the reference's
+                # covecov-coverage.yml merges 1-rank and mpirun runs
+                # the same way).
+                cmd += ["-m", "coverage", "run", "-p"]
             if args.module:
                 cmd += ["-m", args.module]
             cmd += args.cmd

@@ -111,15 +111,15 @@ def ring_attention(
             # kv block currently held came from rank (my_rank - step).
             kv_rank = (my_rank - step) % n
             acc = accumulate(acc, kblk, vblk, kv_rank)
-            # rotate kv one step around the ring (ICI neighbor hop);
-            # the transfer is skipped content-wise on the last
-            # iteration's result but keeping it unconditional keeps the
-            # loop body uniform for XLA.
+            # rotate kv one step around the ring (ICI neighbor hop)
             kblk = sendrecv(kblk, kblk, source, dest, sendtag=20, comm=comm)
             vblk = sendrecv(vblk, vblk, source, dest, sendtag=21, comm=comm)
             return kblk, vblk, acc
 
-        _, _, (m, l, o) = lax.fori_loop(0, n, body, (k, v, (m0, l0, o0)))
+        # n-1 rotations only: the final block is consumed outside the
+        # loop so no wasted k/v transfer trails the last accumulation
+        kblk, vblk, acc = lax.fori_loop(0, n - 1, body, (k, v, (m0, l0, o0)))
+        m, l, o = accumulate(acc, kblk, vblk, (my_rank - (n - 1)) % n)
 
     l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zero output
     return (o / l[..., None]).astype(q.dtype)
