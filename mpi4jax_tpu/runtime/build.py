@@ -27,7 +27,7 @@ def build(verbose: bool = False) -> str:
     # .so must never be visible to another rank's dlopen.
     tmp = f"{OUT}.{os.getpid()}.tmp"
     cmd = [
-        "g++",
+        os.environ.get("CXX", "g++"),
         "-O2",
         "-std=c++17",
         "-shared",

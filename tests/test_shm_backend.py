@@ -365,3 +365,35 @@ def test_split_status_comm_rank_and_proc_null():
     assert res.returncode == 0, res.stderr
     for r in range(4):
         assert f"SPLITSTAT_OK{r}" in res.stdout
+
+
+@needs_native
+def test_rank_divergent_send_recv_jitted():
+    # Regression for the wire-threading bug: inside one jitted program,
+    # XLA's CPU pipeline may delete optimization_barrier ties and
+    # reorder independent side-effecting custom calls — without the
+    # operand wire a rank's recv was scheduled before its own send and
+    # both ranks deadlocked. (The eager variant above never sees this.)
+    res = launch(
+        2,
+        """
+        import jax, numpy as np, jax.numpy as jnp
+        import mpi4jax_tpu as m4t
+        from mpi4jax_tpu.runtime import shm
+        r = shm.rank()
+
+        def prog(x):
+            if r == 1:
+                m4t.send(x, dest=0, tag=5)
+                return m4t.recv(jnp.zeros(()), source=0, tag=6)
+            got = m4t.recv(jnp.zeros(()), source=1, tag=5)
+            m4t.send(got + 10.0, dest=1, tag=6)
+            return got
+
+        out = jax.jit(prog)(jnp.float32(r))
+        assert float(out) == (11.0 if r == 1 else 1.0), float(out)
+        print(f"JITP2P_OK{r}")
+        """,
+    )
+    assert res.returncode == 0, res.stderr
+    assert "JITP2P_OK0" in res.stdout and "JITP2P_OK1" in res.stdout
