@@ -1,0 +1,142 @@
+"""Cross-product sweep: every collective x dtype x shape against a
+numpy oracle computed from the stacked per-rank inputs. The reference
+covers this per-op with hand-written cases (SURVEY.md §4 technique 2);
+this sweep is the dense version of that matrix, catching dtype- or
+shape-specific lowering regressions the targeted tests miss."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m4t
+
+N = 8
+
+DTYPES = [np.float32, np.float64, np.int32, np.int64, np.uint8, np.bool_]
+SHAPES = [(), (5,), (3, 4)]
+
+
+def _inputs(dtype, shape, rng):
+    if dtype == np.bool_:
+        return (rng.rand(N, *shape) > 0.4).astype(dtype)
+    if np.issubdtype(dtype, np.integer):
+        return rng.randint(1, 5, size=(N,) + shape).astype(dtype)
+    return rng.rand(N, *shape).astype(dtype) + 0.5
+
+
+def _tol(dtype):
+    # the harness runs with jax_enable_x64=False (conftest), so f64
+    # inputs execute in f32 — tolerances follow the *effective* dtype
+    if np.issubdtype(dtype, np.floating):
+        return dict(rtol=1e-5, atol=1e-6)
+    return dict(rtol=0)
+
+
+@pytest.mark.parametrize("dtype", DTYPES, ids=lambda d: np.dtype(d).name)
+@pytest.mark.parametrize("shape", SHAPES, ids=str)
+def test_allreduce_sum_matrix(run_spmd, dtype, shape):
+    rng = np.random.RandomState(0)
+    arr = _inputs(dtype, shape, rng)
+    out = run_spmd(lambda x: m4t.allreduce(x, op=m4t.SUM), jnp.asarray(arr))
+    if dtype == np.bool_:
+        expected = arr.any(axis=0)  # bool SUM == logical OR (via int32)
+        assert (np.asarray(out[0]) != 0).astype(bool).tolist() == expected.tolist()
+        return
+    expected = arr.sum(axis=0, dtype=dtype)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], expected, **_tol(dtype))
+
+
+@pytest.mark.parametrize("op,oracle", [
+    (m4t.MAX, lambda a: a.max(axis=0)),
+    (m4t.MIN, lambda a: a.min(axis=0)),
+    (m4t.PROD, lambda a: a.prod(axis=0)),
+], ids=["max", "min", "prod"])
+@pytest.mark.parametrize("dtype", [np.float32, np.int32], ids=["f32", "i32"])
+def test_allreduce_ops_matrix(run_spmd, op, oracle, dtype):
+    rng = np.random.RandomState(1)
+    arr = _inputs(dtype, (4,), rng)
+    out = run_spmd(lambda x: m4t.allreduce(x, op=op), jnp.asarray(arr))
+    expected = oracle(arr)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], expected, **_tol(dtype))
+
+
+@pytest.mark.parametrize("op,oracle", [
+    (m4t.BAND, lambda a: np.bitwise_and.reduce(a, axis=0)),
+    (m4t.BOR, lambda a: np.bitwise_or.reduce(a, axis=0)),
+    (m4t.BXOR, lambda a: np.bitwise_xor.reduce(a, axis=0)),
+    (m4t.LAND, lambda a: (a != 0).all(axis=0)),
+    (m4t.LOR, lambda a: (a != 0).any(axis=0)),
+    (m4t.LXOR, lambda a: ((a != 0).sum(axis=0) % 2).astype(bool)),
+], ids=["band", "bor", "bxor", "land", "lor", "lxor"])
+def test_allreduce_bitlogic_matrix(run_spmd, op, oracle):
+    rng = np.random.RandomState(2)
+    arr = rng.randint(0, 4, size=(N, 6)).astype(np.int32)
+    out = run_spmd(lambda x: m4t.allreduce(x, op=op), jnp.asarray(arr))
+    expected = oracle(arr).astype(np.int32)
+    for r in range(N):
+        np.testing.assert_array_equal(
+            (np.asarray(out[r]) != 0).astype(np.int32)
+            if op in (m4t.LAND, m4t.LOR, m4t.LXOR)
+            else np.asarray(out[r]),
+            (expected != 0).astype(np.int32)
+            if op in (m4t.LAND, m4t.LOR, m4t.LXOR)
+            else expected,
+        )
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.int32, np.bool_],
+                         ids=["f32", "i32", "bool"])
+def test_moving_ops_matrix(run_spmd, dtype):
+    """allgather / alltoall / bcast / scatter / gather move bytes
+    without interpreting them — any dtype must round-trip exactly."""
+    rng = np.random.RandomState(3)
+    arr = _inputs(dtype, (N, 2), rng)  # (N ranks, N blocks, 2)
+
+    def f(x):
+        ag = m4t.allgather(x[0])          # (N, 2)
+        a2a = m4t.alltoall(x)             # (N, 2)
+        bc = m4t.bcast(x[0], 2)
+        sc = m4t.scatter(x, 3)
+        ga = m4t.gather(x[0], 1)
+        return ag, a2a, bc, sc, ga
+
+    ag, a2a, bc, sc, ga = run_spmd(f, jnp.asarray(arr))
+    for r in range(N):
+        np.testing.assert_array_equal(ag[r], arr[:, 0])       # stacked firsts
+        np.testing.assert_array_equal(a2a[r], arr[:, r])      # transposed blocks
+        np.testing.assert_array_equal(bc[r], arr[2, 0])       # root 2's block
+        np.testing.assert_array_equal(sc[r], arr[3, r])       # root 3's row r
+        np.testing.assert_array_equal(ga[r], arr[:, 0])       # gather = stacked
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.int64], ids=["f32", "i64"])
+def test_scan_matrix(run_spmd, dtype):
+    rng = np.random.RandomState(4)
+    arr = _inputs(dtype, (3,), rng)
+    out = run_spmd(lambda x: m4t.scan(x, m4t.SUM), jnp.asarray(arr))
+    running = np.cumsum(arr.astype(np.float64), axis=0)
+    for r in range(N):
+        np.testing.assert_allclose(
+            np.asarray(out[r], np.float64), running[r], rtol=1e-5
+        )
+
+
+def test_inputs_never_mutated(run_spmd):
+    # the reference asserts inputs are preserved everywhere
+    # (test_allreduce.py:17-21 _arr copies); sweep it across ops here
+    rng = np.random.RandomState(5)
+    arr = rng.rand(N, N, 2).astype(np.float32)
+    arr_copy = arr.copy()
+
+    def f(x):
+        m4t.allreduce(x[0], op=m4t.SUM)
+        m4t.alltoall(x)
+        m4t.scan(x[0], m4t.SUM)
+        return x
+
+    out = run_spmd(f, jnp.asarray(arr))
+    np.testing.assert_array_equal(arr, arr_copy)
+    np.testing.assert_array_equal(out, arr_copy)
