@@ -180,6 +180,23 @@ def main():
     f5 = spmd(train, mesh=mesh)
     report("dp_mlp_grad_allreduce", timeit(f5, params_n, xb, yb))
 
+    # --- TPU only: Pallas RDMA ring vs HLO AllReduce ---------------------
+    # Compiled-mode comparison of the hand-scheduled ring against the
+    # XLA-scheduled collective on identical payloads; meaningless in
+    # interpret mode, so gated on real accelerator hardware.
+    if jax.devices()[0].platform == "tpu" and n > 1:
+        from mpi4jax_tpu.ops.pallas_ring import ring_allreduce
+
+        axis = mesh.axis_names[0]
+        fring = spmd(lambda x: ring_allreduce(x, axis, n), mesh=mesh)
+        t_ring = timeit(fring, xbw)
+        report(
+            "pallas_ring_allreduce",
+            t_ring,
+            payload_mb=round(payload / (1 << 20), 3),
+            gb_per_s_per_chip=round(bus_bytes / t_ring / 1e9, 3),
+        )
+
     if args.output:
         doc = {
             "platform": jax.devices()[0].platform,

@@ -108,15 +108,41 @@ def check_no_pending_sends() -> None:
         )
 
 
+def _no_active_trace() -> bool:
+    """True only in plain eager execution (no jit/shard_map/vmap/grad
+    trace anywhere on the stack). Checking the trace *state* — not
+    whether particular operand values are tracers — matters: inside a
+    trace, ops on closed-over constants (``barrier``'s literal token
+    operand especially) must still get their ties, or the collective
+    has no consumers and XLA DCEs it. Best-effort on a private API:
+    returns False (keep the ties) if it moves."""
+    try:
+        from jax._src import core as _core
+
+        return bool(_core.trace_state_clean())
+    except Exception:
+        return False
+
+
 def ordered_call(fn, inputs: Tuple):
     """Run ``fn(*inputs)`` with its inputs tied to the ambient token
     and the token advanced past its outputs.
 
     ``fn`` returns a tuple of arrays. Returns that tuple.
+
+    Plain eager calls (no active trace) skip the ties: XLA executes
+    eager dispatches in submission order per device, so program order
+    already holds and each ``optimization_barrier`` would only add a
+    dispatch round-trip (the reference's eager ops likewise run
+    straight through ``apply_primitive``, ``_src/utils.py:56-57``).
+    The shm backend's cross-call ordering is carried by the operand
+    wire either way (``shm_wire``).
     """
     if config.NO_ORDERING:
         return tuple(fn(*inputs))
     st = _current_state()
+    if _no_active_trace():
+        return tuple(fn(*inputs))
     token = st.token
     if inputs:
         tied = lax.optimization_barrier(tuple(inputs) + (token,))

@@ -257,3 +257,38 @@ def test_no_ordering_env(monkeypatch, run_spmd, per_rank):
     arr = per_rank(lambda r: np.float32(r))
     out = run_spmd(lambda x: m4t.allreduce(x, op=m4t.SUM), arr)
     np.testing.assert_allclose(out, np.full(8, arr.sum()))
+
+
+def test_barrier_inside_jit_not_dced(mesh):
+    # Regression: barrier binds a literal token operand; the eager
+    # fast-path skip must key on trace *state*, not operand
+    # concreteness, or the barrier's collective loses its ties inside
+    # jit and XLA DCEs it entirely.
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    sm = partial(
+        shard_map, mesh=mesh, in_specs=P("ranks"), out_specs=P("ranks"),
+        check_vma=False,
+    )
+
+    def f(x):
+        m4t.barrier()
+        return m4t.allreduce(x, op=m4t.SUM)
+
+    txt = jax.jit(sm(f)).lower(jnp.arange(8.0).reshape(8, 1)).as_text()
+    # barrier's scalar psum + the allreduce, chained: both must survive
+    assert txt.count("all_reduce") >= 2, (
+        "barrier's collective was DCE'd from the trace"
+    )
+    assert txt.count("optimization_barrier") >= 4
+
+
+def test_eager_latency_fast_path():
+    # plain eager ops skip the optimization_barrier ties (no active
+    # trace): two back-to-back eager ops still give correct results
+    out1 = m4t.allreduce(jnp.ones(3), op=m4t.SUM)
+    out2 = m4t.allreduce(out1 * 2, op=m4t.MAX)
+    np.testing.assert_allclose(np.asarray(out2), 2.0)
