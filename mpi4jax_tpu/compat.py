@@ -10,7 +10,8 @@ and friends run unchanged on the TPU path (or the native shm backend
 under the launcher). The :class:`MPI` namespace mirrors the subset of
 ``mpi4py.MPI`` the reference's public API touches: the reduction
 operators (``utils.py:101-128``), ``COMM_WORLD``, ``PROC_NULL``,
-``ANY_TAG``.
+``ANY_TAG``, ``ANY_SOURCE``, and ``Status`` (the latter two are live
+on the multi-process shm backend; reference ``recv.py:49-54,100-103``).
 
 SPMD caveats still apply (per-rank tables for point-to-point, uniform
 gather/scatter shapes — ``docs/sharp-bits.md``).
@@ -34,6 +35,7 @@ from . import (  # noqa: F401
     sendrecv,
 )
 from .comm import (
+    ANY_SOURCE as _ANY_SOURCE,
     ANY_TAG as _ANY_TAG,
     BAND,
     BOR,
@@ -46,6 +48,7 @@ from .comm import (
     PROC_NULL as _PROC_NULL,
     PROD,
     SUM,
+    Status as _Status,
     get_default_comm,
 )
 
@@ -65,6 +68,8 @@ class _MPINamespace:
     BXOR = BXOR
     PROC_NULL = _PROC_NULL
     ANY_TAG = _ANY_TAG
+    ANY_SOURCE = _ANY_SOURCE
+    Status = _Status
 
     @property
     def COMM_WORLD(self):

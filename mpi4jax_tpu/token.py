@@ -138,11 +138,11 @@ def ordered_call(fn, inputs: Tuple):
     The shm backend's cross-call ordering is carried by the operand
     wire either way (``shm_wire``).
     """
-    if config.NO_ORDERING:
+    if config.NO_ORDERING or _no_active_trace():
+        # fast path before any trace-state lookup: plain eager calls
+        # must not pay the deque scan / state allocation either
         return tuple(fn(*inputs))
     st = _current_state()
-    if _no_active_trace():
-        return tuple(fn(*inputs))
     token = st.token
     if inputs:
         tied = lax.optimization_barrier(tuple(inputs) + (token,))

@@ -37,3 +37,15 @@ def test_op_constants_and_sentinels():
 def test_comm_world_eager_size1():
     out = mpi4jax.bcast(jnp.arange(4.0), 0, comm=MPI.COMM_WORLD)
     np.testing.assert_allclose(np.asarray(out), np.arange(4.0))
+
+
+def test_mpi_namespace_surface():
+    # the mpi4py.MPI lookalike exposes everything reference scripts use
+    import mpi4jax_tpu as m4t
+
+    assert MPI.SUM is m4t.SUM and MPI.PROD is m4t.PROD
+    assert MPI.PROC_NULL == m4t.PROC_NULL and MPI.ANY_TAG == m4t.ANY_TAG
+    assert MPI.ANY_SOURCE is m4t.ANY_SOURCE
+    st = MPI.Status()
+    assert hasattr(st, "Get_source") and hasattr(st, "Get_count")
+    assert MPI.COMM_WORLD.Get_size() == 1  # outside any mesh

@@ -54,3 +54,16 @@ def test_bench_smoke():
     rec = json.loads(line)
     assert rec["metric"] == "shallow_water_100x_solve"
     assert rec["unit"] == "s" and rec["value"] > 0
+
+
+def test_cg_solver_example():
+    # distributed CG: sendrecv-halo matvec + allreduce dot products in
+    # a while_loop (the reference's CG-through-allreduce pattern,
+    # tests/test_jax_transforms.py:6-22, as a full example app)
+    res = run_example(
+        "cg_solver.py",
+        "--n", "256", "--nproc", "8", "--platform", "cpu",
+        "--tol", "1e-6", "--max-iters", "2000",
+    )
+    assert res.returncode == 0, res.stderr
+    assert "rel. error" in res.stdout

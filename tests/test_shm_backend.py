@@ -186,14 +186,18 @@ def test_status_and_any_source():
                 assert float(got[0]) == float(st.Get_source())
                 seen.add(st.Get_source())
             assert seen == {1, 2}, seen
+        elif r in (1, 2):
+            m4t.send(jnp.full(4, float(r)), dest=0, tag=40 + r)
+        # fence the wildcard phase: a tag-77 message in flight during
+        # it would match rank 0's ANY_TAG wildcard recv (size mismatch)
+        m4t.barrier()
+        if r == 0:
             # explicit-source recv also fills the status
             st2 = m4t.Status()
             got = m4t.recv(jnp.zeros(2), 1, tag=77, status=st2)
             assert (st2.source, st2.tag) == (1, 77), st2
-        elif r in (1, 2):
-            m4t.send(jnp.full(4, float(r)), dest=0, tag=40 + r)
-            if r == 1:
-                m4t.send(jnp.ones(2), dest=0, tag=77)
+        elif r == 1:
+            m4t.send(jnp.ones(2), dest=0, tag=77)
         m4t.barrier()
         print(f"STATUS_OK{r}")
         """,
