@@ -401,3 +401,34 @@ def test_rank_divergent_send_recv_jitted():
     )
     assert res.returncode == 0, res.stderr
     assert "JITP2P_OK0" in res.stdout and "JITP2P_OK1" in res.stdout
+
+
+@needs_native
+def test_barrier_ordering_interleaved_writes(tmp_path):
+    # The reference proves barrier ordering by interleaving writes from
+    # all ranks into one file with sleeps and asserting every "start"
+    # line precedes every "done" line (test_barrier.py:17-57).
+    logf = os.path.join(tmp_path, "barrier_log.txt")
+    res = launch(
+        3,
+        f"""
+        import time, random
+        import mpi4jax_tpu as m4t
+        from mpi4jax_tpu.runtime import shm
+        r = shm.rank()
+        random.seed(r)
+        time.sleep(random.uniform(0, 0.3))
+        with open({logf!r}, "a") as f:
+            f.write(f"start {{r}}\\n"); f.flush()
+        m4t.barrier()
+        time.sleep(random.uniform(0, 0.1))
+        with open({logf!r}, "a") as f:
+            f.write(f"done {{r}}\\n"); f.flush()
+        """,
+    )
+    assert res.returncode == 0, res.stderr
+    lines = open(logf).read().splitlines()
+    starts = [i for i, l in enumerate(lines) if l.startswith("start")]
+    dones = [i for i, l in enumerate(lines) if l.startswith("done")]
+    assert len(starts) == 3 and len(dones) == 3, lines
+    assert max(starts) < min(dones), lines
