@@ -33,6 +33,15 @@ def _allgather_spmd(x, *, comm: BoundComm):
         return _shm.allgather(x)
     if not comm.axes or comm.size == 1:
         return x[None]
+    from .pallas_ring_parts import ring_allgather, use_ring_parts
+
+    if use_ring_parts(x, comm, footprint_factor=comm.size):
+        import jax
+
+        return ring_allgather(
+            x, comm.axes[0], comm.size,
+            interpret=jax.default_backend() != "tpu",
+        )
     axes, kw = comm.collective_kwargs()
     return lax.all_gather(x, axes, tiled=False, **kw)
 

@@ -114,33 +114,15 @@ def _allreduce_spmd(x, *, op, comm: BoundComm, transpose):
 
 def _use_pallas_ring(x, op, comm: BoundComm) -> bool:
     """Opt-in (MPI4JAX_TPU_PALLAS_RING=1) hand-scheduled RDMA ring for
-    large float SUM payloads on a plain single-axis communicator."""
-    from .. import config
+    large float SUM payloads on a plain single-axis communicator.
+    Lower bound: latency-bound payloads stay on HLO AllReduce. The
+    upper bound is generous because the grid-streamed variant keeps
+    arbitrarily large payloads in HBM (validated at 64 MiB)."""
+    from .pallas_ring import ring_gate
 
-    import jax
-
-    nbytes = x.size * x.dtype.itemsize
-    if not (
-        config.PALLAS_RING
-        and op is SUM
-        and comm.groups is None
-        and len(comm.axes) == 1
-        and x.dtype in (jnp.float32, jnp.bfloat16)
-        # lower bound: latency-bound payloads stay on HLO AllReduce.
-        # No upper bound needed since the grid-streamed variant keeps
-        # arbitrarily large payloads in HBM (validated at 64 MiB in
-        # interpret mode); cap generously as a sanity guard.
-        and (1 << 20) <= nbytes <= (1 << 30)
-    ):
-        return False
-    # The kernel addresses ring neighbors by LOGICAL device id ==
-    # axis_index, which only holds when the comm axis spans the entire
-    # mesh (a 1-D mesh). On a multi-axis mesh the ids would hit other
-    # rows' devices and deadlock — stay on HLO AllReduce there.
-    try:
-        return lax.axis_size(comm.axes[0]) == jax.device_count()
-    except Exception:
-        return False
+    return op is SUM and ring_gate(
+        x, comm, min_bytes=1 << 20, max_bytes=1 << 30
+    )
 
 
 mpi_allreduce_p = define_primitive(

@@ -48,10 +48,11 @@ mesh (``tests/test_pallas_ring.py``, incl. a 64 MiB streamed payload);
 the compiled path targets real multi-chip ICI and is compile-checked
 for the TPU target via cross-platform export (same test file).
 
-The collective id is derived from the axis name with a stable hash
-(identical across processes, never colliding for rings over the *same*
-axis; rings over two differently-named axes collide with probability
-~1/15) — pass ``collective_id=`` explicitly to guarantee separation or
+The collective id is derived from (kernel kind, axis name): each ring
+kernel kind owns a disjoint id range, so the ZeRO reduce_scatter +
+allgather composition can never alias barrier semaphores; two rings of
+the *same* kind over differently-named axes collide with probability
+~1/5 — pass ``collective_id=`` explicitly to guarantee separation or
 to coexist with user Pallas collectives using the same id space.
 """
 
@@ -77,11 +78,53 @@ _SUBLANES = 8
 _VMEM_BUDGET = 6 << 20
 
 
-def _derive_collective_id(axis_name: str) -> int:
+#: disjoint collective-id ranges per ring-kernel kind: two *different*
+#: ring kernels in one program (the ZeRO reduce_scatter + allgather
+#: pair especially) must never share a collective id — a shared id
+#: aliases their barrier semaphores and wedges the Mosaic compile
+#: (reproduced; see tests/test_pallas_ring.py). Range separation makes
+#: a cross-kind collision impossible for any axis name.
+_KIND_ID_BASE = {"allreduce": 1, "reduce_scatter": 6, "allgather": 11}
+
+
+def _derive_collective_id(axis_name: str, kind: str = "allreduce") -> int:
     # Deterministic across processes (zlib.crc32, not hash()) and
     # identical on every device since the axis name is; avoid 0 which
     # user kernels commonly default to.
-    return 1 + (zlib.crc32(str(axis_name).encode()) % 15)
+    return _KIND_ID_BASE[kind] + (zlib.crc32(str(axis_name).encode()) % 5)
+
+
+def ring_gate(x, comm, *, min_bytes: int, max_bytes: int,
+              footprint_factor: int = 1) -> bool:
+    """Shared routing predicate for all Pallas ring kernels.
+
+    ``footprint_factor`` scales the payload when the kernel's resident
+    VMEM footprint is a multiple of the input (ring_allgather's output
+    is ``n`` blocks). The ``axis_size == device_count`` check is
+    load-bearing: the kernels address ring neighbors by LOGICAL device
+    id == axis_index, which only holds when the comm axis spans the
+    entire mesh (a 1-D mesh) — on a multi-axis mesh the ids would hit
+    other rows' devices and deadlock, so those stay on HLO collectives.
+    """
+    from .. import config
+
+    import jax
+
+    nbytes = x.size * x.dtype.itemsize
+    if not (
+        config.PALLAS_RING
+        and comm.backend == "xla"
+        and comm.groups is None
+        and len(comm.axes) == 1
+        and x.dtype in (jnp.float32, jnp.bfloat16)
+        and min_bytes <= nbytes
+        and nbytes * footprint_factor <= max_bytes
+    ):
+        return False
+    try:
+        return lax.axis_size(comm.axes[0]) == jax.device_count()
+    except Exception:
+        return False
 
 
 def _ring_kernel(

@@ -1,0 +1,233 @@
+"""Pallas TPU ring reduce-scatter and all-gather kernels.
+
+The two halves of :mod:`~mpi4jax_tpu.ops.pallas_ring`'s ring
+all-reduce, exposed as standalone collectives: sharded-optimizer (ZeRO)
+data parallelism consumes exactly ``reduce_scatter`` + ``allgather``,
+and running each half as its own kernel moves ``(n-1)/n * payload``
+bytes per chip — the bandwidth-optimal schedule for either primitive.
+
+Flow control is the ring_allreduce protocol (separate staging/landing
+buffers, per-slot consumer credits, entry barrier, end-of-kernel
+drain); each kernel runs ``n - 1`` ring steps. VMEM-resident only —
+the op-level routing (``ops/reduce_scatter.py`` / ``ops/allgather.py``)
+falls back to the HLO collective outside the supported window, and
+these kernels are an opt-in (``MPI4JAX_TPU_PALLAS_RING=1``) or
+direct-call feature exactly like the all-reduce ring.
+
+Correctness: interpret-mode tests against psum_scatter/all_gather
+oracles; the TPU lowering is compile-checked via cross-platform export
+(``tests/test_pallas_ring.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_ring import _LANES, _SUBLANES, _derive_collective_id
+
+
+def use_ring_parts(x, comm, *, sum_only_op=None,
+                   footprint_factor: int = 1) -> bool:
+    """Opt-in routing gate for the VMEM-resident ring kernels (shared
+    predicate: ``pallas_ring.ring_gate``). These kernels are not
+    grid-streamed, so the window is capped at the resident footprint;
+    ``footprint_factor`` accounts for outputs larger than the input
+    (allgather's output is ``n`` blocks)."""
+    from ..comm import SUM
+    from .pallas_ring import ring_gate
+
+    if sum_only_op is not None and sum_only_op is not SUM:
+        return False
+    return ring_gate(
+        x, comm, min_bytes=1 << 20, max_bytes=1 << 22,
+        footprint_factor=footprint_factor,
+    )
+
+
+def _flow(n, interpret, send_buf, recv_buf, send_sem, recv_sem,
+          capacity_sem, axis_name):
+    """Shared ring-step driver: returns (ring_step, finalize).
+
+    ``ring_step(s, value) -> received`` sends ``value`` to the right
+    neighbor and returns the block that arrived from the left, with the
+    credit protocol of pallas_ring (wait for the consumer's credit
+    before reusing a slot, grant one after consuming). ``finalize()``
+    drains the closing credits so regular semaphores are zero on exit.
+    """
+    my = lax.axis_index(axis_name)
+    right = lax.rem(my + 1, n)
+    left = lax.rem(my + n - 1, n)
+    steps = n - 1
+
+    if not interpret:
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(barrier, inc=1, device_id=left)
+        pltpu.semaphore_signal(barrier, inc=1, device_id=right)
+        pltpu.semaphore_wait(barrier, 2)
+
+    def ring_step(s, value):
+        slot = s % 2
+        if not interpret and s >= 2:
+            pltpu.semaphore_wait(capacity_sem.at[slot], 1)
+        send_buf[slot] = value
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=send_buf.at[slot],
+            dst_ref=recv_buf.at[slot],
+            send_sem=send_sem.at[slot],
+            recv_sem=recv_sem.at[slot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        received = recv_buf[slot]
+        if not interpret:
+            pltpu.semaphore_signal(capacity_sem.at[slot], inc=1, device_id=left)
+        return received
+
+    def finalize():
+        # outstanding (signaled, never awaited) credits per slot: one
+        # on each slot that ran at least once without a later wait —
+        # slot0 whenever steps >= 1, slot1 whenever steps >= 2
+        if not interpret:
+            if steps >= 1:
+                pltpu.semaphore_wait(capacity_sem.at[0], 1)
+            if steps >= 2:
+                pltpu.semaphore_wait(capacity_sem.at[1], 1)
+
+    return my, ring_step, finalize
+
+
+def _rs_kernel(n, axis_name, interpret, acc_dtype,
+               x_ref, out_ref, send_buf, recv_buf,
+               send_sem, recv_sem, capacity_sem):
+    """Ring reduce-scatter: rank r ends with sum over ranks of block r.
+
+    Step s: send the running partial for block (my - 1 - s), fold the
+    incoming partial into block (my - 2 - s); after n-1 steps the
+    complete block is ``my``.
+    """
+    my, ring_step, finalize = _flow(
+        n, interpret, send_buf, recv_buf, send_sem, recv_sem,
+        capacity_sem, axis_name,
+    )
+    acc = x_ref[lax.rem(my + n - 1, n)].astype(acc_dtype)
+    for s in range(n - 1):
+        received = ring_step(s, acc.astype(send_buf.dtype))
+        nxt = lax.rem(my + 2 * n - 2 - s, n)
+        acc = x_ref[nxt].astype(acc_dtype) + received.astype(acc_dtype)
+    out_ref[...] = acc
+    finalize()
+
+
+def _ag_kernel(n, axis_name, interpret,
+               x_ref, out_ref, send_buf, recv_buf,
+               send_sem, recv_sem, capacity_sem):
+    """Ring all-gather: every rank ends with all n blocks.
+
+    Step s: forward the block received at step s-1 (own block at s=0);
+    the block arriving at step s is block (my - 1 - s) of the ring.
+    """
+    my, ring_step, finalize = _flow(
+        n, interpret, send_buf, recv_buf, send_sem, recv_sem,
+        capacity_sem, axis_name,
+    )
+    out_ref[my] = x_ref[...]
+    current = x_ref[...]
+    for s in range(n - 1):
+        current = ring_step(s, current)
+        src = lax.rem(my + 2 * n - 1 - s, n)
+        out_ref[src] = current
+    finalize()
+
+
+def _chunk(x):
+    """Pad/reshape a flat payload into (rows, 128) f32-tile chunks."""
+    flat = x.reshape(-1)
+    total = flat.shape[0]
+    sublanes = max(_SUBLANES * (4 // max(flat.dtype.itemsize, 1)), _SUBLANES)
+    rows = -(-total // _LANES)
+    rows = -(-rows // sublanes) * sublanes
+    flat = jnp.pad(flat, (0, rows * _LANES - total))
+    return flat.reshape(rows, _LANES), total
+
+
+def ring_reduce_scatter(x, axis_name: str, n: int, *,
+                        interpret: bool = False,
+                        collective_id: int | None = None):
+    """SUM reduce-scatter over a Pallas RDMA ring: ``x`` is
+    ``(n, *block)`` per rank; rank r receives the sum over ranks of
+    block r. bf16 rides the wire in bf16 with f32 accumulation (like
+    :func:`~mpi4jax_tpu.ops.pallas_ring.ring_allreduce`)."""
+    if n == 1:
+        return x[0]
+    block_shape, dtype = x.shape[1:], x.dtype
+    if dtype == jnp.bfloat16:
+        wire_dtype, acc_dtype = jnp.bfloat16, jnp.float32
+    else:
+        wire_dtype = acc_dtype = dtype
+    per_block = x.reshape(n, -1)
+    blk_total = per_block.shape[1]
+    sublanes = max(_SUBLANES * (4 // max(x.dtype.itemsize, 1)), _SUBLANES)
+    rows = -(-blk_total // _LANES)
+    rows = -(-rows // sublanes) * sublanes
+    pad = rows * _LANES - blk_total
+    stacked = jnp.pad(per_block, ((0, 0), (0, pad))).reshape(n, rows, _LANES)
+
+    if collective_id is None:
+        collective_id = _derive_collective_id(axis_name, "reduce_scatter")
+    kernel = functools.partial(_rs_kernel, n, axis_name, interpret, acc_dtype)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, _LANES), acc_dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, rows, _LANES), wire_dtype),
+            pltpu.VMEM((2, rows, _LANES), wire_dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(collective_id=collective_id),
+        interpret=interpret,
+    )(stacked.astype(wire_dtype))
+    return out.reshape(-1)[:blk_total].reshape(block_shape).astype(dtype)
+
+
+def ring_allgather(x, axis_name: str, n: int, *,
+                   interpret: bool = False,
+                   collective_id: int | None = None):
+    """All-gather over a Pallas RDMA ring: per-rank block ``x`` in,
+    ``(n, *x.shape)`` out on every rank."""
+    if n == 1:
+        return x[None]
+    block_shape, dtype = x.shape, x.dtype
+    chunked, total = _chunk(x)
+    rows = chunked.shape[0]
+
+    if collective_id is None:
+        collective_id = _derive_collective_id(axis_name, "allgather")
+    kernel = functools.partial(_ag_kernel, n, axis_name, interpret)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n, rows, _LANES), dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, rows, _LANES), dtype),
+            pltpu.VMEM((2, rows, _LANES), dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(collective_id=collective_id),
+        interpret=interpret,
+    )(chunked)
+    return out.reshape(n, -1)[:, :total].reshape((n,) + block_shape)

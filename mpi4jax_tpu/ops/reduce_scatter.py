@@ -49,6 +49,15 @@ def _reduce_scatter_spmd(x, *, op: Op, comm: BoundComm):
         return x[0]
     axis = comm.axis_target()
     _, kw = comm.collective_kwargs()
+    from .pallas_ring_parts import ring_reduce_scatter, use_ring_parts
+
+    if use_ring_parts(x, comm, sum_only_op=op):
+        import jax
+
+        return ring_reduce_scatter(
+            x, comm.axes[0], comm.size,
+            interpret=jax.default_backend() != "tpu",
+        )
     if op is SUM and jnp.issubdtype(x.dtype, jnp.number):
         return lax.psum_scatter(x, axis, scatter_dimension=0, tiled=False, **kw)
     from .allreduce import _allreduce_spmd
