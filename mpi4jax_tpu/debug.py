@@ -22,7 +22,6 @@ import random
 import string
 
 import jax
-import jax.numpy as jnp
 
 from . import config
 

@@ -61,7 +61,6 @@ from __future__ import annotations
 import functools
 import zlib
 
-import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -78,29 +77,47 @@ _SUBLANES = 8
 _VMEM_BUDGET = 6 << 20
 
 
-#: disjoint collective-id ranges per ring-kernel kind: two *different*
-#: ring kernels in one program (the ZeRO reduce_scatter + allgather
-#: pair especially) must never share a collective id — a shared id
-#: aliases their barrier semaphores and wedges the Mosaic compile
-#: (reproduced; see tests/test_pallas_ring.py). Range separation makes
-#: a cross-kind collision impossible for any axis name.
-_KIND_ID_BASE = {"allreduce": 1, "reduce_scatter": 6, "allgather": 11}
+#: disjoint collective-id residue classes (mod 3) per ring-kernel
+#: kind: two *different* ring kernels in one program (the ZeRO
+#: reduce_scatter + allgather pair especially) must never share a
+#: collective id — a shared id aliases their barrier semaphores and
+#: wedges the Mosaic compile (reproduced; see tests/test_pallas_ring.py).
+#: Residue separation makes a cross-kind collision impossible for any
+#: axis name or payload; the payload-shape salt keeps two same-kind
+#: kernels of different shapes in one program distinct as well
+#: (collision probability 1/100 — pass ``collective_id=`` to be sure).
+_KIND_ID_RESIDUE = {"allreduce": 0, "reduce_scatter": 1, "allgather": 2}
 
 
-def _derive_collective_id(axis_name: str, kind: str = "allreduce") -> int:
+def tile_rows(total_elems: int, itemsize: int) -> int:
+    """Rows of a (rows, 128) layout holding ``total_elems``, rounded up
+    to a whole packing tile for the dtype (8 sublanes at 4 bytes, 16 at
+    2 bytes)."""
+    sublanes = max(_SUBLANES * (4 // max(itemsize, 1)), _SUBLANES)
+    rows = -(-total_elems // _LANES)
+    return -(-rows // sublanes) * sublanes
+
+
+def _derive_collective_id(
+    axis_name: str, kind: str = "allreduce", salt: str = ""
+) -> int:
     # Deterministic across processes (zlib.crc32, not hash()) and
-    # identical on every device since the axis name is; avoid 0 which
+    # identical on every device since axis/shape are; avoid 0 which
     # user kernels commonly default to.
-    return _KIND_ID_BASE[kind] + (zlib.crc32(str(axis_name).encode()) % 5)
+    h = zlib.crc32(f"{axis_name}|{salt}".encode()) % 100
+    return 1 + _KIND_ID_RESIDUE[kind] + 3 * h
 
 
 def ring_gate(x, comm, *, min_bytes: int, max_bytes: int,
               footprint_factor: int = 1) -> bool:
     """Shared routing predicate for all Pallas ring kernels.
 
-    ``footprint_factor`` scales the payload when the kernel's resident
-    VMEM footprint is a multiple of the input (ring_allgather's output
-    is ``n`` blocks). The ``axis_size == device_count`` check is
+    ``footprint_factor`` scales the payload before *both* window
+    bounds when the kernel's moved/resident bytes are a multiple of
+    the input (ring_allgather's output is ``n`` blocks): the window is
+    a property of the data the ring touches, not of the input alone —
+    applying the factor to only one bound would make the window empty
+    for large rings. The ``axis_size == device_count`` check is
     load-bearing: the kernels address ring neighbors by LOGICAL device
     id == axis_index, which only holds when the comm axis spans the
     entire mesh (a 1-D mesh) — on a multi-axis mesh the ids would hit
@@ -117,8 +134,7 @@ def ring_gate(x, comm, *, min_bytes: int, max_bytes: int,
         and comm.groups is None
         and len(comm.axes) == 1
         and x.dtype in (jnp.float32, jnp.bfloat16)
-        and min_bytes <= nbytes
-        and nbytes * footprint_factor <= max_bytes
+        and min_bytes <= nbytes * footprint_factor <= max_bytes
     ):
         return False
     try:
@@ -279,7 +295,9 @@ def ring_allreduce(
     chunked = flat.reshape(n, rows, _LANES)
 
     if collective_id is None:
-        collective_id = _derive_collective_id(axis_name)
+        collective_id = _derive_collective_id(
+            axis_name, "allreduce", f"{orig_shape}{orig_dtype}"
+        )
 
     kernel = functools.partial(
         _ring_kernel, n, axis_name, interpret, wire_dtype, acc_dtype

@@ -29,7 +29,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .pallas_ring import _LANES, _SUBLANES, _derive_collective_id
+from .pallas_ring import _LANES, _derive_collective_id, tile_rows
 
 
 def use_ring_parts(x, comm, *, sum_only_op=None,
@@ -54,11 +54,13 @@ def _flow(n, interpret, send_buf, recv_buf, send_sem, recv_sem,
           capacity_sem, axis_name):
     """Shared ring-step driver: returns (ring_step, finalize).
 
-    ``ring_step(s, value) -> received`` sends ``value`` to the right
-    neighbor and returns the block that arrived from the left, with the
-    credit protocol of pallas_ring (wait for the consumer's credit
-    before reusing a slot, grant one after consuming). ``finalize()``
-    drains the closing credits so regular semaphores are zero on exit.
+    Returns ``(my, ring_step, finalize)``: the rank's axis index;
+    ``ring_step(s, value) -> received``, which sends ``value`` to the
+    right neighbor and returns the block that arrived from the left,
+    with the credit protocol of pallas_ring (wait for the consumer's
+    credit before reusing a slot, grant one after consuming); and
+    ``finalize()``, which drains the closing credits so regular
+    semaphores are zero on exit.
     """
     my = lax.axis_index(axis_name)
     right = lax.rem(my + 1, n)
@@ -151,9 +153,7 @@ def _chunk(x):
     """Pad/reshape a flat payload into (rows, 128) f32-tile chunks."""
     flat = x.reshape(-1)
     total = flat.shape[0]
-    sublanes = max(_SUBLANES * (4 // max(flat.dtype.itemsize, 1)), _SUBLANES)
-    rows = -(-total // _LANES)
-    rows = -(-rows // sublanes) * sublanes
+    rows = tile_rows(total, flat.dtype.itemsize)
     flat = jnp.pad(flat, (0, rows * _LANES - total))
     return flat.reshape(rows, _LANES), total
 
@@ -174,14 +174,14 @@ def ring_reduce_scatter(x, axis_name: str, n: int, *,
         wire_dtype = acc_dtype = dtype
     per_block = x.reshape(n, -1)
     blk_total = per_block.shape[1]
-    sublanes = max(_SUBLANES * (4 // max(x.dtype.itemsize, 1)), _SUBLANES)
-    rows = -(-blk_total // _LANES)
-    rows = -(-rows // sublanes) * sublanes
+    rows = tile_rows(blk_total, x.dtype.itemsize)
     pad = rows * _LANES - blk_total
     stacked = jnp.pad(per_block, ((0, 0), (0, pad))).reshape(n, rows, _LANES)
 
     if collective_id is None:
-        collective_id = _derive_collective_id(axis_name, "reduce_scatter")
+        collective_id = _derive_collective_id(
+            axis_name, "reduce_scatter", f"{x.shape}{x.dtype}"
+        )
     kernel = functools.partial(_rs_kernel, n, axis_name, interpret, acc_dtype)
     out = pl.pallas_call(
         kernel,
@@ -213,7 +213,9 @@ def ring_allgather(x, axis_name: str, n: int, *,
     rows = chunked.shape[0]
 
     if collective_id is None:
-        collective_id = _derive_collective_id(axis_name, "allgather")
+        collective_id = _derive_collective_id(
+            axis_name, "allgather", f"{x.shape}{x.dtype}"
+        )
     kernel = functools.partial(_ag_kernel, n, axis_name, interpret)
     out = pl.pallas_call(
         kernel,

@@ -22,7 +22,6 @@ lax/jnp — the int8 CollectivePermutes ride ICI on TPU.
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax.numpy as jnp
 from jax import lax

@@ -12,7 +12,6 @@ pipelined by XLA over ICI.
 
 from __future__ import annotations
 
-import jax.numpy as jnp
 
 from ..comm import CartComm
 from ..ops import sendrecv

@@ -18,7 +18,6 @@ from __future__ import annotations
 import atexit
 import os
 import time
-from typing import Optional
 
 import numpy as np
 

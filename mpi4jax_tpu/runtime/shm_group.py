@@ -15,7 +15,7 @@ it can never match user-issued p2p tags.
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Tuple
 
 import numpy as np
 
