@@ -48,12 +48,13 @@ mesh (``tests/test_pallas_ring.py``, incl. a 64 MiB streamed payload);
 the compiled path targets real multi-chip ICI and is compile-checked
 for the TPU target via cross-platform export (same test file).
 
-The collective id is derived from (kernel kind, axis name): each ring
-kernel kind owns a disjoint id range, so the ZeRO reduce_scatter +
-allgather composition can never alias barrier semaphores; two rings of
-the *same* kind over differently-named axes collide with probability
-~1/5 — pass ``collective_id=`` explicitly to guarantee separation or
-to coexist with user Pallas collectives using the same id space.
+The collective id is derived from (kernel kind, axis name, payload
+shape): kernel kinds occupy disjoint mod-3 residue classes, so the
+ZeRO reduce_scatter + allgather composition can never alias barrier
+semaphores, and the shape salt keeps two same-kind rings of different
+shapes distinct too (residual collision probability 1/100) — pass
+``collective_id=`` explicitly to guarantee separation or to coexist
+with user Pallas collectives using the same id space.
 """
 
 from __future__ import annotations
@@ -268,10 +269,8 @@ def ring_allreduce(
     flat = x.reshape(-1)
     total = flat.shape[0]
     chunk_elems = -(-total // n)  # ceil
-    sublanes = _SUBLANES * (4 // max(flat.dtype.itemsize, 1))
-    sublanes = max(sublanes, _SUBLANES)
-    rows = -(-chunk_elems // _LANES)
-    rows = -(-rows // sublanes) * sublanes
+    sublanes = max(_SUBLANES * (4 // max(flat.dtype.itemsize, 1)), _SUBLANES)
+    rows = tile_rows(chunk_elems, flat.dtype.itemsize)
 
     # Resident bytes per row across accumulator (f32), input, and the
     # four wire buffers; choose a block-row count within the budget.

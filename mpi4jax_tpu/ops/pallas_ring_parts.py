@@ -52,7 +52,7 @@ def use_ring_parts(x, comm, *, sum_only_op=None,
 
 def _flow(n, interpret, send_buf, recv_buf, send_sem, recv_sem,
           capacity_sem, axis_name):
-    """Shared ring-step driver: returns (ring_step, finalize).
+    """Shared ring-step driver: returns (my, ring_step, finalize).
 
     Returns ``(my, ring_step, finalize)``: the rank's axis index;
     ``ring_step(s, value) -> received``, which sends ``value`` to the

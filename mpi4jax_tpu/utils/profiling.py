@@ -1,0 +1,68 @@
+"""Profiler integration (superset observability subsystem).
+
+The reference's only tracing facility is the per-op ``DebugTimer`` log
+(``mpi_ops_common.h:154-206``) — mirrored here by ``set_logging``
+(``debug.py``). On TPU the native tool is the XLA profiler: its traces
+show every HLO collective (AllReduce/AllGather/CollectivePermute) with
+per-op device timing and ICI utilization, which is exactly the
+visibility the reference's log lines approximate. This module wraps it
+in two ergonomic entry points so comm-heavy sections can be profiled
+without touching ``jax.profiler`` directly:
+
+    from mpi4jax_tpu.utils import profiling
+
+    with profiling.trace("/tmp/m4t-trace"):       # TensorBoard dir
+        step(params, batch)
+
+    profiling.annotate("halo-exchange")           # decorator/context
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, *, create_perfetto_link: bool = False) -> Iterator[None]:
+    """Capture an XLA profiler trace of the enclosed block.
+
+    The trace lands in ``log_dir`` in TensorBoard format (open with
+    ``tensorboard --logdir``, or upload the contained ``.perfetto``
+    file to ui.perfetto.dev). Collectives appear under their HLO names
+    with device-time ranges — the TPU-native analog of reading the
+    reference's DebugTimer log.
+    """
+    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: Optional[str] = None):
+    """Named region for profiler traces: usable as a decorator or a
+    context manager. Regions nest and show up on the trace timeline,
+    letting a comm-heavy section (a halo-exchange group, a ring
+    rotation) be attributed at a glance.
+
+    ``@annotate()`` on a function uses the function's name.
+    """
+    if callable(name):  # bare @annotate usage
+        return jax.profiler.annotate_function(name)
+
+    class _Region:
+        def __call__(self, fn):
+            return jax.profiler.annotate_function(fn, name=name)
+
+        def __enter__(self):
+            self._ctx = jax.profiler.TraceAnnotation(name or "m4t")
+            self._ctx.__enter__()
+            return self
+
+        def __exit__(self, *exc):
+            return self._ctx.__exit__(*exc)
+
+    return _Region()

@@ -303,3 +303,37 @@ def test_eager_latency_fast_path(monkeypatch):
     out2 = m4t.allreduce(out1 * 2, op=m4t.MAX)
     np.testing.assert_allclose(np.asarray(out2), 2.0)
     assert calls == [], f"eager ops emitted {len(calls)} barrier ties"
+
+
+# --- profiler integration (superset observability) ---
+
+
+def test_profiler_trace_capture(tmp_path, run_spmd, per_rank):
+    from mpi4jax_tpu.utils import profiling
+
+    logdir = str(tmp_path / "trace")
+    arr = per_rank(lambda r: np.float32(r))
+    with profiling.trace(logdir):
+        with profiling.annotate("allreduce-under-trace"):
+            run_spmd(lambda x: m4t.allreduce(x, op=m4t.SUM), arr)
+    import os as _os
+
+    found = [
+        _os.path.join(dp, f)
+        for dp, _, fs in _os.walk(logdir)
+        for f in fs
+        if f.endswith((".pb", ".json.gz", ".xplane.pb"))
+    ]
+    assert found, f"no trace artifacts written under {logdir}"
+
+
+def test_profiler_annotate_decorator(run_spmd, per_rank):
+    from mpi4jax_tpu.utils import profiling
+
+    @profiling.annotate("named-section")
+    def section(x):
+        return m4t.allreduce(x, op=m4t.SUM)
+
+    arr = per_rank(lambda r: np.float32(1))
+    out = run_spmd(section, arr)
+    np.testing.assert_allclose(np.asarray(out).ravel(), 8.0)
