@@ -29,6 +29,7 @@
 #include <complex>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <utility>
@@ -51,7 +52,11 @@ constexpr size_t kCollChunk = size_t{1} << 22;  // 4 MiB per-rank slot
 constexpr size_t kP2PChunk = size_t{1} << 18;   // 256 KiB channel entry
 constexpr int64_t kAnyTag = -1;
 constexpr int64_t kAnySource = -2;  // MPI_ANY_SOURCE analog (recv wildcard)
-constexpr long kSpinTimeoutUs = 120L * 1000 * 1000;  // 2 min -> abort
+// Default 2 min -> abort; override with M4T_SHM_SPIN_TIMEOUT_US (read
+// once at world init) — tests use a short timeout to exercise the
+// stalled-peer abort path without waiting out the production value.
+constexpr long kDefaultSpinTimeoutUs = 120L * 1000 * 1000;
+static long g_spin_timeout_us = kDefaultSpinTimeoutUs;
 
 // Reduction op codes (mirrors mpi4jax_tpu.comm Op order).
 enum OpCode : int64_t {
@@ -111,7 +116,7 @@ static inline void check_abort() {
 
 template <typename Pred>
 static void spin_until(Pred pred, const char* what) {
-  long deadline = now_us() + kSpinTimeoutUs;
+  long deadline = now_us() + g_spin_timeout_us;
   int iter = 0;
   while (!pred()) {
     if (++iter >= 1024) {
@@ -404,14 +409,14 @@ static int p2p_wait_any_source(int64_t tag) {
 
 template <typename A, typename B>
 static void drive(A* a, B* b, const char* what) {
-  long deadline = now_us() + kSpinTimeoutUs;
+  long deadline = now_us() + g_spin_timeout_us;
   int idle = 0;
   while ((a != nullptr && !a->done()) || (b != nullptr && !b->done())) {
     bool progress = false;
     if (a != nullptr) progress |= a->try_step();
     if (b != nullptr) progress |= b->try_step();
     if (progress) {
-      deadline = now_us() + kSpinTimeoutUs;
+      deadline = now_us() + g_spin_timeout_us;
       idle = 0;
     } else if (++idle >= 256) {
       idle = 0;
@@ -660,7 +665,7 @@ static ffi::Error SendrecvImpl(int64_t source, int64_t dest, int64_t sendtag,
     SendCursor s{&g.sh->channels[g.rank][dest],
                  (const char*)x.untyped_data(), x.size_bytes(), sendtag};
     int found = -1;
-    long deadline = now_us() + kSpinTimeoutUs;
+    long deadline = now_us() + g_spin_timeout_us;
     int idle = 0;
     while (found < 0) {
       bool progress = s.try_step();
@@ -674,7 +679,7 @@ static ffi::Error SendrecvImpl(int64_t source, int64_t dest, int64_t sendtag,
         }
       }
       if (progress) {
-        deadline = now_us() + kSpinTimeoutUs;
+        deadline = now_us() + g_spin_timeout_us;
         idle = 0;
       } else if (found < 0 && ++idle >= 256) {
         idle = 0;
@@ -787,6 +792,17 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(kSendrecv, SendrecvImpl,
 
 static int world_init(const char* name, int rank, int size, int create) {
   if (size < 1 || size > kMaxRanks || rank < 0 || rank >= size) return -1;
+  if (const char* t = getenv("M4T_SHM_SPIN_TIMEOUT_US")) {
+    char* end = nullptr;
+    long v = strtol(t, &end, 10);
+    if (end != t && *end == '\0' && v > 0) {
+      g_spin_timeout_us = v;
+    } else {
+      std::fprintf(stderr,
+                   "shmcc: ignoring invalid M4T_SHM_SPIN_TIMEOUT_US=%s "
+                   "(need a positive integer of microseconds)\n", t);
+    }
+  }
   int flags = create ? (O_CREAT | O_RDWR) : O_RDWR;
   int fd = shm_open(name, flags, 0600);
   if (fd < 0) return -2;

@@ -337,3 +337,44 @@ def test_profiler_annotate_decorator(run_spmd, per_rank):
     arr = per_rank(lambda r: np.float32(1))
     out = run_spmd(section, arr)
     np.testing.assert_allclose(np.asarray(out).ravel(), 8.0)
+
+
+def test_multihost_initialize_single_process():
+    # parallel.initialize() is the jax.distributed entry (reference
+    # launch model replacement); it must be called before any JAX
+    # computation, so drive it in a fresh process.
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    import socket
+
+    with socket.socket() as s_:
+        s_.bind(("", 0))
+        port = s_.getsockname()[1]
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {repo!r})
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from mpi4jax_tpu import parallel
+        parallel.initialize(
+            coordinator_address="localhost:{port}",
+            num_processes=1, process_id=0,
+        )
+        m = parallel.world_mesh()
+        assert m.devices.size == 8
+        print("INIT_OK")
+    """)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run(
+        [sys.executable, "-c", script], env=env,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert res.returncode == 0, res.stderr
+    assert "INIT_OK" in res.stdout

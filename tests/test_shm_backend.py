@@ -432,3 +432,28 @@ def test_barrier_ordering_interleaved_writes(tmp_path):
     dones = [i for i, l in enumerate(lines) if l.startswith("done")]
     assert len(starts) == 3 and len(dones) == 3, lines
     assert max(starts) < min(dones), lines
+
+
+@needs_native
+def test_stalled_peer_spin_timeout_aborts():
+    # The stalled-peer failure path (reference: every MPI error ->
+    # MPI_Abort, mpi_ops_common.h:60-78; here: spin timeout -> fatal ->
+    # abort flag -> world teardown). A short M4T_SHM_SPIN_TIMEOUT_US
+    # makes it testable: rank 1 never reaches the barrier.
+    res = launch(
+        2,
+        """
+        import time
+        import jax.numpy as jnp
+        import mpi4jax_tpu as m4t
+        from mpi4jax_tpu.runtime import shm
+        if shm.rank() == 1:
+            time.sleep(60)  # never participates
+        m4t.barrier()
+        """,
+        env_extra={"M4T_SHM_SPIN_TIMEOUT_US": "2000000"},  # 2 s
+        timeout=60,
+    )
+    assert res.returncode != 0
+    assert "barrier timeout" in res.stderr, res.stderr
+    assert "terminating world" in res.stderr
