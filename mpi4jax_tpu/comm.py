@@ -268,6 +268,19 @@ class Comm:
 
     Dup = Clone
 
+    def Free(self) -> None:
+        """No-op (mpi4py compat): communicators here are pure static
+        descriptions with no handle to release."""
+
+    def Get_name(self) -> str:
+        """mpi4py convention: the world communicator answers to
+        ``MPI_COMM_WORLD`` so ported scripts that branch on the
+        default name keep working; other comms get a descriptive name.
+        """
+        if type(self) is Comm and self._axes == (WORLD_AXIS,):
+            return "MPI_COMM_WORLD"
+        return f"{type(self).__name__}{self._axes}"
+
     def Split(self, colors: Sequence[int]) -> "GroupComm":
         """Partition the communicator (``MPI_Comm_split`` analog).
 

@@ -49,3 +49,15 @@ def test_mpi_namespace_surface():
     st = MPI.Status()
     assert hasattr(st, "Get_source") and hasattr(st, "Get_count")
     assert MPI.COMM_WORLD.Get_size() == 1  # outside any mesh
+
+
+def test_comm_portability_noops():
+    # mpi4py scripts commonly call Free()/Get_name(); both must be safe
+    c = MPI.COMM_WORLD
+    assert c.Get_name() == "MPI_COMM_WORLD"  # mpi4py default-name parity
+    c.Free()  # no-op, no error
+    d = c.Clone()
+    d.Free()
+    import mpi4jax_tpu as m4t
+
+    assert "CartComm" in m4t.CartComm(dims=(2, 4)).Get_name()
