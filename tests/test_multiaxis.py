@@ -166,3 +166,21 @@ def test_multiaxis_alltoall_grad(mesh2d):
     x = arr.reshape(8, 8)
     expect = np.stack([2 * x[:, r] for r in range(8)])
     np.testing.assert_allclose(out.reshape(8, 8), expect)
+
+
+def test_multiaxis_quantized_allreduce(mesh2d):
+    comm = m4t.Comm(("a", "b"))
+    rng = np.random.RandomState(7)
+    arr = rng.randn(8, 2048).astype(np.float32).reshape(2, 4, 2048)
+    out = run2d(
+        mesh2d,
+        lambda x: m4t.quantized_allreduce(x, comm=comm),
+        jnp.asarray(arr),
+    )
+    # same accuracy contract as the single-axis tests
+    # (tests/test_quantized.py): max error below 5% of the result scale
+    expected = arr.reshape(8, 2048).sum(axis=0)
+    scale = np.abs(expected).max()
+    for r in range(8):
+        err = np.abs(out.reshape(8, 2048)[r] - expected).max() / scale
+        assert err < 0.05, err

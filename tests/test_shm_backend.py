@@ -307,6 +307,19 @@ def test_comm_split_on_launcher_world():
         g = m4t.gather(jnp.float32(r), root=0, comm=comm)
         if gr == 0:
             assert np.allclose(np.asarray(g), [base, base + 1]), (r, g)
+        # alltoall within the group: member gr's block j = member j's block gr
+        a2a = m4t.alltoall(jnp.asarray([10.0 * r, 10.0 * r + 1]), comm=comm)
+        assert np.allclose(np.asarray(a2a),
+                           [10.0 * base + gr, 10.0 * (base + 1) + gr]), (r, a2a)
+        # root-only reduce: group root gets the sum, others their input
+        red = m4t.reduce(jnp.float32(r), m4t.SUM, 1, comm=comm)
+        assert float(red) == (2 * base + 1 if gr == 1 else r), (r, float(red))
+        # scatter from group root 0: root passes (2,), others a template
+        if gr == 0:
+            sc = m4t.scatter(jnp.asarray([100.0 + r, 200.0 + r]), 0, comm=comm)
+        else:
+            sc = m4t.scatter(jnp.float32(0), 0, comm=comm)
+        assert float(sc) == (100.0 if gr == 0 else 200.0) + base, (r, float(sc))
         m4t.barrier(comm=comm)
         print(f"SPLIT_OK{r}")
         """,
