@@ -470,3 +470,45 @@ def test_stalled_peer_spin_timeout_aborts():
     assert res.returncode != 0
     assert "barrier timeout" in res.stderr, res.stderr
     assert "terminating world" in res.stderr
+
+
+@needs_native
+def test_max_ranks_world():
+    # kMaxRanks boundary: a full 16-rank world (the shm backend's
+    # capacity limit) runs collectives and p2p correctly; 17 ranks is
+    # rejected by the launcher before any process starts.
+    res = launch(
+        16,
+        """
+        import numpy as np, jax.numpy as jnp
+        import mpi4jax_tpu as m4t
+        from mpi4jax_tpu.runtime import shm
+        r, n = shm.rank(), shm.size()
+        assert n == 16
+        s = m4t.allreduce(jnp.float32(r), op=m4t.SUM)
+        assert float(s) == sum(range(16)), float(s)
+        ag = m4t.allgather(jnp.float32(r))
+        assert np.allclose(np.asarray(ag), np.arange(16.0))
+        sw = m4t.sendrecv(jnp.float32(r), jnp.float32(0),
+                          source=(r - 1) % n, dest=(r + 1) % n)
+        assert float(sw) == (r - 1) % n
+        m4t.barrier()
+        print(f"MAX_OK{r}")
+        """,
+        timeout=240,
+    )
+    assert res.returncode == 0, res.stderr
+    for r in range(16):
+        assert f"MAX_OK{r}" in res.stdout
+
+
+def test_launcher_rejects_oversized_world():
+    import subprocess
+    import sys
+
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.launch", "-n", "17", "x.py"],
+        capture_output=True, text=True, timeout=30,
+    )
+    assert res.returncode != 0
+    assert "16" in res.stderr
