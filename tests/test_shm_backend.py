@@ -493,13 +493,14 @@ def test_max_ranks_world():
                           source=(r - 1) % n, dest=(r + 1) % n)
         assert float(sw) == (r - 1) % n
         m4t.barrier()
-        print(f"MAX_OK{r}")
+        print(f"MAX_OK{r}.")
         """,
         timeout=240,
     )
     assert res.returncode == 0, res.stderr
     for r in range(16):
-        assert f"MAX_OK{r}" in res.stdout
+        # trailing delimiter: "MAX_OK1" must not match "MAX_OK10"
+        assert f"MAX_OK{r}." in res.stdout
 
 
 def test_launcher_rejects_oversized_world():
@@ -508,7 +509,7 @@ def test_launcher_rejects_oversized_world():
 
     res = subprocess.run(
         [sys.executable, "-m", "mpi4jax_tpu.launch", "-n", "17", "x.py"],
-        capture_output=True, text=True, timeout=30,
+        capture_output=True, text=True, timeout=30, cwd=REPO,
     )
     assert res.returncode != 0
     assert "16" in res.stderr
