@@ -1,0 +1,162 @@
+"""ZeRO-style sharded-optimizer data parallelism.
+
+The communication pattern that motivates exposing ``reduce_scatter``
+as a first-class op (and its Pallas ring kernels): instead of
+all-reducing gradients and keeping a full optimizer state on every
+rank, each rank owns 1/n of the parameters —
+
+    grads        -> reduce_scatter(SUM)   (each rank gets its shard's
+                                           summed gradient)
+    shard update -> local SGD/Adam on the owned shard only
+    params       -> allgather             (reassemble full params)
+
+moving the same ``2*(n-1)/n`` bytes per step as an all-reduce but
+holding only ``1/n`` of the optimizer state per rank. With
+``MPI4JAX_TPU_PALLAS_RING=1`` both collectives ride the hand-scheduled
+RDMA ring kernels in their supported window.
+
+    python examples/zero_optimizer.py [--steps 200] [--nproc 8]
+
+Trains a small MLP on a synthetic regression task and verifies the
+loss matches plain (all-reduce) data parallelism step for step.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=200)
+    parser.add_argument("--nproc", type=int, default=None)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--platform", default=None)
+    args = parser.parse_args()
+    if args.steps < 2:
+        # losses are measured pre-update, so the first and last loss
+        # coincide below 2 steps and the reduction check is undefined
+        parser.error("--steps must be >= 2")
+
+    if args.platform == "cpu" and (args.nproc or 0) > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={args.nproc}"
+            ).strip()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mpi4jax_tpu as m4t
+    from mpi4jax_tpu.parallel import spmd, world_mesh
+
+    nproc = args.nproc or len(jax.devices())
+    mesh = world_mesh(nproc)
+
+    d_in, d_hidden = 32, 64 * nproc  # hidden divisible by nproc
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(d_in).astype(np.float32)
+
+    def init_params():
+        k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+        return {
+            "w1": jax.random.normal(k1, (d_in, d_hidden)) / np.sqrt(d_in),
+            "w2": jax.random.normal(k2, (d_hidden, 1)) / np.sqrt(d_hidden),
+        }
+
+    def loss_fn(params, xb, yb):
+        h = jnp.tanh(xb @ params["w1"])
+        pred = (h @ params["w2"])[:, 0]
+        return ((pred - yb) ** 2).mean()
+
+    flat_template = init_params()
+    leaves, treedef = jax.tree.flatten(flat_template)
+    sizes = [leaf.size for leaf in leaves]
+    total = sum(sizes)
+    shard = -(-total // nproc)
+    padded = shard * nproc
+
+    def flatten(p):
+        return jnp.concatenate([leaf.reshape(-1) for leaf in jax.tree.leaves(p)])
+
+    def unflatten(vec):
+        out, off = [], 0
+        for leaf, size in zip(leaves, sizes):
+            out.append(vec[off : off + size].reshape(leaf.shape))
+            off += size
+        return jax.tree.unflatten(treedef, out)
+
+    value_and_grad = jax.value_and_grad(
+        lambda v, xb, yb: loss_fn(unflatten(v), xb, yb)
+    )
+
+    def zero_step(params_vec, xb, yb):
+        """One ZeRO-DP step on the flat parameter vector."""
+        local_loss, grads = value_and_grad(params_vec, xb, yb)
+        # mean over the data-parallel group rides the reduce_scatter
+        gshards = m4t.reduce_scatter(
+            jnp.pad(grads, (0, padded - total)).reshape(nproc, shard),
+            m4t.SUM,
+        ) / nproc
+        rank = m4t.get_default_comm().Get_rank()
+        my_shard = jax.lax.dynamic_slice(
+            jnp.pad(params_vec, (0, padded - total)), (rank * shard,), (shard,)
+        )
+        my_shard = my_shard - args.lr * gshards        # owned-shard update
+        full = m4t.allgather(my_shard).reshape(-1)[:total]
+        loss = m4t.allreduce(local_loss, op=m4t.SUM) / nproc
+        return full, loss
+
+    def allreduce_step(params_vec, xb, yb):
+        """Reference: classic all-reduce data parallelism."""
+        local_loss, grads = value_and_grad(params_vec, xb, yb)
+        grads = m4t.allreduce(grads, op=m4t.SUM) / nproc
+        loss = m4t.allreduce(local_loss, op=m4t.SUM) / nproc
+        return params_vec - args.lr * grads, loss
+
+    def make_batches(step):
+        rs = np.random.RandomState(100 + step)
+        xb = rs.randn(nproc, 16, d_in).astype(np.float32)
+        yb = np.tanh(xb @ w_true)  # nonlinear synthetic target
+        return jnp.asarray(xb), jnp.asarray(yb)
+
+    zero = spmd(zero_step, mesh=mesh)
+    ref = spmd(allreduce_step, mesh=mesh)
+
+    v_zero = flatten(init_params())
+    v_ref = flatten(init_params())
+    stack = lambda v: jnp.broadcast_to(v, (nproc,) + v.shape)
+    v_zero, v_ref = stack(v_zero), stack(v_ref)
+
+    first = last = None
+    for step in range(args.steps):
+        xb, yb = make_batches(step)
+        v_zero, l_zero = zero(v_zero, xb, yb)
+        v_ref, l_ref = ref(v_ref, xb, yb)
+        np.testing.assert_allclose(
+            np.asarray(l_zero)[0], np.asarray(l_ref)[0], rtol=1e-3, atol=1e-5
+        )
+        last = float(np.asarray(l_zero)[0])
+        if first is None:
+            first = last
+
+    if not last < first:
+        raise SystemExit(
+            f"training did not reduce the loss ({first:.4f} -> {last:.4f})"
+        )
+    print(
+        f"ZeRO-DP over {nproc} ranks: loss {first:.4f} -> {last:.4f} in "
+        f"{args.steps} steps; matches all-reduce DP step-for-step"
+    )
+
+
+if __name__ == "__main__":
+    main()

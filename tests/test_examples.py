@@ -67,3 +67,14 @@ def test_cg_solver_example():
     )
     assert res.returncode == 0, res.stderr
     assert "rel. error" in res.stdout
+
+
+def test_zero_optimizer_example():
+    # ZeRO-DP (reduce_scatter + shard update + allgather) must match
+    # all-reduce DP step-for-step and reduce the loss
+    res = run_example(
+        "zero_optimizer.py",
+        "--nproc", "8", "--platform", "cpu", "--steps", "30",
+    )
+    assert res.returncode == 0, res.stderr
+    assert "matches all-reduce DP" in res.stdout
