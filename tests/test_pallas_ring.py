@@ -162,3 +162,36 @@ def test_ring_parts_tpu_compile_check():
     except Exception as e:  # pragma: no cover
         pytest.fail(f"TPU lowering of ring parts failed: {e}")
     assert exported.mlir_module().count("tpu_custom_call") >= 2
+
+
+def test_ring_allreduce_streamed_tpu_compile_check():
+    # The grid-streamed variant (multiple macro-blocks, cross-block
+    # credit carries, first-block barrier, last-block drain) and the
+    # bf16 wire path must lower through Mosaic too — the VMEM-resident
+    # f32 check above does not exercise either.
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from mpi4jax_tpu.parallel import world_mesh
+
+    mesh = world_mesh()
+    # ~24 MiB f32 payload -> multiple grid blocks under the 6 MiB budget
+    big = (24 << 20) // 4
+    fn = jax.jit(shard_map(
+        lambda x: ring_allreduce(x.reshape(x.shape[1:]), "ranks", N)[None],
+        mesh=mesh, in_specs=P("ranks"), out_specs=P("ranks"),
+        check_vma=False,
+    ))
+    x = jnp.ones((N, big), jnp.float32)
+    exported = jax.export.export(fn, platforms=["tpu"])(x)
+    assert "tpu_custom_call" in exported.mlir_module()
+
+    xb = jnp.ones((N, (4 << 20) // 2), jnp.bfloat16)  # bf16 wire path
+    fnb = jax.jit(shard_map(
+        lambda x: ring_allreduce(x.reshape(x.shape[1:]), "ranks", N)[None],
+        mesh=mesh, in_specs=P("ranks"), out_specs=P("ranks"),
+        check_vma=False,
+    ))
+    exported_b = jax.export.export(fnb, platforms=["tpu"])(xb)
+    assert "tpu_custom_call" in exported_b.mlir_module()
