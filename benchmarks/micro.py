@@ -184,7 +184,8 @@ def main():
     # Compiled-mode comparison of the hand-scheduled ring against the
     # XLA-scheduled collective on identical payloads; meaningless in
     # interpret mode, so gated on real accelerator hardware.
-    if jax.devices()[0].platform == "tpu" and n > 1:
+    # the container tunnel reports platform "axon" for its TPU chip
+    if jax.devices()[0].platform in ("tpu", "axon") and n > 1:
         from mpi4jax_tpu.ops.pallas_ring import ring_allreduce
 
         axis = mesh.axis_names[0]

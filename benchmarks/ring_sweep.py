@@ -1,0 +1,102 @@
+"""Pallas RDMA ring vs HLO AllReduce sweep, 1–64 MiB per chip.
+
+Compares the hand-scheduled Pallas ring (``ops/pallas_ring.py``) against the
+XLA-scheduled HLO AllReduce on identical payloads across a size sweep, and
+reports bus bandwidth per chip (ring allreduce moves ``2*(n-1)/n * payload``
+bytes per chip — the north-star metric in ``BASELINE.json``).
+
+Meaningful only in compiled mode on real multi-chip hardware; on a single
+device or CPU it exits with a skip record (interpret-mode timings measure the
+HLO emulation of the ring, not the RDMA protocol).
+
+    python benchmarks/ring_sweep.py [--sizes-mb 1 4 16 64] [--output f.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from micro import timeit  # noqa: E402 — shared timing methodology
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--sizes-mb", type=float, nargs="+", default=[1, 4, 16, 64])
+    p.add_argument("--output", default=None)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument(
+        "--platform",
+        default=None,
+        help="force a jax platform (the container sitecustomize overrides "
+        "the JAX_PLATFORMS env var, so an explicit flag is needed to reach "
+        "the CPU skip path without touching the possibly-wedged TPU tunnel)",
+    )
+    args = p.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as m4t
+    from mpi4jax_tpu.ops.pallas_ring import ring_allreduce
+    from mpi4jax_tpu.parallel import spmd, world_mesh
+
+    n = len(jax.devices())
+    platform = jax.devices()[0].platform
+    # the container tunnel reports platform "axon" for its TPU chip
+    # (cf. mpi4jax_tpu/__init__.py has_tpu_support)
+    if platform not in ("tpu", "axon") or n < 2:
+        rec = {
+            "skipped": f"needs >=2 TPU chips (have {n} {platform} device(s))"
+        }
+        print(json.dumps(rec))
+        if args.output:
+            with open(args.output, "w") as f:
+                json.dump(rec, f)
+        return 0
+
+    mesh = world_mesh(n)
+    axis = mesh.axis_names[0]
+    f_hlo = spmd(lambda x: m4t.allreduce(x, op=m4t.SUM), mesh=mesh)
+    f_ring = spmd(lambda x: ring_allreduce(x, axis, n), mesh=mesh)
+
+    rows = []
+    for size_mb in args.sizes_mb:
+        count = int(size_mb * (1 << 20) / 4)
+        x = jnp.ones((n, count), jnp.float32)
+        payload = count * 4
+        bus_bytes = 2 * (n - 1) / n * payload
+        for name, fn in (("hlo_allreduce", f_hlo), ("pallas_ring", f_ring)):
+            try:
+                t = timeit(fn, x, iters=args.iters)
+            except Exception as e:  # noqa: BLE001 — record, keep sweeping
+                rows.append(
+                    {"impl": name, "size_mb": size_mb, "error": repr(e)[:300]}
+                )
+                continue
+            rows.append(
+                {
+                    "impl": name,
+                    "size_mb": size_mb,
+                    "seconds": round(t, 6),
+                    "gb_per_s_per_chip": round(bus_bytes / t / 1e9, 3),
+                }
+            )
+            print(json.dumps(rows[-1]))
+
+    doc = {"platform": platform, "n_devices": n, "rows": rows}
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"# wrote {args.output}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,232 @@
+"""Chip-opportunist harness: probe the TPU tunnel all round, capture on-chip
+numbers the moment it answers.
+
+The axon TPU tunnel has been wedged for two rounds (PJRT init hangs with the
+GIL held in native code, so only process-level kills work — see
+``bench.py:_probe_accelerator``). Instead of checking the chip at two instants
+per round, this supervisor probes every ``--interval`` seconds for the whole
+round, appends one JSON line per attempt to ``BENCH_r03_probes.jsonl``, and on
+the first successful probe fires the full measurement battery:
+
+1. ``bench.py`` — headline shallow-water solve, ``vs_baseline`` vs the
+   reference's 6.28 s P100 row (``/root/reference/docs/shallow-water.rst:81-83``)
+   → ``BENCH_r03_tpu.json``
+2. ``benchmarks/micro.py`` — the five BASELINE.json configs + 1 MB allreduce
+   bus bandwidth → ``benchmarks/results_r03_tpu_micro.json``
+3. Pallas ring vs HLO AllReduce at 1–64 MiB (needs >1 chip; recorded as
+   skipped when the tunnel exposes a single device).
+
+Each probe runs in a fresh process (fresh PJRT client) in its own session so
+a wedged child can be killed as a group. Probes rotate through recovery
+variants (env knobs) in case one of them unwedges the tunnel.
+
+Run:  python benchmarks/tpu_watch.py [--interval 600] [--once]
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROBE_LOG = os.path.join(REPO, "BENCH_r03_probes.jsonl")
+DONE_MARKER = os.path.join(REPO, "benchmarks", "results_r03_tpu_captured")
+
+PROBE_TIMEOUT_S = int(os.environ.get("M4T_WATCH_PROBE_TIMEOUT", "90"))
+BATTERY_TIMEOUT_S = int(os.environ.get("M4T_WATCH_BATTERY_TIMEOUT", "1800"))
+
+_PROBE_SRC = """
+import json, sys
+import jax, jax.numpy as jnp
+d = jax.devices()
+assert d and d[0].platform != "cpu", f"no accelerator: {d}"
+x = jax.jit(lambda a: (a @ a).sum())(jnp.ones((256, 256)))
+x.block_until_ready()
+print("PROBE_OK " + json.dumps(
+    {"device": str(d[0]), "platform": d[0].platform, "n_devices": len(d)}
+), flush=True)
+"""
+
+#: recovery variants rotated across probe attempts; each is a dict of env
+#: overrides layered on os.environ. The tunnel platform is "axon" (the
+#: sitecustomize overrides JAX_PLATFORMS), so variants mostly poke at
+#: client-init behavior rather than platform selection.
+VARIANTS = [
+    {},
+    {"JAX_PLATFORMS": ""},  # let jax pick; clears any stale pin
+    {"TPU_SKIP_MDS_QUERY": "1"},
+    {"JAX_PLATFORMS": "", "XLA_PYTHON_CLIENT_PREALLOCATE": "false"},
+]
+
+
+def _run(cmd, env, timeout):
+    """Run cmd in its own session; kill the whole group on timeout."""
+    proc = subprocess.Popen(
+        cmd,
+        env=env,
+        cwd=REPO,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        start_new_session=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+        return proc.returncode, out
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        out, _ = proc.communicate()
+        return None, out
+
+
+def log_probe(record):
+    record["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(PROBE_LOG, "a") as f:
+        f.write(json.dumps(record) + "\n")
+    print(json.dumps(record), flush=True)
+
+
+def probe(attempt):
+    variant = VARIANTS[attempt % len(VARIANTS)]
+    env = dict(os.environ)
+    env.update(variant)
+    t0 = time.perf_counter()
+    rc, out = _run([sys.executable, "-c", _PROBE_SRC], env, PROBE_TIMEOUT_S)
+    elapsed = round(time.perf_counter() - t0, 1)
+    info = None
+    for line in (out or "").splitlines():
+        if line.startswith("PROBE_OK "):
+            info = json.loads(line[len("PROBE_OK "):])
+    outcome = (
+        "ok" if (rc == 0 and info)
+        else "wedged_timeout" if rc is None
+        else "failed"
+    )
+    log_probe(
+        {
+            "attempt": attempt,
+            "outcome": outcome,
+            "elapsed_s": elapsed,
+            "variant": variant,
+            "exit_code": rc,
+            "device": (info or {}).get("device"),
+            "n_devices": (info or {}).get("n_devices"),
+            "tail": None if outcome == "ok" else (out or "")[-500:],
+        }
+    )
+    return outcome == "ok", info, variant
+
+
+def run_battery(info, variant):
+    """The chip answered — capture everything before it wedges again.
+
+    Returns True only if at least one genuinely on-chip artifact was
+    captured; a False return means the chip re-wedged between the probe
+    and the battery and the supervisor should keep watching.
+    """
+    env = dict(os.environ)
+    env.update(variant)
+    results = {"device": info}
+    captured = False
+
+    # 1. headline bench (vs_baseline vs the 6.28 s P100 row)
+    rc, out = _run([sys.executable, "bench.py"], env, BATTERY_TIMEOUT_S)
+    bench_line = None
+    for line in (out or "").splitlines():
+        try:
+            rec = json.loads(line)
+            if isinstance(rec, dict) and "metric" in rec:
+                bench_line = rec
+        except (json.JSONDecodeError, ValueError):
+            continue
+    results["bench"] = {"exit_code": rc, "result": bench_line,
+                        "tail": (out or "")[-2000:] if bench_line is None else None}
+    # bench.py falls back to CPU when its own canary fails (the chip can
+    # re-wedge between our probe and its run) and still emits a metric
+    # line with vs_baseline null — never record that as an on-chip
+    # number. vs_baseline is only non-null for single-device accelerator
+    # runs on the published config (bench.py:243-247).
+    if bench_line is not None and bench_line.get("vs_baseline") is not None:
+        with open(os.path.join(REPO, "BENCH_r03_tpu.json"), "w") as f:
+            json.dump(bench_line, f)
+        captured = True
+    elif bench_line is not None:
+        results["bench"]["cpu_fallback_suspected"] = True
+
+    # 2. micro battery (BASELINE configs + bus bandwidth); nproc follows
+    # the real device count — with a single tunnel chip the collective
+    # configs are degenerate but the latency rows still stand
+    micro_out = os.path.join(REPO, "benchmarks", "results_r03_tpu_micro.json")
+    rc, out = _run(
+        [sys.executable, "benchmarks/micro.py", "--output", micro_out],
+        env,
+        BATTERY_TIMEOUT_S,
+    )
+    results["micro"] = {
+        "exit_code": rc,
+        "tail": None if rc == 0 else (out or "")[-2000:],
+    }
+    if rc == 0 and os.path.exists(micro_out):
+        captured = True
+
+    # 3. Pallas ring vs HLO sweep — only meaningful with >1 real chip
+    if (info.get("n_devices") or 1) > 1:
+        rc, out = _run(
+            [sys.executable, "benchmarks/ring_sweep.py",
+             "--output", os.path.join(REPO, "benchmarks", "results_r03_ring_sweep.json")],
+            env,
+            BATTERY_TIMEOUT_S,
+        )
+        results["ring_sweep"] = {
+            "exit_code": rc,
+            "tail": None if rc == 0 else (out or "")[-2000:],
+        }
+    else:
+        results["ring_sweep"] = {"skipped": "single device exposed by tunnel"}
+
+    if captured:
+        with open(DONE_MARKER, "w") as f:
+            json.dump(results, f, indent=1)
+    log_probe({"battery": results, "captured": captured})
+    return captured
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--interval", type=int, default=600)
+    p.add_argument("--once", action="store_true")
+    p.add_argument(
+        "--max-hours", type=float, default=12.0,
+        help="stop probing after this much wall-clock",
+    )
+    args = p.parse_args()
+
+    if os.path.exists(DONE_MARKER):
+        print(f"# battery already captured ({DONE_MARKER}); not re-probing")
+        return 0
+
+    deadline = time.monotonic() + args.max_hours * 3600
+    attempt = 0
+    while time.monotonic() < deadline:
+        ok, info, variant = probe(attempt)
+        attempt += 1
+        if ok:
+            if run_battery(info, variant):
+                return 0
+            # chip answered the probe but re-wedged before the battery
+            # could capture anything — keep watching
+        if args.once:
+            return 1
+        time.sleep(max(0, args.interval - PROBE_TIMEOUT_S))
+    log_probe({"outcome": "round_exhausted", "attempts": attempt})
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
