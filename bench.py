@@ -213,16 +213,23 @@ def main():
         # donate the state: the hot loop updates in place in HBM
         multi = jax.jit(lambda s: model.multistep(s, multistep), donate_argnums=0)
 
+    # Timings close with device_sync (a one-element host fetch), not
+    # block_until_ready: the axon tunnel's PJRT resolves ready-events
+    # before the computation finishes, which silently turns this whole
+    # benchmark into a dispatch-latency measurement (observed: 433
+    # steps "completing" in 0.3 ms).
+    from mpi4jax_tpu.utils.profiling import device_sync
+
     state = first(state)
     # compile warm-up (excluded from timing); the state is donated, so
     # keep the advanced result and time one call fewer
     state = multi(state)
-    state[0].block_until_ready()
+    device_sync(state)
 
     start = time.perf_counter()
     for _ in range(max(n_calls - 1, 1)):
         state = multi(state)
-    state[0].block_until_ready()
+    device_sync(state)
     elapsed = time.perf_counter() - start
     elapsed = elapsed * n_calls / max(n_calls - 1, 1)  # normalize to full span
 

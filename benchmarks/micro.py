@@ -26,14 +26,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def timeit(fn, *args, warmup=2, iters=20):
-    import jax
+    # device_sync, not block_until_ready: the axon tunnel's PJRT
+    # resolves ready-events early, so only a host fetch truly waits
+    # (see mpi4jax_tpu.utils.profiling.device_sync).
+    from mpi4jax_tpu.utils.profiling import device_sync
 
     for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
+        device_sync(fn(*args))
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
+    device_sync(out)
     return (time.perf_counter() - t0) / iters
 
 
