@@ -40,9 +40,13 @@ import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
-# MPI-parity sentinel constants (reference exposes mpi4py's:
-# MPI.PROC_NULL == -2 in mpi4py; we use -1 for table ergonomics and
-# document it — any negative entry means "no partner").
+# MPI-parity sentinel constants. PROC_NULL is -1 here; mpi4py's own
+# numeric sentinels vary by MPI implementation (MPI.PROC_NULL is -2 on
+# OpenMPI builds, MPI.ANY_SOURCE is -2 on MPICH builds), so negative
+# partner entries other than -1 are *rejected* with a ValueError
+# (ops/p2p.py _reject_foreign_sentinel) rather than silently
+# normalized — a ported script passing a foreign sentinel must fail
+# loudly, not quietly no-op.
 PROC_NULL = -1
 ANY_TAG = -1
 
@@ -51,8 +55,10 @@ class _AnySource:
     """Wildcard-source sentinel (``MPI.ANY_SOURCE`` analog).
 
     A distinct singleton rather than a negative int so it can never be
-    confused with a PROC_NULL table entry (any negative *partner* means
-    "no partner"). Only meaningful for ``recv``/``sendrecv`` on the
+    confused with a PROC_NULL table entry (and so mpi4py's
+    implementation-dependent numeric wildcard can never be passed
+    through by accident — negative partners other than -1 are
+    rejected). Only meaningful for ``recv``/``sendrecv`` on the
     multi-controller shm backend — static HLO collectives cannot
     express wildcards (SURVEY.md §7 hard-parts; reference
     ``recv.py:49-54``)."""
@@ -84,6 +90,15 @@ class Status:
     int64[3] buffer owned by this object.
     """
 
+    #: buffers whose raw address was baked into a jitted executable as
+    #: a static attr, pinned for the process lifetime: a cached
+    #: executable may be re-run after the Status object is
+    #: garbage-collected, and the native handler would then write 24
+    #: bytes into freed memory. One entry per distinct Status ever
+    #: traced — bounded in practice, and the reference has the same
+    #: lifetime hazard with _addressof(status) (recv.py:100-103).
+    _live_buffers: dict = {}
+
     def __init__(self):
         self._buf = np.zeros(3, np.int64)
         #: global ranks of the communicator the last call ran on (set
@@ -94,7 +109,17 @@ class Status:
 
     @property
     def _addr(self) -> int:
-        return self._buf.ctypes.data
+        addr = self._buf.ctypes.data
+        from .token import _no_active_trace
+
+        # Pin only when the address is being baked into a traced
+        # program (the jit cache can outlive the Status). Eager calls
+        # write through the pointer during the call itself, while the
+        # caller still holds the object — pinning there would turn the
+        # idiomatic fresh-Status-per-recv loop into an unbounded leak.
+        if not _no_active_trace():
+            Status._live_buffers[addr] = self._buf
+        return addr
 
     @property
     def source(self) -> int:

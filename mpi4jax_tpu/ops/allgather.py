@@ -36,12 +36,10 @@ def _allgather_spmd(x, *, comm: BoundComm):
     from .pallas_ring_parts import ring_allgather, use_ring_parts
 
     if use_ring_parts(x, comm, footprint_factor=comm.size):
-        import jax
+        from .ring_guard import routed_ring
 
-        return ring_allgather(
-            x, comm.axes[0], comm.size,
-            interpret=jax.default_backend() != "tpu",
-        )
+        # interpret mode chosen per lowering platform (ring_guard)
+        return routed_ring(ring_allgather, x, comm.axes[0], comm.size)
     axes, kw = comm.collective_kwargs()
     return lax.all_gather(x, axes, tiled=False, **kw)
 

@@ -97,16 +97,13 @@ def _allreduce_spmd(x, *, op, comm: BoundComm, transpose):
         # World size 1: reduction over a single rank is the identity.
         return x
     if _use_pallas_ring(x, op, comm):
-        import jax
-
         from .pallas_ring import ring_allreduce
+        from .ring_guard import routed_ring
 
-        return ring_allreduce(
-            x,
-            comm.axes[0],
-            comm.size,
-            interpret=jax.default_backend() != "tpu",
-        )
+        # interpret mode is chosen per lowering platform (ring_guard):
+        # TPU lowerings get the compiled RDMA ring, everything else
+        # (tests, CPU meshes) the interpret kernel.
+        return routed_ring(ring_allreduce, x, comm.axes[0], comm.size)
     if op.native is not None:
         return _native_reduce(x, op, comm)
     return _generic_reduce(x, op, comm)

@@ -179,6 +179,8 @@ def _normalize_table(value: TableLike, size: int, what: str) -> Tuple[int, ...]:
     for r, v in enumerate(table):
         if v >= size:
             raise ValueError(f"{what}[{r}] = {v} out of range for size {size}")
+        if v < -1:
+            _reject_foreign_sentinel(v, f"{what}[{r}]")
     return table
 
 
@@ -263,6 +265,53 @@ def _status_checked(status, bound: BoundComm, opname: str) -> int:
     return status._addr
 
 
+def _reject_foreign_sentinel(partner: int, what: str) -> None:
+    """Negative partners other than our own PROC_NULL (-1) are
+    rejected, not normalized: mpi4py's numeric sentinels differ by MPI
+    implementation (``MPI.ANY_SOURCE`` is -2 on MPICH builds, where it
+    would silently read as a no-op recv; ``MPI.PROC_NULL`` is -2 on
+    OpenMPI builds) — a ported script passing one through must fail
+    loudly instead of quietly corrupting data."""
+    raise ValueError(
+        f"{what} {partner}: negative partners other than PROC_NULL (-1) "
+        "are not accepted — mpi4py's numeric sentinels vary by MPI "
+        "implementation and would be silently misread. Use "
+        "mpi4jax_tpu.PROC_NULL for 'no partner' or mpi4jax_tpu.ANY_SOURCE "
+        "for a wildcard receive."
+    )
+
+
+def check_user_tag(tag: int, what: str = "tag", *, allow_any: bool = False) -> int:
+    """Validate a user-supplied message tag.
+
+    Tags at or above ``shm_group._TAG_BASE`` (1 << 20) are reserved for
+    group-collective internals — the native wildcard matcher skips that
+    namespace (``shmcc.cpp`` kTagBase), so a user message carrying such
+    a tag would be unreceivable via ANY_TAG. ``ANY_TAG`` itself is only
+    meaningful on the receive side."""
+    from ..runtime.shm_group import _TAG_BASE
+
+    tag = int(tag)
+    if tag == ANY_TAG:
+        if allow_any:
+            return tag
+        raise ValueError(
+            f"{what} must be a concrete tag; ANY_TAG is only valid on "
+            "the receive side"
+        )
+    if tag < 0:
+        raise ValueError(
+            f"{what} {tag}: negative tags other than ANY_TAG (-1) are "
+            "not accepted (MPI parity: tags are non-negative)"
+        )
+    if tag >= _TAG_BASE:
+        raise ValueError(
+            f"{what} {tag} is in the reserved group-collective tag "
+            f"namespace; user tags must be < {_TAG_BASE} (1 << 20)"
+        )
+    return tag
+
+
 def _shm_partner(value: TableLike, bound: BoundComm, what: str) -> int:
     if bound.shm_group is not None:
         # Split sub-communicator: the table is group-rank indexed and
@@ -281,12 +330,10 @@ def _shm_partner(value: TableLike, bound: BoundComm, what: str) -> int:
         partner = table[bound.shm_rank]
     if partner >= bound.size:
         raise ValueError(f"{what} {partner} out of range for size {bound.size}")
-    if partner < 0:
-        # Any negative partner means "no partner" (documented contract,
-        # comm.py PROC_NULL note; mpi4py's own MPI.PROC_NULL is -2) —
-        # normalize so downstream `== PROC_NULL` checks match and a
-        # ported script passing -2 doesn't abort the shm world.
+    if partner == PROC_NULL:
         return PROC_NULL
+    if partner < 0:
+        _reject_foreign_sentinel(partner, what)
     return partner
 
 
@@ -325,6 +372,8 @@ def sendrecv(
     """
     raise_if_token_is_set(token)
     bound = resolve_comm(comm)
+    sendtag = check_user_tag(sendtag, "sendtag")
+    recvtag = check_user_tag(recvtag, "recvtag", allow_any=True)
     status_ptr = _status_checked(status, bound, "sendrecv")
     if bound.backend == "shm":
         sendbuf = jnp.asarray(sendbuf)
@@ -408,6 +457,7 @@ def send(x, dest: TableLike, *, tag: int = 0, comm=None, token=NOTSET):
     the matching :func:`recv` appears later in the same trace."""
     raise_if_token_is_set(token)
     bound = resolve_comm(comm)
+    tag = check_user_tag(tag, "tag")
     x = jnp.asarray(x)
     if bound.backend == "shm":
         dst = _shm_partner(dest, bound, "dest")
@@ -457,6 +507,7 @@ def recv(
     traced program (see module docstring)."""
     raise_if_token_is_set(token)
     bound = resolve_comm(comm)
+    tag = check_user_tag(tag, "tag", allow_any=True)
     status_ptr = _status_checked(status, bound, "recv")
     x = jnp.asarray(x)
     if bound.backend == "shm":

@@ -43,10 +43,19 @@ Opt-in via ``MPI4JAX_TPU_PALLAS_RING=1`` (routes SUM-allreduce of
 float32/bfloat16 payloads >= 1 MiB on a communicator spanning a 1-D
 mesh through this kernel — see ``_use_pallas_ring`` in
 ``ops/allreduce.py``) or call :func:`ring_allreduce` directly.
-Correctness is validated in Pallas interpret mode on the virtual CPU
-mesh (``tests/test_pallas_ring.py``, incl. a 64 MiB streamed payload);
-the compiled path targets real multi-chip ICI and is compile-checked
-for the TPU target via cross-platform export (same test file).
+
+**Validation status.** Correctness is validated in Pallas interpret
+mode on the virtual CPU mesh (``tests/test_pallas_ring.py``, incl. a
+64 MiB streamed payload) and the compiled Mosaic lowering is
+compile-checked for the TPU target via cross-platform export (same
+test file) — but the flow-control protocol below has **not yet
+executed on real multi-chip ICI** (no multi-chip hardware has been
+reachable; single-chip rings are identity). Two rails keep a latent
+protocol bug from wedging user programs (``ring_guard.py``): interpret
+vs compiled is decided per *lowering platform* (``routed_ring``), and
+the first TPU-routed call runs a tiny compiled ring in a
+watchdog-guarded subprocess, permanently falling back to HLO
+AllReduce with a warning if it fails or times out.
 
 The collective id is derived from (kernel kind, axis name, payload
 shape): kernel kinds occupy disjoint mod-3 residue classes, so the
@@ -139,9 +148,21 @@ def ring_gate(x, comm, *, min_bytes: int, max_bytes: int,
     ):
         return False
     try:
-        return lax.axis_size(comm.axes[0]) == jax.device_count()
+        if lax.axis_size(comm.axes[0]) != jax.device_count():
+            return False
     except Exception:
         return False
+    if jax.default_backend() == "tpu":
+        # Compiled-mode safety net: the flow-control protocol is
+        # hardware-validated once per process by a watchdog-guarded
+        # probe; on failure routing degrades to HLO AllReduce with a
+        # warning instead of risking a wedge inside a collective
+        # (ring_guard.py). Opt out: MPI4JAX_TPU_RING_NOPROBE=1.
+        from .ring_guard import compiled_ring_healthy
+
+        if not compiled_ring_healthy():
+            return False
+    return True
 
 
 def _ring_kernel(

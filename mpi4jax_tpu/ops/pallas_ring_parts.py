@@ -16,7 +16,11 @@ direct-call feature exactly like the all-reduce ring.
 
 Correctness: interpret-mode tests against psum_scatter/all_gather
 oracles; the TPU lowering is compile-checked via cross-platform export
-(``tests/test_pallas_ring.py``).
+(``tests/test_pallas_ring.py``). Like the all-reduce ring, the
+flow-control protocol has not yet executed on real multi-chip ICI;
+the ``ring_guard`` rails (platform-derived interpret routing + the
+watchdog-guarded first-use probe with HLO fallback) apply to these
+kernels through the same ``ring_gate`` routing.
 """
 
 from __future__ import annotations

@@ -52,12 +52,10 @@ def _reduce_scatter_spmd(x, *, op: Op, comm: BoundComm):
     from .pallas_ring_parts import ring_reduce_scatter, use_ring_parts
 
     if use_ring_parts(x, comm, sum_only_op=op):
-        import jax
+        from .ring_guard import routed_ring
 
-        return ring_reduce_scatter(
-            x, comm.axes[0], comm.size,
-            interpret=jax.default_backend() != "tpu",
-        )
+        # interpret mode chosen per lowering platform (ring_guard)
+        return routed_ring(ring_reduce_scatter, x, comm.axes[0], comm.size)
     if op is SUM and jnp.issubdtype(x.dtype, jnp.number):
         return lax.psum_scatter(x, axis, scatter_dimension=0, tiled=False, **kw)
     from .allreduce import _allreduce_spmd

@@ -10,6 +10,8 @@ positions and per-rank code runs inside ``shard_map``.
 from .mesh import (  # noqa: F401
     WORLD_AXIS,
     initialize,
+    is_multi_controller,
+    local_blocks,
     spmd,
     world_mesh,
 )

@@ -33,12 +33,47 @@ from ..comm import WORLD_AXIS
 
 
 def initialize(*args, **kwargs) -> None:
-    """Multi-host entry point: thin wrapper over
-    ``jax.distributed.initialize``. After it returns,
+    """Multi-host entry point: ``jax.distributed.initialize`` plus the
+    backend plumbing a multi-controller world needs. After it returns,
     ``jax.devices()`` spans all hosts and :func:`world_mesh` builds the
     global mesh — same program, more chips (DCN between slices is
-    handled by XLA's collectives, SURVEY.md §2.5 backend row)."""
+    handled by XLA's collectives, SURVEY.md §2.5 backend row).
+
+    On the CPU platform, cross-process collectives need a transport;
+    select gloo before the backend initializes (the reference gets this
+    from libmpi itself — here it is jaxlib's CPU collectives). This is
+    the path the reference covers with ``mpirun -np N`` on CPU
+    (``docs/developers.rst:18-27``): one process per rank, each tracing
+    and compiling its own copy of the program.
+    """
+    # Select gloo unconditionally: probing the platform here would
+    # initialize the backend (illegal before jax.distributed), the
+    # config only affects the CPU client, and the jaxlib default
+    # ("none") leaves cross-process CPU collectives unsupported.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # older jaxlib: single transport, nothing to select
     jax.distributed.initialize(*args, **kwargs)
+
+
+def is_multi_controller(mesh: Optional[Mesh] = None) -> bool:
+    """True when this process addresses only part of the mesh (one
+    controller per host, ``jax.distributed`` initialized)."""
+    devices = mesh.devices.flat if mesh is not None else jax.devices()
+    me = jax.process_index()
+    return any(d.process_index != me for d in devices)
+
+
+def local_blocks(global_array) -> np.ndarray:
+    """This process's blocks of an :func:`spmd` output (multi-controller
+    worlds): the addressable shards stacked along the leading axis in
+    device order. In a single-controller world this is simply the whole
+    array."""
+    shards = sorted(
+        global_array.addressable_shards, key=lambda s: s.index[0].start or 0
+    )
+    return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
 
 
 def world_mesh(n: Optional[int] = None, axis: str = WORLD_AXIS) -> Mesh:
@@ -72,6 +107,17 @@ def spmd(
     its slab" in the reference examples); outputs are stacked the same
     way. Inside ``fn``, communication ops resolve the world
     communicator against ``axis``.
+
+    **Multi-controller worlds** (``jax.distributed`` initialized, mesh
+    spanning devices of several processes): each process instead passes
+    its *local* blocks — leading axis = its addressable device count —
+    and receives global ``jax.Array`` outputs whose local blocks are
+    read back with :func:`local_blocks`. This is the reference's
+    one-process-per-rank execution model (``mpirun -np N``): every
+    process traces and compiles the same program; XLA's deterministic
+    channel-id assignment keeps the independently compiled collectives
+    matched (the trace-time ordering discipline is identical on every
+    process by construction).
     """
     if fn is None:
         return partial(spmd, mesh=mesh, axis=axis, donate_argnums=donate_argnums)
@@ -104,6 +150,29 @@ def spmd(
     def run(*args):
         m = mesh if mesh is not None else world_mesh(axis=axis)
         n = math.prod(m.devices.shape)
+        if is_multi_controller(m):
+            from jax.sharding import NamedSharding
+
+            sharding = NamedSharding(m, P(m.axis_names[0]))
+            n_local = sum(
+                1
+                for d in m.devices.flat
+                if d.process_index == jax.process_index()
+            )
+
+            def globalize(a):
+                a = np.asarray(a)
+                if a.shape[:1] != (n_local,):
+                    raise ValueError(
+                        f"spmd arguments in a multi-controller world need "
+                        f"leading axis {n_local} (one block per local "
+                        f"device), got shape {a.shape}"
+                    )
+                return jax.make_array_from_process_local_data(
+                    sharding, a, global_shape=(n,) + a.shape[1:]
+                )
+
+            return _get_compiled(m)(*jax.tree.map(globalize, args))
         for a in jax.tree.leaves(args):
             if a.shape[:1] != (n,):
                 raise ValueError(

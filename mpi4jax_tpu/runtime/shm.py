@@ -111,6 +111,19 @@ def init_from_env() -> bool:
     _active = True
     ext.set_debug(config.DEBUG_LOGGING)
 
+    # The reserved group-collective tag namespace is shared between the
+    # native wildcard-matching exclusions (shmcc.cpp kTagBase) and the
+    # Python layer (shm_group._TAG_BASE, ops/p2p.py check_user_tag); a
+    # drift would silently reopen the group-message-theft race.
+    from .shm_group import _TAG_BASE
+
+    native_base = ext.abi_info().get("tag_base")
+    if native_base != _TAG_BASE:
+        raise RuntimeError(
+            f"native kTagBase ({native_base}) != shm_group._TAG_BASE "
+            f"({_TAG_BASE}); rebuild the extension"
+        )
+
     for name_, cap in ext.targets().items():
         jax.ffi.register_ffi_target(name_, cap, platform="cpu")
 

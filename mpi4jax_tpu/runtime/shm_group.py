@@ -24,7 +24,10 @@ import jax.numpy as jnp
 from . import shm as _shm
 from ..token import ordered_call
 
-#: reserved tag namespace for group-collective internals
+#: reserved tag namespace for group-collective internals; must equal
+#: the native layer's kTagBase (asserted against abi_info() on world
+#: join, runtime/shm.py) — user-facing wrappers reject tags >= this
+#: (ops/p2p.py check_user_tag) so wildcard matching can exclude it
 _TAG_BASE = 1 << 20
 _T_GATHER = _TAG_BASE + 1
 _T_DIST = _TAG_BASE + 2
@@ -221,8 +224,12 @@ def to_global_partner(value, group: Tuple[int, ...], what: str) -> int:
                 f"{len(group)} (the communicator size)"
             )
         partner = table[gr]
+    if partner == -1:
+        return -1  # PROC_NULL
     if partner < 0:
-        return -1  # PROC_NULL (any negative means "no partner")
+        from ..ops.p2p import _reject_foreign_sentinel
+
+        _reject_foreign_sentinel(partner, what)
     if partner >= len(group):
         raise ValueError(
             f"{what} {partner} out of range for size {len(group)}"

@@ -52,6 +52,14 @@ constexpr size_t kCollChunk = size_t{1} << 22;  // 4 MiB per-rank slot
 constexpr size_t kP2PChunk = size_t{1} << 18;   // 256 KiB channel entry
 constexpr int64_t kAnyTag = -1;
 constexpr int64_t kAnySource = -2;  // MPI_ANY_SOURCE analog (recv wildcard)
+// Tags >= kTagBase are reserved for group-collective internals
+// (shm_group.py derives its _TAG_BASE from abi_info()["tag_base"]).
+// Wildcard-tag matching must never claim a reserved-tag message: a
+// Split-comm collective's sender publishes its first chunk before the
+// group receiver arrives, and a concurrent world recv(ANY_SOURCE,
+// ANY_TAG) scanning channels could otherwise steal it — wrong data or
+// a fatal size/tag mismatch aborting the whole world.
+constexpr int64_t kTagBase = INT64_C(1) << 20;
 // Default 2 min -> abort; override with M4T_SHM_SPIN_TIMEOUT_US (read
 // once at world init) — tests use a short timeout to exercise the
 // stalled-peer abort path without waiting out the production value.
@@ -357,6 +365,15 @@ struct RecvCursor {
       if (tag != kAnyTag && ch->tag != tag)
         fatal("recv tag mismatch (shm channels deliver in order; "
               "out-of-order tag matching is not supported)");
+      if (tag == kAnyTag && ch->tag >= kTagBase)
+        // Channels deliver in order, so a reserved message at the head
+        // cannot be skipped: the user recv(ANY_TAG) raced a group
+        // collective on this channel. Delivering it would hand group-
+        // internal bytes to user code — fail loudly instead.
+        fatal("recv(ANY_TAG) matched a reserved group-collective "
+              "message (a Split-comm collective is in flight on this "
+              "channel); order user p2p after the group collective or "
+              "use an explicit tag");
       if (ch->msg_bytes != nbytes) fatal("recv size mismatch");
       seen_tag = ch->tag;
       first = false;
@@ -396,7 +413,11 @@ static int p2p_wait_any_source(int64_t tag) {
           Channel* ch = &g.sh->channels[s][g.rank];
           if (ch->head.load(std::memory_order_acquire) !=
               ch->tail.load(std::memory_order_relaxed)) {
-            if (tag != kAnyTag && ch->tag != tag) continue;
+            if (tag == kAnyTag) {
+              if (ch->tag >= kTagBase) continue;  // reserved group tag
+            } else if (ch->tag != tag) {
+              continue;
+            }
             found = s;
             return true;
           }
@@ -674,7 +695,11 @@ static ffi::Error SendrecvImpl(int64_t source, int64_t dest, int64_t sendtag,
         Channel* ch = &g.sh->channels[c][g.rank];
         if (ch->head.load(std::memory_order_acquire) !=
             ch->tail.load(std::memory_order_relaxed)) {
-          if (recvtag != kAnyTag && ch->tag != recvtag) continue;
+          if (recvtag == kAnyTag) {
+            if (ch->tag >= kTagBase) continue;  // reserved group tag
+          } else if (ch->tag != recvtag) {
+            continue;
+          }
           found = c;
         }
       }
@@ -896,10 +921,11 @@ static PyObject* py_abi_info(PyObject*, PyObject*) {
   // (mpi_ops_common.h:398-425): enough for tests to sanity-check the
   // native layout assumptions.
   return Py_BuildValue(
-      "{s:i,s:n,s:n,s:n}", "max_ranks", shmcc::kMaxRanks, "coll_chunk_bytes",
-      (Py_ssize_t)shmcc::kCollChunk, "p2p_chunk_bytes",
+      "{s:i,s:n,s:n,s:n,s:L}", "max_ranks", shmcc::kMaxRanks,
+      "coll_chunk_bytes", (Py_ssize_t)shmcc::kCollChunk, "p2p_chunk_bytes",
       (Py_ssize_t)shmcc::kP2PChunk, "shared_bytes",
-      (Py_ssize_t)sizeof(shmcc::Shared));
+      (Py_ssize_t)sizeof(shmcc::Shared), "tag_base",
+      (long long)shmcc::kTagBase);
 }
 
 static PyObject* capsule(XLA_FFI_Handler* h) {

@@ -61,9 +61,21 @@ class _TraceState:
 
 _states: Deque[_TraceState] = collections.deque(maxlen=_MAX_TRACE_STATES)
 
+#: (trace key, error text) pairs for states evicted while holding
+#: unmatched sends; the *offending* trace's next op raises the error
+#: (see _current_state) so the failure lands on the buggy program, not
+#: on whatever bystander computation happened to allocate the state
+#: that triggered the eviction. A list because OpaqueTraceState is
+#: unhashable (compared by ==, like _states).
+_poisoned: Deque[Tuple[Any, str]] = collections.deque(maxlen=_MAX_TRACE_STATES)
+
 
 def _current_state() -> _TraceState:
     key = jax.core.get_opaque_trace_state()
+    for i, (pkey, msg) in enumerate(_poisoned):
+        if pkey == key:
+            del _poisoned[i]
+            raise RuntimeError(msg)
     for st in _states:
         if st.key == key:
             return st
@@ -73,21 +85,29 @@ def _current_state() -> _TraceState:
     st = _TraceState(key)
     _states.append(st)
     if evicted is not None and evicted.pending_sends:
-        # Evicting a state with unmatched sends means a transfer would
-        # be silently dropped — that program is wrong whether or not
-        # its trace is still live, so fail loudly (a warning could
-        # scroll past unnoticed while results were quietly corrupt).
-        # The stale state is already evicted and the new one
-        # registered, so this raises exactly once; later traces are
-        # unaffected.
+        # Evicting a state with unmatched sends: raising *here* would
+        # fail whatever unrelated computation allocated the 65th state,
+        # far from the buggy code — so warn loudly (identifying the
+        # offender) and arrange for the offending trace itself to raise
+        # if it ever issues another op. If it never does, the warning
+        # is the only signal, which is sound: an unmatched send emits
+        # no collective at all, and the only party that could observe
+        # missing data — the matching recv — fails hard on its own
+        # ("no matching send", ops/p2p.py) whichever trace it is in;
+        # parallel.spmd additionally hard-errors at trace end
+        # (check_no_pending_sends).
+        import warnings
+
         tags = [rec["tag"] for rec in evicted.pending_sends]
-        raise RuntimeError(
+        msg = (
             f"mpi4jax_tpu: {len(evicted.pending_sends)} send(s) (tags "
             f"{tags}) were never matched by a recv in their traced "
-            "program and their trace state was evicted. On the TPU "
-            "backend a send must be paired with a recv inside the same "
-            "jit/shard_map trace."
+            f"program (trace state {evicted.key!r}) and their trace "
+            "state was evicted. On the TPU backend a send must be "
+            "paired with a recv inside the same jit/shard_map trace."
         )
+        warnings.warn(msg, RuntimeWarning, stacklevel=3)
+        _poisoned.append((evicted.key, msg))
     return st
 
 

@@ -191,3 +191,37 @@ def test_sendrecv_size1_self():
     x = jnp.arange(3.0)
     out = m4t.sendrecv(x, jnp.zeros_like(x), (0,), (0,))
     np.testing.assert_allclose(out, x)
+
+
+def test_user_tag_validation():
+    # Tags >= 1<<20 are reserved for group-collective internals and
+    # rejected at the wrapper (ops/p2p.py check_user_tag); ANY_TAG is
+    # receive-side only; other negatives are invalid (MPI parity).
+    import jax.numpy as jnp
+    import pytest
+
+    import mpi4jax_tpu as m4t
+
+    x = jnp.ones(3)
+    with pytest.raises(ValueError, match="reserved"):
+        m4t.send(x, dest=0, tag=1 << 20)
+    with pytest.raises(ValueError, match="receive side"):
+        m4t.sendrecv(x, x, source=0, dest=0, sendtag=m4t.ANY_TAG)
+    with pytest.raises(ValueError, match="negative tags"):
+        m4t.recv(x, source=0, tag=-7)
+
+
+def test_foreign_negative_sentinel_rejected_in_tables():
+    # mpi4py's numeric sentinels vary by MPI build (-2 is ANY_SOURCE on
+    # MPICH, PROC_NULL on OpenMPI); table entries below -1 must fail
+    # loudly instead of silently acting as PROC_NULL.
+    import jax.numpy as jnp
+    import pytest
+
+    import mpi4jax_tpu as m4t
+    from mpi4jax_tpu import get_default_comm
+
+    x = jnp.ones(3)
+    n = get_default_comm().Get_size()
+    with pytest.raises(ValueError, match="PROC_NULL"):
+        m4t.send(x, dest=(-2,) * n)

@@ -1,0 +1,188 @@
+"""Multi-process ``jax.distributed`` leg: the XLA-collective path in
+the reference's own execution regime — one process per rank, each
+tracing and compiling its program independently.
+
+This is the regime where channel-id assignment across separately
+compiled programs can actually fail (SURVEY.md §7 hard part: HLO
+collectives are matched by channel id across programs; a mismatch
+deadlocks) — the single-process 8-device mesh used by the rest of the
+suite can never exhibit it. The reference covers it by running its
+suite under ``mpirun -np 2`` (``docs/developers.rst:18-27``,
+``.github/workflows/mpi-tests.yml``); here each test spawns real
+processes that rendezvous through a local coordinator and run
+collectives over jaxlib's gloo CPU transport.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PRELUDE = """
+import os, sys
+sys.path.insert(0, {repo!r})
+rank = int(sys.argv[1])
+nprocs = int(sys.argv[2])
+port = sys.argv[3]
+os.environ.pop("XLA_FLAGS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+from mpi4jax_tpu.parallel import initialize
+initialize(f"localhost:{{port}}", num_processes=nprocs, process_id=rank)
+import numpy as np
+import jax.numpy as jnp
+import mpi4jax_tpu as m4t
+from mpi4jax_tpu.parallel import local_blocks, spmd, world_mesh
+assert len(jax.devices()) == nprocs, jax.devices()
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_world(n, script, timeout=300):
+    """Spawn ``n`` processes running ``_PRELUDE + script``; returns the
+    per-rank CompletedProcess list."""
+    path = os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), f"m4t_dist_{os.getpid()}.py"
+    )
+    with open(path, "w") as f:
+        f.write(_PRELUDE.format(repo=REPO))
+        f.write(textwrap.dedent(script))
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, path, str(r), str(n), str(port)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=REPO,
+        )
+        for r in range(n)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    return outs
+
+
+def _assert_ok(outs, marker):
+    for r, (rc, out) in enumerate(outs):
+        assert rc == 0, f"rank {r} exited {rc}:\n{out}"
+        assert f"{marker}{r}" in out, f"rank {r} missing {marker}:\n{out}"
+
+
+
+def test_distributed_collective_pipeline():
+    # allreduce + alltoall + sendrecv + bcast in one jitted program,
+    # compiled independently by each process: any channel-id divergence
+    # between the two compilations deadlocks the world (caught by the
+    # subprocess timeout).
+    outs = run_world(
+        2,
+        """
+        n = nprocs
+        mesh = world_mesh()
+        ring_dst = tuple((r + 1) % n for r in range(n))
+        ring_src = tuple((r - 1) % n for r in range(n))
+
+        def pipeline(x, blocks):
+            s = m4t.allreduce(x, op=m4t.SUM)
+            t = m4t.alltoall(blocks)
+            u = m4t.sendrecv(s, s, ring_src, ring_dst)
+            v = m4t.bcast(u, 0)
+            return s, t, u, v
+
+        f = spmd(pipeline, mesh=mesh)
+        x_local = jnp.full((1, 3), float(rank + 1))
+        blocks_local = jnp.arange(n, dtype=jnp.float32).reshape(1, n) + 10 * rank
+        s, t, u, v = f(x_local, blocks_local)
+        s_l, t_l, u_l, v_l = (local_blocks(a) for a in (s, t, u, v))
+        np.testing.assert_allclose(s_l, 3.0)          # 1 + 2
+        # alltoall: rank r's block j is rank j's input block r
+        expect_t = np.array([[10 * j + rank for j in range(n)]], np.float32)
+        np.testing.assert_allclose(t_l, expect_t)
+        np.testing.assert_allclose(u_l, 3.0)          # ring of equal values
+        np.testing.assert_allclose(v_l, 3.0)          # bcast of the same
+        print(f"PIPE_OK{rank}")
+        """,
+    )
+    _assert_ok(outs, "PIPE_OK")
+
+
+
+def test_distributed_grad_through_allreduce():
+    # The data-parallel gradient identity (reference
+    # test_allreduce.py:141-193) across real processes: grad of
+    # sum(allreduce(x)) is 1 per element on every rank.
+    outs = run_world(
+        2,
+        """
+        mesh = world_mesh()
+
+        def loss(x):
+            return m4t.allreduce(x, op=m4t.SUM).sum()
+
+        g = spmd(lambda x: jax.grad(loss)(x), mesh=mesh)
+        val = spmd(loss, mesh=mesh)
+        x_local = jnp.full((1, 4), float(rank + 1))
+        gl = local_blocks(g(x_local))
+        np.testing.assert_allclose(gl, 1.0)
+        vl = local_blocks(val(x_local))
+        np.testing.assert_allclose(vl, 4 * 3.0)
+        print(f"GRAD_OK{rank}")
+        """,
+    )
+    _assert_ok(outs, "GRAD_OK")
+
+
+
+def test_distributed_ordering_deep_chain():
+    # Ten dependent collectives in program order, twice (two separate
+    # jit programs): exercises the value-token ordering chain and
+    # channel-id determinism across a *sequence* of compilations.
+    outs = run_world(
+        2,
+        """
+        n = nprocs
+        mesh = world_mesh()
+        ring_dst = tuple((r + 1) % n for r in range(n))
+        ring_src = tuple((r - 1) % n for r in range(n))
+
+        def chain(x):
+            for _ in range(5):
+                x = m4t.allreduce(x, op=m4t.SUM) / n
+                x = m4t.sendrecv(x, x, ring_src, ring_dst)
+            return x
+
+        f = spmd(chain, mesh=mesh)
+        x_local = jnp.full((1, 2), float(rank))
+        out1 = local_blocks(f(x_local))
+        out2 = local_blocks(f(x_local + 1))
+        # mean preserved by allreduce/n; ring of equal values is identity
+        np.testing.assert_allclose(out1, 0.5)
+        np.testing.assert_allclose(out2, 1.5)
+        print(f"CHAIN_OK{rank}")
+        """,
+    )
+    _assert_ok(outs, "CHAIN_OK")

@@ -157,10 +157,15 @@ def test_debug_log_format():
 @needs_native
 def test_abi_info():
     from mpi4jax_tpu.runtime import shm
+    from mpi4jax_tpu.runtime.shm_group import _TAG_BASE
 
     info = shm.abi_info()
     assert info["max_ranks"] >= 2
     assert info["coll_chunk_bytes"] >= 1 << 20
+    # the reserved group-collective tag namespace must agree between
+    # the native wildcard exclusions (kTagBase) and the Python layer,
+    # or wildcard matching stops protecting group traffic
+    assert info["tag_base"] == _TAG_BASE
 
 
 @needs_native
@@ -513,3 +518,115 @@ def test_launcher_rejects_oversized_world():
     )
     assert res.returncode != 0
     assert "16" in res.stderr
+
+
+@needs_native
+def test_wildcard_skips_reserved_group_tags():
+    # A recv(ANY_SOURCE, ANY_TAG) concurrent with a Split-comm group
+    # collective must not claim the group's reserved-tag chunks
+    # (shmcc.cpp kTagBase exclusion): rank 1 publishes its group gather
+    # chunk to leader rank 0 well before rank 2's user message arrives,
+    # so without the exclusion rank 0's wildcard recv would steal it
+    # (wrong data or a fatal size/tag mismatch aborting the world).
+    res = launch(
+        4,
+        """
+        import time
+        import numpy as np, jax.numpy as jnp
+        import mpi4jax_tpu as m4t
+        from mpi4jax_tpu.runtime import shm
+        r = shm.rank()
+        sub = m4t.Comm().Split([0, 0, 1, 1])  # groups {0,1} and {2,3}
+        if r == 0:
+            # group-A leader: rank 1's reserved-tag gather chunk lands
+            # on channel[1][0] well before rank 2's user message; the
+            # wildcard recv must wait for the user message regardless
+            st = m4t.Status()
+            got = m4t.recv(jnp.zeros(5), m4t.ANY_SOURCE, status=st)
+            assert st.Get_source() == 2, st
+            assert st.Get_tag() == 9, st
+            assert np.allclose(got, 2.0), got
+            s = m4t.allreduce(jnp.float32(r), op=m4t.SUM, comm=sub)
+            assert float(s) == 1.0, float(s)
+        elif r == 1:
+            # publishes the reserved-tag gather chunk to rank 0
+            # immediately, long before rank 2's user send below
+            s = m4t.allreduce(jnp.float32(r), op=m4t.SUM, comm=sub)
+            assert float(s) == 1.0, float(s)
+        else:
+            if r == 2:
+                time.sleep(0.5)
+                m4t.send(jnp.full(5, 2.0), dest=0, tag=9)
+            s = m4t.allreduce(jnp.float32(r), op=m4t.SUM, comm=sub)
+            assert float(s) == 5.0, float(s)
+        m4t.barrier()
+        print(f"WILDCARD_GROUP_OK{r}")
+        """,
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(4):
+        assert f"WILDCARD_GROUP_OK{r}" in res.stdout
+
+
+@needs_native
+def test_reserved_and_foreign_sentinel_rejected_on_shm():
+    # User tags in the reserved namespace and foreign negative
+    # sentinels (mpi4py's implementation-dependent -2) must fail
+    # loudly instead of silently corrupting or no-opping.
+    res = launch(
+        2,
+        """
+        import jax.numpy as jnp
+        import mpi4jax_tpu as m4t
+        from mpi4jax_tpu.runtime import shm
+        r = shm.rank()
+        try:
+            m4t.send(jnp.ones(2), dest=1 - r, tag=1 << 20)
+            raise SystemExit("reserved tag accepted")
+        except ValueError as e:
+            assert "reserved" in str(e), e
+        try:
+            m4t.recv(jnp.ones(2), source=-2)
+            raise SystemExit("foreign sentinel accepted")
+        except ValueError as e:
+            assert "PROC_NULL" in str(e), e
+        m4t.barrier()
+        print(f"REJECT_OK{r}")
+        """,
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "REJECT_OK0" in res.stdout and "REJECT_OK1" in res.stdout
+
+
+@needs_native
+def test_eager_fast_path_preserves_submission_order():
+    # The eager fast path (token.py ordered_call: no ties outside a
+    # trace) rests on XLA executing eager dispatches in submission
+    # order per device. Two consecutive tagged sends against two
+    # tag-matched recvs pin it: shm channels deliver in order, so any
+    # reorder on either side is a loud tag-mismatch fatal, and a
+    # cross-pairing send/recv order is a deadlock caught by the spin
+    # timeout.
+    res = launch(
+        2,
+        """
+        import numpy as np, jax.numpy as jnp
+        import mpi4jax_tpu as m4t
+        from mpi4jax_tpu.runtime import shm
+        r = shm.rank()
+        if r == 0:
+            m4t.send(jnp.full(3, 1.0), dest=1, tag=11)
+            m4t.send(jnp.full(3, 2.0), dest=1, tag=22)
+            got = m4t.recv(jnp.zeros(3), source=1, tag=33)
+            assert np.allclose(got, 3.0)
+        else:
+            a = m4t.recv(jnp.zeros(3), source=0, tag=11)
+            b = m4t.recv(jnp.zeros(3), source=0, tag=22)
+            m4t.send(jnp.full(3, 3.0), dest=0, tag=33)
+            assert np.allclose(a, 1.0) and np.allclose(b, 2.0)
+        print(f"EAGER_ORDER_OK{r}")
+        """,
+        env_extra={"M4T_SHM_SPIN_TIMEOUT_US": "20000000"},
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "EAGER_ORDER_OK0" in res.stdout and "EAGER_ORDER_OK1" in res.stdout
