@@ -1,0 +1,119 @@
+"""Strong-scaling artifact: shallow-water on the published 100x domain
+at n = 1/2/4/8 ranks, two execution models:
+
+- ``mesh``: single process, n virtual CPU devices
+  (``--xla_force_host_platform_device_count``), domain decomposed over
+  a ``shard_map`` mesh — the TPU-native execution shape.
+- ``shm``: n real processes under ``python -m mpi4jax_tpu.launch``
+  with the native shared-memory backend — the reference's ``mpirun``
+  execution shape (its published CPU column: BASELINE.md rows 1-6,
+  111.95 s at 1 proc -> 15.73 s at 16).
+
+Honest caveat, recorded in the artifact: virtual-device / multiprocess
+scaling on one CPU is a *plumbing and correctness* signal (the XLA CPU
+device already uses every core via intra-op threading at n=1), not an
+ICI performance claim. Numbers land in
+``benchmarks/results_r03_scaling.json``.
+
+    python benchmarks/scaling.py [--ranks 1 2 4 8] [--scale 10]
+"""
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLE = os.path.join(REPO, "examples", "shallow_water.py")
+
+REFERENCE_CPU_S = {1: 111.95, 2: 89.67, 4: 38.57, 6: 28.70, 8: 20.62, 16: 15.73}
+
+
+def _parse(stderr: str):
+    m = re.search(r"Solution took ([0-9.]+)s", stderr)
+    s = re.search(r"steps/s: ([0-9.]+)", stderr)
+    return (float(m.group(1)) if m else None, float(s.group(1)) if s else None)
+
+
+def run_mesh(n, scale, days, multistep, timeout):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n}"
+    res = subprocess.run(
+        [
+            sys.executable, EXAMPLE, "--benchmark", "--platform", "cpu",
+            "--nproc", str(n), "--scale", str(scale), "--days", str(days),
+            "--multistep", str(multistep),
+        ],
+        env=env, capture_output=True, text=True, timeout=timeout, cwd=REPO,
+    )
+    if res.returncode != 0:
+        return {"error": res.stderr[-500:]}
+    secs, sps = _parse(res.stderr)
+    return {"seconds": secs, "steps_per_s": sps}
+
+
+def run_shm(n, scale, days, multistep, timeout):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run(
+        [
+            sys.executable, "-m", "mpi4jax_tpu.launch", "-n", str(n), EXAMPLE,
+            "--benchmark", "--scale", str(scale), "--days", str(days),
+            "--multistep", str(multistep),
+        ],
+        env=env, capture_output=True, text=True, timeout=timeout, cwd=REPO,
+    )
+    if res.returncode != 0:
+        return {"error": (res.stderr or res.stdout)[-500:]}
+    secs, sps = _parse(res.stderr)
+    return {"seconds": secs, "steps_per_s": sps}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--ranks", type=int, nargs="+", default=[1, 2, 4, 8])
+    p.add_argument("--scale", type=int, default=10)
+    p.add_argument("--days", type=float, default=0.1)
+    p.add_argument("--multistep", type=int, default=100)
+    p.add_argument("--timeout", type=int, default=1200)
+    p.add_argument(
+        "--output",
+        default=os.path.join(REPO, "benchmarks", "results_r03_scaling.json"),
+    )
+    args = p.parse_args()
+
+    doc = {
+        "config": {
+            "scale": args.scale, "days": args.days,
+            "multistep": args.multistep,
+            "domain": f"{180 * args.scale}x{360 * args.scale}",
+        },
+        "note": (
+            "single-host CPU scaling: a plumbing/correctness signal for the "
+            "decomposition + halo-exchange path, not an ICI perf claim (the "
+            "XLA CPU device already uses all cores at n=1). Reference "
+            "published CPU column included for shape comparison only "
+            "(different hardware)."
+        ),
+        "reference_cpu_s": REFERENCE_CPU_S,
+        "mesh": {},
+        "shm": {},
+    }
+    for n in args.ranks:
+        doc["mesh"][str(n)] = run_mesh(
+            n, args.scale, args.days, args.multistep, args.timeout
+        )
+        print(f"mesh n={n}: {doc['mesh'][str(n)]}", flush=True)
+        doc["shm"][str(n)] = run_shm(
+            n, args.scale, args.days, args.multistep, args.timeout
+        )
+        print(f"shm  n={n}: {doc['shm'][str(n)]}", flush=True)
+        with open(args.output, "w") as f:
+            json.dump(doc, f, indent=1)
+    print(f"# wrote {args.output}")
+
+
+if __name__ == "__main__":
+    main()

@@ -66,8 +66,15 @@ def main():
         ShallowWaterModel,
     )
     from mpi4jax_tpu.parallel import spmd, world_mesh
+    from mpi4jax_tpu.runtime import shm as _shm
 
-    n = args.nproc
+    # Under `python -m mpi4jax_tpu.launch -n N` (the mpirun-analog
+    # workflow) each process runs this script once and owns one rank's
+    # block — the reference's execution model exactly. The world size
+    # comes from the launcher, ops route to the native shm backend, and
+    # no mesh is built.
+    shm_world = _shm.active()
+    n = _shm.size() if shm_world else args.nproc
     supported = (1, 2, 4, 6, 8, 16, 32)
     if n not in supported:
         raise SystemExit(f"--nproc must be one of {supported}")
@@ -92,7 +99,16 @@ def main():
 
     state0 = model.initial_state_blocks()
 
-    if n == 1:
+    if shm_world:
+        # one process per rank: jit the per-rank step directly; halo
+        # sendrecvs resolve to the shm backend inside the trace
+        rank = _shm.rank()
+        state = ModelState(*(jnp.asarray(b[rank]) for b in state0))
+        first = jax.jit(lambda s: model.step(s, first_step=True))
+        multi = jax.jit(
+            lambda s: model.multistep(s, args.multistep), donate_argnums=0
+        )
+    elif n == 1:
         state = ModelState(*(jnp.asarray(b[0]) for b in state0))
         first = jax.jit(lambda s: model.step(s, first_step=True))
         multi = jax.jit(
@@ -108,24 +124,44 @@ def main():
             donate_argnums=0,
         )
 
+    # device_sync, not block_until_ready: some PJRT transports resolve
+    # ready-events before the computation finishes (see
+    # utils/profiling.device_sync) — timings must close with a host
+    # fetch.
+    from mpi4jax_tpu.utils.profiling import device_sync
+
     state = first(state)
     # warm-up compile of the hot loop (excluded from timing, like the
     # reference's pre-compile call, shallow_water.py:441); the state is
     # donated so keep the advanced result (and its frame) and time one
     # call fewer, normalizing afterwards
     state = multi(state)
-    state[0].block_until_ready()
+    device_sync(state)
+
+    def snapshot(st):
+        """Global (n, ny_l, nx_l) height field for plotting. In the
+        launcher world each process holds one block, so gather to rank
+        0 (reference post-processing: gather(sol, root=0),
+        shallow_water.py:579-586); other ranks record nothing."""
+        if shm_world:
+            import mpi4jax_tpu as m4t
+
+            gathered = m4t.gather(st.h, 0)
+            return np.asarray(gathered) if _shm.rank() == 0 else None
+        h = np.asarray(st.h)
+        return h[None] if n == 1 else h
 
     snapshots = []
     if not args.benchmark:
-        snapshots.append(np.asarray(state.h))
+        snapshots.append(snapshot(state))
     n_timed = max(n_calls - 1, 1)
     start = time.perf_counter()
     for _ in range(n_timed):
         state = multi(state)
-        state[0].block_until_ready()
         if not args.benchmark:
-            snapshots.append(np.asarray(state.h))
+            device_sync(state)
+            snapshots.append(snapshot(state))
+    device_sync(state)
     elapsed = time.perf_counter() - start
     steps_timed = n_timed * args.multistep
 
@@ -140,7 +176,7 @@ def main():
         file=sys.stderr,
     )
 
-    if args.save_animation:
+    if args.save_animation and (not shm_world or _shm.rank() == 0):
         save_animation(model, config, snapshots, n)
 
     return elapsed, num_steps
@@ -159,10 +195,9 @@ def save_animation(model, config, snapshots, n):
 
     frames = []
     for h in snapshots:
-        if n == 1:
-            frames.append(h[1:-1, 1:-1] - config.depth)
-        else:
-            frames.append(model.reassemble(h, config.dims) - config.depth)
+        # snapshots are always stacked (n, ny_l, nx_l) blocks (see
+        # snapshot() in main); reassemble stitches interiors
+        frames.append(model.reassemble(h, config.dims) - config.depth)
 
     fig, ax = plt.subplots()
     im = ax.imshow(frames[0], vmin=-10, vmax=10, cmap="RdBu_r", origin="lower")

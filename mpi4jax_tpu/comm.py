@@ -31,6 +31,7 @@ expressed with per-rank tables (see ``PROC_NULL``) and traced
 
 from __future__ import annotations
 
+import collections as _collections
 import dataclasses
 import math
 from typing import Callable, Optional, Sequence, Tuple, Union
@@ -99,6 +100,15 @@ class Status:
     #: lifetime hazard with _addressof(status) (recv.py:100-103).
     _live_buffers: dict = {}
 
+    #: eager-mode pins: dispatch is asynchronous, so the native handler
+    #: can write *after* the Python statement (and a temporary Status)
+    #: is gone. A bounded FIFO keeps each buffer alive until thousands
+    #: of later eager statuses have been issued — on an in-order device
+    #: queue the earlier handler has long completed by then — without
+    #: the unbounded growth a permanent pin would give fresh-Status
+    #: loops.
+    _eager_pins = _collections.deque(maxlen=4096)
+
     def __init__(self):
         self._buf = np.zeros(3, np.int64)
         #: global ranks of the communicator the last call ran on (set
@@ -112,12 +122,11 @@ class Status:
         addr = self._buf.ctypes.data
         from .token import _no_active_trace
 
-        # Pin only when the address is being baked into a traced
-        # program (the jit cache can outlive the Status). Eager calls
-        # write through the pointer during the call itself, while the
-        # caller still holds the object — pinning there would turn the
-        # idiomatic fresh-Status-per-recv loop into an unbounded leak.
-        if not _no_active_trace():
+        if _no_active_trace():
+            Status._eager_pins.append(self._buf)
+        else:
+            # baked into a traced program: the jit cache can outlive
+            # the Status, so pin permanently
             Status._live_buffers[addr] = self._buf
         return addr
 

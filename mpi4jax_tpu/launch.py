@@ -57,6 +57,12 @@ def main(argv=None):
                 M4T_SHM_NAME=shm_name,
                 M4T_RANK=str(rank),
                 M4T_SIZE=str(args.nproc),
+                # world membership is for *direct* children only:
+                # runtime/shm.py refuses to join when the parent pid
+                # doesn't match, so a rank's own subprocesses (pytest
+                # spawning helper scripts) never attach as duplicate
+                # ranks of the live world
+                M4T_LAUNCHER_PID=str(os.getpid()),
                 JAX_PLATFORMS="cpu",
             )
             cmd = [sys.executable]

@@ -281,16 +281,24 @@ def _reject_foreign_sentinel(partner: int, what: str) -> None:
     )
 
 
-def check_user_tag(tag: int, what: str = "tag", *, allow_any: bool = False) -> int:
+def check_user_tag(
+    tag: int,
+    what: str = "tag",
+    *,
+    allow_any: bool = False,
+    reserved_namespace: bool = False,
+) -> int:
     """Validate a user-supplied message tag.
 
-    Tags at or above ``shm_group._TAG_BASE`` (1 << 20) are reserved for
-    group-collective internals — the native wildcard matcher skips that
-    namespace (``shmcc.cpp`` kTagBase), so a user message carrying such
-    a tag would be unreceivable via ANY_TAG. ``ANY_TAG`` itself is only
-    meaningful on the receive side."""
-    from ..runtime.shm_group import _TAG_BASE
-
+    ``ANY_TAG`` is only meaningful on the receive side; other negative
+    tags are invalid everywhere (MPI parity: tags are non-negative).
+    With ``reserved_namespace`` (the shm backend), tags at or above
+    ``shm_group._TAG_BASE`` (1 << 20) are additionally rejected: they
+    are reserved for group-collective internals and the native wildcard
+    matcher skips that namespace (``shmcc.cpp`` kTagBase), so a user
+    message carrying one would be unreceivable via ANY_TAG. On the XLA
+    path tags are trace-time matching metadata only and any
+    non-negative value is allowed (MPI_TAG_UB-style large tags work)."""
     tag = int(tag)
     if tag == ANY_TAG:
         if allow_any:
@@ -304,11 +312,15 @@ def check_user_tag(tag: int, what: str = "tag", *, allow_any: bool = False) -> i
             f"{what} {tag}: negative tags other than ANY_TAG (-1) are "
             "not accepted (MPI parity: tags are non-negative)"
         )
-    if tag >= _TAG_BASE:
-        raise ValueError(
-            f"{what} {tag} is in the reserved group-collective tag "
-            f"namespace; user tags must be < {_TAG_BASE} (1 << 20)"
-        )
+    if reserved_namespace:
+        from ..runtime.shm_group import _TAG_BASE
+
+        if tag >= _TAG_BASE:
+            raise ValueError(
+                f"{what} {tag} is in the reserved group-collective tag "
+                f"namespace of the shm backend; user tags must be < "
+                f"{_TAG_BASE} (1 << 20)"
+            )
     return tag
 
 
@@ -372,8 +384,11 @@ def sendrecv(
     """
     raise_if_token_is_set(token)
     bound = resolve_comm(comm)
-    sendtag = check_user_tag(sendtag, "sendtag")
-    recvtag = check_user_tag(recvtag, "recvtag", allow_any=True)
+    shm = bound.backend == "shm"
+    sendtag = check_user_tag(sendtag, "sendtag", reserved_namespace=shm)
+    recvtag = check_user_tag(
+        recvtag, "recvtag", allow_any=True, reserved_namespace=shm
+    )
     status_ptr = _status_checked(status, bound, "sendrecv")
     if bound.backend == "shm":
         sendbuf = jnp.asarray(sendbuf)
@@ -457,7 +472,7 @@ def send(x, dest: TableLike, *, tag: int = 0, comm=None, token=NOTSET):
     the matching :func:`recv` appears later in the same trace."""
     raise_if_token_is_set(token)
     bound = resolve_comm(comm)
-    tag = check_user_tag(tag, "tag")
+    tag = check_user_tag(tag, "tag", reserved_namespace=bound.backend == "shm")
     x = jnp.asarray(x)
     if bound.backend == "shm":
         dst = _shm_partner(dest, bound, "dest")
@@ -507,7 +522,9 @@ def recv(
     traced program (see module docstring)."""
     raise_if_token_is_set(token)
     bound = resolve_comm(comm)
-    tag = check_user_tag(tag, "tag", allow_any=True)
+    tag = check_user_tag(
+        tag, "tag", allow_any=True, reserved_namespace=bound.backend == "shm"
+    )
     status_ptr = _status_checked(status, bound, "recv")
     x = jnp.asarray(x)
     if bound.backend == "shm":

@@ -161,6 +161,13 @@ def spmd(
             )
 
             def globalize(a):
+                if isinstance(a, jax.Array) and not a.is_fully_addressable:
+                    # output of a previous multi-controller spmd call
+                    # fed back in (the donate-and-iterate pattern):
+                    # already a global array, pass through untouched —
+                    # np.asarray on it would fail (non-addressable
+                    # shards cannot be fetched).
+                    return a
                 a = np.asarray(a)
                 if a.shape[:1] != (n_local,):
                     raise ValueError(

@@ -87,9 +87,32 @@ def init_from_env() -> bool:
     name = os.environ.get("M4T_SHM_NAME")
     if not name or _active:
         return _active
+    launcher_pid = os.environ.get("M4T_LAUNCHER_PID")
+    if launcher_pid and str(os.getppid()) != launcher_pid:
+        # Inherited world env in a *grandchild* (a rank's own
+        # subprocess — e.g. pytest tests that spawn helper scripts):
+        # joining would attach a duplicate of the parent's rank to the
+        # live world and corrupt its channels. Run standalone instead.
+        return False
     ext = _load_ext()
     rank_ = int(os.environ["M4T_RANK"])
     size_ = int(os.environ["M4T_SIZE"])
+
+    # ABI cross-check BEFORE joining the world: the reserved
+    # group-collective tag namespace must agree between the native
+    # wildcard-matching exclusions (shmcc.cpp kTagBase) and the Python
+    # layer (shm_group._TAG_BASE, ops/p2p.py check_user_tag) — a drift
+    # would silently reopen the group-message-theft race. Checking
+    # before ext.init() means a stale extension fails fast without
+    # half-joining the segment or leaving _active set.
+    from .shm_group import _TAG_BASE
+
+    native_base = ext.abi_info().get("tag_base")
+    if native_base != _TAG_BASE:
+        raise RuntimeError(
+            f"native kTagBase ({native_base}) != shm_group._TAG_BASE "
+            f"({_TAG_BASE}); rebuild the extension"
+        )
 
     import jax
 
@@ -110,19 +133,6 @@ def init_from_env() -> bool:
     _RANK, _SIZE = rank_, size_
     _active = True
     ext.set_debug(config.DEBUG_LOGGING)
-
-    # The reserved group-collective tag namespace is shared between the
-    # native wildcard-matching exclusions (shmcc.cpp kTagBase) and the
-    # Python layer (shm_group._TAG_BASE, ops/p2p.py check_user_tag); a
-    # drift would silently reopen the group-message-theft race.
-    from .shm_group import _TAG_BASE
-
-    native_base = ext.abi_info().get("tag_base")
-    if native_base != _TAG_BASE:
-        raise RuntimeError(
-            f"native kTagBase ({native_base}) != shm_group._TAG_BASE "
-            f"({_TAG_BASE}); rebuild the extension"
-        )
 
     for name_, cap in ext.targets().items():
         jax.ffi.register_ffi_target(name_, cap, platform="cpu")

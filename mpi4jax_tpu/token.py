@@ -115,8 +115,10 @@ def check_no_pending_sends() -> None:
     """Raise if the current trace holds sends that were never matched
     by a recv — called at the end of ``parallel.spmd`` bodies so the
     primary entry point fails loudly instead of silently dropping a
-    transfer. (Raw ``shard_map`` users get a RuntimeError at state
-    eviction instead; see ``_current_state``.)"""
+    transfer. (For raw ``shard_map`` users, state eviction emits a
+    RuntimeWarning and poisons the offending trace, which raises on its
+    next op; the matching recv — the only consumer of the lost data —
+    always fails hard on its own. See ``_current_state``.)"""
     st = _current_state()
     if st.pending_sends:
         tags = [rec["tag"] for rec in st.pending_sends]

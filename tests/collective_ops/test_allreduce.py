@@ -10,6 +10,8 @@ import pytest
 
 import mpi4jax_tpu as m4t
 
+from tests.conftest import MY_RANK, WORLD
+
 N = 8
 
 
@@ -205,16 +207,18 @@ def test_allreduce_grad_requires_sum(run_spmd, per_rank):
 # --- 1-process run (SURVEY.md §4 execution model) ---
 
 
-def test_allreduce_size1_eager():
+def test_allreduce_eager_world():
+    # eager world: identity at size 1, arr * WORLD under the launcher
+    # (every rank feeds the same data — reference oracle arr * size)
     arr = jnp.arange(6.0)
     out = m4t.allreduce(arr, op=m4t.SUM)
-    np.testing.assert_allclose(out, arr)
+    np.testing.assert_allclose(out, np.arange(6.0) * WORLD)
 
 
-def test_allreduce_size1_jit():
+def test_allreduce_jit_world():
     arr = jnp.arange(6.0)
     out = jax.jit(lambda x: m4t.allreduce(x, op=m4t.SUM))(arr)
-    np.testing.assert_allclose(out, arr)
+    np.testing.assert_allclose(out, np.arange(6.0) * WORLD)
 
 
 def test_allreduce_size1_grad():

@@ -9,6 +9,8 @@ import jax.numpy as jnp
 
 import mpi4jax_tpu as m4t
 
+from tests.conftest import MY_RANK, WORLD
+
 N = 8
 
 
@@ -68,6 +70,9 @@ def test_reduce_scatter_split(run_spmd, per_rank):
         np.testing.assert_allclose(out[r].ravel(), [expected])
 
 
-def test_reduce_scatter_size1():
-    x = jnp.arange(3.0).reshape(1, 3)
-    np.testing.assert_allclose(m4t.reduce_scatter(x), x[0])
+def test_reduce_scatter_eager_world():
+    # identical inputs on every rank: reduce = x * WORLD, this rank
+    # keeps block MY_RANK
+    x = jnp.arange(WORLD * 3.0).reshape(WORLD, 3)
+    out = m4t.reduce_scatter(x)
+    np.testing.assert_allclose(out, np.asarray(x)[MY_RANK] * WORLD)

@@ -9,6 +9,8 @@ import pytest
 
 import mpi4jax_tpu as m4t
 
+from tests.conftest import IN_LAUNCHER_WORLD, MY_RANK, WORLD
+
 N = 8
 
 RING_DEST = tuple((r + 1) % N for r in range(N))
@@ -159,7 +161,7 @@ def test_recv_without_send_raises(run_spmd, per_rank):
 
 def test_send_edge_validation():
     with pytest.raises(ValueError, match="out of range"):
-        m4t.send(jnp.zeros(3), (5,))
+        m4t.send(jnp.zeros(3), (WORLD + 3,) * WORLD)
 
 
 def test_sendrecv_mismatched_tables(run_spmd, per_rank):
@@ -169,6 +171,11 @@ def test_sendrecv_mismatched_tables(run_spmd, per_rank):
         run_spmd(lambda x: m4t.sendrecv(x, x, bad_src, RING_DEST), arr)
 
 
+@pytest.mark.skipif(
+    IN_LAUNCHER_WORLD,
+    reason="tests the XLA path's Status/ANY_SOURCE rejections; the shm "
+    "world supports both (tested in test_shm_backend.py)",
+)
 def test_sendrecv_status_contract():
     # wrong type is a TypeError; a real Status raises on the XLA path
     # (no HLO analog — supported on the shm backend only, see
@@ -187,28 +194,38 @@ def test_sendrecv_status_contract():
         m4t.recv(jnp.zeros(3), m4t.ANY_SOURCE)
 
 
-def test_sendrecv_size1_self():
+def test_sendrecv_self_edges():
+    # every rank exchanges with itself: identity at any world size
+    idx = tuple(range(WORLD))
     x = jnp.arange(3.0)
-    out = m4t.sendrecv(x, jnp.zeros_like(x), (0,), (0,))
+    out = m4t.sendrecv(x, jnp.zeros_like(x), idx, idx)
     np.testing.assert_allclose(out, x)
 
 
 def test_user_tag_validation():
-    # Tags >= 1<<20 are reserved for group-collective internals and
-    # rejected at the wrapper (ops/p2p.py check_user_tag); ANY_TAG is
-    # receive-side only; other negatives are invalid (MPI parity).
-    import jax.numpy as jnp
-    import pytest
-
-    import mpi4jax_tpu as m4t
-
+    # ANY_TAG is receive-side only; other negatives are invalid (MPI
+    # parity). The reserved namespace >= 1<<20 applies to the shm
+    # backend only (group-collective internals, ops/p2p.py
+    # check_user_tag); on the XLA path tags are trace-time metadata and
+    # MPI_TAG_UB-style large tags must keep working.
     x = jnp.ones(3)
-    with pytest.raises(ValueError, match="reserved"):
-        m4t.send(x, dest=0, tag=1 << 20)
+    src = (0,) * WORLD if WORLD > 1 else 0
     with pytest.raises(ValueError, match="receive side"):
-        m4t.sendrecv(x, x, source=0, dest=0, sendtag=m4t.ANY_TAG)
+        m4t.sendrecv(x, x, source=src, dest=src, sendtag=m4t.ANY_TAG)
     with pytest.raises(ValueError, match="negative tags"):
-        m4t.recv(x, source=0, tag=-7)
+        m4t.recv(x, source=src, tag=-7)
+    big = (1 << 20) + 5
+    idx = tuple(range(WORLD))
+    if WORLD == 1:
+        out = m4t.sendrecv(
+            x, jnp.zeros_like(x), idx, idx, sendtag=big, recvtag=big
+        )
+        np.testing.assert_allclose(out, x)
+    else:
+        with pytest.raises(ValueError, match="reserved"):
+            m4t.sendrecv(
+                x, jnp.zeros_like(x), idx, idx, sendtag=big, recvtag=big
+            )
 
 
 def test_foreign_negative_sentinel_rejected_in_tables():

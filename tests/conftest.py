@@ -27,8 +27,23 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 from mpi4jax_tpu.parallel import spmd, world_mesh  # noqa: E402
+from mpi4jax_tpu.runtime import shm as _shm  # noqa: E402
 
 N_RANKS = 8
+
+# Reference idiom (its tests read rank/size from COMM_WORLD at module
+# level so one file is valid at any world size): standalone the eager
+# world is size 1; under `python -m mpi4jax_tpu.launch -n N` it is N.
+# Test modules import these from tests.conftest.
+IN_LAUNCHER_WORLD = _shm.active()
+WORLD = _shm.size() if IN_LAUNCHER_WORLD else 1
+MY_RANK = _shm.rank() if IN_LAUNCHER_WORLD else 0
+
+#: skip for cases that assume a size-1 eager world (analog of the
+#: reference's size-conditional skipifs)
+needs_size1_world = pytest.mark.skipif(
+    IN_LAUNCHER_WORLD, reason="assumes a size-1 eager world (launcher world active)"
+)
 
 
 def pytest_report_header(config):

@@ -48,7 +48,10 @@ def test_mpi_namespace_surface():
     assert MPI.ANY_SOURCE is m4t.ANY_SOURCE
     st = MPI.Status()
     assert hasattr(st, "Get_source") and hasattr(st, "Get_count")
-    assert MPI.COMM_WORLD.Get_size() == 1  # outside any mesh
+    from mpi4jax_tpu.runtime import shm as _shm
+
+    world = _shm.size() if _shm.active() else 1
+    assert MPI.COMM_WORLD.Get_size() == world  # eager world size
 
 
 def test_comm_portability_noops():

@@ -186,3 +186,22 @@ def test_distributed_ordering_deep_chain():
         """,
     )
     _assert_ok(outs, "CHAIN_OK")
+
+
+def test_distributed_iterate_outputs():
+    # The donate-and-iterate pattern: feeding a previous spmd output
+    # (a global array with non-addressable shards) back into the next
+    # call must pass through without a host round-trip.
+    outs = run_world(
+        2,
+        """
+        mesh = world_mesh()
+        f = spmd(lambda x: m4t.allreduce(x, op=m4t.SUM) / nprocs, mesh=mesh)
+        state = jnp.full((1, 3), float(rank + 1))
+        for _ in range(4):
+            state = f(state)   # global jax.Array fed straight back in
+        np.testing.assert_allclose(local_blocks(state), 1.5)  # mean fixpoint
+        print(f"ITER_OK{rank}")
+        """,
+    )
+    _assert_ok(outs, "ITER_OK")

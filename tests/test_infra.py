@@ -14,7 +14,10 @@ import jax.numpy as jnp
 import mpi4jax_tpu as m4t
 from mpi4jax_tpu import config, debug, jax_compat
 from mpi4jax_tpu.comm import CartComm, Comm, resolve_comm
+from mpi4jax_tpu.runtime import shm as _shm
 from mpi4jax_tpu.validation import enforce_types
+
+from tests.conftest import WORLD
 
 
 # --- enforce_types (reference test_validation.py) ---
@@ -84,9 +87,13 @@ def test_cartcomm_shift_mirror():
             assert src[dst[r]] == r
 
 
-def test_resolve_comm_outside_mesh_is_size1():
+def test_resolve_comm_outside_mesh():
+    # outside any mesh: the eager world — size 1 standalone, the
+    # launcher world's size under `python -m mpi4jax_tpu.launch`
     bound = resolve_comm(None)
-    assert bound.size == 1 and bound.axes == ()
+    assert bound.size == WORLD and bound.axes == ()
+    if WORLD > 1:
+        assert bound.backend == "shm"
 
 
 def test_resolve_comm_type_error():
@@ -116,6 +123,9 @@ def test_resolve_comm_typo_inside_mesh_raises(mesh, per_rank):
         jax.jit(sm(f))(jnp.asarray(arr))
 
 
+@pytest.mark.skipif(
+    _shm.active(), reason="vmap-of-FFI not defined on the shm backend"
+)
 def test_resolve_comm_vmap_axis_still_works():
     # vmap axis names are not mesh axes; collectives over them (or over
     # the default world comm at size 1) must keep working.
@@ -189,7 +199,8 @@ def test_emission_log_format(capsys):
         m4t.set_logging(False)
     out = capsys.readouterr().out
     assert re.search(
-        r"emit \| [a-z0-9]{8} \| AllReduce \[4 items, op=SUM, n=1\]", out
+        rf"emit \| [a-z0-9]{{8}} \| AllReduce \[4 items, op=SUM, n={WORLD}\]",
+        out,
     ), out
 
 
@@ -225,8 +236,15 @@ def test_capability_queries():
 
 
 def test_shmcomm_outside_world():
-    with pytest.raises(RuntimeError, match="launch"):
-        m4t.ShmComm()
+    if _shm.active():
+        # inside a launcher world the constructor succeeds and reports
+        # the world geometry
+        c = m4t.ShmComm()
+        assert c.Get_size() == WORLD
+        assert 0 <= c.Get_rank() < WORLD
+    else:
+        with pytest.raises(RuntimeError, match="launch"):
+            m4t.ShmComm()
 
 
 # --- ordering token ---
@@ -301,7 +319,7 @@ def test_eager_latency_fast_path(monkeypatch):
     )
     out1 = m4t.allreduce(jnp.ones(3), op=m4t.SUM)
     out2 = m4t.allreduce(out1 * 2, op=m4t.MAX)
-    np.testing.assert_allclose(np.asarray(out2), 2.0)
+    np.testing.assert_allclose(np.asarray(out2), 2.0 * WORLD)
     assert calls == [], f"eager ops emitted {len(calls)} barrier ties"
 
 

@@ -14,6 +14,9 @@ M = 4   # microbatches
 B = 3   # microbatch size
 D = 5
 
+from tests.conftest import needs_size1_world
+
+
 
 def stage_fn(params, h):
     w, b = params
@@ -114,6 +117,7 @@ def test_gpipe_many_microbatches_compiles_fast(run_spmd, stage_weights):
     assert np.isfinite(grads).all() and np.abs(grads).sum() > 0
 
 
+@needs_size1_world
 def test_gpipe_single_rank(stage_weights):
     w, b = stage_weights
     x = np.ones((M, B, D), np.float32)

@@ -11,6 +11,9 @@ from mpi4jax_tpu.ops.quantized import quantized_allreduce
 
 N = 8
 
+from tests.conftest import needs_size1_world
+
+
 
 def test_quantized_allreduce_error_bound(run_spmd, per_rank):
     rng = np.random.RandomState(0)
@@ -41,6 +44,7 @@ def test_quantized_allreduce_unaligned_size(run_spmd, per_rank):
     assert err < 0.05
 
 
+@needs_size1_world
 def test_quantized_allreduce_size1():
     x = jnp.arange(10.0)
     np.testing.assert_allclose(quantized_allreduce(x), x)

@@ -15,6 +15,9 @@ N = 8
 T_LOCAL = 4
 T = N * T_LOCAL
 
+from tests.conftest import needs_size1_world
+
+
 
 def make_cfg(attention, sp):
     return tfm.TransformerConfig(
@@ -30,6 +33,7 @@ def make_cfg(attention, sp):
 
 
 @pytest.mark.parametrize("attention", ["ring", "ulysses"])
+@needs_size1_world
 def test_sp_training_matches_single_device(mesh, attention):
     cfg_sp = make_cfg(attention, sp=True)
     cfg_1 = make_cfg(attention, sp=False)

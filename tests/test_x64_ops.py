@@ -1,0 +1,141 @@
+"""True-width float64 / complex128 coverage of the XLA collective path.
+
+The main suite runs with ``jax_enable_x64=False`` (``conftest.py``), so
+its "float64" parametrizations silently execute at f32. The reference
+tests genuine f64/c128 on every op (``_src/utils.py:101-128`` dtype
+table + per-op tests); this module closes that gap by running the op
+sweep in a subprocess with ``jax_enable_x64=True`` (the flag must be
+set before the backend initializes, hence the subprocess) and asserting
+both the output dtype and precision that only survives at 64-bit width
+(offsets of 1e-12 are representable in f64, absorbed at f32).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SCRIPT = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+import jax.numpy as jnp
+import mpi4jax_tpu as m4t
+from mpi4jax_tpu.parallel import spmd
+
+N = 8
+EPS = 1e-12  # representable at f64, absorbed at f32
+
+# --- allreduce f64: precision must survive ---------------------------------
+base = np.full((N, 4), 1.0, np.float64)
+arr = base + EPS * (np.arange(N, dtype=np.float64)[:, None] + 1)
+out = spmd(lambda x: m4t.allreduce(x, op=m4t.SUM))(jnp.asarray(arr))
+assert out.dtype == jnp.float64, out.dtype
+expected = arr.sum(axis=0)
+np.testing.assert_allclose(np.asarray(out)[0], expected, rtol=0, atol=1e-15)
+# the f64-only part of the signal must be present
+assert abs(np.asarray(out)[0, 0] - N) > 30 * EPS
+
+# --- allreduce c128 --------------------------------------------------------
+carr = (arr + 1j * (2 * arr)).astype(np.complex128)
+out = spmd(lambda x: m4t.allreduce(x, op=m4t.SUM))(jnp.asarray(carr))
+assert out.dtype == jnp.complex128, out.dtype
+np.testing.assert_allclose(np.asarray(out)[0], carr.sum(axis=0), atol=1e-15)
+
+# --- i64: values above the i32 range --------------------------------------
+ia = np.full((N, 3), (1 << 40), np.int64) + np.arange(N, dtype=np.int64)[:, None]
+out = spmd(lambda x: m4t.allreduce(x, op=m4t.SUM))(jnp.asarray(ia))
+assert out.dtype == jnp.int64, out.dtype
+np.testing.assert_array_equal(np.asarray(out)[0], ia.sum(axis=0))
+
+# --- allgather / alltoall c128 --------------------------------------------
+xg = (np.arange(N, dtype=np.float64)[:, None] + EPS + 1j).astype(np.complex128)
+out = spmd(m4t.allgather)(jnp.asarray(xg))
+assert out.dtype == jnp.complex128
+np.testing.assert_allclose(np.asarray(out)[0], xg, atol=0)
+
+xa = np.arange(N * N, dtype=np.float64).reshape(N, N, 1) * EPS
+out = spmd(m4t.alltoall)(jnp.asarray(xa))
+assert out.dtype == jnp.float64
+np.testing.assert_allclose(
+    np.asarray(out)[:, :, 0].T, xa[:, :, 0], rtol=0, atol=0
+)
+
+# --- bcast / gather / scatter / reduce / scan f64 --------------------------
+xb = np.full((N, 2), np.pi, np.float64) + EPS * np.arange(N)[:, None]
+out = spmd(lambda x: m4t.bcast(x, 0))(jnp.asarray(xb))
+assert out.dtype == jnp.float64
+np.testing.assert_allclose(np.asarray(out)[3], xb[0], rtol=0, atol=0)
+
+out = spmd(lambda x: m4t.gather(x, 0))(jnp.asarray(xb))
+assert out.dtype == jnp.float64
+
+blocks = np.arange(N * N, dtype=np.float64).reshape(N, N, 1) + EPS
+out = spmd(lambda x: m4t.scatter(x, 0))(jnp.asarray(np.broadcast_to(blocks[0], (N, N, 1))))
+assert out.dtype == jnp.float64
+np.testing.assert_allclose(np.asarray(out)[2, 0], blocks[0, 2, 0], rtol=0)
+
+out = spmd(lambda x: m4t.reduce(x, op=m4t.SUM, root=0))(jnp.asarray(xb))
+assert out.dtype == jnp.float64
+np.testing.assert_allclose(np.asarray(out)[0], xb.sum(axis=0), atol=1e-15)
+
+out = spmd(lambda x: m4t.scan(x, op=m4t.SUM))(jnp.asarray(xb))
+assert out.dtype == jnp.float64
+np.testing.assert_allclose(np.asarray(out)[5], xb[:6].sum(axis=0), atol=1e-15)
+
+# --- sendrecv c128 ring ----------------------------------------------------
+ring_dst = tuple((r + 1) % N for r in range(N))
+ring_src = tuple((r - 1) % N for r in range(N))
+xs = (np.arange(N, dtype=np.float64)[:, None] * EPS + 1j * np.ones((N, 2))).astype(
+    np.complex128
+)
+out = spmd(
+    lambda x: m4t.sendrecv(x, x, ring_src, ring_dst)
+)(jnp.asarray(xs))
+assert out.dtype == jnp.complex128
+np.testing.assert_allclose(np.asarray(out)[3], xs[2], rtol=0, atol=0)
+
+# --- send/recv f64 ---------------------------------------------------------
+def sr(x):
+    m4t.send(x, ring_dst, tag=4)
+    return m4t.recv(x, ring_src, tag=4)
+
+out = spmd(sr)(jnp.asarray(xb))
+assert out.dtype == jnp.float64
+np.testing.assert_allclose(np.asarray(out)[3], xb[2], rtol=0, atol=0)
+
+# --- grad through allreduce at f64 ----------------------------------------
+g = spmd(lambda x: jax.grad(lambda v: m4t.allreduce(v, op=m4t.SUM).sum())(x))(
+    jnp.asarray(xb)
+)
+assert g.dtype == jnp.float64
+np.testing.assert_allclose(np.asarray(g), 1.0, rtol=0, atol=0)
+
+print("X64_SWEEP_OK")
+"""
+
+
+def test_x64_op_sweep():
+    path = os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), f"m4t_x64_{os.getpid()}.py"
+    )
+    with open(path, "w") as f:
+        f.write(textwrap.dedent(_SCRIPT.format(repo=REPO)))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run(
+        [sys.executable, path],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=REPO,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "X64_SWEEP_OK" in res.stdout
