@@ -37,37 +37,62 @@ def _parse(stderr: str):
     return (float(m.group(1)) if m else None, float(s.group(1)) if s else None)
 
 
+def _run(cmd, env, timeout):
+    """Run one config in its own session; on timeout kill the whole
+    process group (a bare subprocess.run kill would orphan launcher
+    rank children and leak the shm segment) and record the error
+    instead of aborting the remaining sweep."""
+    import signal
+
+    proc = subprocess.Popen(
+        cmd, env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, start_new_session=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        proc.communicate()
+        return None, None, f"timeout after {timeout}s"
+    if proc.returncode != 0:
+        return None, None, (err or out)[-500:]
+    return out, err, None
+
+
 def run_mesh(n, scale, days, multistep, timeout):
     env = dict(os.environ)
     env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n}"
-    res = subprocess.run(
+    out, err, fail = _run(
         [
             sys.executable, EXAMPLE, "--benchmark", "--platform", "cpu",
             "--nproc", str(n), "--scale", str(scale), "--days", str(days),
             "--multistep", str(multistep),
         ],
-        env=env, capture_output=True, text=True, timeout=timeout, cwd=REPO,
+        env, timeout,
     )
-    if res.returncode != 0:
-        return {"error": res.stderr[-500:]}
-    secs, sps = _parse(res.stderr)
+    if fail:
+        return {"error": fail}
+    secs, sps = _parse(err)
     return {"seconds": secs, "steps_per_s": sps}
 
 
 def run_shm(n, scale, days, multistep, timeout):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
-    res = subprocess.run(
+    out, err, fail = _run(
         [
             sys.executable, "-m", "mpi4jax_tpu.launch", "-n", str(n), EXAMPLE,
             "--benchmark", "--scale", str(scale), "--days", str(days),
             "--multistep", str(multistep),
         ],
-        env=env, capture_output=True, text=True, timeout=timeout, cwd=REPO,
+        env, timeout,
     )
-    if res.returncode != 0:
-        return {"error": (res.stderr or res.stdout)[-500:]}
-    secs, sps = _parse(res.stderr)
+    if fail:
+        return {"error": fail}
+    secs, sps = _parse(err)
     return {"seconds": secs, "steps_per_s": sps}
 
 

@@ -99,17 +99,12 @@ def main():
 
     state0 = model.initial_state_blocks()
 
-    if shm_world:
-        # one process per rank: jit the per-rank step directly; halo
-        # sendrecvs resolve to the shm backend inside the trace
-        rank = _shm.rank()
+    if shm_world or n == 1:
+        # one process, one block: jit the per-rank step directly. In a
+        # launcher world each process owns block `rank` and the halo
+        # sendrecvs resolve to the shm backend inside the trace.
+        rank = _shm.rank() if shm_world else 0
         state = ModelState(*(jnp.asarray(b[rank]) for b in state0))
-        first = jax.jit(lambda s: model.step(s, first_step=True))
-        multi = jax.jit(
-            lambda s: model.multistep(s, args.multistep), donate_argnums=0
-        )
-    elif n == 1:
-        state = ModelState(*(jnp.asarray(b[0]) for b in state0))
         first = jax.jit(lambda s: model.step(s, first_step=True))
         multi = jax.jit(
             lambda s: model.multistep(s, args.multistep), donate_argnums=0

@@ -102,12 +102,13 @@ class Status:
 
     #: eager-mode pins: dispatch is asynchronous, so the native handler
     #: can write *after* the Python statement (and a temporary Status)
-    #: is gone. A bounded FIFO keeps each buffer alive until thousands
-    #: of later eager statuses have been issued — on an in-order device
-    #: queue the earlier handler has long completed by then — without
-    #: the unbounded growth a permanent pin would give fresh-Status
-    #: loops.
-    _eager_pins = _collections.deque(maxlen=4096)
+    #: is gone. Buffers accumulate here; when the list fills, the next
+    #: pin first waits for all dispatched effectful computations
+    #: (jax.effects_barrier) — after which every pending native write
+    #: has landed — and drops the old pins. Bounded memory, no
+    #: eviction-while-pending race.
+    _eager_pins: list = []
+    _EAGER_PIN_LIMIT = 4096
 
     def __init__(self):
         self._buf = np.zeros(3, np.int64)
@@ -123,6 +124,14 @@ class Status:
         from .token import _no_active_trace
 
         if _no_active_trace():
+            if len(Status._eager_pins) >= Status._EAGER_PIN_LIMIT:
+                import jax
+
+                try:
+                    jax.effects_barrier()  # all pending writes landed
+                    Status._eager_pins.clear()
+                except Exception:
+                    pass  # keep pinning; correctness over memory
             Status._eager_pins.append(self._buf)
         else:
             # baked into a traced program: the jit cache can outlive
@@ -342,12 +351,14 @@ class Comm:
 
 
 class GroupComm(Comm):
-    """A sub-communicator: disjoint same-size groups of global ranks.
+    """A sub-communicator: disjoint groups of global ranks.
 
     The analog of an ``MPI_Comm_split`` result. Ranks are *group
     ranks* (0..group_size-1); collectives lower with
     ``axis_index_groups`` so each group is an independent
-    ``replica_group`` in the HLO collective.
+    ``replica_group`` in the HLO collective. The XLA path requires
+    equal-size groups (checked at bind time); the shm backend accepts
+    any partition, like MPI.
     """
 
     def __init__(self, groups, axis: Union[str, Sequence[str]] = WORLD_AXIS):
@@ -356,11 +367,12 @@ class GroupComm(Comm):
         if not groups:
             raise ValueError("GroupComm needs at least one group")
         gsize = len(groups[0])
-        if any(len(grp) != gsize for grp in groups):
-            raise ValueError(
-                "all groups must have equal size under SPMD (got sizes "
-                f"{[len(g) for g in groups]})"
-            )
+        #: equal-size groups are required for the XLA path (HLO
+        #: replica_groups are uniform, and per-rank output shapes must
+        #: be identical in one traced program); the multi-controller
+        #: shm backend composes group collectives from p2p and accepts
+        #: any partition, like MPI_Comm_split. Checked at bind time.
+        self.uniform = not any(len(grp) != gsize for grp in groups)
         flat = sorted(r for grp in groups for r in grp)
         if flat != list(range(len(flat))):
             raise ValueError(
@@ -376,8 +388,10 @@ class GroupComm(Comm):
         one entry, like :meth:`Comm.Split`). Each existing group is
         partitioned independently by color — ranks sharing a color
         *within the same parent group* form a new sub-communicator,
-        ordered by global rank (MPI's key=rank default). All resulting
-        groups must have equal size (SPMD shape uniformity).
+        ordered by global rank (MPI's key=rank default). On the XLA
+        path the resulting groups must have equal size (SPMD shape
+        uniformity, checked at bind time); unequal partitions work on
+        the shm backend.
         """
         new_groups = []
         for grp in self.groups:
@@ -681,6 +695,15 @@ def resolve_comm(comm: Optional[Comm]) -> BoundComm:
         if len(comm.axes) != 1:
             raise NotImplementedError(
                 "sub-communicators require a single mesh axis"
+            )
+        if not comm.uniform:
+            raise ValueError(
+                "all groups must have equal size under SPMD (got sizes "
+                f"{[len(g) for g in comm.groups]}): HLO replica_groups "
+                "are uniform and one traced program cannot have "
+                "per-rank output shapes. Unequal partitions run on the "
+                "multi-controller shm backend "
+                "(`python -m mpi4jax_tpu.launch`), like MPI_Comm_split."
             )
         return BoundComm(
             axes=comm.axes, size=len(comm.groups[0]), groups=comm.groups

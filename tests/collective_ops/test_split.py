@@ -127,9 +127,15 @@ def test_split_grad(run_spmd, per_rank):
     np.testing.assert_allclose(out, np.ones(N))
 
 
-def test_split_validation():
+def test_split_validation(run_spmd, per_rank):
+    # Unequal partitions construct fine (MPI_Comm_split parity; legal
+    # on the shm backend) but are rejected when *bound* on the XLA
+    # path, where HLO replica_groups must be uniform.
+    uneven = m4t.GroupComm(((0, 1, 2), (3,), (4, 5, 6, 7)))
+    assert not uneven.uniform
+    arr = per_rank(lambda r: np.float32(r))
     with pytest.raises(ValueError, match="equal size"):
-        m4t.GroupComm(((0, 1, 2), (3,)))
+        run_spmd(lambda x: m4t.allreduce(x, op=m4t.SUM, comm=uneven), arr)
     with pytest.raises(ValueError, match="partition"):
         m4t.GroupComm(((0, 1), (1, 2)))
 

@@ -64,3 +64,57 @@ def test_comm_portability_noops():
     import mpi4jax_tpu as m4t
 
     assert "CartComm" in m4t.CartComm(dims=(2, 4)).Get_name()
+
+
+def test_porting_checklist_errors():
+    # The four SPMD contract deviations a ported reference script can
+    # hit (docs/sharp-bits.md "Porting checklist") must each fail with
+    # the documented, actionable error.
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+
+    import mpi4jax_tpu as m4t
+    from mpi4jax_tpu.parallel import spmd
+
+    from tests.conftest import WORLD, needs_size1_world  # noqa: F401
+
+    N = 8
+    x = jnp.ones(3)
+
+    # 1. bare-int partner at size > 1 -> per-rank table demanded
+    @spmd
+    def bare_int(v):
+        return m4t.sendrecv(v, v, source=1, dest=1)
+
+    with pytest.raises(ValueError, match="per-rank table"):
+        bare_int(jnp.ones((N, 3)))
+
+    # 2. distinct sendrecv tags -> must agree (fused transfer)
+    ring_dst = tuple((r + 1) % N for r in range(N))
+    ring_src = tuple((r - 1) % N for r in range(N))
+
+    @spmd
+    def two_tags(v):
+        return m4t.sendrecv(v, v, ring_src, ring_dst, sendtag=1, recvtag=2)
+
+    with pytest.raises(ValueError, match="must equal sendtag"):
+        two_tags(jnp.ones((N, 3)))
+
+    # 3. scatter without the full (size, ...) input on the XLA path
+    @spmd
+    def bad_scatter(v):
+        return m4t.scatter(v, 0)
+
+    with pytest.raises(ValueError, match="leading axis"):
+        bad_scatter(jnp.ones((N, 3)))
+
+    # 4. unequal Split groups bound on the XLA path
+    uneven = m4t.GroupComm(((0, 1, 2), (3,), (4, 5, 6, 7)))
+
+    @spmd
+    def uneven_allreduce(v):
+        return m4t.allreduce(v, op=m4t.SUM, comm=uneven)
+
+    with pytest.raises(ValueError, match="equal size"):
+        uneven_allreduce(jnp.ones((N, 3)))

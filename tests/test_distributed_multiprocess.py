@@ -83,6 +83,7 @@ def run_world(n, script, timeout=300):
             if p.poll() is None:
                 p.kill()
                 p.communicate()
+        os.remove(path)
     return outs
 
 

@@ -677,3 +677,34 @@ def test_shallow_water_on_launcher_world():
     )
     assert res.returncode == 0, res.stderr + res.stdout
     assert "SW_SHM_OK0" in res.stdout and "SW_SHM_OK1" in res.stdout
+
+
+@needs_native
+def test_unequal_split_on_launcher_world():
+    # MPI_Comm_split parity: unequal-size groups are legal on the shm
+    # backend (p2p-composed group collectives need no uniformity) —
+    # only the XLA path requires equal replica_groups.
+    res = launch(
+        3,
+        """
+        import numpy as np, jax.numpy as jnp
+        import mpi4jax_tpu as m4t
+        from mpi4jax_tpu.runtime import shm
+        r = shm.rank()
+        sub = m4t.Comm().Split([0, 0, 1])  # {0,1} and {2}
+        s = m4t.allreduce(jnp.float32(r + 1), op=m4t.SUM, comm=sub)
+        assert float(s) == (3.0 if r < 2 else 3.0), float(s)  # 1+2 | 3
+        ag = m4t.allgather(jnp.float32(r), comm=sub)
+        if r < 2:
+            assert np.allclose(np.asarray(ag), [0.0, 1.0]), ag
+        else:
+            assert np.allclose(np.asarray(ag), [2.0]), ag
+        sc = m4t.scan(jnp.float32(r + 1), op=m4t.SUM, comm=sub)
+        assert float(sc) == [1.0, 3.0, 3.0][r], float(sc)
+        m4t.barrier()
+        print(f"UNEQ_OK{r}")
+        """,
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(3):
+        assert f"UNEQ_OK{r}" in res.stdout

@@ -129,13 +129,16 @@ def test_x64_op_sweep():
         f.write(textwrap.dedent(_SCRIPT.format(repo=REPO)))
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
-    res = subprocess.run(
-        [sys.executable, path],
-        env=env,
-        capture_output=True,
-        text=True,
-        timeout=600,
-        cwd=REPO,
-    )
+    try:
+        res = subprocess.run(
+            [sys.executable, path],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=600,
+            cwd=REPO,
+        )
+    finally:
+        os.remove(path)
     assert res.returncode == 0, res.stdout + res.stderr
     assert "X64_SWEEP_OK" in res.stdout
