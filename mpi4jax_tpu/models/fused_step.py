@@ -1,0 +1,385 @@
+"""Fused single-pass Pallas step kernel for the shallow-water solver.
+
+The XLA lowering of :meth:`ShallowWaterModel.step` compiles to ~42
+kernels per step (33 fusions + 9 copies measured on TPU v5e), each
+doing a full-grid HBM pass: the step is pure radius-<=3 stencil work,
+so most of those passes re-read fields a prior kernel just wrote.
+This module collapses the entire step — halo/ghost logic, volume
+fluxes, potential vorticity, kinetic energy, Adams-Bashforth update,
+boundary enforcement and lateral friction (reference physics:
+``shallow_water.py:172-403``) — into **one** Pallas kernel: each grid
+tile DMAs a (block_rows + 2*halo)-row slab of the six state fields
+from HBM into VMEM, evaluates the whole step as roll+mask algebra on
+the slab, and writes the six output tiles. HBM traffic drops from
+~40 field passes to ~13 (6 reads + 6 writes + halo overlap), which is
+the bandwidth floor for AB2 state of this size.
+
+Scope (deliberate):
+
+- **single-rank** (``config.n_ranks == 1``) and ``periodic_x`` — the
+  benchmarked configuration (``BASELINE.md``). The SPMD path keeps the
+  composable ``sendrecv``-based exchange; fusing across shards would
+  move the halo exchange inside the kernel (ICI RDMA), a separate
+  project.
+- **float32**, ``first_step=False`` (the first Euler step runs once on
+  the XLA path; the AB2 hot loop is what matters).
+
+Correctness contract: bit-compatible operation order with
+:meth:`ShallowWaterModel.step` wherever sequencing is observable
+(wrap-then-wall ordering, friction applied to interior only with
+pre-friction ghost columns, rank-clamped edge padding). Validated
+against the XLA step in ``tests/test_fused_step.py`` (interpret mode)
+and by an on-device equivalence probe in ``bench.py`` before the fused
+path is trusted for a benchmark run.
+
+The kernel layout follows the Pallas TPU halo pattern: inputs live in
+``pl.ANY`` (compiler-placed, effectively HBM at these sizes); each
+grid step async-copies a clamped row window into a VMEM slab scratch,
+with the next tile's DMA started before the current tile's compute
+(double buffering) so the copy rides under the VPU work.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .shallow_water import ModelState, ShallowWaterConfig
+
+#: halo rows carried by each slab. The step needs radius 3 (deepest
+#: chain: u'/v' <- friction flux (+-1) <- AB2 state (+-1) <-
+#: q/ke/fluxes (+-1) <- edge-clamped hc (+-1)); 8 is used so the DMA
+#: window start stays a multiple of the f32 sublane tiling (8), which
+#: Mosaic requires for dynamic row offsets into HBM.
+HALO = 8
+
+
+#: lane-dimension padding quantum — Mosaic requires HBM row-window DMA
+#: slices to keep a 128-aligned lane extent
+LANE = 128
+
+
+def padded_rows(config: ShallowWaterConfig, block_rows: int) -> int:
+    """Row count after padding to a whole number of kernel tiles."""
+    ny = config.ny_local
+    return -(-ny // block_rows) * block_rows
+
+
+def padded_cols(config: ShallowWaterConfig) -> int:
+    """Column count after padding to the 128-lane quantum."""
+    nx = config.nx_local
+    return -(-nx // LANE) * LANE
+
+
+def pad_state(config: ShallowWaterConfig, state: ModelState,
+              block_rows: int) -> ModelState:
+    """Pad each field with trailing junk rows/columns to tile multiples.
+
+    The kernel masks on *real* row/column indices, so the padding is
+    never read into a real output. ``h`` pads with 1.0 (not 0) so the
+    potential-vorticity division stays finite even in masked-off
+    lanes.
+    """
+    nyp = padded_rows(config, block_rows)
+    nxp = padded_cols(config)
+    pr = nyp - config.ny_local
+    pc = nxp - config.nx_local
+    if pr == 0 and pc == 0:
+        return state
+    pads = ((0, pr), (0, pc))
+    return ModelState(
+        h=jnp.pad(state.h, pads, constant_values=1.0),
+        u=jnp.pad(state.u, pads),
+        v=jnp.pad(state.v, pads),
+        dh=jnp.pad(state.dh, pads),
+        du=jnp.pad(state.du, pads),
+        dv=jnp.pad(state.dv, pads),
+    )
+
+
+def crop_state(config: ShallowWaterConfig, state: ModelState) -> ModelState:
+    """Drop the padding rows/columns again."""
+    ny, nx = config.ny_local, config.nx_local
+    return ModelState(*(f[:ny, :nx] for f in state))
+
+
+def _wrap_cols(a, gcol, nx):
+    """Periodic-x ghost columns: col 0 <- col nx-2, col nx-1 <- col 1
+    (reference ``enforce_boundaries`` single-rank branch)."""
+    lo = lax.slice_in_dim(a, nx - 2, nx - 1, axis=1)
+    hi = lax.slice_in_dim(a, 1, 2, axis=1)
+    return jnp.where(gcol == 0, lo, jnp.where(gcol == nx - 1, hi, a))
+
+
+def _slab_step(config: ShallowWaterConfig, slab: Tuple[jax.Array, ...],
+               grow: jax.Array, gcol: jax.Array):
+    """One full AB2 step evaluated on a row slab.
+
+    ``slab`` holds (h, u, v, dh, du, dv), each ``(rows, nx)``; ``grow``
+    / ``gcol`` are the *global* row/column indices of each slab element
+    (int32, same shape). Rows whose dependencies fall outside the slab
+    produce garbage that the caller must not read — valid only for the
+    center ``rows - 2*HALO`` rows (plus physical-boundary rows, which
+    are mask-resolved). Returns the six updated fields, full slab
+    shape.
+
+    Mirrors ``ShallowWaterModel.step`` stage for stage; the reference
+    physics is ``shallow_water.py:270-403``.
+    """
+    c = config
+    ny, nx = c.ny_local, c.nx_local
+    dt, dx, dy, g = c.dt, c.dx, c.dy, c.gravity
+    h, u, v, dh_old, du_old, dv_old = slab
+    f32 = h.dtype
+
+    # shifts via jnp.roll: the wrapped-around rows/cols carry values
+    # from the far side of the slab — garbage for the formula, but
+    # always finite in-array data, and every use is either inside the
+    # halo margin or mask-resolved (see module docstring)
+    def yp(a):  # value at row i+1
+        return jnp.roll(a, -1, 0)
+
+    def ym(a):  # value at row i-1
+        return jnp.roll(a, 1, 0)
+
+    def xp(a):  # value at col j+1
+        return jnp.roll(a, -1, 1)
+
+    def xm(a):  # value at col j-1
+        return jnp.roll(a, 1, 1)
+
+    row_i = (grow >= 1) & (grow <= ny - 2)
+    col_i = (gcol >= 1) & (gcol <= nx - 2)
+    imask = row_i & col_i
+    zero = jnp.zeros((), f32)
+
+    def interior(expr, base=None):
+        return jnp.where(imask, expr, zero if base is None else base)
+
+    wrap = functools.partial(_wrap_cols, gcol=gcol, nx=nx)
+
+    # -- 1. hc: edge-padded interior of h, then periodic wrap ---------
+    hrow = jnp.where(grow == 0, yp(h), jnp.where(grow == ny - 1, ym(h), h))
+    hc = wrap(hrow)
+
+    # -- 2. volume fluxes at cell faces -------------------------------
+    fe = wrap(interior(0.5 * (hc + xp(hc)) * u))
+    fn = wrap(interior(0.5 * (hc + yp(hc)) * v))
+    fn = jnp.where(grow == ny - 2, zero, fn)  # v-grid northern wall
+
+    # -- 3. continuity ------------------------------------------------
+    dh_new = interior(-(fe - xm(fe)) / dx - (fn - ym(fn)) / dy)
+
+    # -- 4. potential vorticity + kinetic energy ----------------------
+    rel_vort = (xp(v) - v) / dx - (yp(u) - u) / dy
+    face_h = 0.25 * (hc + xp(hc) + yp(hc) + xp(yp(hc)))
+    f_cor = (c.coriolis_f
+             + (grow.astype(f32) - 1.0) * c.dy * c.coriolis_beta)
+    q = wrap(interior((f_cor + rel_vort) / face_h))
+    ke = wrap(interior(
+        0.5 * (0.5 * (u * u + xm(u) * xm(u)) + 0.5 * (v * v + ym(v) * ym(v)))
+    ))
+
+    # -- 5. momentum tendencies ---------------------------------------
+    du_new = interior(
+        -g * (xp(h) - h) / dx
+        + 0.5 * (q * 0.5 * (fn + xp(fn)) + ym(q) * 0.5 * (ym(fn) + xp(ym(fn))))
+        - (xp(ke) - ke) / dx
+    )
+    dv_new = interior(
+        -g * (yp(h) - h) / dy
+        - 0.5 * (q * 0.5 * (fe + yp(fe)) + xm(q) * 0.5 * (xm(fe) + yp(xm(fe))))
+        - (yp(ke) - ke) / dy
+    )
+
+    # -- 6. Adams-Bashforth 2 update (interior; ghosts pass through) --
+    a_c, b_c = c.adams_bashforth_a, c.adams_bashforth_b
+    u_mid = interior(u + dt * (a_c * du_new + b_c * du_old), u)
+    v_mid = interior(v + dt * (a_c * dv_new + b_c * dv_old), v)
+    h_new = interior(h + dt * (a_c * dh_new + b_c * dh_old), h)
+
+    # -- 7. boundary enforcement on the updated state -----------------
+    h_new = wrap(h_new)
+    u_mid = wrap(u_mid)
+    v_mid = jnp.where(grow == ny - 2, zero, wrap(v_mid))
+
+    # -- 8. lateral friction (interior update only; ghost columns keep
+    #       the pre-friction wrap, exactly like the reference) --------
+    u_out, v_out = u_mid, v_mid
+    if c.viscosity > 0:
+        nu = c.viscosity
+        ge_u = wrap(interior(nu * (xp(u_mid) - u_mid) / dx))
+        gn_u = jnp.where(grow == ny - 2, zero,
+                         wrap(interior(nu * (yp(u_mid) - u_mid) / dy)))
+        ge_v = wrap(interior(nu * (xp(v_mid) - v_mid) / dx))
+        gn_v = jnp.where(grow == ny - 2, zero,
+                         wrap(interior(nu * (yp(v_mid) - v_mid) / dy)))
+        u_out = interior(
+            u_mid + dt * ((ge_u - xm(ge_u)) / dx + (gn_u - ym(gn_u)) / dy),
+            u_mid,
+        )
+        v_out = interior(
+            v_mid + dt * ((ge_v - xm(ge_v)) / dx + (gn_v - ym(gn_v)) / dy),
+            v_mid,
+        )
+
+    return h_new, u_out, v_out, dh_new, du_new, dv_new
+
+
+def _make_kernel(config: ShallowWaterConfig, block_rows: int, nyp: int):
+    nx = padded_cols(config)  # physical width; masks use the real nx
+    slab_rows = block_rows + 2 * HALO
+    n_tiles = nyp // block_rows
+
+    def kernel(*refs):
+        ins = refs[:6]
+        outs = refs[6:12]
+        slab_ref, sems = refs[12], refs[13]
+
+        i = pl.program_id(0)
+
+        def slab_start(idx):
+            # clamped DMA window: always slab_rows tall, inside [0, nyp).
+            # Written as 8 * (clipped term) so Mosaic can prove the row
+            # offset is sublane-aligned; block_rows and HALO are both
+            # multiples of 8. (int32-explicit for jax_enable_x64 runs.)
+            q = jnp.clip(
+                idx * jnp.int32(block_rows // 8) - jnp.int32(HALO // 8),
+                jnp.int32(0),
+                jnp.int32((nyp - slab_rows) // 8),
+            )
+            return q * jnp.int32(8)
+
+        def start_dma(idx, slot):
+            s = slab_start(idx)
+            for k in range(6):
+                pltpu.make_async_copy(
+                    ins[k].at[pl.ds(s, slab_rows)],
+                    slab_ref.at[slot, k],
+                    sems.at[slot, k],
+                ).start()
+
+        def wait_dma(idx, slot):
+            s = slab_start(idx)
+            for k in range(6):
+                pltpu.make_async_copy(
+                    ins[k].at[pl.ds(s, slab_rows)],
+                    slab_ref.at[slot, k],
+                    sems.at[slot, k],
+                ).wait()
+
+        slot = lax.rem(i, jnp.int32(2))
+
+        @pl.when(i == 0)
+        def _():
+            start_dma(jnp.int32(0), jnp.int32(0))
+
+        @pl.when(i + 1 < n_tiles)
+        def _():
+            start_dma(i + jnp.int32(1), lax.rem(i + jnp.int32(1), jnp.int32(2)))
+
+        wait_dma(i, slot)
+
+        s = slab_start(i)
+        grow = s + lax.broadcasted_iota(jnp.int32, (slab_rows, nx), 0)
+        gcol = lax.broadcasted_iota(jnp.int32, (slab_rows, nx), 1)
+        slab = tuple(slab_ref[slot, k] for k in range(6))
+
+        results = _slab_step(config, slab, grow, gcol)
+
+        # Center offset inside the slab is 0 for the first tile (DMA
+        # window clamped at the top), 2*HALO for the last (clamped at
+        # the bottom) and HALO otherwise — requires block_rows >= HALO
+        # so interior windows never clamp. Mosaic has no value-level
+        # dynamic_slice, so select between the three static slices.
+        for k in range(6):
+            r = results[k]
+            first = lax.slice_in_dim(r, 0, block_rows, axis=0)
+            mid = lax.slice_in_dim(r, HALO, HALO + block_rows, axis=0)
+            last = lax.slice_in_dim(r, 2 * HALO, 2 * HALO + block_rows, axis=0)
+            outs[k][...] = jnp.where(
+                i == 0, first,
+                jnp.where(i == n_tiles - 1, last, mid),
+            )
+
+    return kernel, slab_rows, n_tiles
+
+
+def fused_step(config: ShallowWaterConfig, state: ModelState, *,
+               block_rows: int = 64, interpret: bool = False) -> ModelState:
+    """One AB2 step on a row-padded state via the fused kernel."""
+    if config.n_ranks != 1:
+        raise NotImplementedError(
+            "fused_step is single-rank only; the SPMD path uses "
+            "ShallowWaterModel.step (see module docstring)"
+        )
+    if not config.periodic_x:
+        raise NotImplementedError("fused_step requires periodic_x")
+    if block_rows < HALO or block_rows % 8:
+        raise ValueError(f"block_rows must be a multiple of 8, >= {HALO}")
+    nyp = padded_rows(config, block_rows)
+    if nyp // block_rows < 2 or nyp < block_rows + 2 * HALO:
+        # the second clause keeps the clamped DMA window inside the
+        # array: nyp < slab_rows would invert the clamp bounds and
+        # produce a negative row offset (out-of-bounds HBM window)
+        raise ValueError(
+            "need at least two row tiles and "
+            f"ny_local padded >= block_rows + {2 * HALO}; "
+            "lower block_rows for this grid"
+        )
+    nx = padded_cols(config)
+    dtype = state.h.dtype
+    if dtype not in (jnp.float32, jnp.float64):
+        # f32 is the TPU path; f64 is accepted for interpret-mode
+        # equivalence testing (tests/test_fused_step.py)
+        raise NotImplementedError("fused_step supports float32/float64 state")
+    for f in state:
+        assert f.shape == (nyp, nx), (
+            f"state must be row-padded to {(nyp, nx)} (pad_state); got "
+            f"{f.shape}"
+        )
+
+    kernel, slab_rows, n_tiles = _make_kernel(config, block_rows, nyp)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 6,
+        out_specs=[
+            pl.BlockSpec((block_rows, nx), lambda i: (i, 0))
+            for _ in range(6)
+        ],
+        out_shape=[jax.ShapeDtypeStruct((nyp, nx), dtype)] * 6,
+        scratch_shapes=[
+            pltpu.VMEM((2, 6, slab_rows, nx), dtype),
+            pltpu.SemaphoreType.DMA((2, 6)),
+        ],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            # the double-buffered slabs + output pipeline exceed the
+            # 16 MiB default scoped-vmem limit at useful block sizes;
+            # v5e has far more physical VMEM, so raise the cap
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(*state)
+    return ModelState(*out)
+
+
+def fused_multistep(config: ShallowWaterConfig, state: ModelState,
+                    num_steps: int, *, block_rows: int = 64,
+                    interpret: bool = False) -> ModelState:
+    """``num_steps`` fused steps; state must already be row-padded."""
+    return lax.fori_loop(
+        0,
+        num_steps,
+        lambda _, s: fused_step(
+            config, s, block_rows=block_rows, interpret=interpret
+        ),
+        state,
+    )

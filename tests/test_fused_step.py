@@ -1,0 +1,150 @@
+"""Fused Pallas shallow-water step vs the composable XLA step.
+
+The fused kernel (``models/fused_step.py``) collapses the whole AB2
+step into one Pallas pass and is the single-chip benchmark hot path
+(``bench.py``). Its correctness contract is *algebraic equivalence*
+with :meth:`ShallowWaterModel.step` (reference physics
+``shallow_water.py:270-403``): in float64 the two trajectories must
+agree to reordering error (~1e-13), which a boundary/indexing bug
+cannot hide under. f64 requires ``jax_enable_x64`` before backend
+init, so that check runs in a subprocess like ``test_x64_ops.py``;
+the in-process tests cover the f32 interpret path, pad/crop plumbing
+and the guard rails.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi4jax_tpu.models import fused_step as fs
+from mpi4jax_tpu.models.shallow_water import (
+    ModelState,
+    ShallowWaterConfig,
+    ShallowWaterModel,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _small_model():
+    cfg = ShallowWaterConfig(nx=48, ny=30, dims=(1, 1))
+    model = ShallowWaterModel(cfg)
+    state = ModelState(*(jnp.asarray(b[0]) for b in model.initial_state_blocks()))
+    return cfg, model, state
+
+
+def test_pad_crop_roundtrip():
+    cfg, _, state = _small_model()
+    padded = fs.pad_state(cfg, state, 8)
+    assert padded.h.shape == (fs.padded_rows(cfg, 8), fs.padded_cols(cfg))
+    back = fs.crop_state(cfg, padded)
+    for a, b in zip(state, back):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fused_matches_xla_step_f32_interpret():
+    cfg, model, state = _small_model()
+    ref = model.step(state, first_step=True)
+    cur = fs.pad_state(cfg, ref, 8)
+    for n in range(1, 5):
+        ref = model.step(ref)
+        cur = fs.fused_step(cfg, cur, block_rows=8, interpret=True)
+        got = fs.crop_state(cfg, cur)
+        for name, a, b in zip(ModelState._fields, ref, got):
+            d = float(jnp.max(jnp.abs(a - b)))
+            scale = 1.0 + float(jnp.max(jnp.abs(a)))
+            assert d / scale < 1e-5, (n, name, d)
+
+
+def test_fused_multistep_equals_repeated_steps():
+    cfg, model, state = _small_model()
+    state = model.step(state, first_step=True)
+    pad = fs.pad_state(cfg, state, 8)
+    a = fs.fused_multistep(cfg, pad, 3, block_rows=8, interpret=True)
+    b = pad
+    for _ in range(3):
+        b = fs.fused_step(cfg, b, block_rows=8, interpret=True)
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=0, atol=0)
+
+
+def test_guard_rails():
+    cfg, model, state = _small_model()
+    padded = fs.pad_state(cfg, state, 8)
+    with pytest.raises(ValueError, match="multiple of 8"):
+        fs.fused_step(cfg, padded, block_rows=12, interpret=True)
+    with pytest.raises(ValueError, match="two row tiles"):
+        fs.fused_step(cfg, padded, block_rows=32, interpret=True)
+    # nyp < block_rows + 2*HALO would invert the DMA-window clamp and
+    # compute a negative (out-of-bounds) slab offset
+    tiny = ShallowWaterConfig(nx=48, ny=14, dims=(1, 1))
+    tiny_model = ShallowWaterModel(tiny)
+    tiny_state = ModelState(
+        *(jnp.asarray(b[0]) for b in tiny_model.initial_state_blocks())
+    )
+    tiny_pad = fs.pad_state(tiny, tiny_state, 8)
+    with pytest.raises(ValueError, match="two row tiles"):
+        fs.fused_step(tiny, tiny_pad, block_rows=8, interpret=True)
+    spmd_cfg = ShallowWaterConfig(nx=48, ny=30, dims=(2, 1))
+    with pytest.raises(NotImplementedError, match="single-rank"):
+        fs.fused_step(spmd_cfg, padded, block_rows=8, interpret=True)
+    walls = ShallowWaterConfig(nx=48, ny=30, dims=(1, 1), periodic_x=False)
+    with pytest.raises(NotImplementedError, match="periodic_x"):
+        fs.fused_step(walls, padded, block_rows=8, interpret=True)
+
+
+_F64_SCRIPT = """
+import sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+import jax.numpy as jnp
+from mpi4jax_tpu.models.shallow_water import (
+    ModelState, ShallowWaterConfig, ShallowWaterModel,
+)
+from mpi4jax_tpu.models import fused_step as fs
+
+cfg = ShallowWaterConfig(nx=48, ny=30, dims=(1, 1), dtype=np.float64)
+model = ShallowWaterModel(cfg)
+state = ModelState(
+    *(jnp.asarray(b[0], jnp.float64) for b in model.initial_state_blocks())
+)
+ref = model.step(state, first_step=True)
+cur = fs.pad_state(cfg, ref, 8)
+worst = 0.0
+for _ in range(8):
+    ref = model.step(ref)
+    cur = fs.fused_step(cfg, cur, block_rows=8, interpret=True)
+    got = fs.crop_state(cfg, cur)
+    for a, b in zip(ref, got):
+        d = float(jnp.max(jnp.abs(a - b)))
+        worst = max(worst, d / (1.0 + float(jnp.max(jnp.abs(a)))))
+assert worst < 1e-12, f"systematic divergence: {{worst:.3e}}"
+print(f"f64 worst scaled diff over 8 steps: {{worst:.3e}}")
+"""
+
+
+def test_fused_matches_xla_step_f64_subprocess():
+    """f64 equivalence: reordering-level agreement (~1e-13), the
+    discriminating test a boundary bug cannot pass."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", textwrap.dedent(_F64_SCRIPT.format(repo=REPO))],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "worst scaled diff" in proc.stdout
