@@ -144,86 +144,6 @@ def supervise():
     return rc
 
 
-#: kernel row-tile size for the fused single-chip path; 128 is the
-#: largest block that fits VMEM on v5e and measured fastest
-#: (1.04 ms/step vs 4.8 ms for the XLA step at the published grid)
-FUSED_BLOCK_ROWS = 128
-
-
-def _try_fused(config, model, multistep, state, first):
-    """Build the fused-Pallas hot loop if it proves itself on-device.
-
-    Runs a 3-step equivalence probe (fused kernel vs the XLA step) on
-    the *actual benchmark grid*, starting from the caller's initial
-    ``state`` and compiled ``first``-step (so neither is rebuilt), and
-    only routes the benchmark through the fused path if the
-    trajectories agree; any compile or numerics failure falls back to
-    the XLA path with a note. Returns ``{"pad", "multi", "crop"}`` or
-    ``None``.
-    """
-    import jax
-    import jax.numpy as jnp
-
-    try:
-        from mpi4jax_tpu.models import fused_step as fs
-
-        B = FUSED_BLOCK_ROWS
-        while B >= fs.HALO and (
-            fs.padded_rows(config, B) // B < 2
-            or fs.padded_rows(config, B) < B + 2 * fs.HALO
-        ):
-            B //= 2
-        if B < fs.HALO or B % 8:
-            return None
-
-        probe = first(state)
-        ref = jax.jit(lambda s: model.multistep(s, 3))(probe)
-        fu = fs.crop_state(
-            config,
-            jax.jit(
-                lambda s: fs.fused_multistep(config, s, 3, block_rows=B)
-            )(fs.pad_state(config, probe, B)),
-        )
-        # mixed abs/rel criterion: near-zero fields (v starts at 0)
-        # make a pure relative test fire on sub-ULP reordering noise,
-        # so each field's diff is scaled by (1 + its own magnitude) —
-        # a genuine boundary/indexing bug shows up as O(field) diffs
-        worst = 0.0
-        for a, b in zip(ref[:3], fu[:3]):  # h, u, v
-            d = float(jnp.max(jnp.abs(a - b)))
-            scale_ = 1.0 + float(jnp.max(jnp.abs(a)))
-            worst = max(worst, d / scale_)
-        if not (worst < 1e-4):
-            print(
-                f"# fused-step probe mismatch (rel {worst:.2e}); "
-                "using XLA path",
-                file=sys.stderr,
-            )
-            return None
-        print(
-            f"# fused Pallas step verified on-device (rel {worst:.2e}, "
-            f"block_rows={B})",
-            file=sys.stderr,
-        )
-        return {
-            "pad": lambda s: fs.pad_state(config, s, B),
-            "multi": jax.jit(
-                lambda s: fs.fused_multistep(
-                    config, s, multistep, block_rows=B
-                ),
-                donate_argnums=0,
-            ),
-            "crop": lambda s: fs.crop_state(config, s),
-        }
-    except Exception as e:  # pragma: no cover - defensive fallback
-        print(
-            f"# fused-step path unavailable ({type(e).__name__}: "
-            f"{str(e)[:120]}); using XLA path",
-            file=sys.stderr,
-        )
-        return None
-
-
 def main():
     import jax
 
@@ -294,7 +214,12 @@ def main():
         # donate the state: the hot loop updates in place in HBM
         multi = jax.jit(lambda s: model.multistep(s, multistep), donate_argnums=0)
         if not on_cpu_platform and os.environ.get("M4T_BENCH_FUSED", "1") != "0":
-            fused = _try_fused(config, model, multistep, state, first)
+            from mpi4jax_tpu.models.fused_step import verified_hot_loop
+
+            fused = verified_hot_loop(
+                config, model, multistep, state, first,
+                log=lambda m: print(f"# {m}", file=sys.stderr),
+            )
 
     # Timings close with device_sync (a one-element host fetch), not
     # block_until_ready: the axon tunnel's PJRT resolves ready-events

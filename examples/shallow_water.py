@@ -47,6 +47,13 @@ def parse_args():
         "reference's JAX_PLATFORM_NAME benchmark switch "
         "(docs/shallow-water.rst:56-91)",
     )
+    p.add_argument(
+        "--fused", choices=("auto", "on", "off"), default="auto",
+        help="single-rank hot loop: 'on' = fused Pallas step "
+        "(models/fused_step.py, compiled Mosaic — accelerator only), "
+        "'off' = composable XLA step, 'auto' = fused on accelerators "
+        "when a 3-step equivalence probe passes (default)",
+    )
     return p.parse_args()
 
 
@@ -99,6 +106,7 @@ def main():
 
     state0 = model.initial_state_blocks()
 
+    fused = None
     if shm_world or n == 1:
         # one process, one block: jit the per-rank step directly. In a
         # launcher world each process owns block `rank` and the halo
@@ -109,7 +117,32 @@ def main():
         multi = jax.jit(
             lambda s: model.multistep(s, args.multistep), donate_argnums=0
         )
+        if shm_world:
+            if args.fused == "on":
+                raise SystemExit(
+                    "--fused on: the fused Pallas step is single-rank only "
+                    "(launcher worlds use the composable shm halo exchange)"
+                )
+        elif args.fused != "off":
+            on_cpu = jax.devices()[0].platform == "cpu"
+            if args.fused == "on" or not on_cpu:
+                from mpi4jax_tpu.models.fused_step import verified_hot_loop
+
+                fused = verified_hot_loop(
+                    config, model, args.multistep, state, first,
+                    log=lambda m: print(m, file=sys.stderr),
+                )
+                if fused is None and args.fused == "on":
+                    raise SystemExit(
+                        "--fused on: fused Pallas path unavailable on this "
+                        "platform/grid"
+                    )
     else:
+        if args.fused == "on":
+            raise SystemExit(
+                "--fused on: the fused Pallas step is single-rank only "
+                "(multi-rank meshes use the composable SPMD halo exchange)"
+            )
         mesh = world_mesh(n)
         state = ModelState(*(jnp.asarray(b) for b in state0))
         first = spmd(lambda s: model.step(s, first_step=True), mesh=mesh)
@@ -126,6 +159,9 @@ def main():
     from mpi4jax_tpu.utils.profiling import device_sync
 
     state = first(state)
+    if fused is not None:
+        state = fused["pad"](state)
+        multi = fused["multi"]
     # warm-up compile of the hot loop (excluded from timing, like the
     # reference's pre-compile call, shallow_water.py:441); the state is
     # donated so keep the advanced result (and its frame) and time one
@@ -138,6 +174,8 @@ def main():
         launcher world each process holds one block, so gather to rank
         0 (reference post-processing: gather(sol, root=0),
         shallow_water.py:579-586); other ranks record nothing."""
+        if fused is not None:
+            st = fused["crop"](st)
         if shm_world:
             import mpi4jax_tpu as m4t
 
