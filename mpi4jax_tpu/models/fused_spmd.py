@@ -1,0 +1,272 @@
+"""Deep-halo fused SPMD shallow-water step — communication-avoiding.
+
+The composable SPMD path (:meth:`ShallowWaterModel.step`) interleaves
+compute with **five** halo-exchange groups per step (~10 directional
+``sendrecv`` collectives), because each intermediate field (fluxes,
+vorticity, energy, friction fluxes) needs fresh ghosts before the
+next stage reads them — a faithful port of the reference's exchange
+placement (``shallow_water.py:270-403``). On an ICI mesh every one of
+those exchanges is a latency-bound CollectivePermute of a single
+ghost row.
+
+This module restructures the step the TPU-first way instead:
+
+1. **One exchange phase per step.** Each rank sends its neighbors a
+   *deep* halo — 3 interior rows of (h, u, v) plus 1 row of the AB2
+   tendencies, packed into a single ``(12, width)`` strip per
+   direction — so the whole step's dependency cone is local
+   afterwards. 2 batched ``sendrecv`` collectives per step instead of
+   ~10: same O(rows) payload, a tenth of the latency terms.
+2. **One fused kernel per rank.** With the deep halo in place, the
+   entire AB2 step runs as the single-pass Pallas kernel of
+   :mod:`.fused_step`, recomputing intermediate quantities redundantly
+   in the 3-row overlap (the classic communication-avoiding trade:
+   a few extra stencil FLOPs, which are free under the HBM-bandwidth
+   roof, for 5x fewer collectives).
+
+Scope: row decomposition ``dims = (n, 1)`` (each rank owns full-width
+row bands, so the periodic-x wrap stays rank-local and the y-walls
+resolve by the rank's global row offset, fed to the kernel as an SMEM
+scalar). Float32, ``periodic_x``, AB2 steps (the single Euler first
+step runs on the composable path once).
+
+State contract: per-rank blocks in the standard ``(ny_local,
+nx_local)`` layout with a 1-cell ghost rim. **Interior rows are
+exact** (equivalent to the composable path to float reordering —
+pinned by ``tests/test_fused_spmd.py`` incl. an f64 ~1e-13 check);
+ghost rows of the *returned* state are unspecified (they are
+refreshed at the top of every step, never consumed stale).
+
+Internally the state rides in an *extended* layout with 2 extra rows
+per side (total ghost depth 3) plus the usual lane/tile padding; rows
+outside the domain hold finite don't-care values that the masks keep
+out of every interior result.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..comm import CartComm, WORLD_AXIS
+from ..ops import sendrecv
+from .shallow_water import ModelState, ShallowWaterConfig
+from . import fused_step as fs
+
+#: extra rows beyond the standard block on each side (ghost depth
+#: 1 + EXT = 3 = the step's full dependency radius)
+EXT = 2
+
+#: sendtags for the two exchange directions; distinct from the
+#: composable exchange's 10-13 so both paths can coexist in one trace
+TAG_NORTH = 14
+TAG_SOUTH = 15
+
+
+class FusedRowDecomp:
+    """Deep-halo fused stepper over a ``(n, 1)`` row decomposition.
+
+    Use inside :func:`mpi4jax_tpu.parallel.spmd` (or a launcher world)
+    exactly like the composable model::
+
+        model = ShallowWaterModel(config)          # dims=(n, 1)
+        stepper = FusedRowDecomp(config)
+        state = spmd(lambda s: model.step(s, first_step=True))(state)
+        state = spmd(lambda s: stepper.multistep(s, 100))(state)
+    """
+
+    def __init__(self, config: ShallowWaterConfig, axis: str = WORLD_AXIS,
+                 *, block_rows: int = fs.DEFAULT_BLOCK_ROWS,
+                 interpret: bool = False):
+        npy, npx = config.dims
+        if npx != 1:
+            raise NotImplementedError(
+                "FusedRowDecomp requires a row decomposition dims=(n, 1); "
+                f"got {config.dims}"
+            )
+        if not config.periodic_x:
+            raise NotImplementedError("FusedRowDecomp requires periodic_x")
+        if config.ny_local < 5:
+            raise ValueError(
+                "deep-halo exchange needs >= 3 interior rows per rank "
+                f"(ny_local >= 5); got ny_local={config.ny_local}"
+            )
+        self.config = config
+        self.cart = CartComm(
+            dims=config.dims, periods=(False, config.periodic_x), axis=axis
+        )
+        self._north = self.cart.shift(0, +1)
+        self._south = self.cart.shift(0, -1)
+
+        nyl = config.ny_local
+        self.ext_rows = nyl + 2 * EXT
+        b = fs.fit_block_rows(self.ext_rows, block_rows)
+        if b is None:
+            raise ValueError(
+                f"no legal block size <= {block_rows} for "
+                f"{self.ext_rows} extended rows"
+            )
+        self.block_rows = b
+        self.interpret = interpret
+        self.nx_pad = fs.padded_cols(config)
+
+    def _padded_ext(self, block_rows: int) -> int:
+        return -(-self.ext_rows // block_rows) * block_rows
+
+    # -- layout -----------------------------------------------------------
+
+    def extend(self, state: ModelState) -> ModelState:
+        """Standard per-rank block -> extended + padded layout."""
+        c = self.config
+        nyp = self._padded_ext(self.block_rows)
+        pr = nyp - c.ny_local - EXT  # trailing rows: EXT + tile padding
+        pc = self.nx_pad - c.nx_local
+        pads = ((EXT, pr), (0, pc))
+        return ModelState(
+            h=jnp.pad(state.h, pads, constant_values=1.0),
+            u=jnp.pad(state.u, pads),
+            v=jnp.pad(state.v, pads),
+            dh=jnp.pad(state.dh, pads),
+            du=jnp.pad(state.du, pads),
+            dv=jnp.pad(state.dv, pads),
+        )
+
+    def crop(self, ext: ModelState) -> ModelState:
+        c = self.config
+        return ModelState(
+            *(f[EXT : EXT + c.ny_local, : c.nx_local] for f in ext)
+        )
+
+    # -- exchange ---------------------------------------------------------
+
+    def _exchange(self, ext: ModelState) -> ModelState:
+        """The single deep-halo refresh: 2 batched sendrecvs.
+
+        Extended-row coordinates (``e = standard_row + EXT``):
+
+        - northward strip: own interior rows ``s in [nyl-4, nyl-2]``
+          of h/u/v plus tendency row ``s = nyl-2``; lands in the
+          receiver's bottom extension ``e in [0, 3)`` / ``e = 2``.
+        - southward strip: own rows ``s in [1, 3]`` plus tendency row
+          ``s = 1``; lands in the receiver's top extension
+          ``e in [E-3, E)`` / ``e = E-3``.
+
+        Edge ranks' missing neighbors are PROC_NULL: the recv template
+        comes back unchanged and the kernel's domain-boundary masks
+        own those rows.
+        """
+        c = self.config
+        nyl = c.ny_local
+        E = nyl + 2 * EXT
+        h, u, v, dh, du, dv = ext
+
+        def huv(lo, hi):
+            return [h[lo:hi], u[lo:hi], v[lo:hi]]
+
+        def tend(lo, hi):
+            return [dh[lo:hi], du[lo:hi], dv[lo:hi]]
+
+        def put(fields, rows_lo_huv, rows_lo_t, got):
+            hh, uu, vv, dhh, duu, dvv = fields
+            hh = hh.at[rows_lo_huv : rows_lo_huv + 3].set(got[0:3])
+            uu = uu.at[rows_lo_huv : rows_lo_huv + 3].set(got[3:6])
+            vv = vv.at[rows_lo_huv : rows_lo_huv + 3].set(got[6:9])
+            dhh = dhh.at[rows_lo_t : rows_lo_t + 1].set(got[9:10])
+            duu = duu.at[rows_lo_t : rows_lo_t + 1].set(got[10:11])
+            dvv = dvv.at[rows_lo_t : rows_lo_t + 1].set(got[11:12])
+            return hh, uu, vv, dhh, duu, dvv
+
+        # e-coords of the strips (s + EXT)
+        n_src_lo = nyl - 2          # s = nyl-4
+        s_src_lo = EXT + 1          # s = 1
+
+        src, dst = self._north
+        payload = jnp.concatenate(
+            huv(n_src_lo, n_src_lo + 3) + tend(nyl, nyl + 1)
+        )
+        template = jnp.concatenate(huv(0, 3) + tend(EXT, EXT + 1))
+        got = sendrecv(
+            payload, template, src, dst, sendtag=TAG_NORTH, comm=self.cart
+        )
+        h, u, v, dh, du, dv = put((h, u, v, dh, du, dv), 0, EXT, got)
+
+        src, dst = self._south
+        payload = jnp.concatenate(
+            huv(s_src_lo, s_src_lo + 3) + tend(s_src_lo, s_src_lo + 1)
+        )
+        template = jnp.concatenate(huv(E - 3, E) + tend(E - 3, E - 2))
+        got = sendrecv(
+            payload, template, src, dst, sendtag=TAG_SOUTH, comm=self.cart
+        )
+        h, u, v, dh, du, dv = put((h, u, v, dh, du, dv), E - 3, E - 3, got)
+
+        return ModelState(h, u, v, dh, du, dv)
+
+    # -- kernel -----------------------------------------------------------
+
+    def _kernel_step(self, ext: ModelState) -> ModelState:
+        c = self.config
+        nyp = self._padded_ext(self.block_rows)
+        kernel, slab_rows, n_tiles = fs._make_kernel(
+            c,
+            self.block_rows,
+            nyp,
+            ny=c.ny_global,
+            nx_real=c.nx_local,  # full width per rank (dims=(n,1))
+            nx_pad=self.nx_pad,
+            with_rank_offset=True,
+        )
+        # grow must be the domain-global row index: extended row e of
+        # rank r sits at global row r*(ny_local-2) + (e - EXT), so the
+        # kernel adds offset = r*(ny_local-2) - EXT (traced, one
+        # program for all ranks; dims=(n,1) makes rank == proc_row)
+        proc_row = self.cart.Get_rank()
+        offset = jnp.asarray(
+            proc_row * (c.ny_local - 2) - EXT, jnp.int32
+        ).reshape(1)
+        out = pl.pallas_call(
+            kernel,
+            grid=(n_tiles,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+            + [pl.BlockSpec(memory_space=pl.ANY)] * 6,
+            out_specs=[
+                pl.BlockSpec(
+                    (self.block_rows, self.nx_pad), lambda i: (i, 0)
+                )
+                for _ in range(6)
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((nyp, self.nx_pad), ext.h.dtype)
+            ] * 6,
+            scratch_shapes=[
+                pltpu.VMEM((2, 6, slab_rows, self.nx_pad), ext.h.dtype),
+                pltpu.SemaphoreType.DMA((2, 6)),
+            ],
+            compiler_params=None if self.interpret else pltpu.CompilerParams(
+                dimension_semantics=("arbitrary",),
+                vmem_limit_bytes=100 * 1024 * 1024,
+            ),
+            interpret=self.interpret,
+        )(offset, *ext)
+        return ModelState(*out)
+
+    # -- public step API --------------------------------------------------
+
+    def step_extended(self, ext: ModelState) -> ModelState:
+        """One AB2 step on the extended layout: exchange, then fuse."""
+        return self._kernel_step(self._exchange(ext))
+
+    def multistep(self, state: ModelState, num_steps: int) -> ModelState:
+        """``num_steps`` deep-halo fused steps on a standard per-rank
+        block (jittable; run inside ``parallel.spmd`` or a launcher
+        world)."""
+        ext = self.extend(state)
+        ext = lax.fori_loop(
+            0, num_steps, lambda _, e: self.step_extended(e), ext
+        )
+        return self.crop(ext)

@@ -73,6 +73,26 @@ def padded_rows(config: ShallowWaterConfig, block_rows: int) -> int:
     return -(-ny // block_rows) * block_rows
 
 
+def block_rows_legal(rows: int, block_rows: int) -> bool:
+    """The tiling constraints every fused-kernel launch must satisfy:
+    blocks are sublane-quantum multiples >= HALO, at least two tiles,
+    and the padded height holds a full clamped DMA slab (otherwise the
+    window clamp inverts into a negative, out-of-bounds row offset)."""
+    if block_rows < HALO or block_rows % 8:
+        return False
+    padded = -(-rows // block_rows) * block_rows
+    return padded // block_rows >= 2 and padded >= block_rows + 2 * HALO
+
+
+def fit_block_rows(rows: int, requested: int):
+    """Largest legal block size <= ``requested`` for ``rows`` total
+    rows (halving search), or ``None`` if no legal size exists."""
+    b = requested
+    while b >= HALO and not block_rows_legal(rows, b):
+        b //= 2
+    return b if b >= HALO and b % 8 == 0 else None
+
+
 def padded_cols(config: ShallowWaterConfig) -> int:
     """Column count after padding to the 128-lane quantum."""
     nx = config.nx_local
@@ -120,22 +140,27 @@ def _wrap_cols(a, gcol, nx):
 
 
 def _slab_step(config: ShallowWaterConfig, slab: Tuple[jax.Array, ...],
-               grow: jax.Array, gcol: jax.Array):
+               grow: jax.Array, gcol: jax.Array,
+               ny: int = None, nx: int = None):
     """One full AB2 step evaluated on a row slab.
 
-    ``slab`` holds (h, u, v, dh, du, dv), each ``(rows, nx)``; ``grow``
-    / ``gcol`` are the *global* row/column indices of each slab element
-    (int32, same shape). Rows whose dependencies fall outside the slab
-    produce garbage that the caller must not read — valid only for the
-    center ``rows - 2*HALO`` rows (plus physical-boundary rows, which
-    are mask-resolved). Returns the six updated fields, full slab
-    shape.
+    ``slab`` holds (h, u, v, dh, du, dv), each ``(rows, width)``;
+    ``grow`` / ``gcol`` are the *domain* row/column indices of each
+    slab element (int32, same shape — for the SPMD deep-halo variant
+    ``grow`` may be a traced array offset by the rank's position, so
+    all comparisons below stay elementwise). ``ny``/``nx`` are the
+    domain extents the boundary masks close over (defaults: the
+    single-rank local grid). Rows whose dependencies fall outside the
+    slab produce garbage that the caller must not read — valid only
+    for the center rows (plus physical-boundary rows, which are
+    mask-resolved). Returns the six updated fields, full slab shape.
 
     Mirrors ``ShallowWaterModel.step`` stage for stage; the reference
     physics is ``shallow_water.py:270-403``.
     """
     c = config
-    ny, nx = c.ny_local, c.nx_local
+    ny = c.ny_local if ny is None else ny
+    nx = c.nx_local if nx is None else nx
     dt, dx, dy, g = c.dt, c.dx, c.dy, c.gravity
     h, u, v, dh_old, du_old, dv_old = slab
     f32 = h.dtype
@@ -234,12 +259,26 @@ def _slab_step(config: ShallowWaterConfig, slab: Tuple[jax.Array, ...],
     return h_new, u_out, v_out, dh_new, du_new, dv_new
 
 
-def _make_kernel(config: ShallowWaterConfig, block_rows: int, nyp: int):
-    nx = padded_cols(config)  # physical width; masks use the real nx
+def _make_kernel(config: ShallowWaterConfig, block_rows: int, nyp: int,
+                 *, ny: int = None, nx_real: int = None, nx_pad: int = None,
+                 with_rank_offset: bool = False):
+    """Build the fused-step kernel body.
+
+    Defaults produce the single-rank kernel. The SPMD deep-halo
+    variant (``fused_spmd.py``) passes the *global* domain extents for
+    the boundary masks and ``with_rank_offset=True``, which prepends
+    an SMEM scalar input carrying the rank's global row offset so
+    ``grow`` becomes a domain-global row index.
+    """
+    nx = nx_pad if nx_pad is not None else padded_cols(config)
+    ny_dom = config.ny_local if ny is None else ny
+    nx_dom = config.nx_local if nx_real is None else nx_real
     slab_rows = block_rows + 2 * HALO
     n_tiles = nyp // block_rows
 
     def kernel(*refs):
+        if with_rank_offset:
+            off_ref, refs = refs[0], refs[1:]
         ins = refs[:6]
         outs = refs[6:12]
         slab_ref, sems = refs[12], refs[13]
@@ -290,10 +329,12 @@ def _make_kernel(config: ShallowWaterConfig, block_rows: int, nyp: int):
 
         s = slab_start(i)
         grow = s + lax.broadcasted_iota(jnp.int32, (slab_rows, nx), 0)
+        if with_rank_offset:
+            grow = grow + off_ref[0]
         gcol = lax.broadcasted_iota(jnp.int32, (slab_rows, nx), 1)
         slab = tuple(slab_ref[slot, k] for k in range(6))
 
-        results = _slab_step(config, slab, grow, gcol)
+        results = _slab_step(config, slab, grow, gcol, ny=ny_dom, nx=nx_dom)
 
         # Center offset inside the slab is 0 for the first tile (DMA
         # window clamped at the top), 2*HALO for the last (clamped at
@@ -325,16 +366,13 @@ def fused_step(config: ShallowWaterConfig, state: ModelState, *,
         raise NotImplementedError("fused_step requires periodic_x")
     if block_rows < HALO or block_rows % 8:
         raise ValueError(f"block_rows must be a multiple of 8, >= {HALO}")
-    nyp = padded_rows(config, block_rows)
-    if nyp // block_rows < 2 or nyp < block_rows + 2 * HALO:
-        # the second clause keeps the clamped DMA window inside the
-        # array: nyp < slab_rows would invert the clamp bounds and
-        # produce a negative row offset (out-of-bounds HBM window)
+    if not block_rows_legal(config.ny_local, block_rows):
         raise ValueError(
             "need at least two row tiles and "
             f"ny_local padded >= block_rows + {2 * HALO}; "
             "lower block_rows for this grid"
         )
+    nyp = padded_rows(config, block_rows)
     nx = padded_cols(config)
     dtype = state.h.dtype
     if dtype not in (jnp.float32, jnp.float64):
@@ -414,13 +452,8 @@ def verified_hot_loop(config, model, multistep: int, state, first, *,
 
     say = log or (lambda _msg: None)
     try:
-        b = block_rows
-        while b >= HALO and (
-            padded_rows(config, b) // b < 2
-            or padded_rows(config, b) < b + 2 * HALO
-        ):
-            b //= 2
-        if b < HALO or b % 8:
+        b = fit_block_rows(config.ny_local, block_rows)
+        if b is None:
             say("fused-step: grid too small for any legal block size")
             return None
 

@@ -1,0 +1,165 @@
+"""Deep-halo fused SPMD step (``models/fused_spmd.py``).
+
+Two equivalence properties pin the design:
+
+1. **vs the composable SPMD path** (f32, interpret): interiors agree
+   to the stale-ghost boundary term. The composable path reproduces
+   the reference's exchange placement (``shallow_water.py:270-403``),
+   where rank-ghost velocity rows carry the *pre-friction* values of
+   the previous step (friction updates interiors after the last
+   exchange); the deep-halo exchange ships post-friction rows, so the
+   paths differ by O(nu*dt) at block boundaries — small but real.
+2. **vs the global single-rank trajectory** (f64, subprocess): the
+   deep-halo path reads globally consistent values everywhere, so its
+   reassembled solution must match the *undecomposed* solve to float
+   reordering (~1e-15 scaled in f64). This is the discriminating
+   check — exact decomposition invariance, a strictly stronger
+   property than the reference path has — and one an exchange-width
+   or offset bug cannot pass.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi4jax_tpu.models import fused_spmd as fsp
+from mpi4jax_tpu.models.shallow_water import (
+    ModelState,
+    ShallowWaterConfig,
+    ShallowWaterModel,
+)
+from mpi4jax_tpu.parallel import spmd, world_mesh
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _setup(n, ny=96, nx=48):
+    cfg = ShallowWaterConfig(nx=nx, ny=ny, dims=(n, 1))
+    model = ShallowWaterModel(cfg)
+    blocks = model.initial_state_blocks()
+    state = ModelState(*(jnp.asarray(b) for b in blocks))
+    return cfg, model, state
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_interiors_match_composable(n):
+    cfg, model, state = _setup(n)
+    mesh = world_mesh(n)
+    stepper = fsp.FusedRowDecomp(cfg, block_rows=8, interpret=True)
+
+    s1 = spmd(lambda s: model.step(s, first_step=True), mesh=mesh)(state)
+    ref = spmd(lambda s: model.multistep(s, 4), mesh=mesh)(s1)
+    fus = spmd(lambda s: stepper.multistep(s, 4), mesh=mesh)(s1)
+
+    for name, a, b in zip(ModelState._fields, ref, fus):
+        ai = np.asarray(a)[:, 1:-1, 1:-1]
+        bi = np.asarray(b)[:, 1:-1, 1:-1]
+        d = np.max(np.abs(ai - bi))
+        scale = 1.0 + np.max(np.abs(ai))
+        assert d / scale < 1e-4, (name, d)
+
+
+def test_multistep_composes():
+    cfg, model, state = _setup(4)
+    mesh = world_mesh(4)
+    stepper = fsp.FusedRowDecomp(cfg, block_rows=8, interpret=True)
+    s1 = spmd(lambda s: model.step(s, first_step=True), mesh=mesh)(state)
+    once = spmd(lambda s: stepper.multistep(s, 2), mesh=mesh)(s1)
+    twice = spmd(
+        lambda s: stepper.multistep(stepper.multistep(s, 1), 1), mesh=mesh
+    )(s1)
+    for a, b in zip(once, twice):
+        # interiors only: ghost rows of a returned state are unspecified
+        np.testing.assert_allclose(
+            np.asarray(a)[:, 1:-1, 1:-1],
+            np.asarray(b)[:, 1:-1, 1:-1],
+            rtol=0,
+            atol=1e-6,
+        )
+
+
+def test_guard_rails():
+    with pytest.raises(NotImplementedError, match="row decomposition"):
+        fsp.FusedRowDecomp(ShallowWaterConfig(nx=48, ny=96, dims=(2, 2)))
+    with pytest.raises(NotImplementedError, match="periodic_x"):
+        fsp.FusedRowDecomp(
+            ShallowWaterConfig(nx=48, ny=96, dims=(4, 1), periodic_x=False)
+        )
+    with pytest.raises(ValueError, match="interior rows per rank"):
+        fsp.FusedRowDecomp(ShallowWaterConfig(nx=48, ny=8, dims=(8, 1)))
+    with pytest.raises(ValueError, match="no legal block size"):
+        fsp.FusedRowDecomp(
+            ShallowWaterConfig(nx=48, ny=32, dims=(4, 1)), block_rows=8
+        )
+
+
+_F64_SCRIPT = """
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import sys
+sys.path.insert(0, {repo!r})
+import jax.numpy as jnp
+import numpy as np
+
+from mpi4jax_tpu.models.shallow_water import (
+    ModelState, ShallowWaterConfig, ShallowWaterModel,
+)
+from mpi4jax_tpu.models.fused_spmd import FusedRowDecomp
+from mpi4jax_tpu.parallel import spmd, world_mesh
+
+N = 4
+cfg = ShallowWaterConfig(nx=48, ny=96, dims=(N, 1), dtype=np.float64)
+gcfg = ShallowWaterConfig(nx=48, ny=96, dims=(1, 1), dtype=np.float64)
+model = ShallowWaterModel(cfg)
+gmodel = ShallowWaterModel(gcfg)
+mesh = world_mesh(N)
+
+state0 = ModelState(
+    *(jnp.asarray(b, jnp.float64) for b in model.initial_state_blocks())
+)
+g = ModelState(
+    *(jnp.asarray(b[0], jnp.float64) for b in gmodel.initial_state_blocks())
+)
+
+s1 = spmd(lambda s: model.step(s, first_step=True), mesh=mesh)(state0)
+stepper = FusedRowDecomp(cfg, block_rows=8, interpret=True)
+fus = spmd(lambda s: stepper.multistep(s, 8), mesh=mesh)(s1)
+
+g = gmodel.step(g, first_step=True)
+for _ in range(8):
+    g = gmodel.step(g)
+
+worst = 0.0
+for blk, want in zip(fus, g):
+    got = ShallowWaterModel.reassemble(np.asarray(blk), (N, 1))
+    ref = np.asarray(want)[1:-1, 1:-1]
+    d = np.max(np.abs(got - ref))
+    worst = max(worst, d / (1.0 + np.max(np.abs(ref))))
+assert worst < 1e-12, f"not decomposition-invariant: {{worst:.3e}}"
+print(f"f64 worst scaled diff vs global solve: {{worst:.3e}}")
+"""
+
+
+def test_decomposition_invariance_f64_subprocess():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", textwrap.dedent(_F64_SCRIPT.format(repo=REPO))],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "worst scaled diff" in proc.stdout
