@@ -54,6 +54,14 @@ def parse_args():
         "'off' = composable XLA step, 'auto' = fused on accelerators "
         "when a 3-step equivalence probe passes (default)",
     )
+    p.add_argument(
+        "--decomp", choices=("ref", "rows"), default="ref",
+        help="multi-rank domain decomposition: 'ref' = the reference's "
+        "(min(n,2), n/2) grid with the composable exchange; 'rows' = "
+        "(n, 1) row bands with the deep-halo fused step "
+        "(models/fused_spmd.py, 2 collectives/step, exactly "
+        "decomposition-invariant)",
+    )
     return p.parse_args()
 
 
@@ -85,8 +93,18 @@ def main():
     supported = (1, 2, 4, 6, 8, 16, 32)
     if n not in supported:
         raise SystemExit(f"--nproc must be one of {supported}")
-    nproc_y = min(n, 2)
-    nproc_x = n // nproc_y
+    if args.decomp == "rows":
+        nproc_y, nproc_x = n, 1
+        ny_g = 180 * args.scale
+        if ny_g % n or ny_g // n < 3:
+            raise SystemExit(
+                f"--decomp rows: ny={ny_g} must divide into >= 3 interior "
+                f"rows per rank; {n} ranks need ny % {n} == 0 "
+                "(try a different --nproc or --scale)"
+            )
+    else:
+        nproc_y = min(n, 2)
+        nproc_x = n // nproc_y
 
     config = ShallowWaterConfig(
         nx=360 * args.scale, ny=180 * args.scale, dims=(nproc_y, nproc_x)
@@ -118,10 +136,28 @@ def main():
             lambda s: model.multistep(s, args.multistep), donate_argnums=0
         )
         if shm_world:
-            if args.fused == "on":
+            if args.decomp == "rows" and args.fused != "off" and n > 1:
+                # deep-halo fused path in a launcher world: the
+                # exchange sendrecvs resolve to the shm backend; the
+                # kernel runs in interpret mode on CPU hosts
+                from mpi4jax_tpu.models.fused_spmd import FusedRowDecomp
+
+                interp = jax.devices()[0].platform == "cpu"
+                stepper = FusedRowDecomp(config, interpret=interp)
+                multi = jax.jit(
+                    lambda s: stepper.multistep(s, args.multistep),
+                    donate_argnums=0,
+                )
+                print(
+                    f"deep-halo fused row decomposition ({n}, 1), "
+                    f"block_rows={stepper.block_rows}"
+                    + (" [interpret]" if interp else ""),
+                    file=sys.stderr,
+                )
+            elif args.fused == "on":
                 raise SystemExit(
-                    "--fused on: the fused Pallas step is single-rank only "
-                    "(launcher worlds use the composable shm halo exchange)"
+                    "--fused on: needs --decomp rows in launcher worlds "
+                    "(the single-rank fused step has no halo exchange)"
                 )
         elif args.fused != "off":
             on_cpu = jax.devices()[0].platform == "cpu"
@@ -138,19 +174,39 @@ def main():
                         "platform/grid"
                     )
     else:
-        if args.fused == "on":
-            raise SystemExit(
-                "--fused on: the fused Pallas step is single-rank only "
-                "(multi-rank meshes use the composable SPMD halo exchange)"
-            )
         mesh = world_mesh(n)
         state = ModelState(*(jnp.asarray(b) for b in state0))
         first = spmd(lambda s: model.step(s, first_step=True), mesh=mesh)
-        multi = spmd(
-            lambda s: model.multistep(s, args.multistep),
-            mesh=mesh,
-            donate_argnums=0,
-        )
+        if args.decomp == "rows" and args.fused != "off":
+            from mpi4jax_tpu.models.fused_spmd import FusedRowDecomp
+
+            # compiled Mosaic needs a real accelerator; the virtual
+            # CPU mesh runs the kernel in interpret mode (slow — for
+            # validation, not benchmarking)
+            interp = jax.devices()[0].platform == "cpu"
+            stepper = FusedRowDecomp(config, interpret=interp)
+            multi = spmd(
+                lambda s: stepper.multistep(s, args.multistep),
+                mesh=mesh,
+                donate_argnums=0,
+            )
+            print(
+                f"deep-halo fused row decomposition ({n}, 1), "
+                f"block_rows={stepper.block_rows}"
+                + (" [interpret]" if interp else ""),
+                file=sys.stderr,
+            )
+        else:
+            if args.fused == "on":
+                raise SystemExit(
+                    "--fused on with --decomp ref is single-rank only; "
+                    "use --decomp rows for the multi-rank fused path"
+                )
+            multi = spmd(
+                lambda s: model.multistep(s, args.multistep),
+                mesh=mesh,
+                donate_argnums=0,
+            )
 
     # device_sync, not block_until_ready: some PJRT transports resolve
     # ready-events before the computation finishes (see
@@ -163,11 +219,14 @@ def main():
         state = fused["pad"](state)
         multi = fused["multi"]
     # warm-up compile of the hot loop (excluded from timing, like the
-    # reference's pre-compile call, shallow_water.py:441); the state is
-    # donated so keep the advanced result (and its frame) and time one
-    # call fewer, normalizing afterwards
-    state = multi(state)
-    device_sync(state)
+    # reference's pre-compile call, shallow_water.py:441) on a
+    # throwaway copy — the loop donates its input, so a copy keeps the
+    # real state intact and the timed loop covers the full n_calls
+    # span with one closing sync (matching bench.py: normalizing a
+    # shorter span would scale the host-fetch latency with it)
+    warm = multi(jax.tree.map(jnp.copy, state))
+    device_sync(warm)
+    del warm
 
     def snapshot(st):
         """Global (n, ny_l, nx_l) height field for plotting. In the
@@ -187,20 +246,19 @@ def main():
     snapshots = []
     if not args.benchmark:
         snapshots.append(snapshot(state))
-    n_timed = max(n_calls - 1, 1)
     start = time.perf_counter()
-    for _ in range(n_timed):
+    for _ in range(n_calls):
         state = multi(state)
         if not args.benchmark:
             device_sync(state)
             snapshots.append(snapshot(state))
     device_sync(state)
     elapsed = time.perf_counter() - start
-    steps_timed = n_timed * args.multistep
+    steps_timed = n_calls * args.multistep
 
     print(
-        f"\nSolution took {elapsed * n_calls / n_timed:.2f}s "
-        f"(timed {steps_timed} of {num_steps} steps)",
+        f"\nSolution took {elapsed:.2f}s "
+        f"({steps_timed} steps timed; requested span {num_steps})",
         file=sys.stderr,
     )
     print(
