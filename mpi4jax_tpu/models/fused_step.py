@@ -192,20 +192,28 @@ def _slab_step(config: ShallowWaterConfig, slab: Tuple[jax.Array, ...],
     wrap = functools.partial(_wrap_cols, gcol=gcol, nx=nx)
 
     # -- 1. hc: edge-padded interior of h, then periodic wrap ---------
-    hrow = jnp.where(grow == 0, yp(h), jnp.where(grow == ny - 1, ym(h), h))
+    h_n = yp(h)  # also the dv pressure gradient's northern neighbor
+    hrow = jnp.where(grow == 0, h_n, jnp.where(grow == ny - 1, ym(h), h))
     hc = wrap(hrow)
 
+    # Shifted views used more than once are bound here by hand: each
+    # roll is a lane/sublane shuffle over the whole slab and Mosaic
+    # does not reliably CSE repeated identical rolls.
+    hc_e, hc_n = xp(hc), yp(hc)
+
     # -- 2. volume fluxes at cell faces -------------------------------
-    fe = wrap(interior(0.5 * (hc + xp(hc)) * u))
-    fn = wrap(interior(0.5 * (hc + yp(hc)) * v))
+    fe = wrap(interior(0.5 * (hc + hc_e) * u))
+    fn = wrap(interior(0.5 * (hc + hc_n) * v))
     fn = jnp.where(grow == ny - 2, zero, fn)  # v-grid northern wall
+    fn_s = ym(fn)
+    fe_w = xm(fe)
 
     # -- 3. continuity ------------------------------------------------
-    dh_new = interior(-(fe - xm(fe)) / dx - (fn - ym(fn)) / dy)
+    dh_new = interior(-(fe - fe_w) / dx - (fn - fn_s) / dy)
 
     # -- 4. potential vorticity + kinetic energy ----------------------
     rel_vort = (xp(v) - v) / dx - (yp(u) - u) / dy
-    face_h = 0.25 * (hc + xp(hc) + yp(hc) + xp(yp(hc)))
+    face_h = 0.25 * (hc + hc_e + hc_n + xp(hc_n))
     f_cor = (c.coriolis_f
              + (grow.astype(f32) - 1.0) * c.dy * c.coriolis_beta)
     q = wrap(interior((f_cor + rel_vort) / face_h))
@@ -216,12 +224,12 @@ def _slab_step(config: ShallowWaterConfig, slab: Tuple[jax.Array, ...],
     # -- 5. momentum tendencies ---------------------------------------
     du_new = interior(
         -g * (xp(h) - h) / dx
-        + 0.5 * (q * 0.5 * (fn + xp(fn)) + ym(q) * 0.5 * (ym(fn) + xp(ym(fn))))
+        + 0.5 * (q * 0.5 * (fn + xp(fn)) + ym(q) * 0.5 * (fn_s + xp(fn_s)))
         - (xp(ke) - ke) / dx
     )
     dv_new = interior(
-        -g * (yp(h) - h) / dy
-        - 0.5 * (q * 0.5 * (fe + yp(fe)) + xm(q) * 0.5 * (xm(fe) + yp(xm(fe))))
+        -g * (h_n - h) / dy
+        - 0.5 * (q * 0.5 * (fe + yp(fe)) + xm(q) * 0.5 * (fe_w + yp(fe_w)))
         - (yp(ke) - ke) / dy
     )
 

@@ -148,3 +148,21 @@ def test_fused_matches_xla_step_f64_subprocess():
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "worst scaled diff" in proc.stdout
+
+
+def test_verified_hot_loop_falls_back_on_cpu():
+    """On the CPU platform the compiled Mosaic kernel cannot build, so
+    the probe must decline cleanly (returns None, logs why) — this is
+    the safety net bench.py and the example rely on."""
+    cfg, model, state = _small_model()
+    first = jax.jit(lambda s: model.step(s, first_step=True))
+    lines = []
+    from mpi4jax_tpu.models.fused_step import verified_hot_loop
+
+    got = verified_hot_loop(
+        cfg, model, 4, state, first, block_rows=8, log=lines.append
+    )
+    assert got is None
+    assert lines and (
+        "unavailable" in lines[0] or "too small" in lines[0]
+    ), lines
