@@ -191,8 +191,12 @@ def main():
 
     dt = config.dt
     t1 = 0.1 * DAY_IN_SECONDS
-    multistep = 100
     num_steps = math.ceil(t1 / dt)
+    # one fori_loop call for the whole span by default: each dispatch
+    # over the container's TPU tunnel costs ~25 ms of host round-trip
+    # that real local hardware doesn't pay; M4T_BENCH_MULTISTEP=100
+    # restores reference-style chunking
+    multistep = int(os.environ.get("M4T_BENCH_MULTISTEP", "0")) or num_steps
     n_calls = math.ceil(num_steps / multistep)
 
     fused = None

@@ -434,8 +434,10 @@ def fused_multistep(config: ShallowWaterConfig, state: ModelState,
 
 
 #: largest row tile that fits v5e VMEM at the published benchmark
-#: width; also the fastest measured (1.04 ms/step vs 1.31 at 64)
-DEFAULT_BLOCK_ROWS = 128
+#: width; also the fastest measured (0.70 ms/step vs 0.98 at 128,
+#: 1.31 at 64). VMEM headroom at 160 is tight, so the hot-loop
+#: builder falls back through smaller tiles on compile failure.
+DEFAULT_BLOCK_ROWS = 160
 
 
 def verified_hot_loop(config, model, multistep: int, state, first, *,
@@ -460,19 +462,44 @@ def verified_hot_loop(config, model, multistep: int, state, first, *,
 
     say = log or (lambda _msg: None)
     try:
-        b = fit_block_rows(config.ny_local, block_rows)
-        if b is None:
+        # candidate tile sizes, largest first: the top size is at the
+        # VMEM ceiling on v5e, so a compile failure (e.g. a different
+        # chip generation or compiler headroom change) falls through
+        # to the next size instead of abandoning the fused path
+        candidates = []
+        for req in (block_rows, 128, 64, 32):
+            fitted = fit_block_rows(config.ny_local, min(req, block_rows))
+            if fitted is not None and fitted not in candidates:
+                candidates.append(fitted)
+        if not candidates:
             say("fused-step: grid too small for any legal block size")
             return None
 
         probe = first(state)
         ref = jax.jit(lambda s: model.multistep(s, 3))(probe)
-        fu = crop_state(
-            config,
-            jax.jit(
-                lambda s: fused_multistep(config, s, 3, block_rows=b)
-            )(pad_state(config, probe, b)),
-        )
+        fu = b = None
+        last_err = None
+        for cand in candidates:
+            try:
+                fu = crop_state(
+                    config,
+                    jax.jit(
+                        lambda s: fused_multistep(
+                            config, s, 3, block_rows=cand
+                        )
+                    )(pad_state(config, probe, cand)),
+                )
+                jax.block_until_ready(fu.h)
+                b = cand
+                break
+            except Exception as e:  # compile/runtime failure: next size
+                last_err = e
+                say(
+                    f"fused-step block_rows={cand} failed "
+                    f"({type(e).__name__}); trying smaller"
+                )
+        if fu is None:
+            raise last_err
         worst = 0.0
         for a_f, b_f in zip(ref[:3], fu[:3]):  # h, u, v
             d = float(jnp.max(jnp.abs(a_f - b_f)))
