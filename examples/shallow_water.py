@@ -49,18 +49,23 @@ def parse_args():
     )
     p.add_argument(
         "--fused", choices=("auto", "on", "off"), default="auto",
-        help="single-rank hot loop: 'on' = fused Pallas step "
-        "(models/fused_step.py, compiled Mosaic — accelerator only), "
-        "'off' = composable XLA step, 'auto' = fused on accelerators "
-        "when a 3-step equivalence probe passes (default)",
+        help="fused Pallas hot loop (single-rank: models/fused_step.py; "
+        "multi-rank, any --decomp: the deep-halo steppers of "
+        "models/fused_spmd.py): 'off' = composable XLA step, 'on' = "
+        "fused, failing loudly if its equivalence probe declines, "
+        "'auto' (default) = fused on real accelerators when the probe "
+        "passes, composable on CPU (the interpret-mode kernel is for "
+        "validation, not speed)",
     )
     p.add_argument(
         "--decomp", choices=("ref", "rows"), default="ref",
         help="multi-rank domain decomposition: 'ref' = the reference's "
-        "(min(n,2), n/2) grid with the composable exchange; 'rows' = "
-        "(n, 1) row bands with the deep-halo fused step "
-        "(models/fused_spmd.py, 2 collectives/step, exactly "
-        "decomposition-invariant)",
+        "(min(n,2), n/2) grid (fused path: FusedDecomp2D, 4 "
+        "collectives/step); 'rows' = (n, 1) row bands (fused path: "
+        "FusedRowDecomp, 2 collectives/step). Both fused paths are "
+        "bit-exactly decomposition-invariant and probe-gated; the "
+        "composable exchange serves either layout when fused is off "
+        "or declined",
     )
     return p.parse_args()
 
@@ -124,6 +129,12 @@ def main():
 
     state0 = model.initial_state_blocks()
 
+    # auto only engages the fused paths on real accelerators — the
+    # interpret-mode multi-rank kernel (CPU) is for validation, not
+    # speed, so CPU runs need an explicit --fused on
+    on_cpu = jax.devices()[0].platform == "cpu"
+    want_fused = args.fused == "on" or (args.fused == "auto" and not on_cpu)
+
     fused = None
     if shm_world or n == 1:
         # one process, one block: jit the per-rank step directly. In a
@@ -135,72 +146,76 @@ def main():
         multi = jax.jit(
             lambda s: model.multistep(s, args.multistep), donate_argnums=0
         )
-        if shm_world:
-            if args.decomp == "rows" and args.fused != "off" and n > 1:
-                # deep-halo fused path in a launcher world: the
-                # exchange sendrecvs resolve to the shm backend; the
-                # kernel runs in interpret mode on CPU hosts
-                from mpi4jax_tpu.models.fused_spmd import FusedRowDecomp
+        if shm_world and n > 1:
+            if want_fused:
+                # deep-halo fused path in a launcher world (row bands
+                # or the 2-D (2, n/2) layout — the gate picks the
+                # stepper): the exchange sendrecvs resolve to the shm
+                # backend; the kernel runs in interpret mode on CPU
+                # hosts. Routing is gated by an in-world equivalence
+                # probe against the composable step (all ranks agree
+                # via a MAX-allreduce on the deviation).
+                from mpi4jax_tpu.models.fused_spmd import (
+                    verified_world_stepper,
+                )
 
-                interp = jax.devices()[0].platform == "cpu"
-                stepper = FusedRowDecomp(config, interpret=interp)
-                multi = jax.jit(
-                    lambda s: stepper.multistep(s, args.multistep),
-                    donate_argnums=0,
-                )
-                print(
-                    f"deep-halo fused row decomposition ({n}, 1), "
-                    f"block_rows={stepper.block_rows}"
-                    + (" [interpret]" if interp else ""),
-                    file=sys.stderr,
-                )
-            elif args.fused == "on":
-                raise SystemExit(
-                    "--fused on: needs --decomp rows in launcher worlds "
-                    "(the single-rank fused step has no halo exchange)"
-                )
-        elif args.fused != "off":
-            on_cpu = jax.devices()[0].platform == "cpu"
-            if args.fused == "on" or not on_cpu:
-                from mpi4jax_tpu.models.fused_step import verified_hot_loop
-
-                fused = verified_hot_loop(
-                    config, model, args.multistep, state, first,
+                stepper = verified_world_stepper(
+                    config, model, state, first, interpret=on_cpu,
                     log=lambda m: print(m, file=sys.stderr),
                 )
-                if fused is None and args.fused == "on":
-                    raise SystemExit(
-                        "--fused on: fused Pallas path unavailable on this "
-                        "platform/grid"
+                if stepper is not None:
+                    multi = jax.jit(
+                        lambda s: stepper.multistep(s, args.multistep),
+                        donate_argnums=0,
                     )
+                    if on_cpu:
+                        print("fused kernel in interpret mode",
+                              file=sys.stderr)
+                elif args.fused == "on":
+                    raise SystemExit(
+                        "--fused on: deep-halo fused path failed its "
+                        "in-world equivalence probe (see log above)"
+                    )
+        elif want_fused:
+            from mpi4jax_tpu.models.fused_step import verified_hot_loop
+
+            fused = verified_hot_loop(
+                config, model, args.multistep, state, first,
+                log=lambda m: print(m, file=sys.stderr),
+            )
+            if fused is None and args.fused == "on":
+                raise SystemExit(
+                    "--fused on: fused Pallas path unavailable on this "
+                    "platform/grid"
+                )
     else:
         mesh = world_mesh(n)
         state = ModelState(*(jnp.asarray(b) for b in state0))
         first = spmd(lambda s: model.step(s, first_step=True), mesh=mesh)
-        if args.decomp == "rows" and args.fused != "off":
-            from mpi4jax_tpu.models.fused_spmd import FusedRowDecomp
+        stepper = None
+        if want_fused:
+            # probe-gated deep-halo fused routing (rows or 2-D grid —
+            # the gate picks the stepper)
+            from mpi4jax_tpu.models.fused_spmd import verified_mesh_stepper
 
-            # compiled Mosaic needs a real accelerator; the virtual
-            # CPU mesh runs the kernel in interpret mode (slow — for
-            # validation, not benchmarking)
-            interp = jax.devices()[0].platform == "cpu"
-            stepper = FusedRowDecomp(config, interpret=interp)
+            stepper = verified_mesh_stepper(
+                config, model, state, first, mesh, interpret=on_cpu,
+                log=lambda m: print(m, file=sys.stderr),
+            )
+            if stepper is not None and on_cpu:
+                print("fused kernel in interpret mode", file=sys.stderr)
+        if stepper is not None:
             multi = spmd(
                 lambda s: stepper.multistep(s, args.multistep),
                 mesh=mesh,
                 donate_argnums=0,
             )
-            print(
-                f"deep-halo fused row decomposition ({n}, 1), "
-                f"block_rows={stepper.block_rows}"
-                + (" [interpret]" if interp else ""),
-                file=sys.stderr,
-            )
         else:
             if args.fused == "on":
                 raise SystemExit(
-                    "--fused on with --decomp ref is single-rank only; "
-                    "use --decomp rows for the multi-rank fused path"
+                    "--fused on: the deep-halo fused path is unavailable "
+                    "or failed its equivalence probe for this "
+                    "configuration (see log above)"
                 )
             multi = spmd(
                 lambda s: model.multistep(s, args.multistep),

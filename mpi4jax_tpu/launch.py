@@ -41,10 +41,12 @@ def main(argv=None):
 
     if args.nproc < 1:
         parser.error("-n must be >= 1")
-    if args.nproc > 16:
-        # kMaxRanks in runtime/shmcc.cpp; checked here so a too-large
-        # world fails immediately instead of after the join timeout.
-        parser.error("-n must be <= 16 (shm backend kMaxRanks)")
+    if args.nproc > 64:
+        # kMaxRanks in runtime/shmcc.cpp (the shm segment itself is
+        # runtime-sized from -n; 64 is a sanity bound on single-host
+        # oversubscription); checked here so a too-large world fails
+        # immediately instead of after the join timeout.
+        parser.error("-n must be <= 64 (shm backend kMaxRanks)")
     if not args.cmd and not args.module:
         parser.error("missing script")
 

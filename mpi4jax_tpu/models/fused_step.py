@@ -86,11 +86,13 @@ def block_rows_legal(rows: int, block_rows: int) -> bool:
 
 def fit_block_rows(rows: int, requested: int):
     """Largest legal block size <= ``requested`` for ``rows`` total
-    rows (halving search), or ``None`` if no legal size exists."""
-    b = requested
+    rows, or ``None`` if no legal size exists. Descends in sublane
+    multiples of 8 so every legal size is visited (a halving search
+    can skip all legal sizes on small extended grids, e.g. 36 rows)."""
+    b = (requested // 8) * 8
     while b >= HALO and not block_rows_legal(rows, b):
-        b //= 2
-    return b if b >= HALO and b % 8 == 0 else None
+        b -= 8
+    return b if b >= HALO else None
 
 
 def padded_cols(config: ShallowWaterConfig) -> int:
@@ -141,7 +143,8 @@ def _wrap_cols(a, gcol, nx):
 
 def _slab_step(config: ShallowWaterConfig, slab: Tuple[jax.Array, ...],
                grow: jax.Array, gcol: jax.Array,
-               ny: int = None, nx: int = None):
+               ny: int = None, nx: int = None,
+               x_mode: str = "wrap"):
     """One full AB2 step evaluated on a row slab.
 
     ``slab`` holds (h, u, v, dh, du, dv), each ``(rows, width)``;
@@ -155,12 +158,28 @@ def _slab_step(config: ShallowWaterConfig, slab: Tuple[jax.Array, ...],
     for the center rows (plus physical-boundary rows, which are
     mask-resolved). Returns the six updated fields, full slab shape.
 
+    ``x_mode`` selects how the periodic-x boundary resolves:
+
+    - ``"wrap"`` (single-rank / full-width): ghost columns are wrapped
+      in-slab (``_wrap_cols``), ``gcol`` is the global column index and
+      the interior mask is ``1 <= gcol <= nx-2``.
+    - ``"exchanged"`` (2-D deep-halo SPMD): ghost and extension
+      columns were filled by the x-neighbor exchange before the
+      kernel, so the wrap is the identity and every *real* extended
+      column (``0 <= gcol < nx``, here ``gcol`` is the local extended
+      column index and ``nx`` the real extended width) recomputes the
+      step — translation invariance in x makes the recomputed ghost
+      values bit-identical to the neighbor's interior computation.
+      Lane-padding columns stay masked off so their roll-wrap junk
+      never contaminates real columns.
+
     Mirrors ``ShallowWaterModel.step`` stage for stage; the reference
     physics is ``shallow_water.py:270-403``.
     """
     c = config
     ny = c.ny_local if ny is None else ny
     nx = c.nx_local if nx is None else nx
+    assert x_mode in ("wrap", "exchanged")
     dt, dx, dy, g = c.dt, c.dx, c.dy, c.gravity
     h, u, v, dh_old, du_old, dv_old = slab
     f32 = h.dtype
@@ -182,14 +201,20 @@ def _slab_step(config: ShallowWaterConfig, slab: Tuple[jax.Array, ...],
         return jnp.roll(a, 1, 1)
 
     row_i = (grow >= 1) & (grow <= ny - 2)
-    col_i = (gcol >= 1) & (gcol <= nx - 2)
+    if x_mode == "wrap":
+        col_i = (gcol >= 1) & (gcol <= nx - 2)
+        wrap = functools.partial(_wrap_cols, gcol=gcol, nx=nx)
+    else:  # exchanged: all real extended columns update, no wrap
+        col_i = (gcol >= 0) & (gcol <= nx - 1)
+
+        def wrap(a):
+            return a
+
     imask = row_i & col_i
     zero = jnp.zeros((), f32)
 
     def interior(expr, base=None):
         return jnp.where(imask, expr, zero if base is None else base)
-
-    wrap = functools.partial(_wrap_cols, gcol=gcol, nx=nx)
 
     # -- 1. hc: edge-padded interior of h, then periodic wrap ---------
     h_n = yp(h)  # also the dv pressure gradient's northern neighbor
@@ -269,14 +294,15 @@ def _slab_step(config: ShallowWaterConfig, slab: Tuple[jax.Array, ...],
 
 def _make_kernel(config: ShallowWaterConfig, block_rows: int, nyp: int,
                  *, ny: int = None, nx_real: int = None, nx_pad: int = None,
-                 with_rank_offset: bool = False):
+                 with_rank_offset: bool = False, x_mode: str = "wrap"):
     """Build the fused-step kernel body.
 
     Defaults produce the single-rank kernel. The SPMD deep-halo
-    variant (``fused_spmd.py``) passes the *global* domain extents for
+    variants (``fused_spmd.py``) pass the *global* domain extents for
     the boundary masks and ``with_rank_offset=True``, which prepends
     an SMEM scalar input carrying the rank's global row offset so
-    ``grow`` becomes a domain-global row index.
+    ``grow`` becomes a domain-global row index; the 2-D variant also
+    passes ``x_mode="exchanged"`` (see :func:`_slab_step`).
     """
     nx = nx_pad if nx_pad is not None else padded_cols(config)
     ny_dom = config.ny_local if ny is None else ny
@@ -342,7 +368,9 @@ def _make_kernel(config: ShallowWaterConfig, block_rows: int, nyp: int,
         gcol = lax.broadcasted_iota(jnp.int32, (slab_rows, nx), 1)
         slab = tuple(slab_ref[slot, k] for k in range(6))
 
-        results = _slab_step(config, slab, grow, gcol, ny=ny_dom, nx=nx_dom)
+        results = _slab_step(
+            config, slab, grow, gcol, ny=ny_dom, nx=nx_dom, x_mode=x_mode
+        )
 
         # Center offset inside the slab is 0 for the first tile (DMA
         # window clamped at the top), 2*HALO for the last (clamped at

@@ -47,7 +47,14 @@ namespace ffi = xla::ffi;
 
 namespace shmcc {
 
-constexpr int kMaxRanks = 16;
+// Sanity bound only — the segment itself is sized at world init from
+// the actual rank count (segment_bytes below), so worlds pay for the
+// ranks they have (tmpfs pages are allocated on touch, not ftruncate).
+// 64 comfortably exceeds single-host core counts; mpirun's worlds are
+// unbounded, but >64 single-host ranks is an oversubscription regime
+// the spin-wait transport is wrong for anyway (documented in
+// docs/sharp-bits.md).
+constexpr int kMaxRanks = 64;
 constexpr size_t kCollChunk = size_t{1} << 22;  // 4 MiB per-rank slot
 constexpr size_t kP2PChunk = size_t{1} << 18;   // 256 KiB channel entry
 constexpr int64_t kAnyTag = -1;
@@ -80,16 +87,30 @@ struct alignas(64) Channel {
   char data[kP2PChunk];
 };
 
-struct Shared {
+// Segment layout (runtime-sized from the world's rank count):
+//   [ SharedHeader, padded to 64 ]
+//   [ coll slots:   size x kCollChunk bytes, 64-aligned             ]
+//   [ p2p channels: size x size x sizeof(Channel), [src][dst] order ]
+struct SharedHeader {
   std::atomic<uint32_t> barrier_count;
   std::atomic<uint32_t> barrier_sense;
   std::atomic<uint32_t> abort_flag;
-  alignas(64) char coll[kMaxRanks][kCollChunk];
-  Channel channels[kMaxRanks][kMaxRanks];  // [src][dst]
 };
 
+constexpr size_t kHeaderBytes = 64;
+static_assert(sizeof(SharedHeader) <= kHeaderBytes, "header overflow");
+static_assert(sizeof(Channel) % 64 == 0, "channel alignment");
+
+static inline size_t segment_bytes(int size) {
+  return kHeaderBytes + (size_t)size * kCollChunk +
+         (size_t)size * (size_t)size * sizeof(Channel);
+}
+
 struct World {
-  Shared* sh = nullptr;
+  SharedHeader* sh = nullptr;
+  char* coll_base = nullptr;
+  Channel* channels_base = nullptr;
+  size_t seg_bytes = 0;
   int rank = -1;
   int size = 0;
   uint32_t barrier_sense_local = 0;
@@ -99,6 +120,14 @@ struct World {
 };
 
 static World g;
+
+static inline char* coll(int r) {
+  return g.coll_base + (size_t)r * kCollChunk;
+}
+
+static inline Channel* channel(int src, int dst) {
+  return g.channels_base + (size_t)src * g.size + dst;
+}
 
 static long now_us() {
   struct timeval tv;
@@ -314,7 +343,7 @@ static void collective_rounds(const void* mine, size_t nbytes,
   do {
     size_t len = nbytes - off < kCollChunk ? nbytes - off : kCollChunk;
     if (mine != nullptr && len > 0)
-      std::memcpy(g.sh->coll[g.rank], (const char*)mine + off, len);
+      std::memcpy(coll(g.rank), (const char*)mine + off, len);
     barrier();
     consume(off, len);
     barrier();
@@ -410,7 +439,7 @@ static int p2p_wait_any_source(int64_t tag) {
       [&found, tag] {
         for (int s = 0; s < g.size; ++s) {
           if (s == g.rank) continue;
-          Channel* ch = &g.sh->channels[s][g.rank];
+          Channel* ch = channel(s, g.rank);
           if (ch->head.load(std::memory_order_acquire) !=
               ch->tail.load(std::memory_order_relaxed)) {
             if (tag == kAnyTag) {
@@ -452,7 +481,7 @@ static void p2p_send(const void* data, size_t nbytes, int dest, int64_t tag) {
   if (dest < 0 || dest >= g.size) fatal("send dest out of range");
   // Zero-byte messages are local no-ops (no rendezvous, no tag check);
   // every framework-level op carries at least one element.
-  SendCursor s{&g.sh->channels[g.rank][dest], (const char*)data, nbytes, tag};
+  SendCursor s{channel(g.rank, dest), (const char*)data, nbytes, tag};
   drive(&s, (RecvCursor*)nullptr, "send timeout (no matching recv?)");
 }
 
@@ -461,7 +490,7 @@ static std::pair<int, int64_t> p2p_recv(void* data, size_t nbytes, int source,
                                         int64_t tag) {
   if (source == kAnySource) source = p2p_wait_any_source(tag);
   if (source < 0 || source >= g.size) fatal("recv source out of range");
-  RecvCursor r{&g.sh->channels[source][g.rank], (char*)data, nbytes, tag};
+  RecvCursor r{channel(source, g.rank), (char*)data, nbytes, tag};
   drive((SendCursor*)nullptr, &r, "recv timeout (no matching send?)");
   return {source, r.seen_tag};
 }
@@ -506,9 +535,9 @@ static ffi::Error AllreduceImpl(int64_t op, ffi::AnyBuffer x,
   char* dst = (char*)out->untyped_data();
   ffi::DataType dt = x.element_type();
   collective_rounds(x.untyped_data(), nbytes, [&](size_t off, size_t len) {
-    std::memcpy(dst + off, g.sh->coll[0], len);
+    std::memcpy(dst + off, coll(0), len);
     for (int r = 1; r < g.size; ++r)
-      accumulate_dtype(dt, op, dst + off, g.sh->coll[r], len);
+      accumulate_dtype(dt, op, dst + off, coll(r), len);
   });
   return ok();
 }
@@ -522,9 +551,9 @@ static ffi::Error ScanImpl(int64_t op, ffi::AnyBuffer x,
   char* dst = (char*)out->untyped_data();
   ffi::DataType dt = x.element_type();
   collective_rounds(x.untyped_data(), nbytes, [&](size_t off, size_t len) {
-    std::memcpy(dst + off, g.sh->coll[0], len);
+    std::memcpy(dst + off, coll(0), len);
     for (int r = 1; r <= g.rank; ++r)
-      accumulate_dtype(dt, op, dst + off, g.sh->coll[r], len);
+      accumulate_dtype(dt, op, dst + off, coll(r), len);
   });
   return ok();
 }
@@ -539,9 +568,9 @@ static ffi::Error ReduceImpl(int64_t op, int64_t root, ffi::AnyBuffer x,
   ffi::DataType dt = x.element_type();
   collective_rounds(x.untyped_data(), nbytes, [&](size_t off, size_t len) {
     if (g.rank == root) {
-      std::memcpy(dst + off, g.sh->coll[0], len);
+      std::memcpy(dst + off, coll(0), len);
       for (int r = 1; r < g.size; ++r)
-        accumulate_dtype(dt, op, dst + off, g.sh->coll[r], len);
+        accumulate_dtype(dt, op, dst + off, coll(r), len);
     } else {
       std::memcpy(dst + off, (const char*)x.untyped_data() + off, len);
     }
@@ -557,7 +586,7 @@ static ffi::Error AllgatherImpl(ffi::AnyBuffer x, ffi::RemainingArgs wire,
   char* dst = (char*)out->untyped_data();
   collective_rounds(x.untyped_data(), nbytes, [&](size_t off, size_t len) {
     for (int r = 0; r < g.size; ++r)
-      std::memcpy(dst + r * nbytes + off, g.sh->coll[r], len);
+      std::memcpy(dst + r * nbytes + off, coll(r), len);
   });
   return ok();
 }
@@ -571,7 +600,7 @@ static ffi::Error BcastImpl(int64_t root, ffi::AnyBuffer x,
   char* dst = (char*)out->untyped_data();
   const void* mine = g.rank == root ? x.untyped_data() : nullptr;
   collective_rounds(mine, nbytes, [&](size_t off, size_t len) {
-    std::memcpy(dst + off, g.sh->coll[root], len);
+    std::memcpy(dst + off, coll(root), len);
   });
   return ok();
 }
@@ -596,7 +625,7 @@ static ffi::Error ScatterImpl(int64_t root, ffi::AnyBuffer x,
     size_t lo = off > my_lo ? off : my_lo;
     size_t hi = off + len < my_hi ? off + len : my_hi;
     if (lo < hi)
-      std::memcpy(dst + (lo - my_lo), g.sh->coll[root] + (lo - off), hi - lo);
+      std::memcpy(dst + (lo - my_lo), coll(root) + (lo - off), hi - lo);
   });
   return ok();
 }
@@ -615,7 +644,7 @@ static ffi::Error GatherImpl(int64_t root, ffi::AnyBuffer x,
   collective_rounds(x.untyped_data(), nbytes, [&](size_t off, size_t len) {
     if (is_root) {
       for (int r = 0; r < g.size; ++r)
-        std::memcpy(dst + r * nbytes + off, g.sh->coll[r], len);
+        std::memcpy(dst + r * nbytes + off, coll(r), len);
     } else {
       std::memcpy(dst + off, (const char*)x.untyped_data() + off, len);
     }
@@ -637,7 +666,7 @@ static ffi::Error AlltoallImpl(ffi::AnyBuffer x, ffi::RemainingArgs wire,
     if (lo < hi)
       for (int r = 0; r < g.size; ++r)
         std::memcpy(dst + r * block + (lo - my_lo),
-                    g.sh->coll[r] + (lo - off), hi - lo);
+                    coll(r) + (lo - off), hi - lo);
   });
   return ok();
 }
@@ -683,7 +712,7 @@ static ffi::Error SendrecvImpl(int64_t source, int64_t dest, int64_t sendtag,
     // draining the send first would deadlock two peers doing a
     // symmetric > kP2PChunk exchange (each blocked publishing chunk 2
     // until the other consumes chunk 1).
-    SendCursor s{&g.sh->channels[g.rank][dest],
+    SendCursor s{channel(g.rank, dest),
                  (const char*)x.untyped_data(), x.size_bytes(), sendtag};
     int found = -1;
     long deadline = now_us() + g_spin_timeout_us;
@@ -692,7 +721,7 @@ static ffi::Error SendrecvImpl(int64_t source, int64_t dest, int64_t sendtag,
       bool progress = s.try_step();
       for (int c = 0; c < g.size && found < 0; ++c) {
         if (c == g.rank) continue;
-        Channel* ch = &g.sh->channels[c][g.rank];
+        Channel* ch = channel(c, g.rank);
         if (ch->head.load(std::memory_order_acquire) !=
             ch->tail.load(std::memory_order_relaxed)) {
           if (recvtag == kAnyTag) {
@@ -714,7 +743,7 @@ static ffi::Error SendrecvImpl(int64_t source, int64_t dest, int64_t sendtag,
         spin_pause();
       }
     }
-    RecvCursor r{&g.sh->channels[found][g.rank], (char*)out->untyped_data(),
+    RecvCursor r{channel(found, g.rank), (char*)out->untyped_data(),
                  out->size_bytes(), recvtag};
     drive(&s, &r, "sendrecv timeout");
     write_status(status_ptr, found, r.seen_tag, out->size_bytes());
@@ -723,9 +752,9 @@ static ffi::Error SendrecvImpl(int64_t source, int64_t dest, int64_t sendtag,
   // Interleaved progress on both cursors: deadlock-free pairwise
   // exchange like MPI_Sendrecv (reference mpi_ops_common.h sendrecv
   // wrapper), without requiring channel capacity >= message size.
-  SendCursor s{&g.sh->channels[g.rank][dest], (const char*)x.untyped_data(),
+  SendCursor s{channel(g.rank, dest), (const char*)x.untyped_data(),
                x.size_bytes(), sendtag};
-  RecvCursor r{&g.sh->channels[source][g.rank], (char*)out->untyped_data(),
+  RecvCursor r{channel(source, g.rank), (char*)out->untyped_data(),
                out->size_bytes(), recvtag};
   if (source < 0 || source >= g.size) fatal("sendrecv source out of range");
   drive(&s, &r, "sendrecv timeout");
@@ -828,11 +857,12 @@ static int world_init(const char* name, int rank, int size, int create) {
                    "(need a positive integer of microseconds)\n", t);
     }
   }
+  size_t seg = segment_bytes(size);
   int flags = create ? (O_CREAT | O_RDWR) : O_RDWR;
   int fd = shm_open(name, flags, 0600);
   if (fd < 0) return -2;
   if (create) {
-    if (ftruncate(fd, sizeof(Shared)) != 0) {
+    if (ftruncate(fd, (off_t)seg) != 0) {
       close(fd);
       return -3;
     }
@@ -840,16 +870,20 @@ static int world_init(const char* name, int rank, int size, int create) {
     // Don't mmap before the creator's ftruncate has sized the segment:
     // touching pages beyond EOF would SIGBUS. -2 is the retryable code.
     struct stat st;
-    if (fstat(fd, &st) != 0 || st.st_size < (off_t)sizeof(Shared)) {
+    if (fstat(fd, &st) != 0 || st.st_size < (off_t)seg) {
       close(fd);
       return -2;
     }
   }
-  void* mem = mmap(nullptr, sizeof(Shared), PROT_READ | PROT_WRITE,
-                   MAP_SHARED, fd, 0);
+  void* mem =
+      mmap(nullptr, seg, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   close(fd);
   if (mem == MAP_FAILED) return -4;
-  g.sh = reinterpret_cast<Shared*>(mem);
+  g.sh = reinterpret_cast<SharedHeader*>(mem);
+  g.coll_base = reinterpret_cast<char*>(mem) + kHeaderBytes;
+  g.channels_base =
+      reinterpret_cast<Channel*>(g.coll_base + (size_t)size * kCollChunk);
+  g.seg_bytes = seg;
   g.rank = rank;
   g.size = size;
   g.shm_name = name;
@@ -860,9 +894,12 @@ static int world_init(const char* name, int rank, int size, int create) {
 
 static void world_finalize() {
   if (g.sh != nullptr) {
-    munmap(g.sh, sizeof(Shared));
+    munmap(g.sh, g.seg_bytes);
     if (g.owner) shm_unlink(g.shm_name.c_str());
     g.sh = nullptr;
+    g.coll_base = nullptr;
+    g.channels_base = nullptr;
+    g.seg_bytes = 0;
   }
 }
 
@@ -920,12 +957,14 @@ static PyObject* py_abi_info(PyObject*, PyObject*) {
   // Parity with the reference's MPI_ABI_INFO self-description
   // (mpi_ops_common.h:398-425): enough for tests to sanity-check the
   // native layout assumptions.
+  // shared_bytes is the live world's mapped segment (runtime-sized
+  // from the rank count); before init it reports the 1-rank size.
   return Py_BuildValue(
       "{s:i,s:n,s:n,s:n,s:L}", "max_ranks", shmcc::kMaxRanks,
       "coll_chunk_bytes", (Py_ssize_t)shmcc::kCollChunk, "p2p_chunk_bytes",
       (Py_ssize_t)shmcc::kP2PChunk, "shared_bytes",
-      (Py_ssize_t)sizeof(shmcc::Shared), "tag_base",
-      (long long)shmcc::kTagBase);
+      (Py_ssize_t)shmcc::segment_bytes(shmcc::g.size > 0 ? shmcc::g.size : 1),
+      "tag_base", (long long)shmcc::kTagBase);
 }
 
 static PyObject* capsule(XLA_FFI_Handler* h) {

@@ -74,6 +74,25 @@ def test_fused_multistep_equals_repeated_steps():
         np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=0, atol=0)
 
 
+def test_fit_block_rows_visits_all_multiples_of_8():
+    """Regression: the old halving search (160->80->40->20->10) skipped
+    every legal size for small extended grids, e.g. the 36 extended
+    rows of a (6,1) row decomposition of ny=180 (`--nproc 6 --decomp
+    rows` of the default example grid)."""
+    got = fs.fit_block_rows(36, 160)
+    assert got is not None and got % 8 == 0
+    assert fs.block_rows_legal(36, got)
+    # the result is the *largest* legal size, not just any legal one
+    for b in range(got + 8, 161, 8):
+        assert not fs.block_rows_legal(36, b)
+    # and the decomposition from the advisory reproduces end-to-end
+    from mpi4jax_tpu.models.fused_spmd import FusedRowDecomp
+
+    cfg = ShallowWaterConfig(nx=360, ny=180, dims=(6, 1))
+    stepper = FusedRowDecomp(cfg)
+    assert fs.block_rows_legal(stepper.ext_rows, stepper.block_rows)
+
+
 def test_guard_rails():
     cfg, model, state = _small_model()
     padded = fs.pad_state(cfg, state, 8)
@@ -163,6 +182,8 @@ def test_verified_hot_loop_falls_back_on_cpu():
         cfg, model, 4, state, first, block_rows=8, log=lines.append
     )
     assert got is None
-    assert lines and (
-        "unavailable" in lines[0] or "too small" in lines[0]
+    # the probe may log per-candidate retry lines before the final
+    # verdict, so the contract is over the whole log, not lines[0]
+    assert lines and any(
+        "unavailable" in ln or "too small" in ln for ln in lines
     ), lines

@@ -478,32 +478,34 @@ def test_stalled_peer_spin_timeout_aborts():
 
 
 @needs_native
-def test_max_ranks_world():
-    # kMaxRanks boundary: a full 16-rank world (the shm backend's
-    # capacity limit) runs collectives and p2p correctly; 17 ranks is
-    # rejected by the launcher before any process starts.
+def test_32_rank_world():
+    # The shm segment is runtime-sized from the launcher's -n (the
+    # reference's mpirun has no compile-time world bound; the old
+    # kMaxRanks=16 hard cap was round 3's one remaining wall): a
+    # 32-rank world — twice the former cap — runs collectives and p2p
+    # correctly.
     res = launch(
-        16,
+        32,
         """
         import numpy as np, jax.numpy as jnp
         import mpi4jax_tpu as m4t
         from mpi4jax_tpu.runtime import shm
         r, n = shm.rank(), shm.size()
-        assert n == 16
+        assert n == 32
         s = m4t.allreduce(jnp.float32(r), op=m4t.SUM)
-        assert float(s) == sum(range(16)), float(s)
+        assert float(s) == sum(range(32)), float(s)
         ag = m4t.allgather(jnp.float32(r))
-        assert np.allclose(np.asarray(ag), np.arange(16.0))
+        assert np.allclose(np.asarray(ag), np.arange(32.0))
         sw = m4t.sendrecv(jnp.float32(r), jnp.float32(0),
                           source=(r - 1) % n, dest=(r + 1) % n)
         assert float(sw) == (r - 1) % n
         m4t.barrier()
         print(f"MAX_OK{r}.")
         """,
-        timeout=240,
+        timeout=480,
     )
     assert res.returncode == 0, res.stderr
-    for r in range(16):
+    for r in range(32):
         # trailing delimiter: "MAX_OK1" must not match "MAX_OK10"
         assert f"MAX_OK{r}." in res.stdout
 
@@ -513,11 +515,11 @@ def test_launcher_rejects_oversized_world():
     import sys
 
     res = subprocess.run(
-        [sys.executable, "-m", "mpi4jax_tpu.launch", "-n", "17", "x.py"],
+        [sys.executable, "-m", "mpi4jax_tpu.launch", "-n", "65", "x.py"],
         capture_output=True, text=True, timeout=30, cwd=REPO,
     )
     assert res.returncode != 0
-    assert "16" in res.stderr
+    assert "64" in res.stderr
 
 
 @needs_native
