@@ -1,0 +1,153 @@
+"""Per-op dispatch cost on the real chip, tunnel cost separated.
+
+Round 3's eager fast-path claim (~85 us/op) came from CPU
+measurements; this records what the ops actually cost through the TPU
+tunnel (VERDICT r3 next #8). Three layers, reported separately so the
+tunnel round-trip is not mistaken for op cost:
+
+1. `tunnel_roundtrip_ms` — host fetch of an already-computed scalar:
+   the pure transport floor every per-call timing includes.
+2. `noop_jit_ms` — dispatch + sync of a jitted identity: transport
+   plus PJRT dispatch, still no collective work.
+3. Per op (allreduce / allgather / alltoall / sendrecv / bcast at the
+   chip's world size of 1):
+   - `eager_ms_per_call`, `jit_ms_per_call`: one call per sync —
+     *includes* the round trip (compare against rows 1-2);
+   - `chained_us_per_op`: slope between 8 and 64 ops chained in one
+     jit — the true per-op device cost with transport cancelled, the
+     number comparable to the reference's per-MPI-call overhead.
+
+Writes `benchmarks/results_r04_tpu_micro.json` (the single-chip micro
+artifact; the collective-bandwidth configs of `micro.py` are size-1
+no-ops on one chip — honestly degenerate — so this is where the
+non-degenerate single-chip numbers live).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+ITERS = int(os.environ.get("M4T_DISPATCH_ITERS", "30"))
+
+
+def median_time(thunk, iters=ITERS, warmup=3):
+    for _ in range(warmup):
+        thunk()
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        thunk()
+        ts.append(time.perf_counter() - t0)
+    return statistics.median(ts)
+
+
+def main():
+    import jax
+
+    if os.environ.get("M4T_DISPATCH_PLATFORM"):
+        jax.config.update(
+            "jax_platforms", os.environ["M4T_DISPATCH_PLATFORM"]
+        )
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as m4t
+    from mpi4jax_tpu.utils.profiling import device_sync
+
+    dev = jax.devices()[0]
+    n = 1  # world size on the single exposed chip
+    ring = tuple((r + 1) % n for r in range(n))
+    x = jnp.ones((8, 128), jnp.float32)
+    jax.block_until_ready(x)
+
+    result = {
+        "artifact": "dispatch_micro",
+        "round": 4,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", "unknown"),
+        "world_size": n,
+        "iters": ITERS,
+        "note": (
+            "eager/jit per-call rows INCLUDE the tunnel round trip "
+            "(compare tunnel_roundtrip_ms / noop_jit_ms); "
+            "chained_us_per_op is the transport-cancelled device cost"
+        ),
+        "ops": {},
+    }
+
+    # 1. pure transport: fetch a ready scalar
+    ready = jax.block_until_ready(jnp.float32(1.0))
+    rt = median_time(lambda: jax.device_get(ready))
+    result["tunnel_roundtrip_ms"] = round(rt * 1e3, 4)
+    print(f"tunnel roundtrip: {rt*1e3:.3f} ms", file=sys.stderr)
+
+    # 2. dispatch floor: jitted identity
+    ident = jax.jit(lambda a: a + 0.0)
+    ident(x)
+    noop = median_time(lambda: device_sync(ident(x)))
+    result["noop_jit_ms"] = round(noop * 1e3, 4)
+    print(f"noop jit dispatch+sync: {noop*1e3:.3f} ms", file=sys.stderr)
+
+    ops = {
+        "allreduce": lambda a: m4t.allreduce(a, op=m4t.SUM),
+        "allgather": lambda a: m4t.allgather(a)[0],
+        "alltoall": lambda a: m4t.alltoall(a.reshape(n, -1)).reshape(a.shape),
+        "sendrecv": lambda a: m4t.sendrecv(
+            a, a, source=ring, dest=ring, sendtag=3
+        ),
+        "bcast": lambda a: m4t.bcast(a, root=0),
+    }
+
+    for name, fn in ops.items():
+        row = {}
+        # eager per call (includes round trip)
+        row["eager_ms_per_call"] = round(
+            median_time(lambda: device_sync(fn(x))) * 1e3, 4
+        )
+        # jitted per call (includes round trip)
+        jf = jax.jit(fn)
+        jf(x)
+        row["jit_ms_per_call"] = round(
+            median_time(lambda: device_sync(jf(x))) * 1e3, 4
+        )
+
+        # chained: slope over op count inside one jit cancels transport
+        def chained(k):
+            def body(a):
+                for _ in range(k):
+                    # the tiny multiply defeats CSE between iterations
+                    a = fn(a * 1.0000001)
+                return a
+
+            cf = jax.jit(body)
+            cf(x)
+            return median_time(lambda: device_sync(cf(x)), iters=10)
+
+        t_lo, t_hi = chained(8), chained(64)
+        row["chained_us_per_op"] = round((t_hi - t_lo) / 56 * 1e6, 2)
+        result["ops"][name] = row
+        print(
+            f"{name}: eager {row['eager_ms_per_call']} ms/call, "
+            f"jit {row['jit_ms_per_call']} ms/call, "
+            f"chained {row['chained_us_per_op']} us/op",
+            file=sys.stderr,
+        )
+
+    out = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "results_r04_tpu_micro.json",
+    )
+    with open(out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps({"artifact": out}))
+
+
+if __name__ == "__main__":
+    main()
