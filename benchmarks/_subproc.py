@@ -1,0 +1,42 @@
+"""Session-isolated subprocess runner shared by the evidence scripts.
+
+The axon TPU tunnel can wedge inside native code where no Python
+signal handler runs — only a process-group kill works — so every
+on-chip child (watcher probes and battery stages, roofline rows,
+mosaic compile attempts) runs in its own session and is SIGKILLed as
+a group on timeout. One implementation, so a timeout-handling fix
+lands everywhere at once.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+
+
+def run_group(cmd, env=None, timeout=None, cwd=None):
+    """Run ``cmd`` in its own session; kill the whole group on timeout.
+
+    Returns ``(returncode, combined_output)``; ``returncode`` is
+    ``None`` when the timeout fired (the group was SIGKILLed).
+    """
+    proc = subprocess.Popen(
+        cmd,
+        env=env,
+        cwd=cwd,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        start_new_session=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+        return proc.returncode, out
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        out, _ = proc.communicate()
+        return None, out

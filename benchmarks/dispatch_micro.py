@@ -17,10 +17,11 @@ tunnel round-trip is not mistaken for op cost:
      jit — the true per-op device cost with transport cancelled, the
      number comparable to the reference's per-MPI-call overhead.
 
-Writes `benchmarks/results_r04_tpu_micro.json` (the single-chip micro
-artifact; the collective-bandwidth configs of `micro.py` are size-1
-no-ops on one chip — honestly degenerate — so this is where the
-non-degenerate single-chip numbers live).
+Writes `benchmarks/results_r{N}_dispatch_micro.json` (N = M4T_ROUND,
+default 5; the single-chip micro artifact — the collective-bandwidth
+configs of `micro.py` are size-1 no-ops on one chip, honestly
+degenerate, so this is where the non-degenerate single-chip numbers
+live).
 """
 
 from __future__ import annotations
@@ -35,6 +36,7 @@ sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 
+ROUND = int(os.environ.get("M4T_ROUND", "5"))
 ITERS = int(os.environ.get("M4T_DISPATCH_ITERS", "30"))
 
 
@@ -69,7 +71,7 @@ def main():
 
     result = {
         "artifact": "dispatch_micro",
-        "round": 4,
+        "round": ROUND,
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", "unknown"),
         "world_size": n,
@@ -142,7 +144,7 @@ def main():
 
     out = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
-        "results_r04_tpu_micro.json",
+        f"results_r{ROUND:02d}_dispatch_micro.json",
     )
     with open(out, "w") as f:
         json.dump(result, f, indent=1)

@@ -1,0 +1,108 @@
+"""Capture the real compile error for the fenced fused-kernel sizes.
+
+The r4 roofline sweep lost block_rows >= 200 to an opaque
+`tpu_compile_helper` HTTP 500 with no Mosaic diagnostic
+(`results_r04_roofline.json`), so those sizes are fenced out of the
+sweep by `fused_step.block_rows_compilable` on a VMEM *model* rather
+than a measured limit. This script exists to replace that guess with
+the compiler's own words: it attempts ONE compile per fenced size,
+each in its own subprocess with a kill-timeout (a wedged compile must
+not take the session down — the suspected r4 wedge cause), and records
+whatever the compiler says verbatim.
+
+Writes `benchmarks/results_r{N}_mosaic_diag.json` (N = M4T_ROUND,
+default 5). Run by the chip watcher battery (`tpu_watch.py`) on any
+healthy-chip window; harmless on CPU (records the platform mismatch).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _subproc import run_group  # noqa: E402
+
+ROUND = int(os.environ.get("M4T_ROUND", "5"))
+COMPILE_TIMEOUT_S = int(os.environ.get("M4T_DIAG_TIMEOUT", "300"))
+
+_CHILD_SRC = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import jax
+if os.environ.get("M4T_DIAG_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["M4T_DIAG_PLATFORM"])
+import jax.numpy as jnp
+from mpi4jax_tpu.models import fused_step as fs
+from mpi4jax_tpu.models.shallow_water import (
+    ModelState, ShallowWaterConfig, ShallowWaterModel,
+)
+
+b = {block_rows}
+cfg = ShallowWaterConfig(nx=3600, ny=1800, dims=(1, 1))
+model = ShallowWaterModel(cfg)
+state = ModelState(*(jnp.asarray(x[0]) for x in model.initial_state_blocks()))
+state = jax.jit(lambda s: model.step(s, first_step=True))(state)
+padded = fs.pad_state(cfg, state, b)
+out = jax.jit(lambda s: fs.fused_step(cfg, s, block_rows=b))(padded)
+jax.block_until_ready(out.h)
+print("COMPILE_OK", flush=True)
+"""
+
+
+def main():
+    from mpi4jax_tpu.models import fused_step as fs
+    from mpi4jax_tpu.models.shallow_water import ShallowWaterConfig
+
+    cfg = ShallowWaterConfig(nx=3600, ny=1800, dims=(1, 1))
+    fenced = [
+        b
+        for b in (200, 240, 320)
+        if fs.block_rows_legal(cfg.ny_local, b)
+        and not fs.block_rows_compilable(cfg, b)
+    ]
+    result = {
+        "artifact": "mosaic_diag",
+        "round": ROUND,
+        "vmem_model_ceiling_bytes": fs.VMEM_COMPILE_CEILING,
+        "attempts": [],
+    }
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"results_r{ROUND:02d}_mosaic_diag.json",
+    )
+    for b in fenced:
+        src = _CHILD_SRC.format(repo=REPO, block_rows=b)
+        t0 = time.perf_counter()
+        rc, out = run_group(
+            [sys.executable, "-c", src],
+            timeout=COMPILE_TIMEOUT_S, cwd=REPO,
+        )
+        rec = {
+            "block_rows": b,
+            "vmem_model_bytes": fs.vmem_model_bytes(b, fs.padded_cols(cfg)),
+            "elapsed_s": round(time.perf_counter() - t0, 1),
+            "outcome": (
+                "compiled" if (rc == 0 and "COMPILE_OK" in (out or ""))
+                else "wedged_timeout" if rc is None
+                else "failed"
+            ),
+            "exit_code": rc,
+            "tail": None if rc == 0 else (out or "")[-1500:],
+        }
+        result["attempts"].append(rec)
+        print(f"b={b}: {rec['outcome']}", file=sys.stderr)
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1)
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps({"artifact": out_path,
+                      "attempts": len(result["attempts"])}))
+
+
+if __name__ == "__main__":
+    main()

@@ -2,8 +2,8 @@
 
 Answers the question the headline number (`bench.py`) cannot: is the
 fused Pallas kernel actually fast *for this chip*, or merely faster
-than the reference's 2016 P100? Three measurements, all on the real
-device, all closed with a host fetch (`device_sync` — the tunnel's
+than the reference's 2016 P100? Measurements, all on the real device,
+all closed with a host fetch (`device_sync` — the tunnel's
 `block_until_ready` is a no-op, see `utils/profiling.py`):
 
 1. **Paper peak**: the device's nominal HBM bandwidth, detected from
@@ -13,17 +13,39 @@ device, all closed with a host fetch (`device_sync` — the tunnel's
    + 6 block writes per tile — but no compute. This is the achievable
    bandwidth for this access pattern; the gap between it and paper
    peak is DMA/grid overhead, not kernel inefficiency.
-3. **The fused step** at every legal block size, plus the composable
-   XLA step for reference.
+3. **Stream ceiling**: a plain 6-in/6-out blocked copy through the
+   standard Pallas grid pipeline — the chip's practical streaming
+   bandwidth for this field count, the bound any halo'd pattern can
+   approach.
+4. **The fused step** at every compilable block size — one step per
+   pass (`fused_b*`) and temporally blocked two steps per pass
+   (`fused2_b*`) — plus the composable XLA step for reference.
 
-Bytes-moved per step comes from the kernel's own pass model (the
-"~13 passes" claim of `models/fused_step.py` made exact):
+Timing is two-point slope timing (`time_loop`): the tunnel pays a
+fixed ~100+ ms per timed call, which naive small-step timings read as
+per-step cost; the slope between `lo` and `lo + steps` chained
+applications cancels any per-call constant exactly.
+
+Bytes-moved per *pass* comes from the kernel's own pass model:
 
     reads  = 6 fields x n_tiles x slab_rows x nx_pad x itemsize
     writes = 6 fields x nyp x nx_pad x itemsize
 
-Writes `benchmarks/results_r04_roofline.json` and prints a summary.
-Run on the default platform (TPU when the tunnel answers); set
+(for `fused2_b*` one pass advances two steps, so bytes per *step* is
+half of that — recorded explicitly per row).
+
+Wedge containment: every row runs in its own subprocess with a
+kill-timeout (the axon tunnel wedges inside native code where no
+Python signal handler runs — same pattern as `bench.py` and
+`tests/test_on_chip.py`), the artifact is rewritten after every row,
+and two consecutive row timeouts abort the sweep (a wedged tunnel
+times out every remaining row identically). Block sizes outside the
+empirical VMEM compile fence (`fused_step.block_rows_compilable`) are
+recorded as fenced, never submitted — the r4 sweep lost its remaining
+rows to an opaque tunnel-side HTTP 500 at block_rows >= 200.
+
+Writes `benchmarks/results_r{N}_roofline.json` (N = M4T_ROUND, default
+5). Run on the default platform (TPU when the tunnel answers); set
 `M4T_ROOFLINE_PLATFORM=cpu` for a plumbing rehearsal (artifact then
 marked `platform: cpu`, numbers meaningless for the roofline).
 
@@ -39,9 +61,10 @@ import os
 import sys
 import time
 
-sys.path.insert(
-    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _subproc import run_group  # noqa: E402
 
 #: nominal HBM bandwidth by TPU generation, GB/s per chip. Sources:
 #: public TPU system architecture docs (v4: 1228, v5e: 819, v5p: 2765,
@@ -56,8 +79,19 @@ HBM_PEAK_GBPS = {
     "v6e": 1640.0,
 }
 
+ROUND = int(os.environ.get("M4T_ROUND", "5"))
 STEPS = int(os.environ.get("M4T_ROOFLINE_STEPS", "50"))
 REPEATS = int(os.environ.get("M4T_ROOFLINE_REPEATS", "3"))
+SCALE = int(os.environ.get("M4T_ROOFLINE_SCALE", "10"))
+#: per-row child budget: compile (~20-40 s healthy) + slope timing
+ROW_TIMEOUT_S = int(os.environ.get("M4T_ROOFLINE_ROW_TIMEOUT", "420"))
+#: consecutive row timeouts that mean "the tunnel is wedged, stop"
+MAX_CONSECUTIVE_TIMEOUTS = 2
+
+ARTIFACT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    f"results_r{ROUND:02d}_roofline.json",
+)
 
 
 def detect_peak(device) -> float | None:
@@ -66,6 +100,41 @@ def detect_peak(device) -> float | None:
         if key in kind:
             return gbps
     return None
+
+
+def make_config():
+    from mpi4jax_tpu.models.shallow_water import ShallowWaterConfig
+
+    return ShallowWaterConfig(nx=360 * SCALE, ny=180 * SCALE, dims=(1, 1))
+
+
+def row_plan():
+    """The sweep, as (name, kind, block_rows) tuples. Pure host-side
+    arithmetic — safe to call in the parent without touching the
+    device. Fenced sizes are included with kind="fenced" so the
+    artifact records *why* they are absent."""
+    from mpi4jax_tpu.models import fused_step as fs
+
+    config = make_config()
+    plan = [("xla_step", "xla", None)]
+    for prefix, kind, spp in (
+        ("fused", "fused1", 1),
+        ("fused2", "fused2", 2),
+        ("fused4", "fused4", 4),
+    ):
+        halo = fs.halo_for(spp)
+        for b in (40, 64, 80, 128, 160, 200, 240, 320):
+            if not fs.block_rows_legal(config.ny_local, b, halo):
+                continue
+            if fs.block_rows_compilable(config, b, halo):
+                plan.append((f"{prefix}_b{b}", kind, b))
+            else:
+                plan.append((f"{prefix}_b{b}", "fenced", b))
+    for b in (80, 160):
+        if fs.block_rows_compilable(config, b):
+            plan.append((f"copy_ceiling_b{b}", "copy_ceiling", b))
+    plan.append(("stream_ceiling_b128", "stream_ceiling", 128))
+    return plan
 
 
 def copy_ceiling_kernel(nyp, nx, block_rows, dtype):
@@ -159,9 +228,7 @@ def copy_ceiling_kernel(nyp, nx, block_rows, dtype):
 
 def stream_ceiling_kernel(nyp, nx, block_rows, dtype):
     """Plain 6-in/6-out blocked copy through the standard Pallas grid
-    pipeline (automatic double buffering, no halo): the chip's
-    practical streaming bandwidth for this field count, the upper
-    bound any halo'd pattern can approach."""
+    pipeline (automatic double buffering, no halo)."""
     import jax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -242,7 +309,7 @@ def time_loop(fn, state, steps, repeats):
     return slopes[len(slopes) // 2]
 
 
-def bytes_per_step(nyp, nx, block_rows, itemsize, halo):
+def bytes_per_pass(nyp, nx, block_rows, itemsize, halo):
     slab_rows = block_rows + 2 * halo
     n_tiles = nyp // block_rows
     reads = 6 * n_tiles * slab_rows * nx * itemsize
@@ -250,7 +317,8 @@ def bytes_per_step(nyp, nx, block_rows, itemsize, halo):
     return reads + writes
 
 
-def main():
+def measure_row(name, kind, block_rows):
+    """Child-process body: time one row, return the row dict."""
     import jax
 
     if os.environ.get("M4T_ROOFLINE_PLATFORM"):
@@ -262,194 +330,189 @@ def main():
     from mpi4jax_tpu.models import fused_step as fs
     from mpi4jax_tpu.models.shallow_water import (
         ModelState,
-        ShallowWaterConfig,
         ShallowWaterModel,
     )
 
     dev = jax.devices()[0]
     peak = detect_peak(dev)
-    scale = int(os.environ.get("M4T_ROOFLINE_SCALE", "10"))
-    config = ShallowWaterConfig(nx=360 * scale, ny=180 * scale, dims=(1, 1))
+    config = make_config()
     model = ShallowWaterModel(config)
     state = ModelState(
         *(jnp.asarray(b[0]) for b in model.initial_state_blocks())
     )
     state = jax.jit(lambda s: model.step(s, first_step=True))(state)
-
     nx_pad = fs.padded_cols(config)
     itemsize = 4
-    result = {
-        "artifact": "roofline",
-        "round": 4,
+
+    row = {
+        "config": name,
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", "unknown"),
         "hbm_peak_gbps": peak,
-        "grid": [config.ny, config.nx],
-        "padded_cols": nx_pad,
-        "steps_timed": STEPS,
-        "repeats": REPEATS,
-        "rows": [],
     }
 
-    # -- XLA composable step (the fused kernel's competition) ---------
-    ms = time_loop(model.step, state, STEPS, REPEATS) * 1e3
-    result["rows"].append(
-        {"config": "xla_step", "ms_per_step": round(ms, 4)}
+    if kind == "xla":
+        ms = time_loop(model.step, state, STEPS, REPEATS) * 1e3
+        row["ms_per_step"] = round(ms, 4)
+        return row
+
+    b = block_rows
+    row["block_rows"] = b
+    nyp = fs.padded_rows(config, b)
+    padded = fs.pad_state(config, state, b)
+    steps_per_pass = 1
+    halo = fs.HALO
+
+    if kind in ("fused1", "fused2", "fused4"):
+        steps_per_pass = int(kind[len("fused"):] or "1")
+        halo = fs.halo_for(steps_per_pass)
+        ms_pass = time_loop(
+            lambda s: fs.fused_step(
+                config, s, block_rows=b, steps_per_pass=steps_per_pass
+            ),
+            padded,
+            STEPS,
+            REPEATS,
+        ) * 1e3
+    elif kind == "copy_ceiling":
+        run, _, _ = copy_ceiling_kernel(nyp, nx_pad, b, jnp.float32)
+        ms_pass = time_loop(
+            lambda s: ModelState(*run(tuple(s))), padded, STEPS, REPEATS
+        ) * 1e3
+    elif kind == "stream_ceiling":
+        run = stream_ceiling_kernel(nyp, nx_pad, b, jnp.float32)
+        ms_pass = time_loop(
+            lambda s: ModelState(*run(tuple(s))), padded, STEPS, REPEATS
+        ) * 1e3
+        nbytes = 12 * nyp * nx_pad * itemsize  # 6 reads + 6 writes
+        gbps = nbytes / (ms_pass * 1e-3) / 1e9
+        row.update(
+            ms_per_step=round(ms_pass, 4),
+            model_bytes_per_step=nbytes,
+            achieved_gbps=round(gbps, 1),
+            pct_of_peak=round(100 * gbps / peak, 1) if peak else None,
+        )
+        return row
+    else:
+        raise ValueError(kind)
+
+    nbytes = bytes_per_pass(nyp, nx_pad, b, itemsize, halo)
+    gbps = nbytes / (ms_pass * 1e-3) / 1e9
+    row.update(
+        steps_per_pass=steps_per_pass,
+        padded_rows=nyp,
+        ms_per_pass=round(ms_pass, 4),
+        ms_per_step=round(ms_pass / steps_per_pass, 4),
+        model_bytes_per_pass=nbytes,
+        model_bytes_per_step=nbytes // steps_per_pass,
+        achieved_gbps=round(gbps, 1),
+        pct_of_peak=round(100 * gbps / peak, 1) if peak else None,
     )
-    print(f"xla_step: {ms:.3f} ms/step", file=sys.stderr)
+    return row
 
-    # -- fused step across legal block sizes --------------------------
-    candidates = [
-        b
-        for b in (40, 64, 80, 128, 160, 200, 240, 320)
-        if fs.block_rows_legal(config.ny_local, b)
-    ]
-    for b in candidates:
-        nyp = fs.padded_rows(config, b)
-        padded = fs.pad_state(config, state, b)
-        try:
-            ms = (
-                time_loop(
-                    lambda s, _b=b: fs.fused_step(config, s, block_rows=_b),
-                    padded,
-                    STEPS,
-                    REPEATS,
-                )
-                * 1e3
-            )
-        except Exception as e:  # VMEM overflow at big blocks: record it
-            result["rows"].append(
-                {
-                    "config": f"fused_b{b}",
-                    "error": f"{type(e).__name__}: {str(e)[:160]}",
-                }
-            )
-            print(f"fused_b{b}: failed ({type(e).__name__})", file=sys.stderr)
-            continue
-        nbytes = bytes_per_step(nyp, nx_pad, b, itemsize, fs.HALO)
-        gbps = nbytes / (ms * 1e-3) / 1e9
-        row = {
-            "config": f"fused_b{b}",
-            "block_rows": b,
-            "padded_rows": nyp,
-            "ms_per_step": round(ms, 4),
-            "model_bytes_per_step": nbytes,
-            "achieved_gbps": round(gbps, 1),
-            "pct_of_peak": round(100 * gbps / peak, 1) if peak else None,
-        }
-        result["rows"].append(row)
-        print(
-            f"fused_b{b}: {ms:.3f} ms/step, {gbps:.0f} GB/s"
-            + (f" ({row['pct_of_peak']}% of peak)" if peak else ""),
-            file=sys.stderr,
-        )
 
-    _write(result)
-
-    # -- pattern ceiling: same DMA pattern, no compute (two sizes
-    # bracket the sweep; the full per-size sweep adds compiles, not
-    # information) --------------------------------------------------
-    for b in [c for c in (80, 160) if c in candidates] or candidates[:1]:
-        nyp = fs.padded_rows(config, b)
-        padded = fs.pad_state(config, state, b)
-        run, slab_rows, n_tiles = copy_ceiling_kernel(
-            nyp, nx_pad, b, jnp.float32
-        )
-        try:
-            ms = (
-                time_loop(
-                    lambda s: ModelState(*run(tuple(s))),
-                    padded,
-                    STEPS,
-                    REPEATS,
-                )
-                * 1e3
-            )
-        except Exception as e:
-            result["rows"].append(
-                {
-                    "config": f"copy_ceiling_b{b}",
-                    "error": f"{type(e).__name__}: {str(e)[:160]}",
-                }
-            )
-            continue
-        nbytes = bytes_per_step(nyp, nx_pad, b, itemsize, fs.HALO)
-        gbps = nbytes / (ms * 1e-3) / 1e9
-        result["rows"].append(
-            {
-                "config": f"copy_ceiling_b{b}",
-                "block_rows": b,
-                "ms_per_step": round(ms, 4),
-                "model_bytes_per_step": nbytes,
-                "achieved_gbps": round(gbps, 1),
-                "pct_of_peak": round(100 * gbps / peak, 1) if peak else None,
-            }
-        )
-        print(
-            f"copy_ceiling_b{b}: {ms:.3f} ms/step, {gbps:.0f} GB/s",
-            file=sys.stderr,
-        )
-
-    _write(result)
-
-    # -- stream ceiling: plain blocked copy, no halo ------------------
-    for b in (128,):
-        if nyp_any := -(-config.ny // b) * b:
-            padded = fs.pad_state(config, state, b)
-            # pad_state pads to padded_rows(config, b) == nyp_any here
-            run = stream_ceiling_kernel(nyp_any, nx_pad, b, jnp.float32)
-            try:
-                ms = (
-                    time_loop(
-                        lambda s: ModelState(*run(tuple(s))),
-                        padded,
-                        STEPS,
-                        REPEATS,
-                    )
-                    * 1e3
-                )
-            except Exception as e:
-                result["rows"].append(
-                    {
-                        "config": f"stream_ceiling_b{b}",
-                        "error": f"{type(e).__name__}: {str(e)[:160]}",
-                    }
-                )
-                continue
-            nbytes = 12 * nyp_any * nx_pad * itemsize  # 6 reads + 6 writes
-            gbps = nbytes / (ms * 1e-3) / 1e9
-            result["rows"].append(
-                {
-                    "config": f"stream_ceiling_b{b}",
-                    "block_rows": b,
-                    "ms_per_step": round(ms, 4),
-                    "model_bytes_per_step": nbytes,
-                    "achieved_gbps": round(gbps, 1),
-                    "pct_of_peak": (
-                        round(100 * gbps / peak, 1) if peak else None
-                    ),
-                }
-            )
-            print(
-                f"stream_ceiling_b{b}: {ms:.3f} ms/step, {gbps:.0f} GB/s",
-                file=sys.stderr,
-            )
-
-    out = _write(result)
-    print(json.dumps({"artifact": out, "rows": len(result["rows"])}))
+def run_child(name, env):
+    """Run one row in its own session; kill the group on timeout."""
+    return run_group(
+        [sys.executable, os.path.abspath(__file__), "--row", name],
+        env=env, timeout=ROW_TIMEOUT_S, cwd=REPO,
+    )
 
 
 def _write(result):
     """Incremental artifact write: the tunnel can wedge mid-run, and a
     partial roofline is still a roofline."""
-    out = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)),
-        "results_r04_roofline.json",
-    )
-    with open(out, "w") as f:
+    with open(ARTIFACT, "w") as f:
         json.dump(result, f, indent=1)
-    return out
+    return ARTIFACT
+
+
+def main():
+    result = {
+        "artifact": "roofline",
+        "round": ROUND,
+        "timing": "two-point slope (fixed per-call cost cancelled)",
+        "grid": [180 * SCALE, 360 * SCALE],
+        "steps_timed": STEPS,
+        "repeats": REPEATS,
+        "row_timeout_s": ROW_TIMEOUT_S,
+        "rows": [],
+    }
+    env = dict(os.environ)
+    consecutive_timeouts = 0
+    # M4T_ROOFLINE_ONLY=a,b,c restricts the *timed* rows (fence rows
+    # are always recorded — they cost nothing); used by the CI smoke
+    only = None
+    if os.environ.get("M4T_ROOFLINE_ONLY"):
+        only = set(os.environ["M4T_ROOFLINE_ONLY"].split(","))
+    for name, kind, b in row_plan():
+        if only is not None and kind != "fenced" and name not in only:
+            continue
+        if kind == "fenced":
+            result["rows"].append(
+                {
+                    "config": name,
+                    "block_rows": b,
+                    "fenced": (
+                        "VMEM model exceeds the empirical compile "
+                        "ceiling (fused_step.block_rows_compilable); "
+                        "r4 sweep died here with tunnel-side HTTP 500"
+                    ),
+                }
+            )
+            _write(result)
+            continue
+        rc, out = run_child(name, env)
+        row = None
+        for line in (out or "").splitlines():
+            if line.startswith("ROW_JSON "):
+                row = json.loads(line[len("ROW_JSON "):])
+        if rc == 0 and row is not None:
+            consecutive_timeouts = 0
+            # hoist device identity to the header from the first row
+            for key in ("platform", "device_kind", "hbm_peak_gbps"):
+                result.setdefault(key, row.pop(key, None))
+                row.pop(key, None)
+            result["rows"].append(row)
+            print(f"{name}: {json.dumps(row)}", file=sys.stderr)
+        elif rc is None:
+            consecutive_timeouts += 1
+            result["rows"].append(
+                {"config": name, "error": f"timeout after {ROW_TIMEOUT_S}s"}
+            )
+            print(f"{name}: TIMEOUT", file=sys.stderr)
+        else:
+            consecutive_timeouts = 0
+            result["rows"].append(
+                {
+                    "config": name,
+                    "error": f"exit {rc}",
+                    "tail": (out or "")[-400:],
+                }
+            )
+            print(f"{name}: exit {rc}", file=sys.stderr)
+        _write(result)
+        if consecutive_timeouts >= MAX_CONSECUTIVE_TIMEOUTS:
+            result["aborted"] = (
+                f"{consecutive_timeouts} consecutive row timeouts — "
+                "tunnel wedged; remaining rows skipped"
+            )
+            _write(result)
+            print("# sweep aborted: tunnel wedged", file=sys.stderr)
+            break
+    out = _write(result)
+    print(json.dumps({"artifact": out, "rows": len(result["rows"])}))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--row":
+        name = sys.argv[2]
+        match = [r for r in row_plan() if r[0] == name]
+        if not match:
+            print(f"unknown row {name}", file=sys.stderr)
+            sys.exit(2)
+        _, kind, b = match[0]
+        row = measure_row(name, kind, b)
+        print("ROW_JSON " + json.dumps(row))
+    else:
+        main()

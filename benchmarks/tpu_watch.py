@@ -1,42 +1,77 @@
 """Chip-opportunist harness: probe the TPU tunnel all round, capture on-chip
 numbers the moment it answers.
 
-The axon TPU tunnel has been wedged for two rounds (PJRT init hangs with the
+The axon TPU tunnel wedges for long stretches (PJRT init hangs with the
 GIL held in native code, so only process-level kills work — see
-``bench.py:_probe_accelerator``). Instead of checking the chip at two instants
-per round, this supervisor probes every ``--interval`` seconds for the whole
-round, appends one JSON line per attempt to ``BENCH_r03_probes.jsonl``, and on
-the first successful probe fires the full measurement battery:
+``bench.py:_probe_accelerator``). Every on-chip number this project has
+ever captured came from an unpredictable chip window, so this
+supervisor probes every ``--interval`` seconds for the whole round,
+appends one JSON line per attempt to ``BENCH_r{N}_probes.jsonl``, and on
+the first successful probe fires the full evidence battery — every
+artifact the round owes, each stage in its own killable subprocess so
+one wedged compile cannot take down the rest:
 
-1. ``bench.py`` — headline shallow-water solve, ``vs_baseline`` vs the
-   reference's 6.28 s P100 row (``/root/reference/docs/shallow-water.rst:81-83``)
-   → ``BENCH_r03_tpu.json``
-2. ``benchmarks/micro.py`` — the five BASELINE.json configs + 1 MB allreduce
-   bus bandwidth → ``benchmarks/results_r03_tpu_micro.json``
-3. Pallas ring vs HLO AllReduce at 1–64 MiB (needs >1 chip; recorded as
-   skipped when the tunnel exposes a single device).
+1. ``bench.py`` — headline shallow-water solve → ``BENCH_r{N}_tpu.json``
+2. ``bench.py`` with ``M4T_BENCH_MULTISTEP=100`` — the reference-style
+   chunked dispatch protocol (``/root/reference/examples/
+   shallow_water.py:440-458``) → ``BENCH_r{N}_tpu_chunked.json``
+3. ``benchmarks/dispatch_micro.py`` — per-op dispatch cost, tunnel
+   cost separated
+4. ``benchmarks/fullspan_equiv.py`` — 433-step fused-vs-XLA end-state
+   deviation (both steps_per_pass variants)
+5. ``benchmarks/roofline.py`` — slope-timed fused/fused2 sweep +
+   pattern/stream ceilings (self-isolates per row)
+6. ``benchmarks/mosaic_diag.py`` — one compile attempt per fenced
+   block size, capturing the real compiler error
+7. ``benchmarks/micro.py`` — BASELINE.json configs (latency rows
+   stand at world size 1)
+8. ``benchmarks/ring_sweep.py`` — only when >1 real chip is exposed
 
-Each probe runs in a fresh process (fresh PJRT client) in its own session so
-a wedged child can be killed as a group. Probes rotate through recovery
-variants (env knobs) in case one of them unwedges the tunnel.
+Wedge forensics (VERDICT r4 next #7): every probe outcome transition
+(healthy <-> wedged) is logged with the last battery activity and its
+end time, so "tunnel died on its own" and "our compile wedged it" are
+distinguishable from the record.
+
+Re-armable: after a successful capture the done marker stores a
+fingerprint of the battery scripts; if the scripts change (a kernel or
+benchmark improved mid-round), the watcher re-arms and captures again
+on the next healthy window instead of sleeping on stale artifacts.
 
 Run:  python benchmarks/tpu_watch.py [--interval 600] [--once]
 """
 
 import argparse
+import hashlib
 import json
 import os
-import signal
-import subprocess
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _subproc import run_group  # noqa: E402
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PROBE_LOG = os.path.join(REPO, "BENCH_r03_probes.jsonl")
-DONE_MARKER = os.path.join(REPO, "benchmarks", "results_r03_tpu_captured")
+ROUND = int(os.environ.get("M4T_ROUND", "5"))
+PROBE_LOG = os.path.join(REPO, f"BENCH_r{ROUND:02d}_probes.jsonl")
+DONE_MARKER = os.path.join(
+    REPO, "benchmarks", f"results_r{ROUND:02d}_tpu_captured"
+)
 
 PROBE_TIMEOUT_S = int(os.environ.get("M4T_WATCH_PROBE_TIMEOUT", "90"))
-BATTERY_TIMEOUT_S = int(os.environ.get("M4T_WATCH_BATTERY_TIMEOUT", "1800"))
+STAGE_TIMEOUT_S = int(os.environ.get("M4T_WATCH_STAGE_TIMEOUT", "1800"))
+
+#: files whose content defines the battery; a change re-arms the watcher
+FINGERPRINT_FILES = [
+    "bench.py",
+    "benchmarks/micro.py",
+    "benchmarks/dispatch_micro.py",
+    "benchmarks/fullspan_equiv.py",
+    "benchmarks/roofline.py",
+    "benchmarks/mosaic_diag.py",
+    "benchmarks/ring_sweep.py",
+    "mpi4jax_tpu/models/fused_step.py",
+    "mpi4jax_tpu/models/shallow_water.py",
+]
 
 _PROBE_SRC = """
 import json, sys
@@ -62,27 +97,20 @@ VARIANTS = [
 ]
 
 
-def _run(cmd, env, timeout):
-    """Run cmd in its own session; kill the whole group on timeout."""
-    proc = subprocess.Popen(
-        cmd,
-        env=env,
-        cwd=REPO,
-        stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT,
-        text=True,
-        start_new_session=True,
-    )
-    try:
-        out, _ = proc.communicate(timeout=timeout)
-        return proc.returncode, out
-    except subprocess.TimeoutExpired:
+def battery_fingerprint():
+    h = hashlib.sha256()
+    for rel in FINGERPRINT_FILES:
+        path = os.path.join(REPO, rel)
         try:
-            os.killpg(proc.pid, signal.SIGKILL)
+            with open(path, "rb") as f:
+                h.update(f.read())
         except OSError:
-            pass
-        out, _ = proc.communicate()
-        return None, out
+            h.update(rel.encode())
+    return h.hexdigest()[:16]
+
+
+def _run(cmd, env, timeout):
+    return run_group(cmd, env=env, timeout=timeout, cwd=REPO)
 
 
 def log_probe(record):
@@ -92,7 +120,19 @@ def log_probe(record):
     print(json.dumps(record), flush=True)
 
 
-def probe(attempt):
+#: forensics state: the most recent builder-initiated chip activity
+_last_activity = {"what": None, "ended": None, "exit": None}
+
+
+def note_activity(what, exit_code):
+    _last_activity.update(
+        what=what,
+        ended=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        exit=exit_code,
+    )
+
+
+def probe(attempt, prev_outcome):
     variant = VARIANTS[attempt % len(VARIANTS)]
     env = dict(os.environ)
     env.update(variant)
@@ -108,19 +148,107 @@ def probe(attempt):
         else "wedged_timeout" if rc is None
         else "failed"
     )
-    log_probe(
-        {
-            "attempt": attempt,
-            "outcome": outcome,
-            "elapsed_s": elapsed,
-            "variant": variant,
-            "exit_code": rc,
-            "device": (info or {}).get("device"),
-            "n_devices": (info or {}).get("n_devices"),
-            "tail": None if outcome == "ok" else (out or "")[-500:],
+    record = {
+        "attempt": attempt,
+        "outcome": outcome,
+        "elapsed_s": elapsed,
+        "variant": variant,
+        "exit_code": rc,
+        "device": (info or {}).get("device"),
+        "n_devices": (info or {}).get("n_devices"),
+        "tail": None if outcome == "ok" else (out or "")[-500:],
+    }
+    # wedge forensics: record what last touched the chip whenever the
+    # health state flips, so a wedge can be attributed (or cleared)
+    if prev_outcome is not None and (prev_outcome == "ok") != (outcome == "ok"):
+        record["transition"] = {
+            "from": prev_outcome,
+            "to": outcome,
+            "last_battery_activity": dict(_last_activity),
         }
-    )
-    return outcome == "ok", info, variant
+    log_probe(record)
+    return outcome, info, variant
+
+
+def _artifact_on_chip(path):
+    """True iff the artifact self-reports a non-CPU platform. Guards
+    the done-marker: a chip that answers the probe but degrades to a
+    silent CPU fallback mid-battery must NOT disarm the watcher —
+    rc==0 alone proves nothing (every script exits 0 on CPU)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False
+    return data.get("platform") not in (None, "cpu")
+
+
+def stage(results, name, cmd, env, timeout=None, expect=None):
+    """One battery stage in a killable subprocess. ``expect`` lists
+    artifact paths (repo-relative); a stage counts as an on-chip
+    capture only when an expected artifact exists AND self-reports a
+    non-CPU platform. Pre-existing artifacts at expected paths are
+    moved aside first (to ``.prev``) — otherwise a stage that wedges
+    before writing would let a *stale* capture masquerade as a fresh
+    one and disarm the watcher with untrue evidence."""
+    for rel in expect or []:
+        path = os.path.join(REPO, rel)
+        if os.path.exists(path):
+            os.replace(path, path + ".prev")
+    rc, out = _run(cmd, env, timeout or STAGE_TIMEOUT_S)
+    note_activity(name, rc)
+    rec = {
+        "exit_code": rc,
+        "tail": None if rc == 0 else (out or "")[-2000:],
+    }
+    captured = []
+    on_chip = False
+    for rel in expect or []:
+        path = os.path.join(REPO, rel)
+        if os.path.exists(path):
+            captured.append(rel)
+            on_chip |= _artifact_on_chip(path)
+    rec["captured"] = captured
+    rec["on_chip"] = on_chip
+    results[name] = rec
+    log_probe({"stage": name, "exit_code": rc, "captured": captured,
+               "on_chip": on_chip})
+    return rc, out, on_chip
+
+
+def _bench_stage(results, env, name, out_name, multistep=None):
+    """bench.py run; only a plausible on-chip metric line is captured
+    (bench falls back to CPU when its canary fails and still emits a
+    line with vs_baseline null — never record that as on-chip; and a
+    433-step solve cannot finish in < 50 ms on any hardware, smaller
+    means the timing loop failed to synchronize)."""
+    stage_env = dict(env)
+    if multistep is not None:
+        stage_env["M4T_BENCH_MULTISTEP"] = str(multistep)
+    rc, out, _ = stage(results, name, [sys.executable, "bench.py"], stage_env)
+    bench_line = None
+    for line in (out or "").splitlines():
+        try:
+            rec = json.loads(line)
+            if isinstance(rec, dict) and "metric" in rec:
+                bench_line = rec
+        except (json.JSONDecodeError, ValueError):
+            continue
+    results[name]["result"] = bench_line
+    if (
+        bench_line is not None
+        and bench_line.get("vs_baseline") is not None
+        and bench_line.get("value", 0.0) >= 0.05
+    ):
+        if multistep is not None:
+            bench_line = dict(bench_line, multistep=multistep)
+        with open(os.path.join(REPO, out_name), "w") as f:
+            json.dump(bench_line, f)
+        results[name]["captured"].append(out_name)
+        return True
+    if bench_line is not None:
+        results[name]["cpu_fallback_suspected"] = True
+    return False
 
 
 def run_battery(info, variant):
@@ -132,77 +260,163 @@ def run_battery(info, variant):
     """
     env = dict(os.environ)
     env.update(variant)
+    env.setdefault("M4T_ROUND", str(ROUND))
     results = {"device": info}
     captured = False
+    # artifact names follow the round the children are told to write
+    # (rehearsal redirects to a scratch round)
+    rr = f"r{int(env['M4T_ROUND']):02d}"
 
-    # 1. headline bench (vs_baseline vs the 6.28 s P100 row)
-    rc, out = _run([sys.executable, "bench.py"], env, BATTERY_TIMEOUT_S)
-    bench_line = None
-    for line in (out or "").splitlines():
-        try:
-            rec = json.loads(line)
-            if isinstance(rec, dict) and "metric" in rec:
-                bench_line = rec
-        except (json.JSONDecodeError, ValueError):
-            continue
-    results["bench"] = {"exit_code": rc, "result": bench_line,
-                        "tail": (out or "")[-2000:] if bench_line is None else None}
-    # bench.py falls back to CPU when its own canary fails (the chip can
-    # re-wedge between our probe and its run) and still emits a metric
-    # line with vs_baseline null — never record that as an on-chip
-    # number. vs_baseline is only non-null for single-device accelerator
-    # runs on the published config (bench.py:243-247). Plausibility
-    # floor: a 433-step solve of an 1800x3600 grid cannot finish in
-    # < 50 ms on any hardware; a smaller value means the timing loop
-    # failed to synchronize (seen with the axon tunnel's no-op
-    # block_until_ready) and must not be captured as a result.
-    if (
-        bench_line is not None
-        and bench_line.get("vs_baseline") is not None
-        and bench_line.get("value", 0.0) >= 0.05
-    ):
-        with open(os.path.join(REPO, "BENCH_r03_tpu.json"), "w") as f:
-            json.dump(bench_line, f)
-        captured = True
-    elif bench_line is not None:
-        results["bench"]["cpu_fallback_suspected"] = True
-
-    # 2. micro battery (BASELINE configs + bus bandwidth); nproc follows
-    # the real device count — with a single tunnel chip the collective
-    # configs are degenerate but the latency rows still stand
-    micro_out = os.path.join(REPO, "benchmarks", "results_r03_tpu_micro.json")
-    rc, out = _run(
-        [sys.executable, "benchmarks/micro.py", "--output", micro_out],
-        env,
-        BATTERY_TIMEOUT_S,
+    # 1+2. headline bench, default protocol then reference-style chunks
+    captured |= _bench_stage(
+        results, env, "bench", f"BENCH_{rr}_tpu.json"
     )
-    results["micro"] = {
-        "exit_code": rc,
-        "tail": None if rc == 0 else (out or "")[-2000:],
-    }
-    if rc == 0 and os.path.exists(micro_out):
-        captured = True
+    captured |= _bench_stage(
+        results, env, "bench_chunked", f"BENCH_{rr}_tpu_chunked.json",
+        multistep=100,
+    )
 
-    # 3. Pallas ring vs HLO sweep — only meaningful with >1 real chip
+    # 3. per-op dispatch cost (tunnel cost separated)
+    _, _, oc = stage(
+        results, "dispatch_micro",
+        [sys.executable, "benchmarks/dispatch_micro.py"], env,
+        expect=[f"benchmarks/results_{rr}_dispatch_micro.json"],
+    )
+    captured |= oc
+
+    # 4. full-span fused-vs-XLA equivalence (both spp variants)
+    _, _, oc = stage(
+        results, "fullspan_equiv",
+        [sys.executable, "benchmarks/fullspan_equiv.py"], env,
+        expect=[f"benchmarks/results_{rr}_fullspan_equiv.json"],
+    )
+    captured |= oc
+
+    # 5. slope-timed roofline sweep (self-isolates per row, writes
+    # incrementally — a partial sweep is still evidence)
+    _, _, oc = stage(
+        results, "roofline",
+        [sys.executable, "benchmarks/roofline.py"], env,
+        timeout=2 * STAGE_TIMEOUT_S,
+        expect=[f"benchmarks/results_{rr}_roofline.json"],
+    )
+    captured |= oc
+
+    # 6. fenced-size compile diagnosis (one attempt per size, isolated;
+    # diagnostic only — never counts toward the done-marker)
+    stage(
+        results, "mosaic_diag",
+        [sys.executable, "benchmarks/mosaic_diag.py"], env,
+        expect=[f"benchmarks/results_{rr}_mosaic_diag.json"],
+    )
+
+    # 7. micro battery (BASELINE configs; latency rows stand at size 1)
+    micro_out = os.path.join(
+        REPO, "benchmarks", f"results_{rr}_tpu_micro.json"
+    )
+    micro_cmd = [sys.executable, "benchmarks/micro.py", "--output", micro_out]
+    if env.get("M4T_MICRO_PLATFORM"):  # rehearsal: keep off the tunnel
+        micro_cmd += ["--platform", env["M4T_MICRO_PLATFORM"]]
+    _, _, oc = stage(
+        results, "micro", micro_cmd, env,
+        expect=[f"benchmarks/results_{rr}_tpu_micro.json"],
+    )
+    captured |= oc
+
+    # 8. Pallas ring vs HLO sweep — only meaningful with >1 real chip
     if (info.get("n_devices") or 1) > 1:
-        rc, out = _run(
-            [sys.executable, "benchmarks/ring_sweep.py",
-             "--output", os.path.join(REPO, "benchmarks", "results_r03_ring_sweep.json")],
+        stage(
+            results, "ring_sweep",
+            [sys.executable, "benchmarks/ring_sweep.py", "--output",
+             os.path.join(REPO, "benchmarks",
+                          f"results_{rr}_ring_sweep.json")],
             env,
-            BATTERY_TIMEOUT_S,
+            expect=[f"benchmarks/results_{rr}_ring_sweep.json"],
         )
-        results["ring_sweep"] = {
-            "exit_code": rc,
-            "tail": None if rc == 0 else (out or "")[-2000:],
-        }
     else:
         results["ring_sweep"] = {"skipped": "single device exposed by tunnel"}
 
     if captured:
+        results["fingerprint"] = battery_fingerprint()
         with open(DONE_MARKER, "w") as f:
             json.dump(results, f, indent=1)
-    log_probe({"battery": results, "captured": captured})
-    return captured
+    log_probe({"battery": {k: v for k, v in results.items()
+                           if k != "device"}, "captured": captured})
+    return captured, results
+
+
+def already_captured():
+    """True iff a capture exists for the *current* battery scripts."""
+    if not os.path.exists(DONE_MARKER):
+        return False
+    try:
+        with open(DONE_MARKER) as f:
+            prior = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False
+    if prior.get("fingerprint") != battery_fingerprint():
+        print("# battery scripts changed since last capture; re-arming")
+        return False
+    return True
+
+
+def rehearse():
+    """Forced-CPU dry run of the whole battery at reduced scale: pins
+    the stage plumbing (subprocess isolation, artifact names, capture
+    plausibility gates) without a chip. The bench stages must be
+    *rejected* as captures (CPU ⇒ vs_baseline null) — rehearsal
+    asserting that is the point. Exits nonzero if any stage's
+    subprocess machinery itself breaks (timeout handling, artifact
+    paths), not when on-chip-only stages fail for platform reasons."""
+    global DONE_MARKER, PROBE_LOG
+    DONE_MARKER = DONE_MARKER + ".rehearsal"
+    # rehearsal records must not interleave with the real round's
+    # tunnel-health forensics log
+    PROBE_LOG = os.path.join(REPO, "BENCH_r89_probes.jsonl")
+    # scratch round, FORCED (not setdefault): rehearsal must never
+    # overwrite real round artifacts (a genuine on-chip
+    # results_r05_*.json would be clobbered with meaningless CPU
+    # numbers — stage() would even move it aside to .prev first)
+    os.environ["M4T_ROUND"] = "89"
+    for key, val in {
+        "M4T_BENCH_PLATFORM": "cpu",
+        "M4T_BENCH_SCALE": "2",
+        "M4T_ROOFLINE_PLATFORM": "cpu",
+        "M4T_ROOFLINE_SCALE": "2",
+        "M4T_ROOFLINE_STEPS": "5",
+        "M4T_ROOFLINE_REPEATS": "2",
+        "M4T_ROOFLINE_ROW_TIMEOUT": "240",
+        "M4T_EQUIV_PLATFORM": "cpu",
+        "M4T_EQUIV_SCALE": "2",
+        "M4T_DISPATCH_PLATFORM": "cpu",
+        "M4T_DISPATCH_ITERS": "5",
+        "M4T_DIAG_TIMEOUT": "120",
+        "M4T_DIAG_PLATFORM": "cpu",
+        "M4T_MICRO_PLATFORM": "cpu",
+    }.items():
+        os.environ.setdefault(key, val)
+    info = {"device": "rehearsal-cpu", "platform": "cpu", "n_devices": 1}
+    try:
+        captured, results = run_battery(info, {})
+    finally:
+        # scratch-round artifacts are rehearsal debris, not evidence
+        import glob
+
+        for path in glob.glob(
+            os.path.join(REPO, "benchmarks", "results_r89_*")
+        ) + glob.glob(os.path.join(REPO, "BENCH_r89_*")):
+            os.unlink(path)
+    # on CPU the bench plausibility gate must have *refused* both runs
+    for name in ("bench", "bench_chunked"):
+        rec = results.get(name, {})
+        assert not any(
+            c.startswith("BENCH_") for c in rec.get("captured", [])
+        ), f"{name} captured a CPU run as on-chip: {rec}"
+    # ... and no CPU artifact may count as an on-chip capture: a True
+    # here would have written the done marker and disarmed the watcher
+    assert not captured, results
+    print(f"# rehearsal done; captured={captured}")
+    return 0
 
 
 def main():
@@ -210,27 +424,42 @@ def main():
     p.add_argument("--interval", type=int, default=600)
     p.add_argument("--once", action="store_true")
     p.add_argument(
+        "--rehearse", action="store_true",
+        help="forced-CPU dry run of the battery plumbing; no probing",
+    )
+    p.add_argument(
         "--max-hours", type=float, default=12.0,
         help="stop probing after this much wall-clock",
     )
     args = p.parse_args()
 
-    if os.path.exists(DONE_MARKER):
-        print(f"# battery already captured ({DONE_MARKER}); not re-probing")
-        return 0
+    if args.rehearse:
+        return rehearse()
 
     deadline = time.monotonic() + args.max_hours * 3600
     attempt = 0
+    prev_outcome = None
     while time.monotonic() < deadline:
-        ok, info, variant = probe(attempt)
-        attempt += 1
-        if ok:
-            if run_battery(info, variant):
+        if already_captured():
+            # stay alive, keep the health record going at a low duty
+            # cycle: scripts may change mid-round (re-arms above), and
+            # the probe log doubles as tunnel-health forensics
+            outcome, _, _ = probe(attempt, prev_outcome)
+            prev_outcome = outcome
+            attempt += 1
+            if args.once:
                 return 0
-            # chip answered the probe but re-wedged before the battery
-            # could capture anything — keep watching
+            time.sleep(max(60, args.interval * 3 - PROBE_TIMEOUT_S))
+            continue
+        outcome, info, variant = probe(attempt, prev_outcome)
+        prev_outcome = outcome
+        attempt += 1
+        if outcome == "ok":
+            run_battery(info, variant)
+            # captured or re-wedged mid-battery: loop decides via the
+            # done-marker fingerprint check
         if args.once:
-            return 1
+            return 0 if already_captured() else 1
         time.sleep(max(0, args.interval - PROBE_TIMEOUT_S))
     log_probe({"outcome": "round_exhausted", "attempts": attempt})
     return 1

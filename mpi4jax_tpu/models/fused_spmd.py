@@ -70,8 +70,12 @@ from ..ops import sendrecv
 from .shallow_water import ModelState, ShallowWaterConfig
 from . import fused_step as fs
 
-#: extra rows/cols beyond the standard block on each side (ghost depth
-#: 1 + EXT = 3 = the step's full dependency radius)
+#: extra rows/cols beyond the standard block on each side for ONE step
+#: per exchange (ghost depth 1 + EXT = 3 = the step's dependency
+#: radius). Temporal blocking deepens this per stepper instance:
+#: ``steps_per_pass`` chained steps need ghost depth ``3 *
+#: steps_per_pass`` (h/u/v) and ``3 * steps_per_pass - 2``
+#: (tendencies), so ``self._ext = 3 * spp - 1``.
 EXT = 2
 
 #: sendtags for the four exchange directions; distinct from the
@@ -91,23 +95,35 @@ class _FusedDecompBase:
 
     def _init_common(self, config: ShallowWaterConfig, axis: str,
                      block_rows: int, interpret: bool, *, x_mode: str,
-                     pad_cols_left: int, nx_pad: int, nx_mask: int):
+                     pad_cols_left: int, nx_pad: int, nx_mask: int,
+                     steps_per_pass: int = 1):
         if not config.periodic_x:
             raise NotImplementedError(
                 f"{type(self).__name__} requires periodic_x"
             )
         self.config = config
+        self.spp = steps_per_pass
+        #: ghost depth of the exchange = the chained dependency radius
+        self._depth = 3 * steps_per_pass
+        #: extension rows/cols beyond the standard 1-ghost block
+        self._ext = self._depth - 1
+        self._halo = fs.halo_for(steps_per_pass)
         self.cart = CartComm(
             dims=config.dims, periods=(False, config.periodic_x), axis=axis
         )
         self._north = self.cart.shift(0, +1)
         self._south = self.cart.shift(0, -1)
-        self.ext_rows = config.ny_local + 2 * EXT
-        b = fs.fit_block_rows(self.ext_rows, block_rows)
+        self.ext_rows = config.ny_local + 2 * self._ext
+        # VMEM-fenced fit: a wide local grid must shrink the tile
+        # rather than submit the over-ceiling compile class that
+        # wedged the r4 chip session (fused_step.VMEM_COMPILE_CEILING)
+        b = fs.fit_block_rows_vmem(
+            self.ext_rows, block_rows, nx_pad, self._halo
+        )
         if b is None:
             raise ValueError(
                 f"no legal block size <= {block_rows} for "
-                f"{self.ext_rows} extended rows"
+                f"{self.ext_rows} extended rows at width {nx_pad}"
             )
         self.block_rows = b
         self.interpret = interpret
@@ -129,9 +145,9 @@ class _FusedDecompBase:
         """
         c = self.config
         nyp = self._padded_ext(self.block_rows)
-        pr = nyp - c.ny_local - EXT
+        pr = nyp - c.ny_local - self._ext
         pc = self.nx_pad - c.nx_local - self._pad_left
-        pads = ((EXT, pr), (self._pad_left, pc))
+        pads = ((self._ext, pr), (self._pad_left, pc))
         return ModelState(
             h=jnp.pad(state.h, pads, constant_values=1.0),
             u=jnp.pad(state.u, pads),
@@ -146,7 +162,7 @@ class _FusedDecompBase:
         return ModelState(
             *(
                 f[
-                    EXT : EXT + c.ny_local,
+                    self._ext : self._ext + c.ny_local,
                     self._pad_left : self._pad_left + c.nx_local,
                 ]
                 for f in ext
@@ -160,54 +176,65 @@ class _FusedDecompBase:
         (padded) width — for 2-D grids the strips carry the fresh
         x-extension columns, so corners resolve over two hops.
 
-        Extended-row coordinates (``e = standard_row + EXT``):
+        Extended-row coordinates (``e = standard_row + self._ext``),
+        with ``d = self._depth = 3 * steps_per_pass`` (h/u/v rows per
+        strip) and ``d - 2`` tendency rows (tendencies enter the
+        chained step at one less radius on each side):
 
-        - northward strip: own interior rows ``s in [nyl-4, nyl-2]``
-          of h/u/v plus tendency row ``s = nyl-2``; lands in the
-          receiver's bottom extension ``e in [0, 3)`` / ``e = 2``.
-        - southward strip: own rows ``s in [1, 3]`` plus tendency row
-          ``s = 1``; lands in the receiver's top extension
-          ``e in [E-3, E)`` / ``e = E-3``.
+        - northward strip: own interior rows ``s in [nyl-1-d, nyl-2]``
+          of h/u/v plus tendency rows ``s in [nyl+1-d, nyl-2]``; lands
+          in the receiver's bottom extension ``e in [0, d)`` /
+          ``e in [2, d)``.
+        - southward strip: own rows ``s in [1, d]`` plus tendency rows
+          ``s in [1, d-2]``; lands in the receiver's top extension
+          ``e in [E-d, E)`` / ``e in [E-d, E-2)``.
 
         Edge ranks' missing neighbors are PROC_NULL: the recv template
         comes back unchanged and the kernel's domain-boundary masks
         own those rows.
         """
         nyl = self.config.ny_local
-        Er = nyl + 2 * EXT
+        d = self._depth
+        Er = nyl + 2 * self._ext
         h, u, v, dh, du, dv = ext
 
         def pack(huv_lo, t_lo):
             return jnp.concatenate(
-                [f[huv_lo : huv_lo + 3] for f in (h, u, v)]
-                + [f[t_lo : t_lo + 1] for f in (dh, du, dv)]
+                [f[huv_lo : huv_lo + d] for f in (h, u, v)]
+                + [f[t_lo : t_lo + d - 2] for f in (dh, du, dv)]
             )
 
         def put(fields, huv_lo, t_lo, got):
             hh, uu, vv, dhh, duu, dvv = fields
-            hh = hh.at[huv_lo : huv_lo + 3].set(got[0:3])
-            uu = uu.at[huv_lo : huv_lo + 3].set(got[3:6])
-            vv = vv.at[huv_lo : huv_lo + 3].set(got[6:9])
-            dhh = dhh.at[t_lo : t_lo + 1].set(got[9:10])
-            duu = duu.at[t_lo : t_lo + 1].set(got[10:11])
-            dvv = dvv.at[t_lo : t_lo + 1].set(got[11:12])
+            t = d - 2
+            hh = hh.at[huv_lo : huv_lo + d].set(got[0 * d : 1 * d])
+            uu = uu.at[huv_lo : huv_lo + d].set(got[1 * d : 2 * d])
+            vv = vv.at[huv_lo : huv_lo + d].set(got[2 * d : 3 * d])
+            dhh = dhh.at[t_lo : t_lo + t].set(got[3 * d : 3 * d + t])
+            duu = duu.at[t_lo : t_lo + t].set(
+                got[3 * d + t : 3 * d + 2 * t]
+            )
+            dvv = dvv.at[t_lo : t_lo + t].set(
+                got[3 * d + 2 * t : 3 * d + 3 * t]
+            )
             return hh, uu, vv, dhh, duu, dvv
 
         src, dst = self._north
-        payload = pack(nyl - 2, nyl)  # e-coords of s = nyl-4 / nyl-2
-        template = pack(0, EXT)
+        # e-coords of s = nyl-1-d (huv) / s = nyl+1-d (tendencies)
+        payload = pack(nyl - 2, nyl)
+        template = pack(0, 2)
         got = sendrecv(
             payload, template, src, dst, sendtag=TAG_NORTH, comm=self.cart
         )
-        h, u, v, dh, du, dv = put((h, u, v, dh, du, dv), 0, EXT, got)
+        h, u, v, dh, du, dv = put((h, u, v, dh, du, dv), 0, 2, got)
 
         src, dst = self._south
-        payload = pack(EXT + 1, EXT + 1)  # e-coord of s = 1
-        template = pack(Er - 3, Er - 3)
+        payload = pack(self._ext + 1, self._ext + 1)  # e-coord of s = 1
+        template = pack(Er - d, Er - d)
         got = sendrecv(
             payload, template, src, dst, sendtag=TAG_SOUTH, comm=self.cart
         )
-        h, u, v, dh, du, dv = put((h, u, v, dh, du, dv), Er - 3, Er - 3, got)
+        h, u, v, dh, du, dv = put((h, u, v, dh, du, dv), Er - d, Er - d, got)
 
         return ModelState(h, u, v, dh, du, dv)
 
@@ -216,7 +243,8 @@ class _FusedDecompBase:
 
     # -- kernel -----------------------------------------------------------
 
-    def _kernel_step(self, ext: ModelState) -> ModelState:
+    def _kernel_step(self, ext: ModelState,
+                     steps_per_pass: int = None) -> ModelState:
         c = self.config
         nyp = self._padded_ext(self.block_rows)
         kernel, slab_rows, n_tiles = fs._make_kernel(
@@ -228,15 +256,18 @@ class _FusedDecompBase:
             nx_pad=self.nx_pad,
             with_rank_offset=True,
             x_mode=self._x_mode,
+            steps_per_pass=steps_per_pass or self.spp,
+            halo=self._halo,
         )
         # grow must be the domain-global row index: extended row e of
         # process-grid row pr sits at global row pr*(ny_local-2) +
-        # (e - EXT), so the kernel adds offset = pr*(ny_local-2) - EXT
-        # (traced, one program for all ranks)
+        # (e - self._ext), so the kernel adds offset =
+        # pr*(ny_local-2) - self._ext (traced, one program for all
+        # ranks)
         npy, npx = c.dims
         proc_row = self.cart.Get_rank() // npx
         offset = jnp.asarray(
-            proc_row * (c.ny_local - 2) - EXT, jnp.int32
+            proc_row * (c.ny_local - 2) - self._ext, jnp.int32
         ).reshape(1)
         out = pl.pallas_call(
             kernel,
@@ -267,17 +298,24 @@ class _FusedDecompBase:
     # -- public step API --------------------------------------------------
 
     def step_extended(self, ext: ModelState) -> ModelState:
-        """One AB2 step on the extended layout: exchange, then fuse."""
+        """One exchange-then-fuse pass on the extended layout,
+        advancing ``self.spp`` AB2 steps."""
         return self._kernel_step(self._exchange(ext))
 
     def multistep(self, state: ModelState, num_steps: int) -> ModelState:
         """``num_steps`` deep-halo fused steps on a standard per-rank
         block (jittable; run inside ``parallel.spmd`` or a launcher
-        world)."""
+        world). With ``steps_per_pass > 1`` the loop advances in
+        temporally blocked passes — the exchange ships the deeper halo
+        either way, so a remainder runs as single-step passes on the
+        same layout."""
         ext = self.extend(state)
+        passes, rem = divmod(num_steps, self.spp)
         ext = lax.fori_loop(
-            0, num_steps, lambda _, e: self.step_extended(e), ext
+            0, passes, lambda _, e: self.step_extended(e), ext
         )
+        for _ in range(rem):
+            ext = self._kernel_step(self._exchange(ext), steps_per_pass=1)
         return self.crop(ext)
 
 
@@ -302,17 +340,19 @@ class FusedRowDecomp(_FusedDecompBase):
 
     def __init__(self, config: ShallowWaterConfig, axis: str = WORLD_AXIS,
                  *, block_rows: int = fs.DEFAULT_BLOCK_ROWS,
-                 interpret: bool = False):
+                 interpret: bool = False, steps_per_pass: int = 1):
         npy, npx = config.dims
         if npx != 1:
             raise NotImplementedError(
                 "FusedRowDecomp requires a row decomposition dims=(n, 1); "
                 f"got {config.dims} (use FusedDecomp2D for 2-D grids)"
             )
-        if config.ny_local < 5:
+        depth = 3 * steps_per_pass
+        if config.ny_local < depth + 2:
             raise ValueError(
-                "deep-halo exchange needs >= 3 interior rows per rank "
-                f"(ny_local >= 5); got ny_local={config.ny_local}"
+                f"deep-halo exchange at steps_per_pass={steps_per_pass} "
+                f"needs >= {depth} interior rows per rank "
+                f"(ny_local >= {depth + 2}); got ny_local={config.ny_local}"
             )
         self._init_common(
             config, axis, block_rows, interpret,
@@ -320,6 +360,7 @@ class FusedRowDecomp(_FusedDecompBase):
             pad_cols_left=0,
             nx_pad=fs.padded_cols(config),
             nx_mask=config.nx_local,
+            steps_per_pass=steps_per_pass,
         )
 
     _exchange = _FusedDecompBase._exchange_y
@@ -376,80 +417,103 @@ class FusedDecomp2D(_FusedDecompBase):
 
     def __init__(self, config: ShallowWaterConfig, axis: str = WORLD_AXIS,
                  *, block_rows: int = fs.DEFAULT_BLOCK_ROWS,
-                 interpret: bool = False):
-        if config.ny_local < 5 or config.nx_local < 5:
+                 interpret: bool = False, steps_per_pass: int = 1):
+        depth = 3 * steps_per_pass
+        if (config.ny_local < depth + 2
+                or config.nx_local < depth + 2):
             raise ValueError(
-                "deep-halo exchange needs >= 3 interior rows and columns "
-                f"per rank; got local block "
+                f"deep-halo exchange at steps_per_pass={steps_per_pass} "
+                f"needs >= {depth} interior rows and columns per rank; "
+                f"got local block "
                 f"{(config.ny_local, config.nx_local)}"
             )
-        self.ext_cols = config.nx_local + 2 * EXT
+        ext = depth - 1
+        self.ext_cols = config.nx_local + 2 * ext
         self._init_common(
             config, axis, block_rows, interpret,
             x_mode="exchanged",
-            pad_cols_left=EXT,
+            pad_cols_left=ext,
             # lane-padded extended width (padding columns hold finite
             # don't-care values the kernel's column mask keeps out of
             # every real result)
             nx_pad=-(-self.ext_cols // fs.LANE) * fs.LANE,
             nx_mask=self.ext_cols,
+            steps_per_pass=steps_per_pass,
         )
         self._east = self.cart.shift(1, +1)
         self._west = self.cart.shift(1, -1)
 
     def _exchange_x(self, ext: ModelState) -> ModelState:
         """Deep column-halo refresh: 2 batched sendrecvs on the
-        periodic x-ring (extended-col coordinates ``ce = s_c + EXT``).
+        periodic x-ring (extended-col coordinates ``ce = s_c +
+        self._ext``), ``d = self._depth`` h/u/v columns and ``d - 2``
+        tendency columns per strip:
 
-        - eastward strip: own interior cols ``s_c in [nxl-4, nxl-2]``
-          of h/u/v plus tendency col ``s_c = nxl-2``; lands in the
-          receiver's west extension ``ce in [0, 3)`` / ``ce = 2``.
-        - westward strip: own cols ``s_c in [1, 3]`` plus tendency col
-          ``s_c = 1``; lands in the receiver's east extension
-          ``ce in [E-3, E)`` / ``ce = E-3``.
+        - eastward strip: own interior cols ``s_c in [nxl-1-d,
+          nxl-2]`` of h/u/v plus tendency cols ``s_c in [nxl+1-d,
+          nxl-2]``; lands in the receiver's west extension
+          ``ce in [0, d)`` / ``ce in [2, d)``.
+        - westward strip: own cols ``s_c in [1, d]`` plus tendency
+          cols ``s_c in [1, d-2]``; lands in the receiver's east
+          extension ``ce in [E-d, E)`` / ``ce in [E-d, E-2)``.
 
         Strips span the rank's own block rows only (``e in
-        [EXT, EXT+nyl)``); the subsequent y-phase carries the received
-        columns onward so corners resolve over two hops.
+        [self._ext, self._ext+nyl)``); the subsequent y-phase carries
+        the received columns onward so corners resolve over two hops.
         """
         c = self.config
         nyl, nxl = c.ny_local, c.nx_local
+        d = self._depth
         E = self.ext_cols
-        rlo, rhi = EXT, EXT + nyl
+        rlo, rhi = self._ext, self._ext + nyl
         h, u, v, dh, du, dv = ext
 
         def pack(huv_lo, t_lo):
             return jnp.concatenate(
-                [f[rlo:rhi, huv_lo : huv_lo + 3] for f in (h, u, v)]
-                + [f[rlo:rhi, t_lo : t_lo + 1] for f in (dh, du, dv)],
+                [f[rlo:rhi, huv_lo : huv_lo + d] for f in (h, u, v)]
+                + [f[rlo:rhi, t_lo : t_lo + d - 2] for f in (dh, du, dv)],
                 axis=1,
             )
 
         def put(fields, huv_lo, t_lo, got):
             hh, uu, vv, dhh, duu, dvv = fields
-            hh = hh.at[rlo:rhi, huv_lo : huv_lo + 3].set(got[:, 0:3])
-            uu = uu.at[rlo:rhi, huv_lo : huv_lo + 3].set(got[:, 3:6])
-            vv = vv.at[rlo:rhi, huv_lo : huv_lo + 3].set(got[:, 6:9])
-            dhh = dhh.at[rlo:rhi, t_lo : t_lo + 1].set(got[:, 9:10])
-            duu = duu.at[rlo:rhi, t_lo : t_lo + 1].set(got[:, 10:11])
-            dvv = dvv.at[rlo:rhi, t_lo : t_lo + 1].set(got[:, 11:12])
+            t = d - 2
+            hh = hh.at[rlo:rhi, huv_lo : huv_lo + d].set(
+                got[:, 0 * d : 1 * d]
+            )
+            uu = uu.at[rlo:rhi, huv_lo : huv_lo + d].set(
+                got[:, 1 * d : 2 * d]
+            )
+            vv = vv.at[rlo:rhi, huv_lo : huv_lo + d].set(
+                got[:, 2 * d : 3 * d]
+            )
+            dhh = dhh.at[rlo:rhi, t_lo : t_lo + t].set(
+                got[:, 3 * d : 3 * d + t]
+            )
+            duu = duu.at[rlo:rhi, t_lo : t_lo + t].set(
+                got[:, 3 * d + t : 3 * d + 2 * t]
+            )
+            dvv = dvv.at[rlo:rhi, t_lo : t_lo + t].set(
+                got[:, 3 * d + 2 * t : 3 * d + 3 * t]
+            )
             return hh, uu, vv, dhh, duu, dvv
 
         src, dst = self._east
-        payload = pack(nxl - 2, nxl)  # ce of s_c = nxl-4 / nxl-2
-        template = pack(0, EXT)
+        # ce of s_c = nxl-1-d (huv) / s_c = nxl+1-d (tendencies)
+        payload = pack(nxl - 2, nxl)
+        template = pack(0, 2)
         got = sendrecv(
             payload, template, src, dst, sendtag=TAG_EAST, comm=self.cart
         )
-        h, u, v, dh, du, dv = put((h, u, v, dh, du, dv), 0, EXT, got)
+        h, u, v, dh, du, dv = put((h, u, v, dh, du, dv), 0, 2, got)
 
         src, dst = self._west
-        payload = pack(EXT + 1, EXT + 1)  # ce of s_c = 1
-        template = pack(E - 3, E - 3)
+        payload = pack(self._ext + 1, self._ext + 1)  # ce of s_c = 1
+        template = pack(E - d, E - d)
         got = sendrecv(
             payload, template, src, dst, sendtag=TAG_WEST, comm=self.cart
         )
-        h, u, v, dh, du, dv = put((h, u, v, dh, du, dv), E - 3, E - 3, got)
+        h, u, v, dh, du, dv = put((h, u, v, dh, du, dv), E - d, E - d, got)
 
         return ModelState(h, u, v, dh, du, dv)
 
@@ -489,7 +553,8 @@ def _stepper_cls(config: ShallowWaterConfig):
 def verified_world_stepper(config, model, state, first, *,
                            axis: str = WORLD_AXIS,
                            block_rows: int = fs.DEFAULT_BLOCK_ROWS,
-                           interpret: bool = False, log=None):
+                           interpret: bool = False,
+                           steps_per_pass: int = 2, log=None):
     """Build a deep-halo stepper iff it proves itself in this world —
     the multi-rank analog of :func:`fused_step.verified_hot_loop`
     (same role: gate routing in ``examples/shallow_water.py``). Picks
@@ -514,7 +579,15 @@ def verified_world_stepper(config, model, state, first, *,
        worst scaled deviation. A mid-phase rank-local crash here is
        an async runtime failure on an already-validated program; the
        backend's spin-timeout abort is the (documented fail-fast)
-       backstop for that residual case.
+       backstop for that residual case. **Expected abort latency:**
+       peers blocked in the probe's sendrecvs spin until
+       ``M4T_SHM_SPIN_TIMEOUT_US`` (default 120 s) and then abort the
+       world — a recoverable-looking rank failure here deliberately
+       costs a world teardown, not a silent fallback. The window is
+       *not* shortened for the probe: phase 2 performs the first jit
+       of the full stepper on every rank, where compile-time skew
+       between ranks is largest, and a tighter window would turn
+       healthy skew into spurious aborts.
 
     Returns the stepper or ``None`` (composable path); ``log``
     receives one diagnostic line either way.
@@ -525,98 +598,131 @@ def verified_world_stepper(config, model, state, first, *,
     :data:`PROBE_TOL` gate an indexing/exchange bug cannot pass.
     """
     say = log or (lambda _msg: None)
-    try:
-        stepper = _stepper_cls(config)(
-            config, axis, block_rows=block_rows, interpret=interpret
-        )
-    except (ValueError, NotImplementedError) as e:
-        # deterministic from the static config: identical on every
-        # rank, so declining before any collective is safe
-        say(f"deep-halo fused path unavailable ({e}); composable path")
-        return None
 
     from ..ops import allreduce
     from ..comm import MAX, MIN
 
-    # first() contains the composable halo exchange (collectives, run
-    # in lockstep on every rank) — it must stay OUTSIDE the guarded
-    # phase-1 region: catching a rank-local failure here and skipping
-    # to the agreement allreduce while peers sit inside first's
-    # sendrecvs would recreate the mismatched-collectives deadlock;
-    # failures in it fall to the backend's documented fail-fast abort
-    probe = first(state)
+    # the spp ladder is walked in lockstep: every gate below resolves
+    # by collective agreement (or deterministically from the static
+    # config), so all ranks fall through to the next variant together
+    spp_ladder = list(dict.fromkeys((steps_per_pass, 1)))
 
-    # phase 1: collective-free kernel build + run, then agree
-    try:
-        kstep = jax.jit(stepper._kernel_step)(stepper.extend(probe))
-        jax.block_until_ready(kstep.h)
-        ok = 1.0
-    except Exception as e:
-        say(f"fused kernel failed locally ({type(e).__name__}: "
-            f"{str(e)[:120]})")
-        ok = 0.0
-    if float(allreduce(jnp.float32(ok), op=MIN)) < 1.0:
-        say("deep-halo fused path declined world-wide (a rank's kernel "
-            "failed); composable path")
-        return None
+    probe = ref = None
+    for spp in spp_ladder:
+        try:
+            stepper = _stepper_cls(config)(
+                config, axis, block_rows=block_rows, interpret=interpret,
+                steps_per_pass=spp,
+            )
+        except (ValueError, NotImplementedError) as e:
+            # deterministic from the static config: identical on every
+            # rank, so declining before any collective is safe
+            say(f"deep-halo spp={spp} unavailable ({e}); next variant")
+            continue
 
-    # phase 2: full-probe numerics, verdict by MAX-allreduce
-    try:
-        ref = jax.jit(lambda s: model.multistep(s, PROBE_STEPS))(probe)
-        fus = jax.jit(lambda s: stepper.multistep(s, PROBE_STEPS))(probe)
-        worst = probe_deviation(ref, fus)
-    except Exception as e:  # pragma: no cover - async runtime failure
-        say(f"deep-halo probe failed locally ({type(e).__name__}: "
-            f"{str(e)[:120]})")
-        worst = float("inf")
-    worst = float(allreduce(jnp.float32(worst), op=MAX))
-    if not (worst < PROBE_TOL):
-        say(f"deep-halo probe mismatch (rel {worst:.2e}); composable path")
-        return None
-    say(f"deep-halo fused step verified in-world (rel {worst:.2e}, "
-        f"dims {config.dims}, block_rows={stepper.block_rows})")
-    return stepper
+        if probe is None:
+            # first() contains the composable halo exchange
+            # (collectives, run in lockstep on every rank) — it must
+            # stay OUTSIDE the guarded phase-1 region: catching a
+            # rank-local failure here and skipping to the agreement
+            # allreduce while peers sit inside first's sendrecvs would
+            # recreate the mismatched-collectives deadlock; failures
+            # in it fall to the backend's documented fail-fast abort
+            probe = first(state)
+
+        # phase 1: collective-free kernel build + run, then agree
+        try:
+            kstep = jax.jit(stepper._kernel_step)(stepper.extend(probe))
+            jax.block_until_ready(kstep.h)
+            ok = 1.0
+        except Exception as e:
+            say(f"fused kernel spp={spp} failed locally "
+                f"({type(e).__name__}: {str(e)[:120]})")
+            ok = 0.0
+        if float(allreduce(jnp.float32(ok), op=MIN)) < 1.0:
+            say(f"deep-halo spp={spp} declined world-wide (a rank's "
+                "kernel failed); next variant")
+            continue
+
+        # phase 2: full-probe numerics, verdict by MAX-allreduce
+        try:
+            if ref is None:
+                ref = jax.jit(
+                    lambda s: model.multistep(s, PROBE_STEPS)
+                )(probe)
+            fus = jax.jit(
+                lambda s: stepper.multistep(s, PROBE_STEPS)
+            )(probe)
+            worst = probe_deviation(ref, fus)
+        except Exception as e:  # pragma: no cover - async runtime failure
+            say(f"deep-halo probe failed locally ({type(e).__name__}: "
+                f"{str(e)[:120]})")
+            worst = float("inf")
+        worst = float(allreduce(jnp.float32(worst), op=MAX))
+        if not (worst < PROBE_TOL):
+            say(f"deep-halo spp={spp} probe mismatch (rel {worst:.2e}); "
+                "next variant")
+            continue
+        say(f"deep-halo fused step verified in-world (rel {worst:.2e}, "
+            f"dims {config.dims}, block_rows={stepper.block_rows}, "
+            f"steps_per_pass={spp})")
+        return stepper
+    say("deep-halo fused path unavailable (no variant passed); "
+        "composable path")
+    return None
 
 
 def verified_mesh_stepper(config, model, state, first, mesh, *,
                           block_rows: int = fs.DEFAULT_BLOCK_ROWS,
-                          interpret: bool = False, log=None):
+                          interpret: bool = False,
+                          steps_per_pass: int = 2, log=None):
     """Single-controller analog of :func:`verified_world_stepper` for
     ``parallel.spmd`` device meshes: the probe trajectories run under
     ``spmd`` over ``mesh`` (``first`` must already be mesh-wrapped)
     and the interiors of every block are compared on the host — one
     controller, so the verdict is trivially consistent across ranks.
-    Returns the stepper or ``None``.
+    Walks the same temporal-blocking ladder (``steps_per_pass -> 1``)
+    as the world gate. Returns the stepper or ``None``.
     """
     from ..parallel import spmd
 
     say = log or (lambda _msg: None)
-    try:
-        stepper = _stepper_cls(config)(
-            config, block_rows=block_rows, interpret=interpret
-        )
-    except (ValueError, NotImplementedError) as e:
-        say(f"deep-halo fused path unavailable ({e}); composable path")
-        return None
-    try:
-        probe = first(state)
-        ref = spmd(lambda s: model.multistep(s, PROBE_STEPS), mesh=mesh)(
-            probe
-        )
-        fus = spmd(lambda s: stepper.multistep(s, PROBE_STEPS), mesh=mesh)(
-            probe
-        )
-        worst = probe_deviation(ref, fus)
-    except Exception as e:
-        say(f"deep-halo fused path unavailable ({type(e).__name__}: "
-            f"{str(e)[:120]}); composable path")
-        return None
-    if not (worst < PROBE_TOL):
-        say(f"deep-halo probe mismatch (rel {worst:.2e}); composable path")
-        return None
-    say(f"deep-halo fused step verified on-mesh (rel {worst:.2e}, "
-        f"dims {config.dims}, block_rows={stepper.block_rows})")
-    return stepper
+    probe = ref = None
+    for spp in dict.fromkeys((steps_per_pass, 1)):
+        try:
+            stepper = _stepper_cls(config)(
+                config, block_rows=block_rows, interpret=interpret,
+                steps_per_pass=spp,
+            )
+        except (ValueError, NotImplementedError) as e:
+            say(f"deep-halo spp={spp} unavailable ({e}); next variant")
+            continue
+        try:
+            if probe is None:
+                probe = first(state)
+            if ref is None:
+                ref = spmd(
+                    lambda s: model.multistep(s, PROBE_STEPS), mesh=mesh
+                )(probe)
+            fus = spmd(
+                lambda s: stepper.multistep(s, PROBE_STEPS), mesh=mesh
+            )(probe)
+            worst = probe_deviation(ref, fus)
+        except Exception as e:
+            say(f"deep-halo spp={spp} failed ({type(e).__name__}: "
+                f"{str(e)[:120]}); next variant")
+            continue
+        if not (worst < PROBE_TOL):
+            say(f"deep-halo spp={spp} probe mismatch (rel {worst:.2e}); "
+                "next variant")
+            continue
+        say(f"deep-halo fused step verified on-mesh (rel {worst:.2e}, "
+            f"dims {config.dims}, block_rows={stepper.block_rows}, "
+            f"steps_per_pass={spp})")
+        return stepper
+    say("deep-halo fused path unavailable (no variant passed); "
+        "composable path")
+    return None
 
 
 #: backward-compatible alias (rounds 3-4 name; rows-only then)

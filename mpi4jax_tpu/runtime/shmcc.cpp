@@ -92,10 +92,18 @@ struct alignas(64) Channel {
 //   [ coll slots:   size x kCollChunk bytes, 64-aligned             ]
 //   [ p2p channels: size x size x sizeof(Channel), [src][dst] order ]
 struct SharedHeader {
+  // stamped LAST by the creator (release order): attachers treat the
+  // magic as the segment-ready signal and validate world_size against
+  // their own, so a stale segment from a previous, larger world can
+  // never be silently joined on a bare byte-count check
+  std::atomic<uint32_t> magic;
+  std::atomic<uint32_t> world_size;
   std::atomic<uint32_t> barrier_count;
   std::atomic<uint32_t> barrier_sense;
   std::atomic<uint32_t> abort_flag;
 };
+
+constexpr uint32_t kMagic = 0x4d34544aU;  // "M4TJ"
 
 constexpr size_t kHeaderBytes = 64;
 static_assert(sizeof(SharedHeader) <= kHeaderBytes, "header overflow");
@@ -858,15 +866,21 @@ static int world_init(const char* name, int rank, int size, int create) {
     }
   }
   size_t seg = segment_bytes(size);
-  int flags = create ? (O_CREAT | O_RDWR) : O_RDWR;
-  int fd = shm_open(name, flags, 0600);
-  if (fd < 0) return -2;
+  int fd;
   if (create) {
+    // a segment left by a crashed or differently-sized previous world
+    // would pass a pure byte-count check while carrying stale barrier
+    // and channel state — always start from a fresh, zero-filled one
+    shm_unlink(name);
+    fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return -2;
     if (ftruncate(fd, (off_t)seg) != 0) {
       close(fd);
       return -3;
     }
   } else {
+    fd = shm_open(name, O_RDWR, 0600);
+    if (fd < 0) return -2;
     // Don't mmap before the creator's ftruncate has sized the segment:
     // touching pages beyond EOF would SIGBUS. -2 is the retryable code.
     struct stat st;
@@ -879,6 +893,21 @@ static int world_init(const char* name, int rank, int size, int create) {
       mmap(nullptr, seg, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   close(fd);
   if (mem == MAP_FAILED) return -4;
+  if (create) {
+    auto* sh = reinterpret_cast<SharedHeader*>(mem);
+    sh->world_size.store((uint32_t)size, std::memory_order_release);
+    sh->magic.store(kMagic, std::memory_order_release);
+  } else {
+    // the magic is the creator's "segment initialized" signal; a
+    // missing stamp or a size mismatch both mean "not our world (yet)"
+    // — unmap and let the caller retry against the current name
+    auto* sh = reinterpret_cast<SharedHeader*>(mem);
+    if (sh->magic.load(std::memory_order_acquire) != kMagic ||
+        sh->world_size.load(std::memory_order_acquire) != (uint32_t)size) {
+      munmap(mem, seg);
+      return -2;
+    }
+  }
   g.sh = reinterpret_cast<SharedHeader*>(mem);
   g.coll_base = reinterpret_cast<char*>(mem) + kHeaderBytes;
   g.channels_base =

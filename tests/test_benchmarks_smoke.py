@@ -1,0 +1,153 @@
+"""Smoke tests for the evidence scripts in ``benchmarks/``: each must
+run end-to-end on a forced-CPU platform at reduced scale and emit its
+JSON artifact with the agreed schema. This pins the plumbing (artifact
+names, field names, subprocess isolation) by CI *before* a chip window
+— the scripts' real numbers can only be captured when the TPU tunnel
+answers, and a window that hits a schema bug is a window lost
+(VERDICT r4 next #5).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "benchmarks")
+
+#: under the launcher-world CI legs every rank runs this file
+#: concurrently; a per-rank round number keeps the scripts' fixed
+#: artifact paths from racing (both ranks writing + unlinking the
+#: same results_r99_*.json)
+SCRATCH_ROUND = str(90 + int(os.environ.get("M4T_RANK", "9")))
+
+
+def run_script(script, env_extra, timeout=560):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["M4T_ROUND"] = SCRATCH_ROUND
+    env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, os.path.join(BENCH, script)],
+        env=env, capture_output=True, text=True, timeout=timeout, cwd=REPO,
+    )
+
+
+def last_json_line(stdout):
+    line = [ln for ln in stdout.splitlines() if ln.startswith("{")][-1]
+    return json.loads(line)
+
+
+def test_dispatch_micro_cpu(tmp_path):
+    res = run_script(
+        "dispatch_micro.py",
+        {"M4T_DISPATCH_PLATFORM": "cpu", "M4T_DISPATCH_ITERS": "3",
+         },
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    artifact = last_json_line(res.stdout)["artifact"]
+    assert artifact.endswith(
+        f"results_r{SCRATCH_ROUND}_dispatch_micro.json"
+    )
+    with open(artifact) as f:
+        data = json.load(f)
+    os.unlink(artifact)
+    assert data["platform"] == "cpu"
+    assert "tunnel_roundtrip_ms" in data and "noop_jit_ms" in data
+    for op in ("allreduce", "allgather", "alltoall", "sendrecv", "bcast"):
+        row = data["ops"][op]
+        assert {"eager_ms_per_call", "jit_ms_per_call",
+                "chained_us_per_op"} <= set(row)
+
+
+def test_fullspan_equiv_cpu():
+    res = run_script(
+        "fullspan_equiv.py",
+        {"M4T_EQUIV_PLATFORM": "cpu", "M4T_EQUIV_SCALE": "1",
+         },
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    artifact = last_json_line(res.stdout)["artifact"]
+    with open(artifact) as f:
+        data = json.load(f)
+    os.unlink(artifact)
+    assert data["platform"] == "cpu"
+    assert data["num_steps"] > 400
+    # on CPU the fused paths must be recorded as errors (Mosaic is
+    # TPU-only), never silently dropped
+    for spp in (1, 2):
+        assert f"fused_spp{spp}" in data["paths"]
+        assert "error" in data["paths"][f"fused_spp{spp}"]
+
+
+def test_fullspan_equiv_calibration_cpu():
+    """The f64-vs-f32 calibration leg writes its own artifact (so an
+    on-chip capture can't clobber the yardstick) and records a
+    nonzero noise amplification."""
+    res = run_script(
+        "fullspan_equiv.py",
+        {"M4T_EQUIV_PLATFORM": "cpu", "M4T_EQUIV_SCALE": "1",
+         "M4T_EQUIV_CALIBRATE": "1", },
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    artifact = last_json_line(res.stdout)["artifact"]
+    assert artifact.endswith(
+        f"results_r{SCRATCH_ROUND}_fullspan_equiv_calib.json"
+    )
+    with open(artifact) as f:
+        data = json.load(f)
+    os.unlink(artifact)
+    calib = data["calibration_f64_vs_f32"]
+    assert 0.0 < calib["worst_scaled_dev"] < 1e-2
+
+
+def test_roofline_cpu_plumbing():
+    env = {
+        "M4T_ROOFLINE_PLATFORM": "cpu",
+        "M4T_ROOFLINE_SCALE": "10",  # benchmark width: fence visible
+        "M4T_ROOFLINE_STEPS": "5",
+        "M4T_ROOFLINE_REPEATS": "1",
+        "M4T_ROOFLINE_ROW_TIMEOUT": "120",
+        # plumbing test: one timed row + the fence rows is enough
+        "M4T_ROOFLINE_ONLY": "xla_step",
+    }
+    res = run_script("roofline.py", env)
+    assert res.returncode == 0, res.stderr[-2000:]
+    artifact = last_json_line(res.stdout)["artifact"]
+    with open(artifact) as f:
+        data = json.load(f)
+    os.unlink(artifact)
+    assert data["platform"] == "cpu"
+    rows = {r["config"]: r for r in data["rows"]}
+    assert rows["xla_step"]["ms_per_step"] > 0
+    # the r4 failure sizes are fenced, not attempted — for every
+    # temporal-blocking depth (the deeper halo only shrinks the fence)
+    for b in (200, 240, 320):
+        assert "fenced" in rows[f"fused_b{b}"]
+        assert "fenced" in rows[f"fused2_b{b}"]
+        assert "fenced" in rows[f"fused4_b{b}"]
+    # the headline size stays compilable at every depth
+    for prefix in ("fused", "fused2", "fused4"):
+        assert "fenced" not in rows.get(f"{prefix}_b160", {})
+
+
+def test_mosaic_diag_cpu():
+    res = run_script(
+        "mosaic_diag.py",
+        {"M4T_DIAG_PLATFORM": "cpu", "M4T_DIAG_TIMEOUT": "120",
+         },
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    artifact = last_json_line(res.stdout)["artifact"]
+    with open(artifact) as f:
+        data = json.load(f)
+    os.unlink(artifact)
+    attempts = {a["block_rows"]: a for a in data["attempts"]}
+    assert set(attempts) == {200, 240, 320}
+    # CPU cannot compile Mosaic: every attempt records a captured
+    # failure with the error tail preserved
+    for rec in attempts.values():
+        assert rec["outcome"] == "failed"
+        assert rec["tail"]

@@ -84,6 +84,61 @@ def test_multistep_composes():
         )
 
 
+@pytest.mark.parametrize("num_steps", [4, 5])
+def test_interiors_match_composable_spp2(num_steps):
+    """Temporal blocking across ranks: one radius-6 exchange per two
+    steps (amortized 1 collective/step instead of 2); the odd span
+    exercises the single-step remainder pass on the deep layout."""
+    n = 4
+    cfg, model, state = _setup(n)
+    mesh = world_mesh(n)
+    stepper = fsp.FusedRowDecomp(
+        cfg, block_rows=8, interpret=True, steps_per_pass=2
+    )
+    assert stepper.spp == 2 and stepper._depth == 6
+
+    s1 = spmd(lambda s: model.step(s, first_step=True), mesh=mesh)(state)
+    ref = spmd(lambda s: model.multistep(s, num_steps), mesh=mesh)(s1)
+    fus = spmd(lambda s: stepper.multistep(s, num_steps), mesh=mesh)(s1)
+
+    for name, a, b in zip(ModelState._fields, ref, fus):
+        ai = np.asarray(a)[:, 1:-1, 1:-1]
+        bi = np.asarray(b)[:, 1:-1, 1:-1]
+        d = np.max(np.abs(ai - bi))
+        scale = 1.0 + np.max(np.abs(ai))
+        assert d / scale < 1e-4, (name, d)
+
+
+def test_2d_interiors_match_composable_spp2():
+    cfg = ShallowWaterConfig(nx=48, ny=96, dims=(2, 2))
+    model = ShallowWaterModel(cfg)
+    state = ModelState(
+        *(jnp.asarray(b) for b in model.initial_state_blocks())
+    )
+    mesh = world_mesh(4)
+    stepper = fsp.FusedDecomp2D(
+        cfg, block_rows=8, interpret=True, steps_per_pass=2
+    )
+    s1 = spmd(lambda s: model.step(s, first_step=True), mesh=mesh)(state)
+    ref = spmd(lambda s: model.multistep(s, 4), mesh=mesh)(s1)
+    fus = spmd(lambda s: stepper.multistep(s, 4), mesh=mesh)(s1)
+    for name, a, b in zip(ModelState._fields, ref, fus):
+        ai = np.asarray(a)[:, 1:-1, 1:-1]
+        bi = np.asarray(b)[:, 1:-1, 1:-1]
+        d = np.max(np.abs(ai - bi))
+        scale = 1.0 + np.max(np.abs(ai))
+        assert d / scale < 1e-4, (name, d)
+
+
+def test_spp2_guard_rails():
+    # depth-6 exchange needs >= 6 interior rows per rank
+    with pytest.raises(ValueError, match="steps_per_pass=2"):
+        fsp.FusedRowDecomp(
+            ShallowWaterConfig(nx=48, ny=40, dims=(8, 1)),
+            steps_per_pass=2,
+        )
+
+
 def test_guard_rails():
     with pytest.raises(NotImplementedError, match="row decomposition"):
         fsp.FusedRowDecomp(ShallowWaterConfig(nx=48, ny=96, dims=(2, 2)))
@@ -145,7 +200,21 @@ for blk, want in zip(fus, g):
     d = np.max(np.abs(got - ref))
     worst = max(worst, d / (1.0 + np.max(np.abs(ref))))
 assert worst < 1e-12, f"not decomposition-invariant: {{worst:.3e}}"
-print(f"f64 worst scaled diff vs global solve: {{worst:.3e}}")
+
+# temporally blocked (spp=2): the deep radius-6 exchange must preserve
+# the same exactness vs the undecomposed global solve
+stepper2 = FusedRowDecomp(cfg, block_rows=8, interpret=True,
+                          steps_per_pass=2)
+fus2 = spmd(lambda s: stepper2.multistep(s, 8), mesh=mesh)(s1)
+worst2 = 0.0
+for blk, want in zip(fus2, g):
+    got = ShallowWaterModel.reassemble(np.asarray(blk), (N, 1))
+    ref = np.asarray(want)[1:-1, 1:-1]
+    d = np.max(np.abs(got - ref))
+    worst2 = max(worst2, d / (1.0 + np.max(np.abs(ref))))
+assert worst2 < 1e-12, f"spp=2 not decomposition-invariant: {{worst2:.3e}}"
+print(f"f64 worst scaled diff vs global solve: {{worst:.3e}} "
+      f"(spp=2: {{worst2:.3e}})")
 """
 
 

@@ -74,6 +74,87 @@ def test_fused_multistep_equals_repeated_steps():
         np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=0, atol=0)
 
 
+def test_fused_two_steps_per_pass_matches_xla_f32_interpret():
+    """Temporal blocking: one steps_per_pass=2 kernel pass must track
+    two XLA steps — the halo margin (HALO=8 >= 2 radius-3 steps)
+    makes the chained in-slab step exact, not an approximation."""
+    cfg, model, state = _small_model()
+    ref = model.step(state, first_step=True)
+    cur = fs.pad_state(cfg, ref, 8)
+    for n in range(1, 4):
+        ref = model.step(model.step(ref))
+        cur = fs.fused_step(
+            cfg, cur, block_rows=8, interpret=True, steps_per_pass=2
+        )
+        got = fs.crop_state(cfg, cur)
+        for name, a, b in zip(ModelState._fields, ref, got):
+            d = float(jnp.max(jnp.abs(a - b)))
+            scale = 1.0 + float(jnp.max(jnp.abs(a)))
+            assert d / scale < 1e-5, (n, name, d)
+
+
+def test_fused_multistep_spp2_handles_odd_counts():
+    """fused_multistep(spp=2) must finish an odd span with a
+    single-step pass and stay on the single-step trajectory."""
+    cfg, model, state = _small_model()
+    state = model.step(state, first_step=True)
+    pad = fs.pad_state(cfg, state, 8)
+    a = fs.fused_multistep(cfg, pad, 5, block_rows=8, interpret=True,
+                           steps_per_pass=2)
+    b = pad
+    for _ in range(5):
+        b = fs.fused_step(cfg, b, block_rows=8, interpret=True)
+    for name, x, y in zip(ModelState._fields, a, b):
+        d = float(jnp.max(jnp.abs(x - y)))
+        scale = 1.0 + float(jnp.max(jnp.abs(y)))
+        assert d / scale < 1e-6, (name, d)
+
+
+def test_steps_per_pass_halo_guard():
+    # deeper passes deepen the halo (halo_for), and block_rows below
+    # the deepened halo is rejected instead of computing garbage
+    assert [fs.halo_for(s) for s in (1, 2, 3, 4, 5, 6)] == \
+        [8, 8, 16, 16, 16, 24]
+    cfg, _, state = _small_model()
+    padded = fs.pad_state(cfg, state, 8)
+    with pytest.raises(ValueError, match="multiple of 8, >= 16"):
+        fs.fused_step(cfg, padded, block_rows=8, interpret=True,
+                      steps_per_pass=3)
+
+
+def test_fused_four_steps_per_pass_matches_xla_f32_interpret():
+    """Deep temporal blocking (steps_per_pass=4, halo=16): one kernel
+    pass must track four XLA steps."""
+    cfg = ShallowWaterConfig(nx=48, ny=64, dims=(1, 1))
+    model = ShallowWaterModel(cfg)
+    state = ModelState(
+        *(jnp.asarray(b[0]) for b in model.initial_state_blocks())
+    )
+    ref = model.step(state, first_step=True)
+    cur = fs.pad_state(cfg, ref, 16)
+    for _ in range(4):
+        ref = model.step(ref)
+    cur = fs.fused_step(cfg, cur, block_rows=16, interpret=True,
+                        steps_per_pass=4)
+    got = fs.crop_state(cfg, cur)
+    for name, a, b in zip(ModelState._fields, ref, got):
+        d = float(jnp.max(jnp.abs(a - b)))
+        scale = 1.0 + float(jnp.max(jnp.abs(a)))
+        assert d / scale < 1e-5, (name, d)
+
+
+def test_vmem_compile_fence_on_benchmark_width():
+    """The empirical compile fence: at the published benchmark width
+    (nx_pad=3712) block_rows=160 stays compilable, the sizes that died
+    in the r4 sweep (200/240/320) are fenced out."""
+    cfg = ShallowWaterConfig(nx=3600, ny=1800, dims=(1, 1))
+    assert fs.padded_cols(cfg) == 3712
+    assert fs.block_rows_compilable(cfg, 160)
+    for b in (200, 240, 320):
+        assert fs.block_rows_legal(cfg.ny_local, b)
+        assert not fs.block_rows_compilable(cfg, b)
+
+
 def test_fit_block_rows_visits_all_multiples_of_8():
     """Regression: the old halving search (160->80->40->20->10) skipped
     every legal size for small extended grids, e.g. the 36 extended
@@ -138,16 +219,46 @@ state = ModelState(
 )
 ref = model.step(state, first_step=True)
 cur = fs.pad_state(cfg, ref, 8)
-worst = 0.0
-for _ in range(8):
+cur2 = cur
+worst = worst2 = 0.0
+for n in range(8):
     ref = model.step(ref)
     cur = fs.fused_step(cfg, cur, block_rows=8, interpret=True)
     got = fs.crop_state(cfg, cur)
     for a, b in zip(ref, got):
         d = float(jnp.max(jnp.abs(a - b)))
         worst = max(worst, d / (1.0 + float(jnp.max(jnp.abs(a)))))
+    if n % 2 == 1:  # temporally blocked path advances two at a time
+        cur2 = fs.fused_step(cfg, cur2, block_rows=8, interpret=True,
+                             steps_per_pass=2)
+        got2 = fs.crop_state(cfg, cur2)
+        for a, b in zip(ref, got2):
+            d = float(jnp.max(jnp.abs(a - b)))
+            worst2 = max(worst2, d / (1.0 + float(jnp.max(jnp.abs(a)))))
 assert worst < 1e-12, f"systematic divergence: {{worst:.3e}}"
-print(f"f64 worst scaled diff over 8 steps: {{worst:.3e}}")
+assert worst2 < 1e-12, f"spp=2 systematic divergence: {{worst2:.3e}}"
+
+# deep temporal blocking (spp=4, halo=16) needs a taller grid for a
+# legal 16-row tile; one quad pass vs four XLA steps
+cfg4 = ShallowWaterConfig(nx=48, ny=64, dims=(1, 1), dtype=np.float64)
+model4 = ShallowWaterModel(cfg4)
+s4 = ModelState(
+    *(jnp.asarray(b[0], jnp.float64) for b in model4.initial_state_blocks())
+)
+ref4 = model4.step(s4, first_step=True)
+cur4 = fs.pad_state(cfg4, ref4, 16)
+for _ in range(4):
+    ref4 = model4.step(ref4)
+cur4 = fs.fused_step(cfg4, cur4, block_rows=16, interpret=True,
+                     steps_per_pass=4)
+got4 = fs.crop_state(cfg4, cur4)
+worst4 = 0.0
+for a, b in zip(ref4, got4):
+    d = float(jnp.max(jnp.abs(a - b)))
+    worst4 = max(worst4, d / (1.0 + float(jnp.max(jnp.abs(a)))))
+assert worst4 < 1e-12, f"spp=4 systematic divergence: {{worst4:.3e}}"
+print(f"f64 worst scaled diff over 8 steps: {{worst:.3e}} "
+      f"(spp=2: {{worst2:.3e}}, spp=4: {{worst4:.3e}})")
 """
 
 
