@@ -211,6 +211,21 @@ def main():
             lambda s: model.multistep(s, multistep), mesh=mesh,
             donate_argnums=0,
         )
+        if not on_cpu_platform and os.environ.get("M4T_BENCH_FUSED", "1") != "0":
+            # deep-halo fused SPMD hot loop (communication-avoiding:
+            # amortized 1 collective/step with temporal blocking),
+            # probe-gated exactly like the example app's mesh path
+            from mpi4jax_tpu.models.fused_spmd import verified_mesh_stepper
+
+            stepper = verified_mesh_stepper(
+                config, model, state, first, mesh,
+                log=lambda m: print(f"# {m}", file=sys.stderr),
+            )
+            if stepper is not None:
+                multi = spmd(
+                    lambda s: stepper.multistep(s, multistep), mesh=mesh,
+                    donate_argnums=0,
+                )
     else:
         blocks = model.initial_state_blocks()
         state = ModelState(*(jnp.asarray(b[0]) for b in blocks))

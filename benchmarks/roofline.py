@@ -472,7 +472,6 @@ def main():
             # hoist device identity to the header from the first row
             for key in ("platform", "device_kind", "hbm_peak_gbps"):
                 result.setdefault(key, row.pop(key, None))
-                row.pop(key, None)
             result["rows"].append(row)
             print(f"{name}: {json.dumps(row)}", file=sys.stderr)
         elif rc is None:

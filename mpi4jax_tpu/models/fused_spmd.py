@@ -573,7 +573,8 @@ def verified_world_stepper(config, model, state, first, *,
        ok-flag: any rank failing degrades the *whole world* to the
        composable path together, before any probe collective starts.
     2. **Numerics phase.** All ranks (all of which passed phase 1)
-       run the :data:`PROBE_STEPS`-step fused trajectory against the
+       run a ``max(PROBE_STEPS, spp + 1)``-step fused trajectory (at
+       least one full temporally blocked pass + remainder) against the
        composable path, compare *interiors* (ghost cells of the fused
        state are unspecified by contract), and MAX-allreduce the
        worst scaled deviation. A mid-phase rank-local crash here is
@@ -607,7 +608,8 @@ def verified_world_stepper(config, model, state, first, *,
     # config), so all ranks fall through to the next variant together
     spp_ladder = list(dict.fromkeys((steps_per_pass, 1)))
 
-    probe = ref = None
+    probe = None
+    refs = {}
     for spp in spp_ladder:
         try:
             stepper = _stepper_cls(config)(
@@ -644,16 +646,21 @@ def verified_world_stepper(config, model, state, first, *,
                 "kernel failed); next variant")
             continue
 
-        # phase 2: full-probe numerics, verdict by MAX-allreduce
+        # phase 2: full-probe numerics, verdict by MAX-allreduce. The
+        # span must include at least one FULL temporally blocked pass
+        # plus a remainder (spp + 1), else the variant being verified
+        # never numerically executes (divmod(3, 4) = (0, 3) would
+        # probe only remainder kernels).
+        n_probe = max(PROBE_STEPS, spp + 1)
         try:
-            if ref is None:
-                ref = jax.jit(
-                    lambda s: model.multistep(s, PROBE_STEPS)
+            if n_probe not in refs:
+                refs[n_probe] = jax.jit(
+                    lambda s, _n=n_probe: model.multistep(s, _n)
                 )(probe)
             fus = jax.jit(
-                lambda s: stepper.multistep(s, PROBE_STEPS)
+                lambda s, _n=n_probe: stepper.multistep(s, _n)
             )(probe)
-            worst = probe_deviation(ref, fus)
+            worst = probe_deviation(refs[n_probe], fus)
         except Exception as e:  # pragma: no cover - async runtime failure
             say(f"deep-halo probe failed locally ({type(e).__name__}: "
                 f"{str(e)[:120]})")
@@ -687,7 +694,8 @@ def verified_mesh_stepper(config, model, state, first, mesh, *,
     from ..parallel import spmd
 
     say = log or (lambda _msg: None)
-    probe = ref = None
+    probe = None
+    refs = {}
     for spp in dict.fromkeys((steps_per_pass, 1)):
         try:
             stepper = _stepper_cls(config)(
@@ -697,17 +705,21 @@ def verified_mesh_stepper(config, model, state, first, mesh, *,
         except (ValueError, NotImplementedError) as e:
             say(f"deep-halo spp={spp} unavailable ({e}); next variant")
             continue
+        # span covers a full blocked pass + remainder (see the world
+        # gate's phase-2 note)
+        n_probe = max(PROBE_STEPS, spp + 1)
         try:
             if probe is None:
                 probe = first(state)
-            if ref is None:
-                ref = spmd(
-                    lambda s: model.multistep(s, PROBE_STEPS), mesh=mesh
+            if n_probe not in refs:
+                refs[n_probe] = spmd(
+                    lambda s, _n=n_probe: model.multistep(s, _n),
+                    mesh=mesh,
                 )(probe)
             fus = spmd(
-                lambda s: stepper.multistep(s, PROBE_STEPS), mesh=mesh
+                lambda s, _n=n_probe: stepper.multistep(s, _n), mesh=mesh
             )(probe)
-            worst = probe_deviation(ref, fus)
+            worst = probe_deviation(refs[n_probe], fus)
         except Exception as e:
             say(f"deep-halo spp={spp} failed ({type(e).__name__}: "
                 f"{str(e)[:120]}); next variant")

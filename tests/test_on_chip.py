@@ -181,3 +181,37 @@ assert worst < 1e-5, worst
 print(f"PASS worst={worst:.2e}")
 """)
     assert rc == 0 and "PASS" in out, (rc, out, err[-1500:])
+
+
+def test_fused_temporal_blocking_compiled_on_chip(chip):
+    """Compiled Mosaic temporally blocked passes (steps_per_pass=2 at
+    halo 8 and =4 at halo 16) vs the XLA trajectory on the real chip —
+    the hot-loop variants bench.py's routing ladder prefers."""
+    rc, out, err = _run("""
+import jax, jax.numpy as jnp
+from mpi4jax_tpu.models.shallow_water import (
+    ModelState, ShallowWaterConfig, ShallowWaterModel,
+)
+from mpi4jax_tpu.models import fused_step as fs
+
+cfg = ShallowWaterConfig(nx=48, ny=64, dims=(1, 1))
+model = ShallowWaterModel(cfg)
+state = ModelState(*(jnp.asarray(b[0]) for b in model.initial_state_blocks()))
+s1 = model.step(state, first_step=True)
+ref = s1
+for _ in range(4):
+    ref = model.step(ref)
+for spp in (2, 4):
+    b = 16
+    fus = fs.crop_state(cfg, fs.fused_multistep(
+        cfg, fs.pad_state(cfg, s1, b), 4, block_rows=b,
+        interpret=False, steps_per_pass=spp))
+    worst = 0.0
+    for a, g in zip(ref, fus):
+        d = float(jnp.max(jnp.abs(a - g)))
+        worst = max(worst, d / (1.0 + float(jnp.max(jnp.abs(a)))))
+    assert worst < 1e-5, (spp, worst)
+    print(f"spp={spp} worst={worst:.2e}")
+print("PASS")
+""")
+    assert rc == 0 and "PASS" in out, (rc, out, err[-1500:])
