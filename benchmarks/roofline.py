@@ -121,6 +121,10 @@ def row_plan():
         ("fused", "fused1", 1),
         ("fused2", "fused2", 2),
         ("fused4", "fused4", 4),
+        # same 16-row halo as spp=4, one more step amortized per pass:
+        # strictly less HBM traffic per step — the sweep shows whether
+        # compute has taken over by this depth
+        ("fused5", "fused5", 5),
     ):
         halo = fs.halo_for(spp)
         for b in (40, 64, 80, 128, 160, 200, 240, 320):
@@ -363,7 +367,7 @@ def measure_row(name, kind, block_rows):
     steps_per_pass = 1
     halo = fs.HALO
 
-    if kind in ("fused1", "fused2", "fused4"):
+    if kind in ("fused1", "fused2", "fused4", "fused5"):
         steps_per_pass = int(kind[len("fused"):] or "1")
         halo = fs.halo_for(steps_per_pass)
         ms_pass = time_loop(

@@ -48,6 +48,7 @@ with the next tile's DMA started before the current tile's compute
 from __future__ import annotations
 
 import functools
+import math
 from typing import Tuple
 
 import jax
@@ -603,8 +604,12 @@ def verified_hot_loop(config, model, multistep: int, state, first, *,
     per step) is probed first; any compile or numerics failure falls
     through ``4 -> 2 -> 1``, then down the block-size ladder, so a
     chip generation where a blocked variant misbehaves still gets the
-    fused path. The probe span is ``spp + 1`` steps so every variant
-    exercises both its full pass and a remainder pass.
+    fused path. The probe span is ``lcm(ladder) + 1`` steps so every
+    variant exercises whole blocked passes plus exactly one
+    single-step remainder — identical across variants. When two depths
+    verify, the faster one is chosen by slope-timing the compiled
+    probe functions — deeper blocking trades HBM traffic for compute,
+    and near the VPU balance point depth alone doesn't decide.
 
     The acceptance criterion is mixed absolute/relative per field
     (``diff <= 1e-4 * (1 + max|field|)``): ``v`` starts near zero, so
@@ -639,39 +644,73 @@ def verified_hot_loop(config, model, multistep: int, state, first, *,
 
         probe = first(state)
 
-        def try_variant(spp, cand, n_probe, ref):
-            fu = crop_state(
-                config,
-                jax.jit(
-                    lambda s: fused_multistep(
-                        config, s, n_probe, block_rows=cand,
-                        steps_per_pass=spp,
-                    )
-                )(pad_state(config, probe, cand)),
+        # One probe span for every variant: lcm(ladder) + 1, so each
+        # probe call runs WHOLE blocked passes plus exactly one
+        # single-step remainder pass — the remainder cost and the
+        # per-call overhead share are identical across variants, which
+        # makes both the numerics check and the slope-timing
+        # comparison below variant-fair (timing spans with per-variant
+        # remainder mixes would bias the pick).
+        span = math.lcm(*spp_ladder) + 1
+
+        ref = jax.jit(lambda s: model.multistep(s, span))(probe)
+
+        def try_variant(spp, cand):
+            mfn = jax.jit(
+                lambda s: fused_multistep(
+                    config, s, span, block_rows=cand,
+                    steps_per_pass=spp,
+                )
             )
+            padded = pad_state(config, probe, cand)
+            fu = crop_state(config, mfn(padded))
             jax.block_until_ready(fu.h)
             worst = 0.0
             for a_f, b_f in zip(ref[:3], fu[:3]):  # h, u, v
                 d = float(jnp.max(jnp.abs(a_f - b_f)))
                 scale = 1.0 + float(jnp.max(jnp.abs(a_f)))
                 worst = max(worst, d / scale)
-            return worst
+            return worst, mfn, padded
 
-        chosen = None
+        def time_variant(mfn, padded, calls=9, repeats=3):
+            """Per-step seconds by slope over call count on the
+            already-compiled span function. The 1-call-vs-`calls`
+            difference cancels the per-run fixed cost (state copies +
+            closing fetch); the per-call dispatch cost does NOT cancel,
+            but every variant runs the same `span` steps per call, so
+            it inflates all variants equally and the *comparison*
+            stays fair. Median over repeats rejects outliers."""
+            import time as _time
+
+            from ..utils.profiling import device_sync
+
+            def run(k):
+                cur = jax.tree.map(jnp.copy, padded)
+                device_sync(cur)
+                t0 = _time.perf_counter()
+                for _ in range(k):
+                    cur = mfn(cur)
+                device_sync(cur)
+                return _time.perf_counter() - t0
+
+            slopes = []
+            for _ in range(repeats):
+                slopes.append(
+                    (run(calls) - run(1)) / ((calls - 1) * span)
+                )
+            slopes.sort()
+            return slopes[len(slopes) // 2]
+
+        #: verified variants as (spp, cand, worst, mfn, padded)
+        verified = []
         last_err = None
         any_candidates = False
         any_verdict = False
-        refs = {}
         for spp in spp_ladder:
-            n_probe = spp + 1  # one full pass + a remainder pass
             for cand in candidates_for(spp):
                 any_candidates = True
-                if n_probe not in refs:
-                    refs[n_probe] = jax.jit(
-                        lambda s, _n=n_probe: model.multistep(s, _n)
-                    )(probe)
                 try:
-                    worst = try_variant(spp, cand, n_probe, refs[n_probe])
+                    worst, mfn, padded = try_variant(spp, cand)
                 except Exception as e:  # compile/runtime failure
                     last_err = e
                     say(
@@ -681,7 +720,7 @@ def verified_hot_loop(config, model, multistep: int, state, first, *,
                     continue
                 any_verdict = True
                 if worst < 1e-4:
-                    chosen = (spp, cand, worst)
+                    verified.append((spp, cand, worst, mfn, padded))
                     break
                 # a numerics mismatch is a property of the kernel
                 # arithmetic, not the tile size — smaller tiles would
@@ -692,9 +731,10 @@ def verified_hot_loop(config, model, multistep: int, state, first, *,
                     f"mismatch (rel {worst:.2e}); trying next spp"
                 )
                 break
-            if chosen:
+            if len(verified) >= 2:
+                # two verified depths is enough for an empirical pick
                 break
-        if chosen is None:
+        if not verified:
             if not any_candidates:
                 say("fused-step: grid too small for any legal block size")
                 return None
@@ -704,7 +744,22 @@ def verified_hot_loop(config, model, multistep: int, state, first, *,
                 raise last_err
             say("fused-step: no variant passed the probe; XLA path")
             return None
-        spp, b, worst = chosen
+        if len(verified) > 1:
+            # deeper temporal blocking moves less HBM per step but
+            # computes more per pass; at spp=4 the kernel sits near the
+            # VPU balance point, so pick by measurement, not by depth
+            timed = []
+            for spp, cand, worst, mfn, padded in verified:
+                per_step = time_variant(mfn, padded)
+                timed.append((per_step, spp, cand, worst))
+                say(
+                    f"fused-step spp={spp} block_rows={cand}: "
+                    f"{per_step * 1e3:.3f} ms/step measured"
+                )
+            timed.sort()
+            _, spp, b, worst = timed[0]
+        else:
+            spp, b, worst = verified[0][:3]
         say(f"fused Pallas step verified on-device (rel {worst:.2e}, "
             f"block_rows={b}, steps_per_pass={spp})")
         return {

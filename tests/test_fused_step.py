@@ -122,9 +122,10 @@ def test_steps_per_pass_halo_guard():
                       steps_per_pass=3)
 
 
-def test_fused_four_steps_per_pass_matches_xla_f32_interpret():
-    """Deep temporal blocking (steps_per_pass=4, halo=16): one kernel
-    pass must track four XLA steps."""
+@pytest.mark.parametrize("spp", [4, 5])
+def test_fused_deep_steps_per_pass_matches_xla_f32_interpret(spp):
+    """Deep temporal blocking (halo=16 covers up to five chained
+    radius-3 steps): one kernel pass must track spp XLA steps."""
     cfg = ShallowWaterConfig(nx=48, ny=64, dims=(1, 1))
     model = ShallowWaterModel(cfg)
     state = ModelState(
@@ -132,10 +133,10 @@ def test_fused_four_steps_per_pass_matches_xla_f32_interpret():
     )
     ref = model.step(state, first_step=True)
     cur = fs.pad_state(cfg, ref, 16)
-    for _ in range(4):
+    for _ in range(spp):
         ref = model.step(ref)
     cur = fs.fused_step(cfg, cur, block_rows=16, interpret=True,
-                        steps_per_pass=4)
+                        steps_per_pass=spp)
     got = fs.crop_state(cfg, cur)
     for name, a, b in zip(ModelState._fields, ref, got):
         d = float(jnp.max(jnp.abs(a - b)))
