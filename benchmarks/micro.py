@@ -17,7 +17,6 @@ allreduce are ``2 * (n-1)/n * payload`` per chip.
 
 import argparse
 import json
-import math
 import os
 import sys
 import time

@@ -10,7 +10,7 @@ import pytest
 
 import mpi4jax_tpu as m4t
 
-from tests.conftest import MY_RANK, WORLD
+from tests.conftest import WORLD
 
 N = 8
 

@@ -2,7 +2,6 @@
 allreduce, exactness for representable values."""
 
 import numpy as np
-import pytest
 
 import jax.numpy as jnp
 
