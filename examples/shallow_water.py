@@ -58,6 +58,13 @@ def parse_args():
         "validation, not speed)",
     )
     p.add_argument(
+        "--steps-per-pass", type=int, default=None,
+        help="top rung of the fused temporal-blocking ladder (steps "
+        "advanced per HBM pass / per halo exchange). Default: the "
+        "gates' own preference (single-rank 4, multi-rank 2); the "
+        "probe still falls back to shallower variants on failure",
+    )
+    p.add_argument(
         "--decomp", choices=("ref", "rows"), default="ref",
         help="multi-rank domain decomposition: 'ref' = the reference's "
         "(min(n,2), n/2) grid (fused path: FusedDecomp2D, 4 "
@@ -135,6 +142,13 @@ def main():
     on_cpu = jax.devices()[0].platform == "cpu"
     want_fused = args.fused == "on" or (args.fused == "auto" and not on_cpu)
 
+    if args.steps_per_pass is not None and args.steps_per_pass < 1:
+        raise SystemExit("--steps-per-pass must be a positive integer")
+    spp_kw = (
+        {"steps_per_pass": args.steps_per_pass}
+        if args.steps_per_pass is not None else {}
+    )
+
     fused = None
     if shm_world or n == 1:
         # one process, one block: jit the per-rank step directly. In a
@@ -161,7 +175,7 @@ def main():
 
                 stepper = verified_world_stepper(
                     config, model, state, first, interpret=on_cpu,
-                    log=lambda m: print(m, file=sys.stderr),
+                    log=lambda m: print(m, file=sys.stderr), **spp_kw,
                 )
                 if stepper is not None:
                     multi = jax.jit(
@@ -181,7 +195,7 @@ def main():
 
             fused = verified_hot_loop(
                 config, model, args.multistep, state, first,
-                log=lambda m: print(m, file=sys.stderr),
+                log=lambda m: print(m, file=sys.stderr), **spp_kw,
             )
             if fused is None and args.fused == "on":
                 raise SystemExit(
@@ -200,7 +214,7 @@ def main():
 
             stepper = verified_mesh_stepper(
                 config, model, state, first, mesh, interpret=on_cpu,
-                log=lambda m: print(m, file=sys.stderr),
+                log=lambda m: print(m, file=sys.stderr), **spp_kw,
             )
             if stepper is not None and on_cpu:
                 print("fused kernel in interpret mode", file=sys.stderr)
