@@ -200,6 +200,7 @@ def main():
     n_calls = math.ceil(num_steps / multistep)
 
     fused = None
+    fused_info = None
     if nproc > 1:
         from mpi4jax_tpu.parallel import spmd, world_mesh
 
@@ -226,6 +227,11 @@ def main():
                     lambda s: stepper.multistep(s, multistep), mesh=mesh,
                     donate_argnums=0,
                 )
+                fused_info = {
+                    "path": "deep_halo_spmd",
+                    "steps_per_pass": stepper.spp,
+                    "block_rows": stepper.block_rows,
+                }
     else:
         blocks = model.initial_state_blocks()
         state = ModelState(*(jnp.asarray(b[0]) for b in blocks))
@@ -251,6 +257,11 @@ def main():
     if fused is not None:
         state = fused["pad"](state)
         multi = fused["multi"]
+        fused_info = {
+            "path": "fused_single_chip",
+            "steps_per_pass": fused["steps_per_pass"],
+            "block_rows": fused["block_rows"],
+        }
     # compile warm-up (excluded from timing) on a throwaway copy of the
     # state — the hot loop donates its input, so warming up on a copy
     # keeps the real state intact and the timed loop then covers the
@@ -295,6 +306,9 @@ def main():
                 "unit": "s",
                 "vs_baseline": vs,
                 "nproc": nproc,
+                # which hot loop actually ran — makes a captured row
+                # self-describing (null = composable XLA step)
+                "fused": fused_info,
             }
         )
     )
