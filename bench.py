@@ -9,7 +9,9 @@ an NVIDIA Tesla P100 (``docs/shallow-water.rst:81-83``); values > 1
 mean this framework on one TPU chip beats the reference on the P100.
 
 Prints exactly one JSON line:
-    {"metric": "...", "value": N, "unit": "s", "vs_baseline": N}
+    {"metric": "...", "value": N, "unit": "s", "vs_baseline": N,
+     "nproc": N, "fused": {"path": ..., "steps_per_pass": N,
+     "block_rows": N} | null}
 """
 
 import json
