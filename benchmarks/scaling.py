@@ -13,7 +13,7 @@ Honest caveat, recorded in the artifact: virtual-device / multiprocess
 scaling on one CPU is a *plumbing and correctness* signal (the XLA CPU
 device already uses every core via intra-op threading at n=1), not an
 ICI performance claim. Numbers land in
-``benchmarks/results_r03_scaling.json``.
+``benchmarks/results_r{N}_scaling.json`` (N = M4T_ROUND, default 5).
 
     python benchmarks/scaling.py [--ranks 1 2 4 8] [--scale 10]
 """
@@ -105,7 +105,11 @@ def main():
     p.add_argument("--timeout", type=int, default=1200)
     p.add_argument(
         "--output",
-        default=os.path.join(REPO, "benchmarks", "results_r03_scaling.json"),
+        default=os.path.join(
+            REPO, "benchmarks",
+            f"results_r{int(os.environ.get('M4T_ROUND', '5')):02d}"
+            "_scaling.json",
+        ),
     )
     args = p.parse_args()
 
