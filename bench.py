@@ -243,9 +243,14 @@ def main():
         if not on_cpu_platform and os.environ.get("M4T_BENCH_FUSED", "1") != "0":
             from mpi4jax_tpu.models.fused_step import verified_hot_loop
 
+            # M4T_BENCH_SPP overrides the temporal-blocking ladder's
+            # top rung (e.g. 5 — roofline-swept but not in the default
+            # ladder) for chip-window experiments without code edits
+            spp_env = int(os.environ.get("M4T_BENCH_SPP", "0"))
             fused = verified_hot_loop(
                 config, model, multistep, state, first,
                 log=lambda m: print(f"# {m}", file=sys.stderr),
+                **({"steps_per_pass": spp_env} if spp_env > 0 else {}),
             )
 
     # Timings close with device_sync (a one-element host fetch), not
