@@ -21,10 +21,10 @@ state by several AB2 steps (~6.5 passes/step at 2, ~3.4 at 4).
 Scope (deliberate):
 
 - **single-rank** (``config.n_ranks == 1``) and ``periodic_x`` — the
-  benchmarked configuration (``BASELINE.md``). The SPMD path keeps the
-  composable ``sendrecv``-based exchange; fusing across shards would
-  move the halo exchange inside the kernel (ICI RDMA), a separate
-  project.
+  benchmarked configuration (``BASELINE.md``). Multi-rank fusion lives
+  in :mod:`.fused_spmd` (deep-halo exchange outside the kernel, one
+  fused pass per rank); moving the exchange *inside* the kernel
+  (ICI RDMA) remains a separate project.
 - **float32**, ``first_step=False`` (the first Euler step runs once on
   the XLA path; the AB2 hot loop is what matters).
 
@@ -34,9 +34,9 @@ Correctness contract: bit-compatible operation order with
 pre-friction ghost columns, rank-clamped edge padding). Validated
 against the XLA step in ``tests/test_fused_step.py`` (interpret mode,
 f64 to ~1e-13) and ``tests/test_on_chip.py`` (compiled Mosaic), and
-at runtime by :func:`verified_hot_loop` — the 3-step on-device
-equivalence probe that gates routing in ``bench.py`` and
-``examples/shallow_water.py``.
+at runtime by :func:`verified_hot_loop` — the short on-device
+equivalence probe (whole blocked passes + one remainder step) that
+gates routing in ``bench.py`` and ``examples/shallow_water.py``.
 
 The kernel layout follows the Pallas TPU halo pattern: inputs live in
 ``pl.ANY`` (compiler-placed, effectively HBM at these sizes); each
