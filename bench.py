@@ -34,6 +34,46 @@ TIMEOUT_S = int(os.environ.get("M4T_BENCH_TIMEOUT", "900"))
 CANARY_TIMEOUT_S = int(os.environ.get("M4T_BENCH_CANARY_TIMEOUT", "75"))
 CANARY_ATTEMPTS = int(os.environ.get("M4T_BENCH_CANARY_ATTEMPTS", "3"))
 
+#: largest steps_per_pass the M4T_BENCH_SPP override may request: the
+#: deep-halo ladder has only been verified to spp=5 (roofline sweep),
+#: and the halo grows 3 rows per step — beyond this the variant cannot
+#: be tiling-legal on the benchmark grid anyway
+SPP_MAX = 8
+
+
+def parse_spp_env() -> int:
+    """Parse ``M4T_BENCH_SPP`` defensively (ADVICE.md): a malformed or
+    out-of-range value must fall back to the default ladder with a
+    stderr warning, never kill a headline bench during a healthy-chip
+    window. Returns 0 for "use the default ladder"."""
+    raw = os.environ.get("M4T_BENCH_SPP", "")
+    if not raw:
+        return 0
+    try:
+        spp = int(raw)
+    except ValueError:
+        print(
+            f"# M4T_BENCH_SPP={raw!r} is not an integer; "
+            "using the default steps-per-pass ladder",
+            file=sys.stderr,
+        )
+        return 0
+    if spp < 0:
+        print(
+            f"# M4T_BENCH_SPP={spp} is negative; "
+            "using the default steps-per-pass ladder",
+            file=sys.stderr,
+        )
+        return 0
+    if spp > SPP_MAX:
+        print(
+            f"# M4T_BENCH_SPP={spp} exceeds the verified range; "
+            f"clamping to {SPP_MAX}",
+            file=sys.stderr,
+        )
+        return SPP_MAX
+    return spp
+
 _CANARY_SRC = """
 import jax, jax.numpy as jnp
 d = jax.devices()
@@ -246,7 +286,7 @@ def main():
             # M4T_BENCH_SPP overrides the temporal-blocking ladder's
             # top rung (e.g. 5 — roofline-swept but not in the default
             # ladder) for chip-window experiments without code edits
-            spp_env = int(os.environ.get("M4T_BENCH_SPP", "0"))
+            spp_env = parse_spp_env()
             fused = verified_hot_loop(
                 config, model, multistep, state, first,
                 log=lambda m: print(f"# {m}", file=sys.stderr),
@@ -305,18 +345,29 @@ def main():
         if scale == 10 and not on_cpu_platform and nproc == 1
         else None
     )
-    print(
-        json.dumps(
-            {
-                "metric": "shallow_water_100x_solve",
-                "value": round(elapsed, 3),
-                "unit": "s",
-                "vs_baseline": vs,
-                "nproc": nproc,
-                # which hot loop actually ran — makes a captured row
-                # self-describing (null = composable XLA step)
-                "fused": fused_info,
-            }
+    record = {
+        "metric": "shallow_water_100x_solve",
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "vs_baseline": vs,
+        "nproc": nproc,
+        # which hot loop actually ran — makes a captured row
+        # self-describing (null = composable XLA step)
+        "fused": fused_info,
+    }
+    print(json.dumps(record))
+    # Mirror the result into the shared telemetry event stream
+    # (observability/events.py) — no-op unless M4T_TELEMETRY_EVENTS
+    # names a sink. The stdout line above stays the parse contract for
+    # tpu_watch.py; the event record is the durable structured copy.
+    from mpi4jax_tpu.observability import events as obs_events
+
+    obs_events.emit(
+        obs_events.event(
+            "bench",
+            platform=jax.devices()[0].platform,
+            steps=num_steps,
+            **record,
         )
     )
 

@@ -113,8 +113,13 @@ def main():
     # a full-span record
     # VMEM-fenced fit: same guard as the routing ladders — a wide
     # grid must shrink the tile, not submit the compile class that
-    # wedged the r4 chip session
-    b = fs.fit_compilable_block_rows(config, fs.DEFAULT_BLOCK_ROWS)
+    # wedged the r4 chip session. Fitted at the deepest spp this
+    # artifact runs (2) so one shared block size is fence-safe for
+    # both variants (the spp>1 fence now charges unrolled
+    # intermediates, fused_step.vmem_model_bytes).
+    b = fs.fit_compilable_block_rows(
+        config, fs.DEFAULT_BLOCK_ROWS, fs.halo_for(2), 2
+    )
     result["block_rows"] = b
     worst_overall = 0.0
     for spp in (1, 2):

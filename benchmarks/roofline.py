@@ -130,7 +130,7 @@ def row_plan():
         for b in (40, 64, 80, 128, 160, 200, 240, 320):
             if not fs.block_rows_legal(config.ny_local, b, halo):
                 continue
-            if fs.block_rows_compilable(config, b, halo):
+            if fs.block_rows_compilable(config, b, halo, spp):
                 plan.append((f"{prefix}_b{b}", kind, b))
             else:
                 plan.append((f"{prefix}_b{b}", "fenced", b))

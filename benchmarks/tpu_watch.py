@@ -51,6 +51,32 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from _subproc import run_group  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Probe/stage records go through the shared JSONL event layer
+# (mpi4jax_tpu/observability/events.py) — same schema as the per-op
+# telemetry stream. The supervisor must keep probing even on hosts
+# where the package cannot import (e.g. an unsupported jax), so a
+# minimal same-schema fallback writer is kept behind the import guard.
+try:
+    from mpi4jax_tpu.observability.events import EventLog
+except Exception:  # pragma: no cover — degraded-host fallback
+
+    class EventLog:  # type: ignore[no-redef]
+        def __init__(self, path, echo=False):
+            self.path, self.echo = path, echo
+
+        def append(self, record):
+            rec = dict(record)
+            rec.setdefault(
+                "ts", time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+            )
+            line = json.dumps(rec, default=str)
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+            if self.echo:
+                print(line, flush=True)
+            return rec
 ROUND = int(os.environ.get("M4T_ROUND", "5"))
 PROBE_LOG = os.path.join(REPO, f"BENCH_r{ROUND:02d}_probes.jsonl")
 DONE_MARKER = os.path.join(
@@ -113,11 +139,18 @@ def _run(cmd, env, timeout):
     return run_group(cmd, env=env, timeout=timeout, cwd=REPO)
 
 
+_probe_sink = None
+
+
 def log_probe(record):
-    record["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    with open(PROBE_LOG, "a") as f:
-        f.write(json.dumps(record) + "\n")
-    print(json.dumps(record), flush=True)
+    """Append one probe/stage record to the round's JSONL forensics
+    log through the shared event layer (echoing to stdout, as
+    before). The sink is rebuilt when ``PROBE_LOG`` is repointed
+    (rehearsal redirects it to a scratch file)."""
+    global _probe_sink
+    if _probe_sink is None or _probe_sink.path != PROBE_LOG:
+        _probe_sink = EventLog(PROBE_LOG, echo=True)
+    return _probe_sink.append(record)
 
 
 #: forensics state: the most recent builder-initiated chip activity
@@ -190,11 +223,19 @@ def stage(results, name, cmd, env, timeout=None, expect=None):
     non-CPU platform. Pre-existing artifacts at expected paths are
     moved aside first (to ``.prev``) — otherwise a stage that wedges
     before writing would let a *stale* capture masquerade as a fresh
-    one and disarm the watcher with untrue evidence."""
+    one and disarm the watcher with untrue evidence. If the stage then
+    fails or wedges without writing a replacement, the ``.prev`` copy
+    is restored to its original path (ADVICE.md: genuine on-chip
+    evidence must never be left stranded at a ``.prev`` name) — the
+    restore is recorded in the probe log and deliberately does NOT
+    count toward ``captured``/``on_chip``, so a restored stale
+    artifact can never disarm the watcher."""
+    moved = []
     for rel in expect or []:
         path = os.path.join(REPO, rel)
         if os.path.exists(path):
             os.replace(path, path + ".prev")
+            moved.append(rel)
     rc, out = _run(cmd, env, timeout or STAGE_TIMEOUT_S)
     note_activity(name, rc)
     rec = {
@@ -208,11 +249,20 @@ def stage(results, name, cmd, env, timeout=None, expect=None):
         if os.path.exists(path):
             captured.append(rel)
             on_chip |= _artifact_on_chip(path)
+    restored = []
+    for rel in moved:
+        path = os.path.join(REPO, rel)
+        if not os.path.exists(path) and os.path.exists(path + ".prev"):
+            os.replace(path + ".prev", path)
+            restored.append(rel)
     rec["captured"] = captured
     rec["on_chip"] = on_chip
+    if restored:
+        rec["restored_prev"] = restored
     results[name] = rec
     log_probe({"stage": name, "exit_code": rc, "captured": captured,
-               "on_chip": on_chip})
+               "on_chip": on_chip,
+               **({"restored_prev": restored} if restored else {})})
     return rc, out, on_chip
 
 

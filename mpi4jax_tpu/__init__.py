@@ -26,8 +26,10 @@ __version__ = "0.1.0"
 import os as _os
 
 from .jax_compat import check_jax_version as _check_jax_version
+from .jax_compat import install_shims as _install_shims
 
 _check_jax_version()  # reference parity: _src/__init__.py:6-8
+_install_shims()
 
 from .comm import (  # noqa: F401
     ANY_SOURCE,

@@ -41,6 +41,8 @@ import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
+from .jax_compat import axis_size as _axis_size
+
 # MPI-parity sentinel constants. PROC_NULL is -1 here; mpi4py's own
 # numeric sentinels vary by MPI implementation (MPI.PROC_NULL is -2 on
 # OpenMPI builds, MPI.ANY_SOURCE is -2 on MPICH builds), so negative
@@ -524,7 +526,7 @@ class BoundComm:
             return jnp.zeros((), jnp.int32)
         r = jnp.zeros((), jnp.int32)
         for name in self.axes:
-            r = r * lax.axis_size(name) + lax.axis_index(name)
+            r = r * _axis_size(name) + lax.axis_index(name)
         return r
 
     def rank(self):
@@ -589,7 +591,7 @@ class BoundComm:
 
 def _axis_is_bound(name: str) -> bool:
     try:
-        lax.axis_size(name)
+        _axis_size(name)
         return True
     except (NameError, KeyError):
         return False
@@ -683,7 +685,7 @@ def resolve_comm(comm: Optional[Comm]) -> BoundComm:
         )
     size = 1
     for a in comm.axes:
-        size *= lax.axis_size(a)
+        size *= _axis_size(a)
     size = int(size)
     if isinstance(comm, GroupComm):
         total = sum(len(g) for g in comm.groups)

@@ -14,6 +14,20 @@ Recognised variables:
   (device-side) log callbacks, not just trace-time emission logs.
 - ``MPI4JAX_TPU_NO_ORDERING``: truthy -> disable the ambient token
   ordering chain (for benchmarking the effect of forced ordering).
+
+Telemetry variables (the ``observability`` subsystem; short ``M4T_``
+prefix matching the bench/watch driver family, long prefix accepted):
+
+- ``M4T_TELEMETRY``: truthy -> enable the comm telemetry registry
+  (per-op emission counters + byte accounting, ``observability/``).
+- ``M4T_TELEMETRY_RUNTIME``: truthy -> additionally sample per-op
+  device latencies through ``jax.debug.callback`` pairs (requires
+  ``M4T_TELEMETRY``; adds host callbacks to the computation).
+- ``M4T_TELEMETRY_EVENTS``: path -> append one JSONL record per op
+  emission (and per bench/watch event) to this file, in the
+  ``BENCH_r*_probes.jsonl`` schema.
+- ``M4T_TELEMETRY_RESERVOIR``: int -> per-op latency reservoir size
+  (default 256; bounds telemetry memory and report cost).
 """
 
 import os
@@ -40,9 +54,50 @@ def env_flag(name: str, default: bool = False) -> bool:
     return default
 
 
+def env_flag2(name: str, alt: str, default: bool = False) -> bool:
+    """``env_flag`` over two spellings; the first one set wins."""
+    for candidate in (name, alt):
+        if os.environ.get(candidate, ""):
+            return env_flag(candidate, default)
+    return default
+
+
+def env_int(name: str, default: int) -> int:
+    """Defensive int parse: malformed values warn-and-default rather
+    than raising at import time."""
+    value = os.environ.get(name, "")
+    if not value:
+        return default
+    try:
+        return int(value)
+    except ValueError:
+        import sys
+
+        print(
+            f"# {name}={value!r} is not an integer; using {default}",
+            file=sys.stderr,
+        )
+        return default
+
+
 DEBUG_LOGGING = env_flag("MPI4JAX_TPU_DEBUG")
 DEBUG_RUNTIME = env_flag("MPI4JAX_TPU_DEBUG_RUNTIME")
 NO_ORDERING = env_flag("MPI4JAX_TPU_NO_ORDERING")
 #: route large SUM-allreduces through the hand-written Pallas RDMA
 #: ring kernel (ops/pallas_ring.py) instead of HLO AllReduce
 PALLAS_RING = env_flag("MPI4JAX_TPU_PALLAS_RING")
+
+#: comm telemetry subsystem (observability/): per-op metrics registry,
+#: JSONL event log, correlation-id profiler annotations
+TELEMETRY = env_flag2("M4T_TELEMETRY", "MPI4JAX_TPU_TELEMETRY")
+#: runtime latency sampling via jax.debug.callback pairs (needs
+#: TELEMETRY; inserts host callbacks, so it is opt-in separately)
+TELEMETRY_RUNTIME = env_flag2(
+    "M4T_TELEMETRY_RUNTIME", "MPI4JAX_TPU_TELEMETRY_RUNTIME"
+)
+#: default JSONL event sink path ('' = no sink)
+TELEMETRY_EVENTS = os.environ.get(
+    "M4T_TELEMETRY_EVENTS", os.environ.get("MPI4JAX_TPU_TELEMETRY_EVENTS", "")
+)
+#: fixed per-op latency reservoir size (bounds telemetry overhead)
+TELEMETRY_RESERVOIR = max(1, env_int("M4T_TELEMETRY_RESERVOIR", 256))

@@ -14,16 +14,26 @@ two:
   per op printing ``r{rank} | {id} | {Op} ... done`` from the device,
   with the per-rank prefix matching the reference format tested by
   ``tests/collective_ops/test_common.py:118-146``.
+
+This module is also the funnel into the telemetry subsystem
+(``observability/``): the correlation id minted here is shared by the
+log line, the metrics-registry record, the JSONL event, and the
+profiler annotation of one emission, so all four can be joined after
+the fact. Telemetry recording only happens when
+``observability.enabled()`` (``M4T_TELEMETRY=1``); otherwise
+:func:`log_emission` does exactly what it always did.
 """
 
 from __future__ import annotations
 
 import random
 import string
+from typing import Optional, Sequence
 
 import jax
 
 from . import config
+from . import observability as _obs
 
 _logging = config.DEBUG_LOGGING
 _runtime_logging = config.DEBUG_RUNTIME
@@ -42,16 +52,51 @@ def get_logging() -> bool:
     return _logging
 
 
-def _random_id(n: int = 8) -> str:
-    # Reference: random_id(), mpi_ops_common.h:116-124.
+def new_cid(n: int = 8) -> str:
+    """Mint an emission correlation id (reference: random_id(),
+    mpi_ops_common.h:116-124). One id ties together the debug log
+    line, the metrics record, the JSONL event, and the profiler
+    annotation of a single op emission."""
     return "".join(random.choices(string.ascii_lowercase + string.digits, k=n))
 
 
-def log_emission(opname: str, details: str) -> str:
-    """Print a trace-time emission record; returns the correlation id."""
-    ident = _random_id()
+def _random_id(n: int = 8) -> str:
+    # kept under the historical name for external callers
+    return new_cid(n)
+
+
+def log_emission(
+    opname: str,
+    details: str,
+    *,
+    cid: Optional[str] = None,
+    nbytes: int = 0,
+    dtype: Optional[str] = None,
+    axes: Optional[Sequence[str]] = None,
+    world: Optional[int] = None,
+    annotation: Optional[str] = None,
+) -> str:
+    """Record a trace-time emission; returns the correlation id.
+
+    Prints the reference-format log line when debug logging is on, and
+    feeds the telemetry registry + JSONL event sink when telemetry is
+    on. The structured fields (``nbytes``/``dtype``/``axes``/``world``/
+    ``annotation``) are only consulted on the telemetry path.
+    """
+    ident = cid or new_cid()
     if _logging:
         print(f"emit | {ident} | {opname} {details}", flush=True)
+    if _obs.enabled():
+        record = _obs.registry.record_emission(
+            opname,
+            nbytes=nbytes,
+            dtype=dtype,
+            axes=axes,
+            world=world,
+            cid=ident,
+            annotation=annotation,
+        )
+        _obs.events.emit(record)
     return ident
 
 

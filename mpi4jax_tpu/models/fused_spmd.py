@@ -118,7 +118,8 @@ class _FusedDecompBase:
         # rather than submit the over-ceiling compile class that
         # wedged the r4 chip session (fused_step.VMEM_COMPILE_CEILING)
         b = fs.fit_block_rows_vmem(
-            self.ext_rows, block_rows, nx_pad, self._halo
+            self.ext_rows, block_rows, nx_pad, self._halo,
+            steps_per_pass,
         )
         if b is None:
             raise ValueError(

@@ -110,27 +110,32 @@ def fit_block_rows(rows: int, requested: int, halo: int = HALO):
 
 
 def fit_block_rows_vmem(rows: int, requested: int, nx: int,
-                        halo: int = HALO):
+                        halo: int = HALO, steps_per_pass: int = 1):
     """Largest block size <= ``requested`` that is tiling-legal for
     ``rows`` AND inside the VMEM compile fence at width ``nx``. All
     routing ladders (single-rank and SPMD) use this rather than
     :func:`fit_block_rows` so a wider-than-benchmark grid can't submit
-    the over-ceiling compile class that wedged the r4 chip session."""
+    the over-ceiling compile class that wedged the r4 chip session.
+    ``steps_per_pass`` must be the variant's pass depth: the fence
+    charges deep temporal blocking for its unrolled intermediates."""
     b = (requested // 8) * 8
     while b >= halo and not (
         block_rows_legal(rows, b, halo)
-        and vmem_model_bytes(b, nx, halo=halo) <= VMEM_COMPILE_CEILING
+        and vmem_model_bytes(b, nx, halo=halo,
+                             steps_per_pass=steps_per_pass)
+        <= VMEM_COMPILE_CEILING
     ):
         b -= 8
     return b if b >= halo else None
 
 
 def fit_compilable_block_rows(config: ShallowWaterConfig, requested: int,
-                              halo: int = HALO):
+                              halo: int = HALO, steps_per_pass: int = 1):
     """:func:`fit_block_rows_vmem` for a single-rank config's own
     grid extents."""
     return fit_block_rows_vmem(
-        config.ny_local, requested, padded_cols(config), halo
+        config.ny_local, requested, padded_cols(config), halo,
+        steps_per_pass,
     )
 
 
@@ -142,12 +147,22 @@ def padded_cols(config: ShallowWaterConfig) -> int:
 
 #: kernel VMEM residency model: double-buffered 6-field slab scratch
 #: plus the double-buffered 6-field output pipeline (inputs live in
-#: ``pl.ANY``/HBM and cost no VMEM)
+#: ``pl.ANY``/HBM and cost no VMEM). ``steps_per_pass > 1`` adds an
+#: intermediate-footprint term: each additional chained step keeps a
+#: full 6-field slab of intermediates live while producing the next
+#: (the unrolled temporal-blocking loop, ``fused_kernel``) — without
+#: this term the fence passed deep variants whose real footprint was
+#: unmodeled (ADVICE.md), exactly the compile class suspected of
+#: wedging the r4 chip session at spp>1, block_rows>=200.
 def vmem_model_bytes(block_rows: int, nx: int, itemsize: int = 4,
-                     halo: int = HALO) -> int:
+                     halo: int = HALO, steps_per_pass: int = 1) -> int:
     slab = 2 * 6 * (block_rows + 2 * halo) * nx * itemsize
     outs = 2 * 6 * block_rows * nx * itemsize
-    return slab + outs
+    inter = (
+        max(0, steps_per_pass - 1)
+        * 6 * (block_rows + 2 * halo) * nx * itemsize
+    )
+    return slab + outs + inter
 
 
 #: empirical compile ceiling for the VMEM model on the benchmark width
@@ -163,11 +178,13 @@ VMEM_COMPILE_CEILING = 64 * 1024 * 1024
 
 def block_rows_compilable(config: ShallowWaterConfig,
                           block_rows: int,
-                          halo: int = HALO) -> bool:
+                          halo: int = HALO,
+                          steps_per_pass: int = 1) -> bool:
     """Legality + the empirical VMEM-model compile fence."""
     return (
         block_rows_legal(config.ny_local, block_rows, halo)
-        and vmem_model_bytes(block_rows, padded_cols(config), halo=halo)
+        and vmem_model_bytes(block_rows, padded_cols(config), halo=halo,
+                             steps_per_pass=steps_per_pass)
         <= VMEM_COMPILE_CEILING
     )
 
@@ -636,7 +653,7 @@ def verified_hot_loop(config, model, multistep: int, state, first, *,
             out = []
             for req in (block_rows, 128, 64, 32):
                 fitted = fit_compilable_block_rows(
-                    config, min(req, block_rows), halo
+                    config, min(req, block_rows), halo, spp
                 )
                 if fitted is not None and fitted not in out:
                     out.append(fitted)

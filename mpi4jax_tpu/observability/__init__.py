@@ -1,0 +1,52 @@
+"""Comm telemetry subsystem: metrics registry + JSONL events +
+profiler annotations.
+
+Three correlated layers over every collective emission
+(``ops/_core.py``), sharing one 8-char correlation id per emission:
+
+1. **metrics** (:mod:`.metrics`) — per-op trace-time counters (op
+   name, payload bytes, dtype, mesh axes, emission count) and optional
+   runtime latency reservoirs; ``snapshot()`` / ``reset()`` /
+   ``report()``.
+2. **events** (:mod:`.events`) — structured JSONL records in the
+   ``BENCH_r*_probes.jsonl`` schema; the bench drivers and the per-op
+   emission stream share this one sink format.
+3. **profiler annotations** — every op emission is wrapped in a
+   ``m4t.<op>`` named scope (``utils/profiling.emission_scope``) so
+   XLA traces attribute ICI time to the mpi4jax-level op; with
+   telemetry on, the scope name carries the correlation id
+   (``m4t.allreduce.<cid>``).
+
+Everything is a no-op unless enabled (``M4T_TELEMETRY=1`` or
+:func:`enable`); see ``docs/observability.md``.
+"""
+
+from . import events  # noqa: F401
+from . import metrics  # noqa: F401
+from .metrics import (  # noqa: F401
+    MetricsRegistry,
+    Reservoir,
+    disable,
+    enable,
+    enabled,
+    registry,
+    report,
+    reset,
+    runtime_enabled,
+    snapshot,
+)
+
+__all__ = [
+    "MetricsRegistry",
+    "Reservoir",
+    "disable",
+    "enable",
+    "enabled",
+    "events",
+    "metrics",
+    "registry",
+    "report",
+    "reset",
+    "runtime_enabled",
+    "snapshot",
+]

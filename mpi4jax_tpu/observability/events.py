@@ -1,0 +1,141 @@
+"""Structured JSONL event log — one schema, one sink.
+
+Before this module, three places hand-rolled append-a-JSON-line code:
+``benchmarks/tpu_watch.py`` (probe/stage forensics,
+``BENCH_r*_probes.jsonl``), the round driver's ``PROGRESS.jsonl``, and
+``bench.py``'s stdout metric line. They already agreed on the
+essentials — one JSON object per line, a ``ts`` field in UTC
+``%Y-%m-%dT%H:%M:%SZ`` — so that is the schema this module pins down:
+
+- every record is a flat-ish JSON object on its own line;
+- ``ts`` (UTC second resolution) is stamped at append time if absent;
+- a ``kind`` field names the record family (``"emission"``,
+  ``"probe"``, ``"stage"``, ``"bench"``, ...) so one file can hold
+  mixed streams and still be filtered with one ``json.loads`` loop.
+
+Two layers of API:
+
+- :class:`EventLog` — an explicit append-only JSONL file handle, used
+  by the bench drivers (``tpu_watch.py`` probe log, ``bench.py``
+  results).
+- a module default sink (``M4T_TELEMETRY_EVENTS=<path>`` or
+  :func:`set_sink`) that :func:`emit` writes through; the op-emission
+  telemetry (``debug.py``) uses this, and it is a no-op when no sink
+  is configured.
+
+Writes are line-buffered appends under a lock: concurrent writers
+(battery stages in subprocesses append to the same probe log) each
+write whole lines, which POSIX appends keep intact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from .. import config
+
+#: the shared timestamp format (BENCH_r*_probes.jsonl / PROGRESS.jsonl)
+TS_FORMAT = "%Y-%m-%dT%H:%M:%SZ"
+
+
+def utc_stamp(t: Optional[float] = None) -> str:
+    """UTC timestamp string in the shared schema format."""
+    return time.strftime(TS_FORMAT, time.gmtime(t))
+
+
+def event(kind: str, **fields: Any) -> Dict[str, Any]:
+    """Build a schema-shaped record (``ts`` stamped at append time)."""
+    record: Dict[str, Any] = {"kind": kind}
+    record.update(fields)
+    return record
+
+
+class EventLog:
+    """Append-only JSONL sink.
+
+    ``echo=True`` mirrors each line to stdout (the ``tpu_watch.py``
+    behavior — its probe log doubles as live console output).
+    """
+
+    def __init__(self, path: str, *, echo: bool = False):
+        self.path = os.fspath(path)
+        self.echo = bool(echo)
+        self._lock = threading.Lock()
+
+    def append(self, record: Dict[str, Any]) -> Dict[str, Any]:
+        """Stamp ``ts`` (if absent), append one line, return the
+        record as written. Non-JSON-able values fall back to ``str``
+        so telemetry can never throw from a repr."""
+        rec = dict(record)
+        rec.setdefault("ts", utc_stamp())
+        line = json.dumps(rec, default=str)
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+        if self.echo:
+            print(line, flush=True)
+        return rec
+
+    def __repr__(self) -> str:
+        return f"EventLog({self.path!r})"
+
+
+def read(path: str) -> List[Dict[str, Any]]:
+    """Load every record of a JSONL file (skipping malformed lines —
+    a crashed writer may leave a torn final line)."""
+    return list(iter_records(path))
+
+
+def iter_records(path: str) -> Iterator[Dict[str, Any]]:
+    try:
+        f = open(path)
+    except OSError:
+        return
+    with f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                yield rec
+
+
+# -- module default sink (op-emission telemetry) ----------------------
+
+_sink: Optional[EventLog] = (
+    EventLog(config.TELEMETRY_EVENTS) if config.TELEMETRY_EVENTS else None
+)
+_sink_lock = threading.Lock()
+
+
+def set_sink(path: Optional[str]) -> Optional[EventLog]:
+    """Point the default sink at ``path`` (None disables it)."""
+    global _sink
+    with _sink_lock:
+        _sink = EventLog(path) if path else None
+        return _sink
+
+
+def get_sink() -> Optional[EventLog]:
+    return _sink
+
+
+def emit(record: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Append ``record`` to the default sink; no-op (returns None)
+    when no sink is configured. Never raises: a full disk or revoked
+    path must not take down the computation being observed."""
+    sink = _sink
+    if sink is None:
+        return None
+    try:
+        return sink.append(record)
+    except OSError:
+        return None

@@ -1,0 +1,348 @@
+"""Per-op comm metrics registry (trace-time counters + runtime samples).
+
+The reference's only telemetry is the per-call ``DebugTimer`` log line
+(``mpi_ops_common.h:154-206``) — unstructured text that cannot answer
+"how many bytes moved per collective, per mesh axis, per step". This
+registry is the structured replacement: every op emission
+(``ops/_core.py:emit`` / ``emit_shm``) records
+
+- op name, payload bytes, dtype, communicator mesh axes, world size,
+- the emission correlation id (shared with the ``debug.py`` log line
+  and the ``m4t.<op>`` profiler annotation),
+
+and, when runtime sampling is enabled
+(``M4T_TELEMETRY_RUNTIME``), per-execution latency samples captured
+through ``jax.debug.callback`` pairs land in a fixed-size reservoir so
+memory and report cost stay bounded no matter how long the program
+runs.
+
+Everything in this module is plain host-side Python: recording happens
+at trace time (one dict update per ``bind``) or inside host callbacks,
+never on the device. The whole layer is inert unless enabled
+(``M4T_TELEMETRY=1`` or :func:`enable`): the op layer checks
+:func:`enabled` before doing any telemetry work, so the disabled path
+adds a single attribute read per emission and zero runtime callbacks.
+
+Usage::
+
+    from mpi4jax_tpu import observability as obs
+
+    obs.enable()                  # or M4T_TELEMETRY=1
+    ... run jitted collectives ...
+    snap = obs.snapshot()         # plain-JSON dict
+    print(obs.report())           # pretty per-op table
+    obs.reset()
+"""
+
+from __future__ import annotations
+
+import io
+import random
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence
+
+from .. import config
+
+#: how many of the most recent per-emission records are retained for
+#: correlation (cid <-> op <-> annotation); counters are exact forever,
+#: this ring only bounds the per-record detail
+EMISSION_RING = 1024
+
+
+class Reservoir:
+    """Fixed-capacity uniform sample of a float stream (Vitter's
+    algorithm R). Exact count/sum/min/max over the full stream;
+    quantiles are estimated from the reservoir."""
+
+    __slots__ = ("capacity", "count", "total", "minimum", "maximum", "samples")
+
+    def __init__(self, capacity: int):
+        self.capacity = int(capacity)
+        self.count = 0
+        self.total = 0.0
+        self.minimum: Optional[float] = None
+        self.maximum: Optional[float] = None
+        self.samples: List[float] = []
+
+    def add(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.total += value
+        self.minimum = value if self.minimum is None else min(self.minimum, value)
+        self.maximum = value if self.maximum is None else max(self.maximum, value)
+        if len(self.samples) < self.capacity:
+            self.samples.append(value)
+        else:
+            j = random.randrange(self.count)
+            if j < self.capacity:
+                self.samples[j] = value
+
+    def quantile(self, q: float) -> Optional[float]:
+        if not self.samples:
+            return None
+        ordered = sorted(self.samples)
+        idx = min(len(ordered) - 1, max(0, round(q * (len(ordered) - 1))))
+        return ordered[idx]
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "count": self.count,
+            "mean": (self.total / self.count) if self.count else None,
+            "min": self.minimum,
+            "max": self.maximum,
+            "p50": self.quantile(0.50),
+            "p90": self.quantile(0.90),
+            "p99": self.quantile(0.99),
+        }
+
+
+class OpMetrics:
+    """Counters for a single op name (e.g. ``AllReduce``)."""
+
+    __slots__ = (
+        "op",
+        "emissions",
+        "payload_bytes",
+        "by_dtype",
+        "by_axes",
+        "last_cid",
+        "latency",
+    )
+
+    def __init__(self, op: str, reservoir: int):
+        self.op = op
+        self.emissions = 0
+        self.payload_bytes = 0
+        #: dtype str -> [emission count, payload bytes]
+        self.by_dtype: Dict[str, List[int]] = {}
+        #: mesh-axes key ("dp,tp" / "<none>") -> emission count
+        self.by_axes: Dict[str, int] = {}
+        self.last_cid = ""
+        self.latency = Reservoir(reservoir)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "op": self.op,
+            "emissions": self.emissions,
+            "payload_bytes": self.payload_bytes,
+            "by_dtype": {k: list(v) for k, v in self.by_dtype.items()},
+            "by_axes": dict(self.by_axes),
+            "last_cid": self.last_cid,
+            "latency_s": self.latency.summary(),
+        }
+
+
+def _axes_key(axes: Optional[Sequence[str]]) -> str:
+    if not axes:
+        return "<none>"
+    return ",".join(str(a) for a in axes)
+
+
+class MetricsRegistry:
+    """Thread-safe accumulator for every op emission and runtime sample.
+
+    One process-global instance (:data:`registry`) backs the module-
+    level helpers; independent instances are constructible for tests.
+    """
+
+    def __init__(self, reservoir: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._reservoir = int(reservoir or config.TELEMETRY_RESERVOIR)
+        self._ops: Dict[str, OpMetrics] = {}
+        self._emissions: deque = deque(maxlen=EMISSION_RING)
+        #: cid -> host-clock start mark for in-flight runtime samples
+        self._inflight: Dict[str, float] = {}
+        self._created = time.time()
+
+    # -- recording ---------------------------------------------------
+
+    def record_emission(
+        self,
+        op: str,
+        *,
+        nbytes: int,
+        dtype: Optional[str],
+        axes: Optional[Sequence[str]],
+        world: Optional[int],
+        cid: str,
+        annotation: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Count one trace-time op emission; returns the record stored
+        in the emission ring (shared schema with the JSONL event log)."""
+        record = {
+            "kind": "emission",
+            "cid": cid,
+            "op": op,
+            "bytes": int(nbytes),
+            "dtype": None if dtype is None else str(dtype),
+            "axes": list(axes) if axes else [],
+            "world": None if world is None else int(world),
+            "annotation": annotation,
+        }
+        key = _axes_key(axes)
+        with self._lock:
+            m = self._ops.get(op)
+            if m is None:
+                m = self._ops[op] = OpMetrics(op, self._reservoir)
+            m.emissions += 1
+            m.payload_bytes += int(nbytes)
+            per_dtype = m.by_dtype.setdefault(record["dtype"] or "<none>", [0, 0])
+            per_dtype[0] += 1
+            per_dtype[1] += int(nbytes)
+            m.by_axes[key] = m.by_axes.get(key, 0) + 1
+            m.last_cid = cid
+            self._emissions.append(record)
+        return record
+
+    def mark_runtime_start(self, cid: str) -> None:
+        """Host-callback hook: an op with correlation id ``cid`` began
+        executing (first callback of the pair)."""
+        with self._lock:
+            self._inflight[cid] = time.perf_counter()
+
+    def mark_runtime_end(self, cid: str, op: str) -> Optional[float]:
+        """Host-callback hook: the op finished; records the latency
+        sample and returns it (None when the start mark is missing or
+        the callbacks arrived out of order)."""
+        now = time.perf_counter()
+        with self._lock:
+            start = self._inflight.pop(cid, None)
+            if start is None or now < start:
+                return None
+            sample = now - start
+            m = self._ops.get(op)
+            if m is None:
+                m = self._ops[op] = OpMetrics(op, self._reservoir)
+            m.latency.add(sample)
+        return sample
+
+    def record_latency(self, op: str, seconds: float) -> None:
+        """Direct latency sample (bench drivers measuring externally)."""
+        with self._lock:
+            m = self._ops.get(op)
+            if m is None:
+                m = self._ops[op] = OpMetrics(op, self._reservoir)
+            m.latency.add(seconds)
+
+    # -- reading -----------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Plain-JSON state: per-op counters plus the emission ring."""
+        with self._lock:
+            return {
+                "since": self._created,
+                "ops": {name: m.as_dict() for name, m in self._ops.items()},
+                "emissions": [dict(r) for r in self._emissions],
+                "totals": {
+                    "emissions": sum(m.emissions for m in self._ops.values()),
+                    "payload_bytes": sum(
+                        m.payload_bytes for m in self._ops.values()
+                    ),
+                },
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ops.clear()
+            self._emissions.clear()
+            self._inflight.clear()
+            self._created = time.time()
+
+    def report(self, file=None) -> str:
+        """Human-readable per-op table; returns the string (and writes
+        it to ``file`` when given)."""
+        snap = self.snapshot()
+        out = io.StringIO()
+        ops = sorted(snap["ops"].values(), key=lambda m: -m["payload_bytes"])
+        out.write(
+            f"comm telemetry: {snap['totals']['emissions']} emissions, "
+            f"{_fmt_bytes(snap['totals']['payload_bytes'])} total payload\n"
+        )
+        if ops:
+            out.write(
+                f"{'op':<16} {'emits':>6} {'payload':>10} "
+                f"{'dtypes':<18} {'axes':<14} {'lat p50/p99':>16}\n"
+            )
+        for m in ops:
+            lat = m["latency_s"]
+            lat_txt = (
+                f"{_fmt_s(lat['p50'])}/{_fmt_s(lat['p99'])}"
+                if lat["count"]
+                else "-"
+            )
+            out.write(
+                f"{m['op']:<16} {m['emissions']:>6} "
+                f"{_fmt_bytes(m['payload_bytes']):>10} "
+                f"{','.join(m['by_dtype']):<18} "
+                f"{';'.join(m['by_axes']):<14} {lat_txt:>16}\n"
+            )
+        text = out.getvalue()
+        if file is not None:
+            file.write(text)
+        return text
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024
+    return f"{n}B"
+
+
+def _fmt_s(s: Optional[float]) -> str:
+    if s is None:
+        return "-"
+    if s < 1e-3:
+        return f"{s * 1e6:.0f}us"
+    if s < 1:
+        return f"{s * 1e3:.1f}ms"
+    return f"{s:.2f}s"
+
+
+#: process-global registry backing the module-level API
+registry = MetricsRegistry()
+
+#: dynamic on/off switch, seeded from M4T_TELEMETRY
+_enabled = bool(config.TELEMETRY)
+_runtime_enabled = bool(config.TELEMETRY_RUNTIME)
+
+
+def enabled() -> bool:
+    """Is the telemetry layer on? The single gate every op-emission
+    call site checks before doing any telemetry work."""
+    return _enabled
+
+
+def runtime_enabled() -> bool:
+    """Are runtime latency callbacks requested (implies :func:`enabled`)?"""
+    return _enabled and _runtime_enabled
+
+
+def enable(*, runtime: Optional[bool] = None) -> None:
+    """Turn the telemetry registry on at runtime (analog of
+    ``set_logging``). ``runtime=True`` additionally samples per-op
+    device latency via host callbacks in subsequently traced programs."""
+    global _enabled, _runtime_enabled
+    _enabled = True
+    if runtime is not None:
+        _runtime_enabled = bool(runtime)
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def snapshot() -> Dict[str, Any]:
+    return registry.snapshot()
+
+
+def reset() -> None:
+    registry.reset()
+
+
+def report(file=None) -> str:
+    return registry.report(file=file)

@@ -14,20 +14,30 @@ SURVEY.md §2.2): every op is a JAX ``Primitive`` with
   on every platform, which *is* the TPU-native data path demanded by
   ``BASELINE.json``'s north star (no FFI custom call, no host staging).
 
-Op emission goes through :func:`emit`, which adds debug logging and the
-ambient ordering-token ties.
+Op emission goes through :func:`emit`, which adds debug logging, the
+ambient ordering-token ties, and the telemetry layer: every bind site
+mints one correlation id shared by the debug log line, the metrics-
+registry record (op name, payload bytes, dtype, mesh axes — see
+``observability/metrics.py``), the JSONL event, and the profiler
+annotation (``m4t.<op>``, ``utils/profiling.emission_scope``) wrapping
+the emission. With telemetry off (the default) all of that collapses
+to the pre-existing behavior: one flag check, no callbacks, no scopes
+beyond the plain ``m4t.<op>`` HLO name scope.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable, Tuple
+from typing import Callable, Optional, Tuple
 
+import jax
 import jax.extend as jex
 from jax.interpreters import batching, mlir, xla
 
 from .. import debug
+from .. import observability as _obs
 from ..token import ordered_call
+from ..utils.profiling import emission_scope
 
 
 def define_primitive(
@@ -68,15 +78,130 @@ def register_passthrough_batcher(prim, n_operands: int = 1):
     batching.primitive_batchers[prim] = rule
 
 
-def emit_shm(fn, inputs: Tuple, *, opname: str, details: str, bound_comm):
+def _payload_bytes(inputs: Tuple) -> int:
+    """Default payload accounting: bytes of the first operand (the
+    payload array by convention at every call site; companion operands
+    like p2p's recv template describe the same payload again)."""
+    if not inputs:
+        return 0
+    x = inputs[0]
+    try:
+        return int(x.size) * x.dtype.itemsize
+    except (AttributeError, TypeError):
+        return 0
+
+
+def _payload_dtype(inputs: Tuple) -> Optional[str]:
+    if not inputs:
+        return None
+    dtype = getattr(inputs[0], "dtype", None)
+    return None if dtype is None else str(dtype)
+
+
+def _scalar_probe(x):
+    """A one-element view of ``x`` for latency-callback data
+    dependence (forces the callback after the op that produced it)."""
+    if getattr(x, "ndim", 0):
+        return x.reshape(-1)[:1]
+    return x
+
+
+def _telemetry_prologue(
+    inputs: Tuple,
+    *,
+    opname: str,
+    details: str,
+    bound_comm,
+    annotation: Optional[str],
+    payload: Optional[int],
+) -> Tuple[str, str]:
+    """Mint the correlation id and feed log line + registry + events.
+
+    Returns ``(ident, scope)`` where ``scope`` is the profiler
+    annotation name for this emission: ``m4t.<op>`` normally,
+    ``m4t.<op>.<cid>`` with telemetry on (the trace region is then
+    joinable against the metrics record and the log line).
+    """
+    base = annotation or f"m4t.{opname.lower()}"
+    ident = debug.new_cid()
+    scope = f"{base}.{ident}" if _obs.enabled() else base
+    debug.log_emission(
+        opname,
+        details,
+        cid=ident,
+        nbytes=_payload_bytes(inputs) if payload is None else int(payload),
+        dtype=_payload_dtype(inputs),
+        axes=getattr(bound_comm, "axes", None),
+        world=getattr(bound_comm, "size", None),
+        annotation=scope,
+    )
+    debug.log_runtime(bound_comm, ident, opname, details)
+    return ident, scope
+
+
+def _with_runtime_sampling(fn: Callable, ident: str, opname: str) -> Callable:
+    """Bracket ``fn`` with latency-sampling host callbacks when runtime
+    telemetry is on (``M4T_TELEMETRY_RUNTIME``). The start callback
+    depends on the first operand (fires once inputs are ready), the end
+    callback on the first output (fires once the op completed); the
+    delta lands in the op's fixed-size reservoir. Best-effort by
+    design: backends that reject callbacks degrade to no sampling, and
+    out-of-order arrivals are dropped by the registry."""
+    if not _obs.runtime_enabled():
+        return fn
+
+    def sampled(*args):
+        try:
+            if args:
+                jax.debug.callback(
+                    lambda _v, _cid=ident: _obs.registry.mark_runtime_start(
+                        _cid
+                    ),
+                    _scalar_probe(args[0]),
+                )
+        except Exception:
+            pass
+        out = fn(*args)
+        try:
+            jax.debug.callback(
+                lambda _v, _cid=ident, _op=opname: (
+                    _obs.registry.mark_runtime_end(_cid, _op)
+                ),
+                _scalar_probe(out[0]),
+            )
+        except Exception:
+            pass
+        return out
+
+    return sampled
+
+
+def emit_shm(
+    fn,
+    inputs: Tuple,
+    *,
+    opname: str,
+    details: str,
+    bound_comm,
+    annotation: Optional[str] = None,
+    payload: Optional[int] = None,
+):
     """Run a native shm-backend op under the ambient ordering token.
 
     Used by op wrappers whose shm path cannot go through the primitive
     (rank-dependent output shapes — gather/scatter root-only semantics —
     or per-process scalar arguments, reference execution model)."""
-    ident = debug.log_emission(opname, details)
-    debug.log_runtime(bound_comm, ident, opname, details)
-    return ordered_call(fn, tuple(inputs))
+    ident, scope = _telemetry_prologue(
+        inputs,
+        opname=opname,
+        details=details,
+        bound_comm=bound_comm,
+        annotation=annotation,
+        payload=payload,
+    )
+    wrapped = _with_runtime_sampling(fn, ident, opname)
+    with emission_scope(scope):
+        return ordered_call(wrapped, tuple(inputs))
 
 
 def emit(
@@ -87,13 +212,27 @@ def emit(
     opname: str,
     details: str,
     bound_comm,
+    annotation: Optional[str] = None,
+    payload: Optional[int] = None,
 ) -> Tuple:
-    """Bind ``prim`` under the ambient ordering token, with logging.
+    """Bind ``prim`` under the ambient ordering token, with logging,
+    telemetry, and the ``m4t.<op>`` profiler annotation.
+
+    ``annotation`` overrides the default ``m4t.<opname.lower()>`` scope
+    name; ``payload`` overrides the default byte accounting (bytes of
+    the first operand) for ops whose first operand is not the payload
+    (barrier's dummy token).
 
     Returns a tuple of outputs (even for single-result primitives).
     """
-    ident = debug.log_emission(opname, details)
-    debug.log_runtime(bound_comm, ident, opname, details)
+    ident, scope = _telemetry_prologue(
+        inputs,
+        opname=opname,
+        details=details,
+        bound_comm=bound_comm,
+        annotation=annotation,
+        payload=payload,
+    )
 
     def bind(*args):
         out = prim.bind(*args, **params)
@@ -101,4 +240,6 @@ def emit(
             return tuple(out)
         return (out,)
 
-    return ordered_call(bind, tuple(inputs))
+    wrapped = _with_runtime_sampling(bind, ident, opname)
+    with emission_scope(scope):
+        return ordered_call(wrapped, tuple(inputs))

@@ -66,5 +66,6 @@ def allgather(x, *, comm=None, token=NOTSET):
         opname="AllGather",
         details=f"[{x.size} items, n={bound.size}]",
         bound_comm=bound,
+        annotation="m4t.allgather",
     )
     return out

@@ -97,13 +97,17 @@ def _allreduce_spmd(x, *, op, comm: BoundComm, transpose):
         # World size 1: reduction over a single rank is the identity.
         return x
     if _use_pallas_ring(x, op, comm):
+        from ..utils.profiling import emission_scope
         from .pallas_ring import ring_allreduce
         from .ring_guard import routed_ring
 
         # interpret mode is chosen per lowering platform (ring_guard):
         # TPU lowerings get the compiled RDMA ring, everything else
-        # (tests, CPU meshes) the interpret kernel.
-        return routed_ring(ring_allreduce, x, comm.axes[0], comm.size)
+        # (tests, CPU meshes) the interpret kernel. The extra scope
+        # distinguishes ring-routed allreduces from HLO AllReduce in
+        # profiler traces (nested under the emission's m4t.allreduce).
+        with emission_scope("m4t.pallas_ring"):
+            return routed_ring(ring_allreduce, x, comm.axes[0], comm.size)
     if op.native is not None:
         return _native_reduce(x, op, comm)
     return _generic_reduce(x, op, comm)
@@ -209,5 +213,6 @@ def allreduce(x, op=SUM, *, comm=None, token=NOTSET):
         opname="AllReduce",
         details=f"[{x.size} items, op={op.name}, n={bound.size}]",
         bound_comm=bound,
+        annotation="m4t.allreduce",
     )
     return out

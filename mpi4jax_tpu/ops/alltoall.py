@@ -93,5 +93,6 @@ def alltoall(x, *, comm=None, token=NOTSET):
         opname="AllToAll",
         details=f"[{x.size} items, n={bound.size}]",
         bound_comm=bound,
+        annotation="m4t.alltoall",
     )
     return out

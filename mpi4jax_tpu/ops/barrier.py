@@ -64,5 +64,7 @@ def barrier(*, comm=None, token=NOTSET):
         opname="Barrier",
         details=f"[n={bound.size}]",
         bound_comm=bound,
+        annotation="m4t.barrier",
+        payload=0,  # the uint32 operand is a sync token, not a payload
     )
     return None

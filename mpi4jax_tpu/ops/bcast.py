@@ -108,5 +108,6 @@ def bcast(x, root, *, comm=None, token=NOTSET):
         opname="Bcast",
         details=f"[{x.size} items, root={root}, n={bound.size}]",
         bound_comm=bound,
+        annotation="m4t.bcast",
     )
     return out

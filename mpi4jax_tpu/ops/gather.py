@@ -86,6 +86,7 @@ def gather(x, root, *, comm=None, token=NOTSET):
             opname="Gather",
             details=f"[{x.size} items, root={root}, n={bound.size}]",
             bound_comm=bound,
+            annotation="m4t.gather",
         )
         return out
     (out,) = emit(
@@ -95,5 +96,6 @@ def gather(x, root, *, comm=None, token=NOTSET):
         opname="Gather",
         details=f"[{x.size} items, root={root}, n={bound.size}]",
         bound_comm=bound,
+        annotation="m4t.gather",
     )
     return out

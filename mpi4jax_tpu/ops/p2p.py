@@ -352,7 +352,14 @@ def _shm_partner(value: TableLike, bound: BoundComm, what: str) -> int:
 def _shm_ordered(fn, inputs, opname, details, bound):
     from ._core import emit_shm
 
-    return emit_shm(fn, inputs, opname=opname, details=details, bound_comm=bound)
+    return emit_shm(
+        fn,
+        inputs,
+        opname=opname,
+        details=details,
+        bound_comm=bound,
+        annotation=f"m4t.{opname.lower()}",
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -456,6 +463,7 @@ def sendrecv(
         opname="Sendrecv",
         details=f"[{sendbuf.size} items, {len(send_edges)} edges, n={bound.size}]",
         bound_comm=bound,
+        annotation="m4t.sendrecv",
     )
     return out
 
@@ -487,8 +495,17 @@ def send(x, dest: TableLike, *, tag: int = 0, comm=None, token=NOTSET):
         return None
     dest_t = _normalize_table(dest, bound.size, "dest")
     edges = _edges_from_dest(dest_t)
+    # No bind happens here (the transfer is emitted by the matching
+    # recv), so this is a log/metrics record only — the recv's
+    # emission carries the profiler annotation for the actual permute.
     debug.log_emission(
-        "Send", f"[{x.size} items, {len(edges)} edges, tag={tag}, n={bound.size}]"
+        "Send",
+        f"[{x.size} items, {len(edges)} edges, tag={tag}, n={bound.size}]",
+        nbytes=int(x.size) * x.dtype.itemsize,
+        dtype=str(x.dtype),
+        axes=bound.axes,
+        world=bound.size,
+        annotation="m4t.send",
     )
     pending_sends().append(
         dict(
@@ -582,5 +599,6 @@ def recv(
         opname="Recv",
         details=f"[{x.size} items, {len(recv_edges)} edges, tag={tag}, n={bound.size}]",
         bound_comm=bound,
+        annotation="m4t.recv",
     )
     return out

@@ -147,8 +147,10 @@ def ring_gate(x, comm, *, min_bytes: int, max_bytes: int,
         and min_bytes <= nbytes * footprint_factor <= max_bytes
     ):
         return False
+    from ..jax_compat import axis_size as _axis_size
+
     try:
-        if lax.axis_size(comm.axes[0]) != jax.device_count():
+        if _axis_size(comm.axes[0]) != jax.device_count():
             return False
     except Exception:
         return False

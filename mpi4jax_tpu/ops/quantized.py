@@ -28,7 +28,9 @@ from jax import lax
 
 from ..comm import Comm, resolve_comm
 from ..token import NOTSET, raise_if_token_is_set
+from ..utils.profiling import emission_scope
 from ..validation import enforce_types
+from ._core import _telemetry_prologue
 
 _BLOCK = 256
 
@@ -70,6 +72,24 @@ def quantized_allreduce(x, *, comm=None, token=NOTSET):
             "the shm backend use the exact allreduce"
         )
 
+    # Telemetry parity with the primitive ops (ops/_core.py:emit):
+    # this collective is composed from raw lax ppermutes rather than a
+    # primitive bind, so it mints its correlation id and annotation
+    # scope here. The scope wraps every hop of both rings, so a trace
+    # shows the whole quantized collective as one m4t region.
+    _, scope = _telemetry_prologue(
+        (x,),
+        opname="QuantizedAllReduce",
+        details=f"[{x.size} items, n={n}]",
+        bound_comm=bound,
+        annotation="m4t.quantized_allreduce",
+        payload=None,
+    )
+    with emission_scope(scope):
+        return _quantized_ring(x, bound, n, axis)
+
+
+def _quantized_ring(x, bound, n: int, axis):
     orig_shape, orig_dtype = x.shape, x.dtype
     flat = x.astype(jnp.float32).reshape(-1)
     total = flat.shape[0]

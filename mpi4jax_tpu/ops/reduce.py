@@ -68,5 +68,6 @@ def reduce(x, op=SUM, root=0, *, comm=None, token=NOTSET):
         opname="Reduce",
         details=f"[{x.size} items, op={op.name}, root={root}, n={bound.size}]",
         bound_comm=bound,
+        annotation="m4t.reduce",
     )
     return out

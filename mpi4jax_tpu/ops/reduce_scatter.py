@@ -119,5 +119,6 @@ def reduce_scatter(x, op=SUM, *, comm=None, token=NOTSET):
         opname="ReduceScatter",
         details=f"[{x.size} items, op={op.name}, n={bound.size}]",
         bound_comm=bound,
+        annotation="m4t.reduce_scatter",
     )
     return out

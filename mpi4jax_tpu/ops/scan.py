@@ -77,5 +77,6 @@ def scan(x, op=SUM, *, comm=None, token=NOTSET):
         opname="Scan",
         details=f"[{x.size} items, op={op.name}, n={bound.size}]",
         bound_comm=bound,
+        annotation="m4t.scan",
     )
     return out

@@ -102,6 +102,7 @@ def scatter(x, root=0, *, comm=None, token=NOTSET):
             opname="Scatter",
             details=f"[{x.size} items, root={root}, n={bound.size}]",
             bound_comm=bound,
+            annotation="m4t.scatter",
         )
         return out
     if x.ndim < 1 or x.shape[0] != bound.size:
@@ -117,5 +118,6 @@ def scatter(x, root=0, *, comm=None, token=NOTSET):
         opname="Scatter",
         details=f"[{x.size} items, root={root}, n={bound.size}]",
         bound_comm=bound,
+        annotation="m4t.scatter",
     )
     return out

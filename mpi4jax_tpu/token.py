@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from . import config
+from .jax_compat import get_opaque_trace_state as _get_opaque_trace_state
 
 _MAX_TRACE_STATES = 64
 
@@ -71,7 +72,7 @@ _poisoned: Deque[Tuple[Any, str]] = collections.deque(maxlen=_MAX_TRACE_STATES)
 
 
 def _current_state() -> _TraceState:
-    key = jax.core.get_opaque_trace_state()
+    key = _get_opaque_trace_state()
     for i, (pkey, msg) in enumerate(_poisoned):
         if pkey == key:
             del _poisoned[i]

@@ -60,6 +60,29 @@ def trace(log_dir: str, *, create_perfetto_link: bool = False) -> Iterator[None]
         jax.profiler.stop_trace()
 
 
+@contextlib.contextmanager
+def emission_scope(name: str) -> Iterator[None]:
+    """Profiler auto-annotation for one op emission (``m4t.<op>``).
+
+    Used by ``ops/_core.py`` around every collective's ``bind``: the
+    enclosed trace-time emission is wrapped in
+
+    - ``jax.named_scope(name)`` — the scope lands in the HLO metadata
+      of every op the emission creates, so XLA profiler traces
+      attribute device/ICI time to the mpi4jax-level op (search for
+      ``m4t.`` in the trace viewer), not just the HLO instruction name;
+    - ``jax.profiler.TraceAnnotation(name)`` — in eager execution the
+      same name appears on the host timeline.
+
+    With telemetry on the name carries the emission correlation id
+    (``m4t.allreduce.<cid>``), joining the trace region to the debug
+    log line and the metrics record.
+    """
+    with jax.named_scope(name):
+        with jax.profiler.TraceAnnotation(name):
+            yield
+
+
 def annotate(name: Optional[str] = None):
     """Named region for profiler traces: usable as a decorator or a
     context manager. Regions nest and show up on the trace timeline,

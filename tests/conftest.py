@@ -9,6 +9,13 @@ analog (SURVEY.md §4 closing note): the same suite runs single-rank
 
 import os
 
+# The suite must collect (and mostly run) even on containers whose jax
+# predates jax_compat.MINIMUM_JAX — the seed state was a full-suite
+# collection failure on exactly such a container. The suite itself is
+# the compatibility evidence, so the test harness opts in to the
+# version-gate escape hatch; library users still hit the hard gate.
+os.environ.setdefault("MPI4JAX_TPU_SKIP_VERSION_CHECK", "1")
+
 # Must happen before the first backend initialization. The container's
 # sitecustomize registers the axon TPU plugin and forces
 # jax_platforms="axon,cpu"; re-force cpu below after import.

@@ -127,9 +127,19 @@ def test_roofline_cpu_plumbing():
         assert "fenced" in rows[f"fused_b{b}"]
         assert "fenced" in rows[f"fused2_b{b}"]
         assert "fenced" in rows[f"fused4_b{b}"]
-    # the headline size stays compilable at every depth
-    for prefix in ("fused", "fused2", "fused4"):
-        assert "fenced" not in rows.get(f"{prefix}_b160", {})
+    # the headline size stays compilable at the empirically verified
+    # depth (spp=1: block 160 compiled and ran on v5e)
+    assert "fenced" not in rows.get("fused_b160", {})
+    # deeper variants are charged for their unrolled intermediates
+    # (fused_step.vmem_model_bytes steps_per_pass term, ADVICE.md):
+    # b160 exceeds the ceiling at depth >= 2, so those rows must be
+    # fenced rather than submitted as the unmodeled compile class
+    # suspected of wedging the r4 session ...
+    assert "fenced" in rows["fused2_b160"]
+    assert "fenced" in rows["fused4_b160"]
+    # ... while every depth keeps a compilable rung to fall back to
+    assert "fenced" not in rows.get("fused2_b128", {})
+    assert "fenced" not in rows.get("fused4_b80", {})
 
 
 def test_mosaic_diag_cpu():

@@ -1,0 +1,384 @@
+"""Comm telemetry subsystem tests (``mpi4jax_tpu/observability/``).
+
+Covers the ISSUE-1 acceptance surface:
+
+- counters increment per bind with correct byte accounting across
+  dtypes;
+- ``snapshot()`` / ``reset()`` semantics (snapshots are detached
+  copies);
+- JSONL event schema round-trips and matches the probe-log shape
+  (``ts`` in ``%Y-%m-%dT%H:%M:%SZ``, one JSON object per line);
+- the registry is zero-overhead when disabled: no host callbacks in
+  the traced program, no records accumulated;
+- the emission correlation id is shared across the debug log line,
+  the metric record, and the profiler annotation name.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as m4t
+from mpi4jax_tpu import observability as obs
+from mpi4jax_tpu.observability import events
+from mpi4jax_tpu.observability.metrics import MetricsRegistry, Reservoir
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.fixture(autouse=True)
+def _isolated_telemetry():
+    """Each test starts disabled with an empty registry and no sink,
+    and leaves no global telemetry state behind."""
+    from mpi4jax_tpu.observability import metrics as metrics_mod
+
+    prev_enabled = metrics_mod._enabled
+    prev_runtime = metrics_mod._runtime_enabled
+    prev_sink = events.get_sink()
+    obs.reset()
+    obs.disable()
+    metrics_mod._runtime_enabled = False
+    events.set_sink(None)
+    yield
+    obs.reset()
+    metrics_mod._enabled = prev_enabled
+    metrics_mod._runtime_enabled = prev_runtime
+    events._sink = prev_sink
+
+
+# ---------------------------------------------------------------------------
+# smoke / CI guard
+# ---------------------------------------------------------------------------
+
+
+def test_import_smoke_and_disabled_by_default():
+    """Tier-1-safe smoke: the subsystem imports under JAX_PLATFORMS=cpu
+    and is inert unless explicitly enabled."""
+    import mpi4jax_tpu.observability  # noqa: F401
+
+    assert obs.enabled() is False
+    assert obs.runtime_enabled() is False
+    m4t.allreduce(jnp.ones(3))
+    snap = obs.snapshot()
+    assert snap["totals"]["emissions"] == 0
+    assert snap["ops"] == {}
+
+
+# ---------------------------------------------------------------------------
+# counters and byte accounting
+# ---------------------------------------------------------------------------
+
+
+def test_counters_increment_per_bind_with_byte_accounting():
+    obs.enable()
+    m4t.allreduce(jnp.ones((4, 2), jnp.float32))  # 8 * 4 B
+    m4t.allreduce(jnp.ones(16, jnp.float32))      # 16 * 4 B
+    m4t.allgather(jnp.ones(3, jnp.int8))          # 3 * 1 B
+    m4t.bcast(jnp.ones(5, jnp.float16), 0)        # 5 * 2 B
+
+    snap = obs.snapshot()
+    ar = snap["ops"]["AllReduce"]
+    assert ar["emissions"] == 2
+    assert ar["payload_bytes"] == 8 * 4 + 16 * 4
+    assert ar["by_dtype"]["float32"] == [2, 96]
+    ag = snap["ops"]["AllGather"]
+    assert ag["emissions"] == 1 and ag["payload_bytes"] == 3
+    bc = snap["ops"]["Bcast"]
+    assert bc["emissions"] == 1 and bc["payload_bytes"] == 10
+    assert snap["totals"]["emissions"] == 4
+    assert snap["totals"]["payload_bytes"] == 32 + 64 + 3 + 10
+
+
+def test_dtype_breakdown_across_mixed_dtypes():
+    obs.enable()
+    m4t.allreduce(jnp.ones(8, jnp.float32))
+    m4t.allreduce(jnp.ones(8, jnp.bfloat16))
+    by_dtype = obs.snapshot()["ops"]["AllReduce"]["by_dtype"]
+    assert by_dtype["float32"] == [1, 32]
+    assert by_dtype["bfloat16"] == [1, 16]
+
+
+def test_barrier_counts_zero_payload():
+    obs.enable()
+    m4t.barrier()
+    b = obs.snapshot()["ops"]["Barrier"]
+    assert b["emissions"] == 1
+    assert b["payload_bytes"] == 0
+
+
+def test_every_collective_wrapper_records(run_spmd, per_rank):
+    """One pass over the non-root collective family under the 8-rank
+    mesh: every op shows up in the registry under its own name."""
+    obs.enable()
+
+    def step(x):
+        y = m4t.allreduce(x)
+        y = m4t.allgather(y)[0]
+        z = m4t.alltoall(jnp.broadcast_to(y, (8,) + y.shape))
+        w = m4t.reduce_scatter(jnp.broadcast_to(y, (8,) + y.shape))
+        s = m4t.scan(x)
+        m4t.barrier()
+        return y + z[0] + w + s
+
+    run_spmd(step, np.ones((8, 4), np.float32))
+    ops = obs.snapshot()["ops"]
+    for name in (
+        "AllReduce", "AllGather", "AllToAll", "ReduceScatter", "Scan",
+        "Barrier",
+    ):
+        assert ops[name]["emissions"] >= 1, name
+        assert ops[name]["by_axes"].get("ranks", 0) >= 1, name
+
+
+def test_mesh_axes_recorded(run_spmd):
+    obs.enable()
+    run_spmd(lambda x: m4t.allreduce(x), np.ones((8, 4), np.float32))
+    ar = obs.snapshot()["ops"]["AllReduce"]
+    assert ar["by_axes"] == {"ranks": 1}
+    # per-rank payload: 4 f32 items
+    assert ar["payload_bytes"] == 16
+
+
+def test_quantized_allreduce_recorded(run_spmd):
+    obs.enable()
+    out = run_spmd(
+        lambda x: m4t.quantized_allreduce(x),
+        np.ones((8, 512), np.float32),
+    )
+    assert np.allclose(out[0], 8.0, atol=0.2)
+    q = obs.snapshot()["ops"]["QuantizedAllReduce"]
+    assert q["emissions"] == 1
+    assert q["payload_bytes"] == 512 * 4
+
+
+# ---------------------------------------------------------------------------
+# snapshot / reset semantics
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_is_detached_copy():
+    obs.enable()
+    m4t.allreduce(jnp.ones(4))
+    snap = obs.snapshot()
+    snap["ops"]["AllReduce"]["emissions"] = 999
+    snap["emissions"].clear()
+    fresh = obs.snapshot()
+    assert fresh["ops"]["AllReduce"]["emissions"] == 1
+    assert len(fresh["emissions"]) == 1
+
+
+def test_reset_clears_counters_and_ring():
+    obs.enable()
+    m4t.allreduce(jnp.ones(4))
+    assert obs.snapshot()["totals"]["emissions"] == 1
+    obs.reset()
+    snap = obs.snapshot()
+    assert snap["totals"]["emissions"] == 0
+    assert snap["ops"] == {} and snap["emissions"] == []
+
+
+def test_report_lists_ops_and_totals():
+    obs.enable()
+    m4t.allreduce(jnp.ones((4, 2), jnp.float32))
+    m4t.allgather(jnp.ones(3, jnp.int8))
+    text = obs.report()
+    assert "AllReduce" in text and "AllGather" in text
+    assert "2 emissions" in text
+
+
+def test_reservoir_bounded_and_exact_aggregates():
+    r = Reservoir(capacity=16)
+    for i in range(1000):
+        r.add(float(i))
+    assert r.count == 1000
+    assert r.minimum == 0.0 and r.maximum == 999.0
+    assert len(r.samples) == 16  # bounded regardless of stream length
+    s = r.summary()
+    assert s["count"] == 1000 and s["mean"] == pytest.approx(499.5)
+    assert s["p50"] is not None
+
+
+def test_registry_independent_instances():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.record_emission(
+        "X", nbytes=4, dtype="float32", axes=(), world=1, cid="aaaaaaaa"
+    )
+    assert a.snapshot()["totals"]["emissions"] == 1
+    assert b.snapshot()["totals"]["emissions"] == 0
+
+
+# ---------------------------------------------------------------------------
+# JSONL events
+# ---------------------------------------------------------------------------
+
+
+def test_event_log_roundtrip_and_probe_schema(tmp_path):
+    path = tmp_path / "events.jsonl"
+    log = events.EventLog(str(path))
+    written = log.append(events.event("probe", outcome="ok", attempt=3))
+    # tpu_watch-shaped records (no "kind") share the same sink format
+    log.append({"stage": "bench", "exit_code": 0, "captured": []})
+
+    records = events.read(str(path))
+    assert len(records) == 2
+    first, second = records
+    assert first == written
+    assert first["kind"] == "probe" and first["outcome"] == "ok"
+    # ts is stamped in the shared probe-log format
+    for rec in records:
+        time.strptime(rec["ts"], events.TS_FORMAT)
+    # raw lines are one JSON object each (JSONL)
+    lines = path.read_text().strip().splitlines()
+    assert len(lines) == 2
+    assert all(isinstance(json.loads(ln), dict) for ln in lines)
+
+
+def test_emission_events_flow_to_sink(tmp_path):
+    path = tmp_path / "telemetry.jsonl"
+    events.set_sink(str(path))
+    obs.enable()
+    m4t.allreduce(jnp.ones((4, 2), jnp.float32))
+    m4t.allgather(jnp.ones(3, jnp.int8))
+
+    records = events.read(str(path))
+    assert [r["op"] for r in records] == ["AllReduce", "AllGather"]
+    for rec in records:
+        assert rec["kind"] == "emission"
+        assert set(rec) >= {
+            "kind", "cid", "op", "bytes", "dtype", "axes", "world", "ts",
+            "annotation",
+        }
+        time.strptime(rec["ts"], events.TS_FORMAT)
+    assert records[0]["bytes"] == 32 and records[1]["bytes"] == 3
+    # the event stream and the registry ring agree record-for-record
+    ring = obs.snapshot()["emissions"]
+    assert [r["cid"] for r in records] == [r["cid"] for r in ring]
+
+
+def test_no_sink_means_no_file(tmp_path):
+    obs.enable()
+    m4t.allreduce(jnp.ones(4))
+    assert events.get_sink() is None  # fixture cleared it
+    assert events.emit({"kind": "x"}) is None
+
+
+def test_malformed_lines_skipped(tmp_path):
+    path = tmp_path / "torn.jsonl"
+    path.write_text('{"kind": "ok"}\n{"torn...\n')
+    records = events.read(str(path))
+    assert len(records) == 1 and records[0]["kind"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# disabled path is zero-overhead
+# ---------------------------------------------------------------------------
+
+
+def _trace_text(fn, *args):
+    return str(jax.make_jaxpr(fn)(*args))
+
+
+def test_disabled_no_records_and_no_callbacks():
+    assert not obs.enabled()
+
+    def program(x):
+        y = m4t.allreduce(x + 1)
+        return m4t.allgather(y)
+
+    trace = _trace_text(program, jnp.ones(8))
+    assert "callback" not in trace
+    assert obs.snapshot()["totals"]["emissions"] == 0
+
+
+def test_enabled_without_runtime_adds_no_callbacks():
+    """Trace-time counters must not change the traced computation:
+    telemetry on (runtime sampling off) produces an identical jaxpr
+    modulo nothing — in particular, zero host callbacks. Fresh
+    function objects per trace: jax caches tracing per fn object."""
+    def make_program():
+        def program(x):
+            return m4t.allreduce(x * 2)
+
+        return program
+
+    baseline = _trace_text(make_program(), jnp.ones(8))
+    obs.enable(runtime=False)
+    with_telemetry = _trace_text(make_program(), jnp.ones(8))
+    assert with_telemetry == baseline
+    assert "callback" not in with_telemetry
+    assert obs.snapshot()["ops"]["AllReduce"]["emissions"] == 1
+
+
+def test_runtime_sampling_emits_callbacks_and_samples():
+    obs.enable(runtime=True)
+
+    def program(x):
+        return m4t.allreduce(x + 1)
+
+    trace = _trace_text(program, jnp.ones(8))
+    assert "callback" in trace
+
+    f = jax.jit(program)
+    for _ in range(3):
+        f(jnp.ones(8)).block_until_ready()
+    jax.effects_barrier()
+    lat = obs.snapshot()["ops"]["AllReduce"]["latency_s"]
+    assert lat["count"] >= 1
+    assert lat["min"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# correlation id ties log line <-> metric record <-> annotation
+# ---------------------------------------------------------------------------
+
+
+def test_correlation_id_shared_across_layers(capsys):
+    obs.enable()
+    m4t.set_logging(True)
+    try:
+        m4t.allreduce(jnp.ones(4))
+    finally:
+        m4t.set_logging(False)
+
+    out = capsys.readouterr().out
+    emit_lines = [ln for ln in out.splitlines() if ln.startswith("emit | ")]
+    assert len(emit_lines) == 1
+    cid_from_log = emit_lines[0].split(" | ")[1]
+
+    rec = obs.snapshot()["emissions"][-1]
+    assert rec["cid"] == cid_from_log
+    assert rec["annotation"] == f"m4t.allreduce.{cid_from_log}"
+    assert obs.snapshot()["ops"]["AllReduce"]["last_cid"] == cid_from_log
+
+
+def test_annotation_scope_lands_in_compiled_hlo(mesh):
+    """The m4t.<op>.<cid> named scope must reach compiled-HLO op
+    metadata — that is what makes XLA profiler traces attribute
+    collective time to the mpi4jax-level op."""
+    from mpi4jax_tpu.parallel import spmd
+
+    obs.enable()
+    compiled = (
+        jax.jit(lambda x: spmd(lambda y: m4t.allreduce(y), mesh=mesh)(x))
+        .lower(jnp.zeros((8, 3)))
+        .compile()
+    )
+    hlo = compiled.as_text()
+    cid = obs.snapshot()["ops"]["AllReduce"]["last_cid"]
+    assert f"m4t.allreduce.{cid}" in hlo
+
+
+def test_annotation_plain_when_disabled():
+    """With telemetry off the scope stays the stable aggregate name
+    (no cid suffix), so profiles group by op."""
+    hlo = (
+        jax.jit(lambda x: m4t.allreduce(x))
+        .lower(jnp.zeros(4))
+        .compile()
+        .as_text()
+    )
+    assert "m4t.allreduce." not in hlo  # no per-emission suffix
