@@ -196,6 +196,14 @@ def main():
         jax.config.update("jax_platforms", os.environ["M4T_BENCH_PLATFORM"])
     import jax.numpy as jnp
 
+    # Periodic liveness through the shared event layer (no-op without
+    # M4T_TELEMETRY_EVENTS): a bench that wedges in PJRT init or a
+    # compile fence leaves a heartbeat trail ending at the wedge, so
+    # the doctor/forensics can date the hang from artifacts alone.
+    from mpi4jax_tpu.observability import events as obs_events
+
+    obs_events.start_heartbeat(source="bench")
+
     from mpi4jax_tpu.models.shallow_water import (
         DAY_IN_SECONDS,
         ModelState,
@@ -360,8 +368,6 @@ def main():
     # (observability/events.py) — no-op unless M4T_TELEMETRY_EVENTS
     # names a sink. The stdout line above stays the parse contract for
     # tpu_watch.py; the event record is the durable structured copy.
-    from mpi4jax_tpu.observability import events as obs_events
-
     obs_events.emit(
         obs_events.event(
             "bench",

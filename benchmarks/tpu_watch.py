@@ -59,8 +59,10 @@ sys.path.insert(0, REPO)
 # where the package cannot import (e.g. an unsupported jax), so a
 # minimal same-schema fallback writer is kept behind the import guard.
 try:
+    from mpi4jax_tpu.observability import events as _events
     from mpi4jax_tpu.observability.events import EventLog
 except Exception:  # pragma: no cover — degraded-host fallback
+    _events = None
 
     class EventLog:  # type: ignore[no-redef]
         def __init__(self, path, echo=False):
@@ -153,6 +155,16 @@ def log_probe(record):
     return _probe_sink.append(record)
 
 
+def emit_heartbeat(**fields):
+    """Periodic liveness record through the shared event layer's
+    default sink (``M4T_TELEMETRY_EVENTS``; no-op when unset or when
+    the package couldn't import). The probe log shows what the watcher
+    *did*; the heartbeat stream shows that it was *alive* — the same
+    hung-vs-dead distinction the cross-rank doctor draws for ranks."""
+    if _events is not None:
+        _events.heartbeat("tpu_watch", **fields)
+
+
 #: forensics state: the most recent builder-initiated chip activity
 _last_activity = {"what": None, "ended": None, "exit": None}
 
@@ -238,6 +250,7 @@ def stage(results, name, cmd, env, timeout=None, expect=None):
             moved.append(rel)
     rc, out = _run(cmd, env, timeout or STAGE_TIMEOUT_S)
     note_activity(name, rc)
+    emit_heartbeat(stage=name, exit_code=rc)
     rec = {
         "exit_code": rc,
         "tail": None if rc == 0 else (out or "")[-2000:],
@@ -490,6 +503,7 @@ def main():
     attempt = 0
     prev_outcome = None
     while time.monotonic() < deadline:
+        emit_heartbeat(attempt=attempt, prev_outcome=prev_outcome)
         if already_captured():
             # stay alive, keep the health record going at a low duty
             # cycle: scripts may change mid-round (re-arms above), and

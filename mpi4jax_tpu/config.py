@@ -25,9 +25,27 @@ prefix matching the bench/watch driver family, long prefix accepted):
   ``M4T_TELEMETRY``; adds host callbacks to the computation).
 - ``M4T_TELEMETRY_EVENTS``: path -> append one JSONL record per op
   emission (and per bench/watch event) to this file, in the
-  ``BENCH_r*_probes.jsonl`` schema.
+  ``BENCH_r*_probes.jsonl`` schema. A literal ``{rank}`` placeholder
+  is substituted with the process rank (``M4T_RANK`` under the
+  launcher, else ``jax.process_index()``) so multi-rank runs get one
+  sink per rank instead of interleaving torn writes into one file.
 - ``M4T_TELEMETRY_RESERVOIR``: int -> per-op latency reservoir size
   (default 256; bounds telemetry memory and report cost).
+- ``M4T_TELEMETRY_FSYNC``: truthy -> fsync the event sink after every
+  record (crash-safe flush: the final pre-hang events survive a
+  SIGKILL; costs one fsync per record).
+- ``M4T_HEARTBEAT``: float seconds -> emit periodic ``heartbeat``
+  events through the sink from a daemon thread (the doctor's
+  liveness signal distinguishing a hung rank from a slow one).
+
+Flight recorder (``observability/recorder.py``):
+
+- ``M4T_FLIGHT_RECORDER``: set falsy to disable the always-cheap
+  in-memory ring of recent collective emissions (on by default).
+- ``M4T_FLIGHT_RECORDER_SIZE``: ring capacity (default 512).
+- ``M4T_FLIGHT_RECORDER_DIR``: directory -> arm post-mortem dumps:
+  the ring is written to ``recorder-rank{rank}.jsonl`` there on
+  crash, atexit, SIGTERM, or SIGUSR1 (on demand, without dying).
 """
 
 import os
@@ -80,6 +98,23 @@ def env_int(name: str, default: int) -> int:
         return default
 
 
+def env_float(name: str, default: float) -> float:
+    """Defensive float parse, mirroring :func:`env_int`."""
+    value = os.environ.get(name, "")
+    if not value:
+        return default
+    try:
+        return float(value)
+    except ValueError:
+        import sys
+
+        print(
+            f"# {name}={value!r} is not a number; using {default}",
+            file=sys.stderr,
+        )
+        return default
+
+
 DEBUG_LOGGING = env_flag("MPI4JAX_TPU_DEBUG")
 DEBUG_RUNTIME = env_flag("MPI4JAX_TPU_DEBUG_RUNTIME")
 NO_ORDERING = env_flag("MPI4JAX_TPU_NO_ORDERING")
@@ -101,3 +136,15 @@ TELEMETRY_EVENTS = os.environ.get(
 )
 #: fixed per-op latency reservoir size (bounds telemetry overhead)
 TELEMETRY_RESERVOIR = max(1, env_int("M4T_TELEMETRY_RESERVOIR", 256))
+#: fsync the event sink after each record (crash-safe flush mode)
+TELEMETRY_FSYNC = env_flag2("M4T_TELEMETRY_FSYNC", "MPI4JAX_TPU_TELEMETRY_FSYNC")
+#: heartbeat period in seconds (0 = no heartbeat thread)
+HEARTBEAT_S = max(0.0, env_float("M4T_HEARTBEAT", 0.0))
+
+#: flight recorder: always-cheap in-memory ring of recent collective
+#: emissions (observability/recorder.py); on unless explicitly off
+FLIGHT_RECORDER = env_flag("M4T_FLIGHT_RECORDER", True)
+#: ring capacity (each entry is one small dict)
+FLIGHT_RECORDER_SIZE = max(1, env_int("M4T_FLIGHT_RECORDER_SIZE", 512))
+#: directory for post-mortem dumps ('' = dumps not armed)
+FLIGHT_RECORDER_DIR = os.environ.get("M4T_FLIGHT_RECORDER_DIR", "")

@@ -75,6 +75,7 @@ def log_emission(
     axes: Optional[Sequence[str]] = None,
     world: Optional[int] = None,
     annotation: Optional[str] = None,
+    shape: Optional[Sequence[int]] = None,
 ) -> str:
     """Record a trace-time emission; returns the correlation id.
 
@@ -95,6 +96,7 @@ def log_emission(
             world=world,
             cid=ident,
             annotation=annotation,
+            shape=shape,
         )
         _obs.events.emit(record)
     return ident

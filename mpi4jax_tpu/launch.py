@@ -14,6 +14,15 @@ mpi4py's import-time ``MPI_Init``), and runs the script unchanged.
 Fail-fast parity with the reference's ``MPI_Abort``
 (``mpi_ops_common.h:60-78``): if any rank exits nonzero, the launcher
 terminates the whole world and propagates the exit code.
+
+Observability (``--events-dir DIR``): every rank writes a per-rank
+JSONL event sink (``events-rank<k>.jsonl``, fsync'd), arms the flight
+recorder to dump into ``DIR`` on the way down, and emits heartbeats —
+the artifact layout the cross-rank doctor consumes. On any failure
+(nonzero rank, or no progress within ``--hang-timeout`` seconds, the
+``MPI_Abort``-less failure mode mpirun never diagnoses) the launcher
+tears the world down and prints the doctor's diagnosis: which rank
+diverged/hung at which collective sequence number.
 """
 
 from __future__ import annotations
@@ -27,6 +36,28 @@ import time
 import uuid
 
 
+def _run_doctor(events_dir):
+    """Post-mortem: merge the per-rank artifacts in ``events_dir`` and
+    print the cross-rank diagnosis. Never raises — the diagnosis must
+    not mask the exit code it is explaining."""
+    try:
+        from .observability import doctor
+
+        report = doctor.diagnose([events_dir])
+        if report is None:
+            sys.stderr.write(
+                f"mpi4jax_tpu.launch: no telemetry records in "
+                f"{events_dir}; nothing to diagnose\n"
+            )
+            return
+        sys.stderr.write(
+            "mpi4jax_tpu.launch: post-mortem diagnosis "
+            f"({events_dir}):\n{doctor.format_report(report)}\n"
+        )
+    except Exception as exc:  # pragma: no cover — diagnosis best-effort
+        sys.stderr.write(f"mpi4jax_tpu.launch: doctor failed: {exc!r}\n")
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="python -m mpi4jax_tpu.launch", description=__doc__
@@ -35,6 +66,30 @@ def main(argv=None):
     parser.add_argument(
         "-m", dest="module", default=None,
         help="run a module (like python -m) instead of a script",
+    )
+    parser.add_argument(
+        "--events-dir", default=None, metavar="DIR",
+        help="per-rank telemetry directory: each rank appends events "
+        "to DIR/events-rank<k>.jsonl (fsync'd), arms flight-recorder "
+        "dumps into DIR, and heartbeats; failures get a cross-rank "
+        "doctor diagnosis",
+    )
+    parser.add_argument(
+        "--hang-timeout", type=float, default=0.0, metavar="S",
+        help="wall-clock budget for the whole world; exceeded -> "
+        "terminate every rank, run the doctor over --events-dir, "
+        "exit 124 (0 = no watchdog)",
+    )
+    parser.add_argument(
+        "--heartbeat", type=float, default=5.0, metavar="S",
+        help="per-rank heartbeat period under --events-dir "
+        "(the doctor's hung-vs-dead signal; default %(default)s)",
+    )
+    parser.add_argument(
+        "--doctor", action="store_true",
+        help="always print the cross-rank diagnosis at the end, not "
+        "just on failure (requires --events-dir); a mismatch the "
+        "backend happened to survive still gets named",
     )
     parser.add_argument("cmd", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -49,6 +104,11 @@ def main(argv=None):
         parser.error("-n must be <= 64 (shm backend kMaxRanks)")
     if not args.cmd and not args.module:
         parser.error("missing script")
+
+    events_dir = args.events_dir
+    if events_dir:
+        events_dir = os.path.abspath(events_dir)
+        os.makedirs(events_dir, exist_ok=True)
 
     shm_name = f"/m4t_{os.getpid()}_{uuid.uuid4().hex[:8]}"
     procs = []
@@ -67,6 +127,19 @@ def main(argv=None):
                 M4T_LAUNCHER_PID=str(os.getpid()),
                 JAX_PLATFORMS="cpu",
             )
+            if events_dir:
+                # literal {rank} on purpose: each child resolves the
+                # template from its own M4T_RANK (events.py), so the
+                # launcher and any grandchildren agree on the layout
+                env.update(
+                    M4T_TELEMETRY="1",
+                    M4T_TELEMETRY_EVENTS=os.path.join(
+                        events_dir, "events-rank{rank}.jsonl"
+                    ),
+                    M4T_TELEMETRY_FSYNC="1",
+                    M4T_FLIGHT_RECORDER_DIR=events_dir,
+                    M4T_HEARTBEAT=str(args.heartbeat),
+                )
             cmd = [sys.executable]
             if os.environ.get("M4T_LAUNCH_COVERAGE"):
                 # Run each rank under parallel-mode coverage so CI can
@@ -82,6 +155,11 @@ def main(argv=None):
 
         exit_code = 0
         done = [False] * len(procs)
+        deadline = (
+            time.monotonic() + args.hang_timeout if args.hang_timeout > 0
+            else None
+        )
+        hung = False
         while not all(done):
             for i, p in enumerate(procs):
                 if done[i]:
@@ -99,7 +177,40 @@ def main(argv=None):
                     for q in procs:
                         if q.poll() is None:
                             q.terminate()
+            if deadline is not None and not all(done) and (
+                time.monotonic() > deadline
+            ):
+                hung = True
+                alive = [i for i, p in enumerate(procs) if p.poll() is None]
+                sys.stderr.write(
+                    f"mpi4jax_tpu.launch: hang watchdog fired after "
+                    f"{args.hang_timeout:g}s; rank(s) "
+                    f"{','.join(map(str, alive))} still running — "
+                    "terminating world\n"
+                )
+                # SIGTERM first: a rank blocked in Python dumps its
+                # flight recorder from the handler; a rank wedged in a
+                # native collective wait can't run the handler and
+                # needs the SIGKILL below (its trace-time events are
+                # already fsync'd on disk).
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                grace = time.monotonic() + 5.0
+                while time.monotonic() < grace and any(
+                    p.poll() is None for p in procs
+                ):
+                    time.sleep(0.05)
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                for p in procs:
+                    p.wait()
+                exit_code = 124
+                break
             time.sleep(0.02)
+        if events_dir and (hung or exit_code != 0 or args.doctor):
+            _run_doctor(events_dir)
         return exit_code
     except KeyboardInterrupt:
         for p in procs:

@@ -1,28 +1,44 @@
-"""Comm telemetry subsystem: metrics registry + JSONL events +
-profiler annotations.
+"""Comm observability subsystem: metrics + events + flight recorder +
+cross-rank doctor + trace export.
 
-Three correlated layers over every collective emission
-(``ops/_core.py``), sharing one 8-char correlation id per emission:
+Per-process layers over every collective emission (``ops/_core.py``),
+sharing one 8-char correlation id per emission:
 
 1. **metrics** (:mod:`.metrics`) — per-op trace-time counters (op
-   name, payload bytes, dtype, mesh axes, emission count) and optional
-   runtime latency reservoirs; ``snapshot()`` / ``reset()`` /
-   ``report()``.
+   name, payload bytes, dtype, mesh axes, emission count, monotonic
+   seq) and optional runtime latency reservoirs; ``snapshot()`` /
+   ``reset()`` / ``report()``.
 2. **events** (:mod:`.events`) — structured JSONL records in the
-   ``BENCH_r*_probes.jsonl`` schema; the bench drivers and the per-op
-   emission stream share this one sink format.
+   ``BENCH_r*_probes.jsonl`` schema; rank-templated sinks
+   (``{rank}`` in the path), crash-safe fsync mode, heartbeats.
 3. **profiler annotations** — every op emission is wrapped in a
    ``m4t.<op>`` named scope (``utils/profiling.emission_scope``) so
    XLA traces attribute ICI time to the mpi4jax-level op; with
    telemetry on, the scope name carries the correlation id
    (``m4t.allreduce.<cid>``).
+4. **flight recorder** (:mod:`.recorder`) — always-on in-memory ring
+   of the last N emissions, dumped to JSONL on crash/atexit/signal
+   for post-mortem analysis even when everything else was off.
 
-Everything is a no-op unless enabled (``M4T_TELEMETRY=1`` or
-:func:`enable`); see ``docs/observability.md``.
+Cross-rank (offline, artifact-driven):
+
+5. **doctor** (:mod:`.doctor`) — ``python -m
+   mpi4jax_tpu.observability.doctor RUNDIR`` merges per-rank logs and
+   names collective mismatches, hung/behind/missing ranks, and
+   stragglers.
+6. **trace** (:mod:`.trace`) — export merged logs to Chrome
+   trace-event JSON (Perfetto): one track per rank, latency slices,
+   payload-byte counters.
+
+Layers 1–3 are no-ops unless enabled (``M4T_TELEMETRY=1`` or
+:func:`enable`); the flight recorder stays on (one dict append per
+trace-time emission) unless ``M4T_FLIGHT_RECORDER=0``. See
+``docs/observability.md``.
 """
 
 from . import events  # noqa: F401
 from . import metrics  # noqa: F401
+from . import recorder  # noqa: F401
 from .metrics import (  # noqa: F401
     MetricsRegistry,
     Reservoir,
@@ -35,15 +51,31 @@ from .metrics import (  # noqa: F401
     runtime_enabled,
     snapshot,
 )
+from .recorder import FlightRecorder  # noqa: F401
+from .recorder import recorder as flight_recorder  # noqa: F401
+
+# doctor/trace are import-light (no jax) but only needed offline;
+# imported lazily by their CLIs and by launch.py's watchdog.
+
+from .. import config as _config
+
+if _config.HEARTBEAT_S > 0 and events.get_sink() is not None:
+    # M4T_HEARTBEAT=<seconds> with a configured sink: start the
+    # liveness stream immediately (the launcher sets both for every
+    # rank when --events-dir is given).
+    events.start_heartbeat(_config.HEARTBEAT_S)
 
 __all__ = [
+    "FlightRecorder",
     "MetricsRegistry",
     "Reservoir",
     "disable",
     "enable",
     "enabled",
     "events",
+    "flight_recorder",
     "metrics",
+    "recorder",
     "registry",
     "report",
     "reset",

@@ -1,0 +1,476 @@
+"""Cross-rank post-mortem doctor: merge per-rank logs, name the bug.
+
+The failure modes that actually kill SPMD programs are cross-rank
+phenomena no single rank's log can diagnose:
+
+- **mismatch** — ranks diverge in what they emit at the same sequence
+  number (rank 0's 17th collective is an AllReduce, rank 1's is an
+  AllGather, or same op with a different shape/dtype/mesh-axes
+  fingerprint). Token ordering serializes emissions per rank, so equal
+  seq ⇒ must be the same collective; the first unequal seq is where
+  the program forked.
+- **hang** — one rank's emission stream ends K or more seqs before its
+  peers'. Heartbeat records separate the two sub-cases: a rank whose
+  heartbeats kept arriving long after its last emission is *alive but
+  stuck* (blocked inside a collective its peers never joined); a rank
+  whose heartbeats stopped with its emissions is *gone* (crashed or
+  killed).
+- **straggler** — a rank whose runtime latency samples for an op are
+  far above its peers' (slow host, bad link, noisy neighbor). Needs
+  ``latency`` records (``M4T_TELEMETRY_RUNTIME=1``).
+
+Inputs are the per-rank artifacts the rest of the subsystem produces:
+JSONL event sinks (``launch --events-dir``, rank-templated
+``M4T_TELEMETRY_EVENTS``) and/or flight-recorder dumps
+(``recorder-rank*.jsonl``). Records carry their rank; filenames like
+``...rank3.jsonl`` are the fallback.
+
+CLI::
+
+    python -m mpi4jax_tpu.observability.doctor RUNDIR
+    python -m mpi4jax_tpu.observability.doctor rank0.jsonl rank1.jsonl \
+        --json --hang-gap 2 --trace merged-trace.json
+
+Exit status: 0 clean, 1 findings, 2 no usable input. Used by the
+launcher's hang watchdog (``launch.py --hang-timeout``) to print a
+diagnosis the moment a world is torn down.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from collections import defaultdict
+from typing import Any, Dict, Iterable, List, Optional
+
+from . import events
+from .recorder import fingerprint
+
+#: a rank is reported hung/behind when it trails the front rank by at
+#: least this many seqs (1: any divergence in stream length matters —
+#: token-ordered streams can legitimately differ by the one collective
+#: currently in flight, so findings at gap 1 are advisory)
+DEFAULT_HANG_GAP = 1
+
+#: a rank is a straggler when its mean op latency exceeds the median
+#: of the per-rank means by this factor (with >= 3 samples)
+DEFAULT_STRAGGLER_RATIO = 2.0
+
+_RANK_RE = re.compile(r"rank[-_]?(\d+)")
+
+
+# ---------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------
+
+
+def _rank_of(record: Dict[str, Any], path: str) -> Optional[int]:
+    rank = record.get("rank")
+    if isinstance(rank, int):
+        return rank
+    if isinstance(rank, str) and rank.isdigit():
+        return int(rank)
+    m = _RANK_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def _expand_inputs(inputs: Iterable[str]) -> List[str]:
+    paths: List[str] = []
+    for item in inputs:
+        if os.path.isdir(item):
+            paths.extend(sorted(glob.glob(os.path.join(item, "*.jsonl"))))
+        else:
+            paths.append(item)
+    # dedupe, keep order
+    seen = set()
+    out = []
+    for p in paths:
+        if p not in seen:
+            seen.add(p)
+            out.append(p)
+    return out
+
+
+def load(inputs: Iterable[str]) -> Dict[int, List[Dict[str, Any]]]:
+    """Read every JSONL record from files/directories, grouped by
+    rank. Records whose rank cannot be determined (no ``rank`` field,
+    no ``rank<k>`` in the filename) are attributed to rank 0 only if
+    nothing else claims a rank — otherwise they are dropped."""
+    by_rank: Dict[int, List[Dict[str, Any]]] = defaultdict(list)
+    unattributed: List[Dict[str, Any]] = []
+    for path in _expand_inputs(inputs):
+        for rec in events.iter_records(path):
+            rank = _rank_of(rec, path)
+            if rank is None:
+                unattributed.append(rec)
+            else:
+                by_rank[rank].append(rec)
+    if not by_rank and unattributed:
+        by_rank[0] = unattributed
+    return dict(by_rank)
+
+
+def collective_stream(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """One rank's ordered collective stream: ``emission`` (event sink)
+    and ``recorder`` (flight-recorder dump) records merged by seq,
+    preferring the richer ``emission`` record when both describe the
+    same seq. Records without a seq (pre-PR2 logs) keep file order and
+    are assigned positional seqs — alignment still works on artifacts
+    from older runs."""
+    chosen: Dict[int, Dict[str, Any]] = {}
+    unseq: List[Dict[str, Any]] = []
+    for rec in records:
+        if rec.get("kind") not in ("emission", "recorder"):
+            continue
+        seq = rec.get("seq")
+        if not isinstance(seq, int):
+            unseq.append(rec)
+            continue
+        prev = chosen.get(seq)
+        if prev is None or (
+            prev.get("kind") == "recorder" and rec.get("kind") == "emission"
+        ):
+            chosen[seq] = rec
+    stream = [chosen[k] for k in sorted(chosen)]
+    if not stream and unseq:
+        stream = [dict(rec, seq=i + 1) for i, rec in enumerate(unseq)]
+    return stream
+
+
+# ---------------------------------------------------------------------
+# analyses
+# ---------------------------------------------------------------------
+
+
+def _find_mismatch(
+    streams: Dict[int, List[Dict[str, Any]]]
+) -> List[Dict[str, Any]]:
+    """First seq at which the per-rank fingerprints disagree."""
+    if len(streams) < 2:
+        return []
+    by_seq: Dict[int, Dict[int, str]] = defaultdict(dict)
+    for rank, stream in streams.items():
+        for rec in stream:
+            by_seq[rec["seq"]][rank] = fingerprint(rec)
+    for seq in sorted(by_seq):
+        prints = by_seq[seq]
+        if len(prints) < 2:
+            continue  # only one rank got this far — hang analysis' job
+        if len(set(prints.values())) > 1:
+            groups: Dict[str, List[int]] = defaultdict(list)
+            for rank, fp in sorted(prints.items()):
+                groups[fp].append(rank)
+            return [
+                {
+                    "kind": "mismatch",
+                    "seq": seq,
+                    "fingerprints": {str(r): fp for r, fp in sorted(prints.items())},
+                    "groups": [
+                        {"fingerprint": fp, "ranks": ranks}
+                        for fp, ranks in groups.items()
+                    ],
+                }
+            ]
+    return []
+
+
+def _last_heartbeat_t(records: List[Dict[str, Any]]) -> Optional[float]:
+    ts = [
+        rec.get("t")
+        for rec in records
+        if rec.get("kind") == "heartbeat" and isinstance(rec.get("t"), (int, float))
+    ]
+    return max(ts) if ts else None
+
+
+def _find_hang(
+    streams: Dict[int, List[Dict[str, Any]]],
+    by_rank: Dict[int, List[Dict[str, Any]]],
+    hang_gap: int,
+) -> List[Dict[str, Any]]:
+    """Ranks whose stream ends >= hang_gap seqs before the front rank,
+    plus ranks missing entirely from a world the logs describe."""
+    findings: List[Dict[str, Any]] = []
+    if not streams:
+        return findings
+    last_seq = {rank: (s[-1]["seq"] if s else 0) for rank, s in streams.items()}
+    front = max(last_seq.values())
+    front_ranks = sorted(r for r, s in last_seq.items() if s == front)
+    for rank in sorted(streams):
+        gap = front - last_seq[rank]
+        if gap < max(1, hang_gap):
+            continue
+        stream = streams[rank]
+        last_emit_t = (
+            stream[-1].get("t") if stream and isinstance(
+                stream[-1].get("t"), (int, float)
+            ) else None
+        )
+        hb_t = _last_heartbeat_t(by_rank.get(rank, []))
+        if hb_t is not None and last_emit_t is not None and hb_t > last_emit_t + 1.0:
+            verdict = "hung"  # alive (heartbeats continued) but stopped emitting
+        elif hb_t is not None:
+            verdict = "dead"  # heartbeats stopped with the emissions
+        else:
+            verdict = "behind"  # no liveness signal: hung or merely slow
+        # what the front ranks emitted at the seq this rank never reached
+        next_seq = last_seq[rank] + 1
+        expected = None
+        for fr in front_ranks:
+            for rec in streams[fr]:
+                if rec["seq"] == next_seq:
+                    expected = fingerprint(rec)
+                    break
+            if expected:
+                break
+        findings.append(
+            {
+                "kind": "hang",
+                "rank": rank,
+                "verdict": verdict,
+                "last_seq": last_seq[rank],
+                "front_seq": front,
+                "gap": gap,
+                "front_ranks": front_ranks,
+                "stuck_before": expected,
+                "last_heartbeat_t": hb_t,
+                "last_emission_t": last_emit_t,
+            }
+        )
+    # one-rank-missing: the logs say the world was bigger than the set
+    # of ranks that produced any log at all
+    worlds = [
+        rec.get("world")
+        for recs in by_rank.values()
+        for rec in recs
+        if isinstance(rec.get("world"), int)
+    ]
+    if worlds:
+        world = max(worlds)
+        missing = sorted(set(range(world)) - set(by_rank))
+        for rank in missing:
+            findings.append(
+                {
+                    "kind": "missing_rank",
+                    "rank": rank,
+                    "world": world,
+                    "note": "no log produced by this rank at all",
+                }
+            )
+    return findings
+
+
+def _find_stragglers(
+    by_rank: Dict[int, List[Dict[str, Any]]], ratio: float
+) -> List[Dict[str, Any]]:
+    """Per-op, per-rank mean runtime latency vs the median rank."""
+    samples: Dict[str, Dict[int, List[float]]] = defaultdict(lambda: defaultdict(list))
+    for rank, recs in by_rank.items():
+        for rec in recs:
+            if rec.get("kind") == "latency" and isinstance(
+                rec.get("seconds"), (int, float)
+            ):
+                samples[rec.get("op", "?")][rank].append(float(rec["seconds"]))
+    findings: List[Dict[str, Any]] = []
+    for op, per_rank in sorted(samples.items()):
+        means = {
+            rank: sum(vals) / len(vals)
+            for rank, vals in per_rank.items()
+            if len(vals) >= 3
+        }
+        if len(means) < 2:
+            continue
+        for rank, mean in sorted(means.items()):
+            # median of the *other* ranks: with 2 ranks a rank must
+            # not be its own reference, or the outlier defines normal
+            peers = sorted(v for r, v in means.items() if r != rank)
+            peer_median = peers[(len(peers) - 1) // 2]
+            if peer_median <= 0:
+                continue
+            if mean > ratio * peer_median:
+                findings.append(
+                    {
+                        "kind": "straggler",
+                        "op": op,
+                        "rank": rank,
+                        "mean_s": mean,
+                        "peer_median_s": peer_median,
+                        "ratio": mean / peer_median,
+                        "samples": len(per_rank[rank]),
+                    }
+                )
+    return findings
+
+
+def analyze(
+    by_rank: Dict[int, List[Dict[str, Any]]],
+    *,
+    hang_gap: int = DEFAULT_HANG_GAP,
+    straggler_ratio: float = DEFAULT_STRAGGLER_RATIO,
+) -> Dict[str, Any]:
+    """Run every cross-rank analysis; returns a plain-JSON report:
+    ``{"ranks": [...], "seqs": {rank: last_seq}, "findings": [...]}``
+    with findings ordered mismatch > hang/missing > straggler (the
+    order in which a human should read them: a mismatch usually
+    *causes* the hang that follows it)."""
+    streams = {rank: collective_stream(recs) for rank, recs in by_rank.items()}
+    findings = (
+        _find_mismatch(streams)
+        + _find_hang(streams, by_rank, hang_gap)
+        + _find_stragglers(by_rank, straggler_ratio)
+    )
+    return {
+        "ranks": sorted(by_rank),
+        "records": {str(r): len(recs) for r, recs in sorted(by_rank.items())},
+        "seqs": {
+            str(r): (s[-1]["seq"] if s else 0) for r, s in sorted(streams.items())
+        },
+        "findings": findings,
+    }
+
+
+def diagnose(
+    inputs: Iterable[str],
+    *,
+    hang_gap: int = DEFAULT_HANG_GAP,
+    straggler_ratio: float = DEFAULT_STRAGGLER_RATIO,
+) -> Optional[Dict[str, Any]]:
+    """Load + analyze; None when the inputs held no usable records."""
+    by_rank = load(inputs)
+    if not by_rank:
+        return None
+    return analyze(
+        by_rank, hang_gap=hang_gap, straggler_ratio=straggler_ratio
+    )
+
+
+# ---------------------------------------------------------------------
+# report formatting
+# ---------------------------------------------------------------------
+
+
+def _fmt_finding(f: Dict[str, Any]) -> str:
+    kind = f["kind"]
+    if kind == "mismatch":
+        lines = [f"MISMATCH at seq {f['seq']}: ranks diverged"]
+        for group in f["groups"]:
+            ranks = ",".join(str(r) for r in group["ranks"])
+            lines.append(f"  rank(s) {ranks}: {group['fingerprint']}")
+        return "\n".join(lines)
+    if kind == "hang":
+        head = {
+            "hung": "HANG (alive but stuck)",
+            "dead": "RANK DIED",
+            "behind": "RANK BEHIND (hung or slow; no heartbeat to tell)",
+        }[f["verdict"]]
+        txt = (
+            f"{head}: rank {f['rank']} stopped at seq {f['last_seq']}, "
+            f"{f['gap']} seq(s) behind rank(s) "
+            f"{','.join(str(r) for r in f['front_ranks'])} (at seq {f['front_seq']})"
+        )
+        if f.get("stuck_before"):
+            txt += f"\n  peers' next collective was: {f['stuck_before']}"
+        return txt
+    if kind == "missing_rank":
+        return (
+            f"MISSING RANK: rank {f['rank']} of world {f['world']} "
+            f"produced no log at all"
+        )
+    if kind == "straggler":
+        return (
+            f"STRAGGLER: rank {f['rank']} {f['op']} mean "
+            f"{f['mean_s'] * 1e3:.2f}ms vs peer median "
+            f"{f['peer_median_s'] * 1e3:.2f}ms "
+            f"({f['ratio']:.1f}x, {f['samples']} samples)"
+        )
+    return json.dumps(f)
+
+
+def format_report(report: Dict[str, Any]) -> str:
+    ranks = ",".join(str(r) for r in report["ranks"])
+    seqs = ", ".join(f"r{r}:{s}" for r, s in report["seqs"].items())
+    out = [
+        f"doctor: {len(report['ranks'])} rank log(s) [{ranks}]; "
+        f"last seq per rank: {seqs}"
+    ]
+    if not report["findings"]:
+        out.append("no findings: ranks aligned, nobody behind, no stragglers")
+    for f in report["findings"]:
+        out.append(_fmt_finding(f))
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi4jax_tpu.observability.doctor",
+        description=(
+            "Merge per-rank telemetry logs (event sinks, flight-recorder "
+            "dumps) and diagnose cross-rank failures: collective "
+            "mismatch, hung/behind/missing ranks, stragglers."
+        ),
+    )
+    parser.add_argument(
+        "inputs",
+        nargs="+",
+        help="per-rank .jsonl files and/or directories of them "
+        "(e.g. the launcher's --events-dir)",
+    )
+    parser.add_argument(
+        "--hang-gap",
+        type=int,
+        default=DEFAULT_HANG_GAP,
+        metavar="K",
+        help="report a rank as behind when it trails the front rank "
+        "by >= K seqs (default %(default)s)",
+    )
+    parser.add_argument(
+        "--straggler-ratio",
+        type=float,
+        default=DEFAULT_STRAGGLER_RATIO,
+        metavar="R",
+        help="report a rank as a straggler when its mean op latency "
+        "exceeds the peer median by Rx (default %(default)s)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="print the report as JSON"
+    )
+    parser.add_argument(
+        "--trace",
+        metavar="OUT.json",
+        default=None,
+        help="additionally export the merged logs as Chrome "
+        "trace-event JSON (load in Perfetto / chrome://tracing)",
+    )
+    args = parser.parse_args(argv)
+
+    report = diagnose(
+        args.inputs,
+        hang_gap=args.hang_gap,
+        straggler_ratio=args.straggler_ratio,
+    )
+    if report is None:
+        print("doctor: no usable records in the given inputs", file=sys.stderr)
+        return 2
+    if args.trace:
+        from . import trace
+
+        trace.export(args.inputs, args.trace)
+        print(f"# trace written to {args.trace}", file=sys.stderr)
+    if args.json:
+        print(json.dumps(report, indent=1, default=str))
+    else:
+        print(format_report(report))
+    return 1 if report["findings"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,14 @@ Two layers of API:
 Writes are line-buffered appends under a lock: concurrent writers
 (battery stages in subprocesses append to the same probe log) each
 write whole lines, which POSIX appends keep intact.
+
+Multi-rank runs should not share one sink at all: a ``{rank}``
+placeholder in the sink path (``M4T_TELEMETRY_EVENTS`` or
+:func:`set_sink`) is substituted with the process rank
+(:func:`current_rank`), giving each rank its own file — the layout the
+cross-rank doctor (:mod:`.doctor`) consumes. ``fsync=True`` (or
+``M4T_TELEMETRY_FSYNC=1``) additionally fsyncs after every record so
+the final pre-hang events of a killed rank actually reach disk.
 """
 
 from __future__ import annotations
@@ -34,9 +42,45 @@ import json
 import os
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Callable, Dict, Iterator, List, Optional
 
 from .. import config
+
+
+def current_rank() -> int:
+    """This process's rank for telemetry purposes.
+
+    ``M4T_RANK`` (set by ``mpi4jax_tpu.launch``) wins; otherwise a
+    ``jax.distributed``-initialized process reports
+    ``jax.process_index()``; otherwise 0. Never initializes a backend:
+    the jax path is only consulted when the distributed client already
+    exists, so this is safe to call at import time.
+    """
+    raw = os.environ.get("M4T_RANK", "")
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+    try:
+        from jax._src import distributed
+
+        if distributed.global_state.client is not None:
+            import jax
+
+            return jax.process_index()
+    except Exception:
+        pass
+    return 0
+
+
+def expand_rank_template(path: str, rank: Optional[int] = None) -> str:
+    """Substitute a literal ``{rank}`` placeholder in a sink path."""
+    if "{rank}" not in path:
+        return path
+    return path.replace(
+        "{rank}", str(current_rank() if rank is None else rank)
+    )
 
 #: the shared timestamp format (BENCH_r*_probes.jsonl / PROGRESS.jsonl)
 TS_FORMAT = "%Y-%m-%dT%H:%M:%SZ"
@@ -59,12 +103,24 @@ class EventLog:
 
     ``echo=True`` mirrors each line to stdout (the ``tpu_watch.py``
     behavior — its probe log doubles as live console output).
+
+    ``fsync=True`` is the crash-safe flush mode: the file is held open
+    line-buffered and ``os.fsync``'d after every record, so the last
+    events before a hang-watchdog SIGKILL survive in the file (the
+    doctor's evidence). Without it each append opens/flushes/closes —
+    whole lines on disk at every return, but an OS crash may still
+    lose the tail.
+
+    A ``{rank}`` placeholder in ``path`` is expanded via
+    :func:`expand_rank_template` at construction.
     """
 
-    def __init__(self, path: str, *, echo: bool = False):
-        self.path = os.fspath(path)
+    def __init__(self, path: str, *, echo: bool = False, fsync: bool = False):
+        self.path = expand_rank_template(os.fspath(path))
         self.echo = bool(echo)
+        self.fsync = bool(fsync)
         self._lock = threading.Lock()
+        self._file = None
 
     def append(self, record: Dict[str, Any]) -> Dict[str, Any]:
         """Stamp ``ts`` (if absent), append one line, return the
@@ -74,11 +130,23 @@ class EventLog:
         rec.setdefault("ts", utc_stamp())
         line = json.dumps(rec, default=str)
         with self._lock:
-            with open(self.path, "a") as f:
-                f.write(line + "\n")
+            if self.fsync:
+                if self._file is None or self._file.closed:
+                    # buffering=1: line-buffered, one write per record
+                    self._file = open(self.path, "a", buffering=1)
+                self._file.write(line + "\n")
+                os.fsync(self._file.fileno())
+            else:
+                with open(self.path, "a") as f:
+                    f.write(line + "\n")
         if self.echo:
             print(line, flush=True)
         return rec
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None and not self._file.closed:
+                self._file.close()
 
     def __repr__(self) -> str:
         return f"EventLog({self.path!r})"
@@ -111,16 +179,30 @@ def iter_records(path: str) -> Iterator[Dict[str, Any]]:
 # -- module default sink (op-emission telemetry) ----------------------
 
 _sink: Optional[EventLog] = (
-    EventLog(config.TELEMETRY_EVENTS) if config.TELEMETRY_EVENTS else None
+    EventLog(config.TELEMETRY_EVENTS, fsync=config.TELEMETRY_FSYNC)
+    if config.TELEMETRY_EVENTS
+    else None
 )
 _sink_lock = threading.Lock()
 
 
-def set_sink(path: Optional[str]) -> Optional[EventLog]:
-    """Point the default sink at ``path`` (None disables it)."""
+def set_sink(
+    path: Optional[str], *, fsync: Optional[bool] = None
+) -> Optional[EventLog]:
+    """Point the default sink at ``path`` (None disables it).
+    ``fsync`` defaults to the ``M4T_TELEMETRY_FSYNC`` setting."""
     global _sink
     with _sink_lock:
-        _sink = EventLog(path) if path else None
+        if _sink is not None:
+            _sink.close()
+        _sink = (
+            EventLog(
+                path,
+                fsync=config.TELEMETRY_FSYNC if fsync is None else fsync,
+            )
+            if path
+            else None
+        )
         return _sink
 
 
@@ -129,13 +211,65 @@ def get_sink() -> Optional[EventLog]:
 
 
 def emit(record: Dict[str, Any]) -> Optional[Dict[str, Any]]:
-    """Append ``record`` to the default sink; no-op (returns None)
-    when no sink is configured. Never raises: a full disk or revoked
-    path must not take down the computation being observed."""
+    """Append ``record`` to the default sink, stamping the process
+    rank (the doctor's merge key); no-op (returns None) when no sink
+    is configured. Never raises: a full disk or revoked path must not
+    take down the computation being observed."""
     sink = _sink
     if sink is None:
         return None
     try:
-        return sink.append(record)
+        rec = dict(record)
+        rec.setdefault("rank", current_rank())
+        return sink.append(rec)
     except OSError:
         return None
+
+
+# -- heartbeats -------------------------------------------------------
+#
+# Periodic liveness records through the default sink. The doctor uses
+# them to separate "rank is hung inside a collective" (heartbeats
+# continue long after its last emission) from "rank died" (heartbeats
+# stop with the emissions). bench.py and benchmarks/tpu_watch.py start
+# one; any long-running rank can too (M4T_HEARTBEAT=<seconds>).
+
+_heartbeat_stop: Optional[threading.Event] = None
+_heartbeat_lock = threading.Lock()
+
+
+def heartbeat(source: str, **fields: Any) -> Optional[Dict[str, Any]]:
+    """Emit one ``heartbeat`` record (no-op without a sink)."""
+    return emit(event("heartbeat", source=source, t=time.time(), **fields))
+
+
+def start_heartbeat(
+    interval_s: Optional[float] = None, *, source: str = "heartbeat"
+) -> Callable[[], None]:
+    """Start a daemon thread emitting a ``heartbeat`` record every
+    ``interval_s`` seconds (default ``M4T_HEARTBEAT``, else 5 s);
+    returns a zero-argument stopper. Idempotent: a second call
+    replaces the previous thread. A no-op stopper is returned when no
+    sink is configured — heartbeats without a sink have no reader.
+    """
+    global _heartbeat_stop
+    if get_sink() is None:
+        return lambda: None
+    period = float(interval_s or config.HEARTBEAT_S or 5.0)
+    with _heartbeat_lock:
+        if _heartbeat_stop is not None:
+            _heartbeat_stop.set()
+        stop = threading.Event()
+        _heartbeat_stop = stop
+
+    def run():
+        n = 0
+        while not stop.wait(period):
+            n += 1
+            heartbeat(source, n=n, period_s=period)
+
+    heartbeat(source, n=0, period_s=period)
+    threading.Thread(
+        target=run, name="m4t-heartbeat", daemon=True
+    ).start()
+    return stop.set

@@ -108,6 +108,7 @@ class OpMetrics:
         "by_dtype",
         "by_axes",
         "last_cid",
+        "seq",
         "latency",
     )
 
@@ -120,6 +121,9 @@ class OpMetrics:
         #: mesh-axes key ("dp,tp" / "<none>") -> emission count
         self.by_axes: Dict[str, int] = {}
         self.last_cid = ""
+        #: per-op monotonic emission sequence number (1-based; the
+        #: doctor's per-op alignment key, zeroed by reset())
+        self.seq = 0
         self.latency = Reservoir(reservoir)
 
     def as_dict(self) -> Dict[str, Any]:
@@ -130,6 +134,7 @@ class OpMetrics:
             "by_dtype": {k: list(v) for k, v in self.by_dtype.items()},
             "by_axes": dict(self.by_axes),
             "last_cid": self.last_cid,
+            "seq": self.seq,
             "latency_s": self.latency.summary(),
         }
 
@@ -152,8 +157,16 @@ class MetricsRegistry:
         self._reservoir = int(reservoir or config.TELEMETRY_RESERVOIR)
         self._ops: Dict[str, OpMetrics] = {}
         self._emissions: deque = deque(maxlen=EMISSION_RING)
+        #: global monotonic emission counter across all ops (the
+        #: cross-rank alignment key: rank A's k-th emission must match
+        #: rank B's k-th in deadlock-free SPMD programs)
+        self._seq = 0
         #: cid -> host-clock start mark for in-flight runtime samples
         self._inflight: Dict[str, float] = {}
+        #: cid -> global seq of the emission, bounded alongside the
+        #: emission ring, so runtime latency samples inherit their
+        #: emission's alignment key in the event stream
+        self._cid_seq: Dict[str, int] = {}
         self._created = time.time()
 
     # -- recording ---------------------------------------------------
@@ -168,9 +181,16 @@ class MetricsRegistry:
         world: Optional[int],
         cid: str,
         annotation: Optional[str] = None,
+        shape: Optional[Sequence[int]] = None,
     ) -> Dict[str, Any]:
         """Count one trace-time op emission; returns the record stored
-        in the emission ring (shared schema with the JSONL event log)."""
+        in the emission ring (shared schema with the JSONL event log).
+
+        The record carries two monotonic sequence numbers: ``seq``
+        (global across ops — the doctor's cross-rank alignment key)
+        and ``op_seq`` (per op, also exposed as ``snapshot()['ops']
+        [op]['seq']``); both restart from 1 after :meth:`reset`.
+        """
         record = {
             "kind": "emission",
             "cid": cid,
@@ -180,6 +200,8 @@ class MetricsRegistry:
             "axes": list(axes) if axes else [],
             "world": None if world is None else int(world),
             "annotation": annotation,
+            "shape": None if shape is None else [int(d) for d in shape],
+            "t": time.time(),
         }
         key = _axes_key(axes)
         with self._lock:
@@ -193,6 +215,14 @@ class MetricsRegistry:
             per_dtype[1] += int(nbytes)
             m.by_axes[key] = m.by_axes.get(key, 0) + 1
             m.last_cid = cid
+            m.seq += 1
+            self._seq += 1
+            record["seq"] = self._seq
+            record["op_seq"] = m.seq
+            if len(self._emissions) == self._emissions.maxlen:
+                evicted = self._emissions[0]
+                self._cid_seq.pop(evicted["cid"], None)
+            self._cid_seq[cid] = self._seq
             self._emissions.append(record)
         return record
 
@@ -205,7 +235,10 @@ class MetricsRegistry:
     def mark_runtime_end(self, cid: str, op: str) -> Optional[float]:
         """Host-callback hook: the op finished; records the latency
         sample and returns it (None when the start mark is missing or
-        the callbacks arrived out of order)."""
+        the callbacks arrived out of order). The sample is mirrored as
+        a ``latency`` event through the default sink (no-op without
+        one) so the doctor can see per-rank runtime behavior —
+        straggler detection — from the log files alone."""
         now = time.perf_counter()
         with self._lock:
             start = self._inflight.pop(cid, None)
@@ -216,6 +249,19 @@ class MetricsRegistry:
             if m is None:
                 m = self._ops[op] = OpMetrics(op, self._reservoir)
             m.latency.add(sample)
+            seq = self._cid_seq.get(cid)
+        from . import events
+
+        events.emit(
+            {
+                "kind": "latency",
+                "cid": cid,
+                "op": op,
+                "seq": seq,
+                "seconds": sample,
+                "t": time.time(),
+            }
+        )
         return sample
 
     def record_latency(self, op: str, seconds: float) -> None:
@@ -240,6 +286,7 @@ class MetricsRegistry:
                     "payload_bytes": sum(
                         m.payload_bytes for m in self._ops.values()
                     ),
+                    "seq": self._seq,
                 },
             }
 
@@ -248,6 +295,8 @@ class MetricsRegistry:
             self._ops.clear()
             self._emissions.clear()
             self._inflight.clear()
+            self._cid_seq.clear()
+            self._seq = 0
             self._created = time.time()
 
     def report(self, file=None) -> str:

@@ -1,0 +1,251 @@
+"""Per-rank flight recorder: the last N collective emissions, always.
+
+SPMD failures are diagnosed from *artifacts*, and the artifact that
+matters most — what was this rank about to do when it stopped — is
+exactly the one a crashed or killed process never got to write. This
+module keeps it in memory the whole time: an always-on, always-cheap
+ring buffer of the most recent collective emissions (one small dict
+appended per primitive bind, trace-time only — no device work, no
+callbacks, no I/O), dumped to JSONL only when something goes wrong.
+
+Each entry carries
+
+- ``seq`` — a per-process monotonic sequence number. Token ordering
+  (``token.py``) serializes emissions in program order, so in a
+  deadlock-free SPMD program every rank's seq-k entry must describe
+  *the same collective*; the cross-rank doctor (:mod:`.doctor`) keys
+  its mismatch/hang analysis on it.
+- ``op``, ``cid``, payload ``bytes``/``dtype``/``shape``, communicator
+  ``axes``/``world`` — the op fingerprint (:func:`fingerprint`)
+  compared across ranks at equal seq.
+- ``t`` — a ``time.time()`` stamp (when the emission was *traced*).
+
+Dumping is armed by pointing ``M4T_FLIGHT_RECORDER_DIR`` at a
+directory (``mpi4jax_tpu.launch --events-dir`` does this for every
+rank): :func:`arm` installs atexit / unhandled-exception / signal
+hooks that write ``recorder-rank{rank}.jsonl`` there on the way down.
+SIGUSR1 dumps without dying (poke a live-but-suspect rank from
+outside). The recorder deliberately does not depend on the telemetry
+flag: it is the post-mortem layer that survives even when the event
+sink was off.
+
+The ring itself stays enabled unless ``M4T_FLIGHT_RECORDER=0``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence
+
+from .. import config
+
+#: recorder dump file name pattern inside the armed directory
+DUMP_NAME = "recorder-rank{rank}.jsonl"
+
+
+def fingerprint(record: Dict[str, Any]) -> str:
+    """Compact op identity compared across ranks at equal seq:
+    ``Op[shape:dtype]@axes``. Collectives whose fingerprints differ at
+    the same sequence number have diverged — the SPMD bug class this
+    subsystem exists to name."""
+    shape = record.get("shape")
+    if shape is not None:
+        shape_txt = "x".join(str(d) for d in shape) or "scalar"
+    elif record.get("bytes"):
+        shape_txt = f"{record['bytes']}B"
+    else:
+        shape_txt = "scalar"
+    dtype = record.get("dtype") or "?"
+    axes = record.get("axes") or []
+    axes_txt = ",".join(str(a) for a in axes) if axes else "<none>"
+    return f"{record.get('op', '?')}[{shape_txt}:{dtype}]@{axes_txt}"
+
+
+class FlightRecorder:
+    """Bounded in-memory ring of recent collective emissions."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(
+            maxlen=int(capacity or config.FLIGHT_RECORDER_SIZE)
+        )
+        self._seq = 0
+        self._enabled = bool(config.FLIGHT_RECORDER)
+        self._armed_dir: Optional[str] = None
+        self._dumped_reason: Optional[str] = None
+
+    # -- recording (the hot path: one lock, one dict, one append) ----
+
+    def record(
+        self,
+        op: str,
+        *,
+        cid: str,
+        nbytes: int = 0,
+        dtype: Optional[str] = None,
+        shape: Optional[Sequence[int]] = None,
+        axes: Optional[Sequence[str]] = None,
+        world: Optional[int] = None,
+    ) -> int:
+        """Append one emission; returns its sequence number (0 when
+        the recorder is disabled)."""
+        if not self._enabled:
+            return 0
+        with self._lock:
+            self._seq += 1
+            self._ring.append(
+                {
+                    "kind": "recorder",
+                    "seq": self._seq,
+                    "op": op,
+                    "cid": cid,
+                    "bytes": int(nbytes),
+                    "dtype": None if dtype is None else str(dtype),
+                    "shape": None if shape is None else [int(d) for d in shape],
+                    "axes": list(axes) if axes else [],
+                    "world": None if world is None else int(world),
+                    "t": time.time(),
+                }
+            )
+            return self._seq
+
+    # -- reading ------------------------------------------------------
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(r) for r in self._ring]
+
+    @property
+    def seq(self) -> int:
+        return self._seq
+
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self, on: bool = True) -> None:
+        self._enabled = bool(on)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._seq = 0
+            self._dumped_reason = None
+
+    # -- dumping ------------------------------------------------------
+
+    def dump(
+        self, path: Optional[str] = None, *, reason: str = "manual"
+    ) -> Optional[str]:
+        """Write the ring to ``path`` as JSONL (a ``recorder_meta``
+        header line, then one line per entry, oldest first). Returns
+        the path written, or None when there was nowhere to write.
+        Overwrites: the latest state is the post-mortem truth. Never
+        raises — dumping happens on the way down, where a secondary
+        failure must not mask the primary one."""
+        try:
+            from . import events
+
+            rank = events.current_rank()
+            if path is None:
+                directory = self._armed_dir or config.FLIGHT_RECORDER_DIR
+                if not directory:
+                    return None
+                path = os.path.join(directory, DUMP_NAME.format(rank=rank))
+            # best-effort lock: a signal handler must not deadlock on
+            # a lock the interrupted thread was holding mid-record
+            acquired = self._lock.acquire(timeout=1.0)
+            try:
+                entries = [dict(r) for r in list(self._ring)]
+                last_seq = self._seq
+                self._dumped_reason = reason
+            finally:
+                if acquired:
+                    self._lock.release()
+            meta = {
+                "kind": "recorder_meta",
+                "rank": rank,
+                "pid": os.getpid(),
+                "reason": reason,
+                "last_seq": last_seq,
+                "entries": len(entries),
+                "ts": events.utc_stamp(),
+                "t": time.time(),
+            }
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(meta, default=str) + "\n")
+                for rec in entries:
+                    rec.setdefault("rank", rank)
+                    f.write(json.dumps(rec, default=str) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            return path
+        except Exception:
+            return None
+
+    # -- arming (atexit / crash / signal hooks) -----------------------
+
+    def arm(self, directory: str) -> None:
+        """Arm post-mortem dumps into ``directory``: atexit (clean or
+        unclean interpreter exit), sys.excepthook (unhandled
+        exception, dumped with the exception named), SIGTERM (the
+        launcher watchdog's kill — dump, then die with the default
+        disposition), and SIGUSR1 (dump and keep running)."""
+        os.makedirs(directory, exist_ok=True)
+        first = self._armed_dir is None
+        self._armed_dir = directory
+        if not first:
+            return
+
+        atexit.register(self._atexit_dump)
+
+        prev_hook = sys.excepthook
+
+        def hook(exc_type, exc, tb):
+            self.dump(reason=f"crash:{exc_type.__name__}")
+            prev_hook(exc_type, exc, tb)
+
+        sys.excepthook = hook
+
+        def on_term(signum, frame):
+            self.dump(reason=f"signal:{signal.Signals(signum).name}")
+            # restore the default disposition and re-deliver so the
+            # exit status still says "killed by signal"
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+        def on_usr1(signum, frame):
+            self.dump(reason="signal:SIGUSR1")
+
+        try:
+            if threading.current_thread() is threading.main_thread():
+                signal.signal(signal.SIGTERM, on_term)
+                signal.signal(signal.SIGUSR1, on_usr1)
+        except (ValueError, OSError):  # non-main thread / exotic host
+            pass
+
+    def _atexit_dump(self) -> None:
+        # A dump that already happened (crash/signal path) is newer
+        # truth than the atexit state; keep the reason that killed us.
+        if self._dumped_reason is None:
+            self.dump(reason="atexit")
+
+
+#: process-global recorder fed by ops/_core.py's telemetry prologue
+recorder = FlightRecorder()
+
+if config.FLIGHT_RECORDER_DIR:
+    recorder.arm(config.FLIGHT_RECORDER_DIR)
+
+
+def record(op: str, **kwargs: Any) -> int:
+    """Module-level shorthand for :meth:`FlightRecorder.record`."""
+    return recorder.record(op, **kwargs)

@@ -1,0 +1,210 @@
+"""Export merged per-rank telemetry to Chrome trace-event JSON.
+
+The output loads in Perfetto (ui.perfetto.dev) or ``chrome://tracing``
+and turns the JSONL artifacts into the picture a human actually wants
+of a multi-rank run:
+
+- one **process track per rank** (``pid`` = rank, labeled ``rank N``),
+- **duration slices** for every runtime latency sample (``ph: "X"`` —
+  start reconstructed as ``t - seconds``), on the rank's "runtime"
+  thread,
+- **instant events** for every trace-time emission (``ph: "i"``) and
+  heartbeat, so ranks with runtime sampling off still show their
+  collective stream,
+- a **counter track** (``ph: "C"``) of cumulative payload bytes per
+  rank — the at-a-glance "who moved how much" view.
+
+Timestamps are microseconds relative to the earliest record across
+all ranks, so unsynchronized-but-same-host ranks line up the way they
+actually interleaved (cross-host clock skew shows up as track offset,
+which is itself diagnostic).
+
+Same inputs as the doctor: event-sink files, flight-recorder dumps,
+or a directory of both (``launch --events-dir``).
+
+CLI::
+
+    python -m mpi4jax_tpu.observability.trace RUNDIR -o trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Iterable, List, Optional
+
+#: trace-event "thread" ids within each rank's process track
+TID_EMISSIONS = 0
+TID_RUNTIME = 1
+TID_HEARTBEAT = 2
+
+_THREAD_NAMES = {
+    TID_EMISSIONS: "collectives (trace-time)",
+    TID_RUNTIME: "runtime",
+    TID_HEARTBEAT: "heartbeat",
+}
+
+
+def _micros(t: float, t0: float) -> float:
+    return round((t - t0) * 1e6, 1)
+
+
+def build_trace(by_rank: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
+    """Build the Chrome trace-event object from rank-grouped records
+    (the :func:`mpi4jax_tpu.observability.doctor.load` output)."""
+    times = [
+        rec["t"]
+        for recs in by_rank.values()
+        for rec in recs
+        if isinstance(rec.get("t"), (int, float))
+    ]
+    t0 = min(times) if times else 0.0
+
+    trace_events: List[Dict[str, Any]] = []
+    for rank in sorted(by_rank):
+        trace_events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": rank,
+                "tid": 0,
+                "args": {"name": f"rank {rank}"},
+            }
+        )
+        for tid, tname in _THREAD_NAMES.items():
+            trace_events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": rank,
+                    "tid": tid,
+                    "args": {"name": tname},
+                }
+            )
+
+        cumulative_bytes = 0
+        for rec in by_rank[rank]:
+            kind = rec.get("kind")
+            t = rec.get("t")
+            if not isinstance(t, (int, float)):
+                continue
+            if kind in ("emission", "recorder"):
+                args = {
+                    k: rec[k]
+                    for k in ("seq", "cid", "bytes", "dtype", "world")
+                    if rec.get(k) is not None
+                }
+                if rec.get("axes"):
+                    args["axes"] = ",".join(str(a) for a in rec["axes"])
+                trace_events.append(
+                    {
+                        "name": rec.get("op", "?"),
+                        "ph": "i",
+                        "s": "t",  # thread-scoped instant
+                        "pid": rank,
+                        "tid": TID_EMISSIONS,
+                        "ts": _micros(t, t0),
+                        "args": args,
+                    }
+                )
+                cumulative_bytes += int(rec.get("bytes") or 0)
+                trace_events.append(
+                    {
+                        "name": "payload bytes",
+                        "ph": "C",
+                        "pid": rank,
+                        "ts": _micros(t, t0),
+                        "args": {"cumulative": cumulative_bytes},
+                    }
+                )
+            elif kind == "latency":
+                seconds = rec.get("seconds")
+                if not isinstance(seconds, (int, float)) or seconds < 0:
+                    continue
+                args = {
+                    k: rec[k]
+                    for k in ("seq", "cid")
+                    if rec.get(k) is not None
+                }
+                trace_events.append(
+                    {
+                        "name": rec.get("op", "?"),
+                        "ph": "X",
+                        "pid": rank,
+                        "tid": TID_RUNTIME,
+                        "ts": _micros(t - seconds, t0),
+                        "dur": round(seconds * 1e6, 1),
+                        "args": args,
+                    }
+                )
+            elif kind == "heartbeat":
+                trace_events.append(
+                    {
+                        "name": "heartbeat",
+                        "ph": "i",
+                        "s": "t",
+                        "pid": rank,
+                        "tid": TID_HEARTBEAT,
+                        "ts": _micros(t, t0),
+                        "args": {
+                            k: rec[k]
+                            for k in ("source", "n")
+                            if rec.get(k) is not None
+                        },
+                    }
+                )
+    return {
+        "traceEvents": trace_events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "mpi4jax_tpu.observability.trace",
+            "ranks": sorted(by_rank),
+        },
+    }
+
+
+def export(
+    inputs: Iterable[str], out_path: str
+) -> Optional[Dict[str, Any]]:
+    """Load rank logs (files/dirs) and write the trace JSON; returns
+    the trace object, or None when the inputs held no records."""
+    from . import doctor
+
+    by_rank = doctor.load(inputs)
+    if not by_rank:
+        return None
+    obj = build_trace(by_rank)
+    with open(out_path, "w") as f:
+        json.dump(obj, f, sort_keys=True)
+    return obj
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi4jax_tpu.observability.trace",
+        description="Export merged per-rank telemetry logs to Chrome "
+        "trace-event JSON (Perfetto-loadable).",
+    )
+    parser.add_argument(
+        "inputs", nargs="+", help="per-rank .jsonl files or directories"
+    )
+    parser.add_argument(
+        "-o", "--output", required=True, metavar="OUT.json",
+        help="trace file to write",
+    )
+    args = parser.parse_args(argv)
+    obj = export(args.inputs, args.output)
+    if obj is None:
+        print("trace: no usable records in the given inputs", file=sys.stderr)
+        return 2
+    print(
+        f"# {len(obj['traceEvents'])} trace events from "
+        f"{len(obj['otherData']['ranks'])} rank(s) -> {args.output}",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -106,6 +106,18 @@ def _scalar_probe(x):
     return x
 
 
+def _payload_shape(inputs: Tuple) -> Optional[Tuple[int, ...]]:
+    if not inputs:
+        return None
+    shape = getattr(inputs[0], "shape", None)
+    if shape is None:
+        return None
+    try:
+        return tuple(int(d) for d in shape)
+    except (TypeError, ValueError):
+        return None
+
+
 def _telemetry_prologue(
     inputs: Tuple,
     *,
@@ -115,7 +127,8 @@ def _telemetry_prologue(
     annotation: Optional[str],
     payload: Optional[int],
 ) -> Tuple[str, str]:
-    """Mint the correlation id and feed log line + registry + events.
+    """Mint the correlation id and feed log line + registry + events +
+    flight recorder.
 
     Returns ``(ident, scope)`` where ``scope`` is the profiler
     annotation name for this emission: ``m4t.<op>`` normally,
@@ -125,15 +138,34 @@ def _telemetry_prologue(
     base = annotation or f"m4t.{opname.lower()}"
     ident = debug.new_cid()
     scope = f"{base}.{ident}" if _obs.enabled() else base
+    nbytes = _payload_bytes(inputs) if payload is None else int(payload)
+    dtype = _payload_dtype(inputs)
+    shape = _payload_shape(inputs)
+    axes = getattr(bound_comm, "axes", None)
+    world = getattr(bound_comm, "size", None)
+    # Flight recorder first (observability/recorder.py): unconditional
+    # and telemetry-independent — its ring is the post-mortem record of
+    # what this rank was about to emit, kept even when every other
+    # telemetry layer is off.
+    _obs.flight_recorder.record(
+        opname,
+        cid=ident,
+        nbytes=nbytes,
+        dtype=dtype,
+        shape=shape,
+        axes=axes,
+        world=world,
+    )
     debug.log_emission(
         opname,
         details,
         cid=ident,
-        nbytes=_payload_bytes(inputs) if payload is None else int(payload),
-        dtype=_payload_dtype(inputs),
-        axes=getattr(bound_comm, "axes", None),
-        world=getattr(bound_comm, "size", None),
+        nbytes=nbytes,
+        dtype=dtype,
+        axes=axes,
+        world=world,
         annotation=scope,
+        shape=shape,
     )
     debug.log_runtime(bound_comm, ident, opname, details)
     return ident, scope

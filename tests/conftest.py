@@ -52,6 +52,27 @@ needs_size1_world = pytest.mark.skipif(
     IN_LAUNCHER_WORLD, reason="assumes a size-1 eager world (launcher world active)"
 )
 
+from mpi4jax_tpu import jax_compat as _jax_compat  # noqa: E402
+
+#: is the ambient jax older than the supported floor? (The suite runs
+#: under the MPI4JAX_TPU_SKIP_VERSION_CHECK escape hatch, above, so it
+#: collects and mostly passes on such containers; the few tests that
+#: genuinely need post-0.6 APIs — pallas platform_dependent lowering,
+#: AbstractMesh.manual_axes, x64 interpret-mode bit-exactness — carry
+#: this skip instead of failing as false alarms.)
+JAX_BELOW_MINIMUM = _jax_compat.versiontuple(
+    jax.__version__
+) < _jax_compat.versiontuple(_jax_compat.MINIMUM_JAX)
+
+needs_supported_jax = pytest.mark.skipif(
+    JAX_BELOW_MINIMUM,
+    reason=(
+        f"requires jax>={_jax_compat.MINIMUM_JAX} "
+        f"(found {jax.__version__}; running under the version-gate "
+        "escape hatch)"
+    ),
+)
+
 
 def pytest_report_header(config):
     # Analog of the reference's vendor/rank/size header

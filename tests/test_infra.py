@@ -17,6 +17,8 @@ from mpi4jax_tpu.comm import CartComm, Comm, resolve_comm
 from mpi4jax_tpu.runtime import shm as _shm
 from mpi4jax_tpu.validation import enforce_types
 
+from tests.conftest import needs_supported_jax
+
 from tests.conftest import WORLD
 
 
@@ -101,6 +103,7 @@ def test_resolve_comm_type_error():
         resolve_comm("world")
 
 
+@needs_supported_jax  # typo detection reads AbstractMesh.manual_axes (jax>=0.6)
 def test_resolve_comm_typo_inside_mesh_raises(mesh, per_rank):
     # An axis-name typo inside a shard_map must fail loudly, not
     # silently resolve to a size-1 world where every collective is an

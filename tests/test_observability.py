@@ -372,6 +372,100 @@ def test_annotation_scope_lands_in_compiled_hlo(mesh):
     assert f"m4t.allreduce.{cid}" in hlo
 
 
+def test_per_op_and_global_seq_counters():
+    """ISSUE-2 satellite: record_emission carries monotonic sequence
+    numbers — global (``seq``, cross-rank alignment key) and per-op
+    (``op_seq``, exposed in snapshot()) — and reset() zeroes both."""
+    obs.enable()
+    m4t.allreduce(jnp.ones(4))
+    m4t.allgather(jnp.ones(4))
+    m4t.allreduce(jnp.ones(4))
+    snap = obs.snapshot()
+    assert snap["ops"]["AllReduce"]["seq"] == 2
+    assert snap["ops"]["AllGather"]["seq"] == 1
+    assert snap["totals"]["seq"] == 3
+    assert [r["seq"] for r in snap["emissions"]] == [1, 2, 3]
+    assert [r["op_seq"] for r in snap["emissions"]] == [1, 1, 2]
+    obs.reset()
+    m4t.allreduce(jnp.ones(4))
+    snap = obs.snapshot()
+    assert snap["ops"]["AllReduce"]["seq"] == 1
+    assert snap["emissions"][0]["seq"] == 1
+
+
+def test_rank_templated_sink(tmp_path, monkeypatch):
+    """ISSUE-2 satellite: a {rank} placeholder in the sink path is
+    resolved from M4T_RANK, giving each rank its own file."""
+    monkeypatch.setenv("M4T_RANK", "7")
+    sink = events.set_sink(str(tmp_path / "events-rank{rank}.jsonl"))
+    assert sink.path.endswith("events-rank7.jsonl")
+    obs.enable()
+    m4t.allreduce(jnp.ones(4))
+    (rec,) = events.read(str(tmp_path / "events-rank7.jsonl"))
+    assert rec["rank"] == 7  # emit() stamps the rank into each record
+    assert rec["op"] == "AllReduce" and rec["seq"] == 1
+
+
+def test_current_rank_resolution(monkeypatch):
+    monkeypatch.setenv("M4T_RANK", "3")
+    assert events.current_rank() == 3
+    monkeypatch.delenv("M4T_RANK")
+    assert events.current_rank() == 0
+    assert events.expand_rank_template("a/b-{rank}.jsonl", 5) == "a/b-5.jsonl"
+    assert events.expand_rank_template("plain.jsonl") == "plain.jsonl"
+
+
+def test_event_log_fsync_mode(tmp_path):
+    """ISSUE-2 satellite: crash-safe flush — every append is on disk
+    (line-buffered + fsync) the moment it returns."""
+    path = str(tmp_path / "durable.jsonl")
+    log = events.EventLog(path, fsync=True)
+    log.append(events.event("emission", op="AllReduce", seq=1))
+    # read through a separate handle WITHOUT closing the writer: the
+    # line must already be durable
+    (rec,) = events.read(path)
+    assert rec["op"] == "AllReduce"
+    log.append(events.event("emission", op="AllGather", seq=2))
+    assert [r["op"] for r in events.read(path)] == ["AllReduce", "AllGather"]
+    log.close()
+
+
+def test_latency_samples_mirrored_as_events(tmp_path):
+    """Runtime latency samples reach the event sink as ``latency``
+    records (the doctor's straggler evidence), tagged with the
+    emission's seq."""
+    path = str(tmp_path / "ev.jsonl")
+    events.set_sink(path)
+    obs.enable(runtime=True)
+    f = jax.jit(lambda x: m4t.allreduce(x + 1))
+    for _ in range(2):
+        f(jnp.ones(8)).block_until_ready()
+    jax.effects_barrier()
+    recs = events.read(path)
+    lat = [r for r in recs if r["kind"] == "latency"]
+    assert lat, recs
+    emission_seq = [r for r in recs if r["kind"] == "emission"][0]["seq"]
+    for r in lat:
+        assert r["op"] == "AllReduce"
+        assert r["seconds"] >= 0
+        assert r["seq"] == emission_seq
+        assert r["rank"] == 0
+
+
+def test_heartbeat_records(tmp_path):
+    path = str(tmp_path / "hb.jsonl")
+    events.set_sink(path)
+    rec = events.heartbeat("test", n=1)
+    assert rec["kind"] == "heartbeat" and rec["source"] == "test"
+    assert isinstance(rec["t"], float) and "rank" in rec
+    assert events.read(path)[0]["kind"] == "heartbeat"
+    # without a sink: no-op, and start_heartbeat declines to spawn
+    events.set_sink(None)
+    assert events.heartbeat("test") is None
+    stop = events.start_heartbeat(0.01)
+    stop()
+
+
 def test_annotation_plain_when_disabled():
     """With telemetry off the scope stays the stable aggregate name
     (no cid suffix), so profiles group by op."""

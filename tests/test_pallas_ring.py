@@ -10,6 +10,13 @@ import jax.numpy as jnp
 import mpi4jax_tpu as m4t
 from mpi4jax_tpu.ops.pallas_ring import ring_allreduce
 
+from tests.conftest import needs_supported_jax
+
+# jax<0.6 cannot lower these kernels (lax.platform_dependent
+# concretizes under interpret mode; Pallas API drift) — skip the
+# module below the supported floor instead of failing as false alarms
+pytestmark = needs_supported_jax
+
 N = 8
 
 
