@@ -23,56 +23,16 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main():
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--n", type=int, default=1024, help="global unknowns")
-    parser.add_argument("--nproc", type=int, default=None)
-    parser.add_argument("--tol", type=float, default=1e-6)
-    parser.add_argument("--max-iters", type=int, default=2000)
-    parser.add_argument(
-        "--platform", default=None,
-        help="force a jax platform (e.g. cpu); with cpu and --nproc > 1 "
-        "the virtual device count is set automatically",
-    )
-    args = parser.parse_args()
+def build_cg(nproc: int, tol: float = 1e-6, max_iters: int = 2000):
+    """Build the per-rank CG solver (the ``parallel.spmd`` body).
 
-    if args.platform == "cpu" and (args.nproc or 0) > 1:
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                f"{flags} --xla_force_host_platform_device_count={args.nproc}"
-            ).strip()
-
+    Module-level (with lazy imports) so the static linter can trace it
+    with abstract shapes and no devices — see ``M4T_LINT_TARGETS``.
+    """
     import jax
-
-    if args.platform:
-        jax.config.update("jax_platforms", args.platform)
-
     import jax.numpy as jnp
-    import numpy as np
 
     import mpi4jax_tpu as m4t
-    from mpi4jax_tpu.parallel import spmd, world_mesh
-
-    nproc = args.nproc or len(jax.devices())
-    mesh = world_mesh(nproc)
-    n = args.n - (args.n % nproc)  # divisible global size
-    if n == 0:
-        parser.error(f"--n must be >= --nproc (got n={args.n}, nproc={nproc})")
-    n_loc = n // nproc
-
-    # random full-spectrum right-hand side (a smooth manufactured rhs
-    # sits in one Laplacian eigenvector and CG would "converge" in two
-    # steps without exercising the machinery); oracle = banded direct
-    # solve of the tridiagonal system in float64 (O(n), unlike a dense
-    # solve)
-    from scipy.linalg import solveh_banded
-
-    rng = np.random.RandomState(0)
-    b_glob = rng.randn(n)
-    bands = np.vstack([np.full(n, -1.0), np.full(n, 2.0)])
-    u_exact = solveh_banded(bands, b_glob)
-    f_blocks = jnp.asarray(b_glob.reshape(nproc, n_loc).astype(np.float32))
 
     # chain-neighbor tables: forward exchange sends to rank+1, the
     # reverse exchange is the same tables swapped
@@ -102,7 +62,7 @@ def main():
 
         def cond(state):
             _, _, _, rs, it = state
-            return (rs > args.tol ** 2) & (it < args.max_iters)
+            return (rs > tol ** 2) & (it < max_iters)
 
         def body(state):
             x, r, p, rs, it = state
@@ -117,6 +77,75 @@ def main():
         x, _, _, rs, iters = jax.lax.while_loop(cond, body, state0)
         return x, jnp.sqrt(rs), iters
 
+    return cg
+
+
+def _lint_cg(nproc: int = 8, n_loc: int = 16):
+    import jax
+
+    from mpi4jax_tpu.analysis import LintTarget
+
+    return LintTarget(
+        fn=build_cg(nproc),
+        args=(jax.ShapeDtypeStruct((n_loc,), "float32"),),
+        axis_env={"ranks": nproc},
+    )
+
+
+M4T_LINT_TARGETS = {"cg": _lint_cg}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--n", type=int, default=1024, help="global unknowns")
+    parser.add_argument("--nproc", type=int, default=None)
+    parser.add_argument("--tol", type=float, default=1e-6)
+    parser.add_argument("--max-iters", type=int, default=2000)
+    parser.add_argument(
+        "--platform", default=None,
+        help="force a jax platform (e.g. cpu); with cpu and --nproc > 1 "
+        "the virtual device count is set automatically",
+    )
+    args = parser.parse_args()
+
+    if args.platform == "cpu" and (args.nproc or 0) > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={args.nproc}"
+            ).strip()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi4jax_tpu.parallel import spmd, world_mesh
+
+    nproc = args.nproc or len(jax.devices())
+    mesh = world_mesh(nproc)
+    n = args.n - (args.n % nproc)  # divisible global size
+    if n == 0:
+        parser.error(f"--n must be >= --nproc (got n={args.n}, nproc={nproc})")
+    n_loc = n // nproc
+
+    # random full-spectrum right-hand side (a smooth manufactured rhs
+    # sits in one Laplacian eigenvector and CG would "converge" in two
+    # steps without exercising the machinery); oracle = banded direct
+    # solve of the tridiagonal system in float64 (O(n), unlike a dense
+    # solve)
+    from scipy.linalg import solveh_banded
+
+    rng = np.random.RandomState(0)
+    b_glob = rng.randn(n)
+    bands = np.vstack([np.full(n, -1.0), np.full(n, 2.0)])
+    u_exact = solveh_banded(bands, b_glob)
+    f_blocks = jnp.asarray(b_glob.reshape(nproc, n_loc).astype(np.float32))
+
+    cg = build_cg(nproc, tol=args.tol, max_iters=args.max_iters)
     solve = spmd(cg, mesh=mesh)
     u_blocks, res, iters = solve(f_blocks)
     u = np.asarray(u_blocks).reshape(-1)

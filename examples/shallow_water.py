@@ -77,6 +77,35 @@ def parse_args():
     return p.parse_args()
 
 
+def _lint_step(nproc_y: int = 2, nproc_x: int = 4):
+    """Static-linter entry: the composable per-rank step over the same
+    2-D process grid main() builds for --nproc 8 (abstract shapes, no
+    devices); the fused deep-halo variants are TPU-kernel paths gated
+    at runtime and are exercised by their own equivalence probes."""
+    import jax
+
+    from mpi4jax_tpu.analysis import LintTarget
+    from mpi4jax_tpu.models.shallow_water import (
+        ModelState,
+        ShallowWaterConfig,
+        ShallowWaterModel,
+    )
+
+    config = ShallowWaterConfig(nx=32, ny=16, dims=(nproc_y, nproc_x))
+    model = ShallowWaterModel(config)
+    block = jax.ShapeDtypeStruct(
+        (config.ny_local, config.nx_local), config.dtype
+    )
+    return LintTarget(
+        fn=lambda s: model.step(s, first_step=True),
+        args=(ModelState(*([block] * 6)),),
+        axis_env={"ranks": config.n_ranks},
+    )
+
+
+M4T_LINT_TARGETS = {"step": _lint_step}
+
+
 def main():
     args = parse_args()
 

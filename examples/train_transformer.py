@@ -19,6 +19,38 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _lint_train_step(attention: str, nproc: int = 8, t_local: int = 16):
+    """Static-linter entry: the exact per-rank step main() hands to
+    ``parallel.spmd`` (same config shape, abstract arrays, no
+    devices)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpi4jax_tpu.analysis import LintTarget
+    from mpi4jax_tpu.models import attention as tfm
+
+    cfg = tfm.TransformerConfig(
+        vocab=64, d_model=64, n_heads=8, n_layers=2, d_ff=128,
+        sp_axis="ranks", sp_size=nproc, attention=attention,
+        learning_rate=0.05,
+    )
+    params = jax.eval_shape(
+        lambda k: tfm.init_params(cfg, k), jax.random.PRNGKey(0)
+    )
+    tok = jax.ShapeDtypeStruct((t_local,), jnp.int32)
+    return LintTarget(
+        fn=lambda pp, tk, tg: tfm.train_step(cfg, pp, tk, tg),
+        args=(params, tok, tok),
+        axis_env={"ranks": nproc},
+    )
+
+
+M4T_LINT_TARGETS = {
+    "train_step_ring": lambda: _lint_train_step("ring"),
+    "train_step_ulysses": lambda: _lint_train_step("ulysses"),
+}
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--nproc", type=int, default=None)

@@ -28,42 +28,25 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main():
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--steps", type=int, default=200)
-    parser.add_argument("--nproc", type=int, default=None)
-    parser.add_argument("--lr", type=float, default=0.05)
-    parser.add_argument("--platform", default=None)
-    args = parser.parse_args()
-    if args.steps < 2:
-        # losses are measured pre-update, so the first and last loss
-        # coincide below 2 steps and the reduction check is undefined
-        parser.error("--steps must be >= 2")
+def build_workload(nproc: int, d_in: int = 32, lr: float = 0.05):
+    """Build the per-rank ZeRO and all-reduce DP steps (the
+    ``parallel.spmd`` bodies) plus the parameter helpers.
 
-    if args.platform == "cpu" and (args.nproc or 0) > 1:
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                f"{flags} --xla_force_host_platform_device_count={args.nproc}"
-            ).strip()
+    Module-level (with lazy imports) so the static linter can trace
+    both steps with abstract shapes and no devices — see
+    ``M4T_LINT_TARGETS``. Returns a namespace with ``zero_step``,
+    ``allreduce_step``, ``init_params``, ``flatten`` and the size
+    bookkeeping main() needs.
+    """
+    import types
 
     import jax
-
-    if args.platform:
-        jax.config.update("jax_platforms", args.platform)
-
     import jax.numpy as jnp
     import numpy as np
 
     import mpi4jax_tpu as m4t
-    from mpi4jax_tpu.parallel import spmd, world_mesh
 
-    nproc = args.nproc or len(jax.devices())
-    mesh = world_mesh(nproc)
-
-    d_in, d_hidden = 32, 64 * nproc  # hidden divisible by nproc
-    rng = np.random.RandomState(0)
-    w_true = rng.randn(d_in).astype(np.float32)
+    d_hidden = 64 * nproc  # hidden divisible by nproc
 
     def init_params():
         k1, k2 = jax.random.split(jax.random.PRNGKey(1))
@@ -77,7 +60,7 @@ def main():
         pred = (h @ params["w2"])[:, 0]
         return ((pred - yb) ** 2).mean()
 
-    flat_template = init_params()
+    flat_template = jax.eval_shape(init_params)
     leaves, treedef = jax.tree.flatten(flat_template)
     sizes = [leaf.size for leaf in leaves]
     total = sum(sizes)
@@ -110,7 +93,7 @@ def main():
         my_shard = jax.lax.dynamic_slice(
             jnp.pad(params_vec, (0, padded - total)), (rank * shard,), (shard,)
         )
-        my_shard = my_shard - args.lr * gshards        # owned-shard update
+        my_shard = my_shard - lr * gshards              # owned-shard update
         full = m4t.allgather(my_shard).reshape(-1)[:total]
         loss = m4t.allreduce(local_loss, op=m4t.SUM) / nproc
         return full, loss
@@ -120,19 +103,89 @@ def main():
         local_loss, grads = value_and_grad(params_vec, xb, yb)
         grads = m4t.allreduce(grads, op=m4t.SUM) / nproc
         loss = m4t.allreduce(local_loss, op=m4t.SUM) / nproc
-        return params_vec - args.lr * grads, loss
+        return params_vec - lr * grads, loss
+
+    return types.SimpleNamespace(
+        d_in=d_in,
+        d_hidden=d_hidden,
+        total=total,
+        init_params=init_params,
+        flatten=flatten,
+        zero_step=zero_step,
+        allreduce_step=allreduce_step,
+    )
+
+
+def _lint_step(which: str, nproc: int = 8):
+    import jax
+
+    from mpi4jax_tpu.analysis import LintTarget
+
+    ns = build_workload(nproc)
+    return LintTarget(
+        fn=getattr(ns, which),
+        args=(
+            jax.ShapeDtypeStruct((ns.total,), "float32"),
+            jax.ShapeDtypeStruct((16, ns.d_in), "float32"),
+            jax.ShapeDtypeStruct((16,), "float32"),
+        ),
+        axis_env={"ranks": nproc},
+    )
+
+
+M4T_LINT_TARGETS = {
+    "zero_step": lambda: _lint_step("zero_step"),
+    "allreduce_step": lambda: _lint_step("allreduce_step"),
+}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=200)
+    parser.add_argument("--nproc", type=int, default=None)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--platform", default=None)
+    args = parser.parse_args()
+    if args.steps < 2:
+        # losses are measured pre-update, so the first and last loss
+        # coincide below 2 steps and the reduction check is undefined
+        parser.error("--steps must be >= 2")
+
+    if args.platform == "cpu" and (args.nproc or 0) > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={args.nproc}"
+            ).strip()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi4jax_tpu.parallel import spmd, world_mesh
+
+    nproc = args.nproc or len(jax.devices())
+    mesh = world_mesh(nproc)
+
+    ns = build_workload(nproc, lr=args.lr)
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(ns.d_in).astype(np.float32)
 
     def make_batches(step):
         rs = np.random.RandomState(100 + step)
-        xb = rs.randn(nproc, 16, d_in).astype(np.float32)
+        xb = rs.randn(nproc, 16, ns.d_in).astype(np.float32)
         yb = np.tanh(xb @ w_true)  # nonlinear synthetic target
         return jnp.asarray(xb), jnp.asarray(yb)
 
-    zero = spmd(zero_step, mesh=mesh)
-    ref = spmd(allreduce_step, mesh=mesh)
+    zero = spmd(ns.zero_step, mesh=mesh)
+    ref = spmd(ns.allreduce_step, mesh=mesh)
 
-    v_zero = flatten(init_params())
-    v_ref = flatten(init_params())
+    v_zero = ns.flatten(ns.init_params())
+    v_ref = ns.flatten(ns.init_params())
     stack = lambda v: jnp.broadcast_to(v, (nproc,) + v.shape)
     v_zero, v_ref = stack(v_zero), stack(v_ref)
 

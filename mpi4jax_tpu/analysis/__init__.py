@@ -1,0 +1,68 @@
+"""Trace-time SPMD collective linter.
+
+Static counterpart of the runtime observability stack: where the
+flight recorder + doctor (``observability/``) diagnose collective
+mismatch, deadlock, and stragglers *post-mortem* from per-rank
+artifacts, this package catches the same bug classes *before any
+multi-rank run*, from a single process, by abstractly tracing the
+program to a jaxpr (no devices, no execution), normalizing every
+collective equation into a :class:`~.sites.CollectiveSite` — same
+fingerprint schema the recorder emits, so static sites and runtime
+verdicts join (``doctor --static``) — and running a rule registry
+over the per-path collective sequences.
+
+Layers:
+
+- :mod:`.sites` — CollectiveSite records + the recorder-schema
+  fingerprint.
+- :mod:`.walker` — recursive jaxpr walker (cond/scan/while/pjit/
+  remat/shard_map/custom-vjp) + rank-taint dataflow.
+- :mod:`.rules` — the M4T101–M4T106 rule registry (open for
+  project-specific additions).
+- :mod:`.linter` — ``lint()`` driver, text/JSON reporters, the
+  ``M4T_LINT_TARGETS`` module self-lint convention.
+- :mod:`.emit_check` — the opt-in ``M4T_STATIC_CHECK=1`` hook run by
+  ``ops/_core.py`` at every emission's first trace (the subset of
+  rules decidable from one call site).
+- CLI: ``python -m mpi4jax_tpu.analysis <module:fn|file> [--json]``
+  (exit 0 clean / 1 findings / 2 error).
+
+Rule catalog with examples: ``docs/static-analysis.md``.
+"""
+
+from .linter import (  # noqa: F401
+    LintTarget,
+    Report,
+    lint,
+    lint_module,
+    reports_to_json,
+    rule_catalog,
+    trace_sites,
+)
+from .rules import RULES, Finding, LintConfig, rule, run_rules  # noqa: F401
+from .sites import (  # noqa: F401
+    CollectiveSite,
+    PRIM_TO_OP,
+    canonical_fingerprint,
+)
+from .walker import ProgramGraph, walk_closed_jaxpr  # noqa: F401
+
+__all__ = [
+    "CollectiveSite",
+    "Finding",
+    "LintConfig",
+    "LintTarget",
+    "PRIM_TO_OP",
+    "ProgramGraph",
+    "RULES",
+    "Report",
+    "canonical_fingerprint",
+    "lint",
+    "lint_module",
+    "reports_to_json",
+    "rule",
+    "rule_catalog",
+    "run_rules",
+    "trace_sites",
+    "walk_closed_jaxpr",
+]

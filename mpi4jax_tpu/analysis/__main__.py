@@ -1,0 +1,239 @@
+"""CLI: ``python -m mpi4jax_tpu.analysis <target> [...]``.
+
+Targets:
+
+- ``pkg.module:fn`` — import ``pkg.module``, lint function ``fn``
+  (abstract argument shapes via ``--arg``, axes via ``--axis``).
+- ``pkg.module`` / ``path/to/file.py`` — import it and lint every
+  entry point it declares in ``M4T_LINT_TARGETS`` (see
+  ``analysis.linter.LintTarget``); ``path/to/file.py:fn`` lints one
+  function from a file.
+
+Exit status: **0** clean, **1** findings, **2** error (unimportable
+target, untraceable function, bad arguments) — same convention as the
+runtime doctor CLI.
+
+Examples::
+
+    python -m mpi4jax_tpu.analysis mymodel:train_step \\
+        --arg 'f32[64,128]' --arg 'f32[64]' --axis ranks=8
+    python -m mpi4jax_tpu.analysis examples/cg_solver.py --json
+    python -m mpi4jax_tpu.analysis --rules      # print the catalog
+
+Functions already wrapped in ``parallel.spmd`` / ``shard_map`` need a
+real (virtual) device mesh to trace; pass ``--devices 8`` to force 8
+virtual CPU devices before JAX's backend initializes. Plain per-rank
+functions need no devices at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import importlib.util
+import json
+import os
+import re
+import sys
+from typing import List, Optional
+
+_ARG_RE = re.compile(r"^([a-z]+[0-9]*)\[([0-9,\s]*)\]$")
+
+_DTYPES = {
+    "f16": "float16",
+    "bf16": "bfloat16",
+    "f32": "float32",
+    "f64": "float64",
+    "i8": "int8",
+    "i16": "int16",
+    "i32": "int32",
+    "i64": "int64",
+    "u8": "uint8",
+    "u16": "uint16",
+    "u32": "uint32",
+    "u64": "uint64",
+    "bool": "bool",
+}
+
+
+def _parse_arg_spec(spec: str):
+    """``f32[64,128]`` -> ShapeDtypeStruct((64, 128), float32)."""
+    import jax
+    import numpy as np
+
+    m = _ARG_RE.match(spec.strip())
+    if not m:
+        raise ValueError(
+            f"bad --arg spec {spec!r}; expected dtype[dims] like "
+            "'f32[64,128]', 'bf16[1024]', 'i32[]'"
+        )
+    short, dims = m.groups()
+    dtype = _DTYPES.get(short, short)
+    shape = tuple(int(d) for d in dims.replace(" ", "").split(",") if d)
+    return jax.ShapeDtypeStruct(shape, np.dtype(dtype))
+
+
+def _parse_axis(spec: str):
+    name, _, size = spec.partition("=")
+    if not name or not size.isdigit():
+        raise ValueError(
+            f"bad --axis spec {spec!r}; expected name=SIZE like ranks=8 "
+            "(or the single word 'none' for an empty axis env)"
+        )
+    return name, int(size)
+
+
+def parse_axis_env(specs) -> Optional[dict]:
+    """``--axis`` specs -> axis env: None (use the linter default)
+    when none given, ``{}`` for the explicit ``none`` spelling (lint
+    in the size-1/launcher-world resolution, where fingerprints carry
+    ``@<none>`` like the shm backend's runtime records)."""
+    specs = list(specs)
+    if any(s.strip().lower() == "none" for s in specs):
+        if len(specs) > 1:
+            raise ValueError("--axis none cannot be combined with others")
+        return {}
+    return dict(_parse_axis(s) for s in specs) or None
+
+
+def _import_target(target: str):
+    """Resolve ``module[:fn]`` / ``file.py[:fn]`` to (module, fn|None)."""
+    modpart, sep, fnname = target.partition(":")
+    if modpart.endswith(".py") or os.path.sep in modpart:
+        path = os.path.abspath(modpart)
+        name = os.path.splitext(os.path.basename(path))[0]
+        spec = importlib.util.spec_from_file_location(name, path)
+        if spec is None or spec.loader is None:
+            raise ImportError(f"cannot load {path}")
+        module = importlib.util.module_from_spec(spec)
+        sys.modules.setdefault(name, module)
+        spec.loader.exec_module(module)
+    else:
+        module = importlib.import_module(modpart)
+    if not sep:
+        return module, None
+    fn = getattr(module, fnname, None)
+    if fn is None or not callable(fn):
+        raise ImportError(f"{modpart} has no callable {fnname!r}")
+    return module, fn
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi4jax_tpu.analysis",
+        description=(
+            "Static SPMD collective linter: abstractly trace a "
+            "function (no devices, no execution), walk every "
+            "sub-jaxpr, and check the collective sequences for "
+            "deadlock/mismatch/token-discipline bugs (M4T101-M4T106)."
+        ),
+    )
+    parser.add_argument(
+        "targets",
+        nargs="*",
+        help="module:fn, module, file.py, or file.py:fn "
+        "(modules without :fn lint their M4T_LINT_TARGETS)",
+    )
+    parser.add_argument(
+        "--arg",
+        action="append",
+        default=[],
+        metavar="SPEC",
+        help="abstract argument for a :fn target, e.g. 'f32[64,128]' "
+        "(repeat in positional order)",
+    )
+    parser.add_argument(
+        "--axis",
+        action="append",
+        default=[],
+        metavar="NAME=SIZE",
+        help="communicator axis binding (default: ranks=8; repeatable; "
+        "'none' lints with no bound axes — the launcher-world/"
+        "multi-controller resolution)",
+    )
+    parser.add_argument(
+        "--devices",
+        type=int,
+        default=None,
+        metavar="N",
+        help="force N virtual CPU devices (needed only for targets "
+        "already wrapped in spmd/shard_map)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit the JSON report"
+    )
+    parser.add_argument(
+        "--rules",
+        action="store_true",
+        help="print the rule catalog and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.rules:
+        from .linter import rule_catalog
+
+        print(rule_catalog())
+        return 0
+    if not args.targets:
+        parser.error("no targets given (or use --rules)")
+
+    if args.devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{args.devices}"
+            ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    try:
+        axis_env = parse_axis_env(args.axis)
+        arg_structs = tuple(_parse_arg_spec(s) for s in args.arg)
+    except (TypeError, ValueError) as e:  # incl. np.dtype on bad names
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    from .linter import lint, lint_module, reports_to_json
+
+    reports = []
+    for target in args.targets:
+        try:
+            module, fn = _import_target(target)
+        except Exception as e:
+            print(f"error: cannot resolve {target!r}: {e}", file=sys.stderr)
+            return 2
+        if fn is not None:
+            reports.append(
+                lint(fn, arg_structs, axis_env=axis_env, name=target)
+            )
+        else:
+            module_reports = lint_module(module)
+            if not module_reports:
+                print(
+                    f"error: {target!r} declares no M4T_LINT_TARGETS "
+                    "and no :fn was given",
+                    file=sys.stderr,
+                )
+                return 2
+            reports.extend(module_reports)
+
+    if args.json:
+        print(json.dumps(reports_to_json(reports), indent=1, default=str))
+    else:
+        for r in reports:
+            print(r.to_text())
+
+    if any(r.error is not None for r in reports):
+        for r in reports:
+            if r.error is not None:
+                print(
+                    f"error: {r.target}: {r.error}", file=sys.stderr
+                )
+        return 2
+    return 1 if any(r.findings for r in reports) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,123 @@
+"""Emission-time static checks (the ``M4T_STATIC_CHECK`` hook).
+
+The full linter needs the whole jaxpr; a useful subset of the rules is
+decidable from a *single call site* at the moment ``ops/_core.emit``
+runs inside the user's first trace. With ``M4T_STATIC_CHECK=1`` (or
+``warn``) every emission is screened and violations become
+``M4TStaticCheckWarning`` warnings; with ``M4T_STATIC_CHECK=error``
+they raise :class:`StaticCheckError` at trace time — the op never
+makes it into the program.
+
+Site-local rules applied here:
+
+- **M4T103** (partial): self-edge point-to-point transfers on a
+  multi-rank communicator (degenerate shift arithmetic).
+- **M4T106**: low-precision / narrow-integer SUM reduction hazards.
+
+The control-flow rules (M4T101/102) and whole-program token checks
+(M4T104) fundamentally need the closed jaxpr — run the linter
+(``python -m mpi4jax_tpu.analysis``) or ``analysis.lint`` for those.
+
+Each distinct (rule, op, fingerprint-ish) violation warns once per
+process: the hook sits on the hot trace path and re-warning on every
+retrace of the same site is noise.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Optional, Set, Tuple
+
+from .. import config
+from .rules import LintConfig
+from .sites import REDUCTION_OPS
+
+
+class M4TStaticCheckWarning(UserWarning):
+    """A static-check rule fired at op-emission time."""
+
+
+class StaticCheckError(RuntimeError):
+    """A static-check rule fired with ``M4T_STATIC_CHECK=error``."""
+
+
+_seen: Set[Tuple[str, str, str]] = set()
+_config = LintConfig()
+
+
+def reset_seen() -> None:
+    """Forget warned-once state (tests)."""
+    _seen.clear()
+
+
+def _report(code: str, opname: str, key: str, message: str) -> None:
+    dedupe = (code, opname, key)
+    if config.STATIC_CHECK == "error":
+        raise StaticCheckError(f"{code}: {message}")
+    if dedupe in _seen:
+        return
+    _seen.add(dedupe)
+    warnings.warn(f"{code}: {message}", M4TStaticCheckWarning, stacklevel=4)
+
+
+def check_emission(
+    opname: str,
+    inputs: Tuple,
+    params: Optional[dict],
+    bound_comm,
+) -> None:
+    """Screen one emission. Called from ``ops/_core.py`` only when
+    ``config.STATIC_CHECK`` is enabled; must stay cheap and must never
+    raise except the deliberate :class:`StaticCheckError`."""
+    params = params or {}
+    world = getattr(bound_comm, "size", None)
+    dtype = None
+    if inputs:
+        d = getattr(inputs[0], "dtype", None)
+        dtype = None if d is None else str(d)
+
+    # M4T103 (site-local): degenerate self-edges in a p2p transfer
+    perm = params.get("perm")
+    if perm and world and world > 1:
+        selfies = [(s, d) for s, d in perm if s == d]
+        if selfies:
+            _report(
+                "M4T103",
+                opname,
+                str(sorted(selfies)),
+                f"{opname} transfer contains self-edges {selfies} on a "
+                f"size-{world} communicator — shift arithmetic gone "
+                "degenerate ((r + k) % n with k % n == 0)? The rank "
+                "pairs with nobody (docs/static-analysis.md#m4t103).",
+            )
+
+    # M4T106: reduction dtype hazards
+    op = params.get("op")
+    op_name = getattr(op, "name", None)
+    if (
+        opname in REDUCTION_OPS
+        and op_name == "SUM"
+        and dtype is not None
+        and world
+    ):
+        if (
+            dtype in ("bfloat16", "float16")
+            and world >= _config.low_precision_world
+        ):
+            _report(
+                "M4T106",
+                opname,
+                dtype,
+                f"{opname} SUMs {dtype} across {world} ranks; reduce in "
+                "f32 and cast back to bound the accumulation error "
+                "(docs/static-analysis.md#m4t106).",
+            )
+        elif dtype in ("int8", "uint8", "int16", "uint16"):
+            _report(
+                "M4T106",
+                opname,
+                dtype,
+                f"{opname} SUMs {dtype} across {world} ranks; narrow "
+                "integer sums wrap silently — accumulate in int32 "
+                "(docs/static-analysis.md#m4t106).",
+            )

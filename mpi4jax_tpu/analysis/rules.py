@@ -1,0 +1,374 @@
+"""Rule registry: the M4T1xx static checks over a ProgramGraph.
+
+Each rule has a stable code (the vocabulary shared with
+``docs/static-analysis.md`` and the runtime doctor), a severity, and a
+checker ``fn(graph, config) -> [Finding]``. The registry is open:
+downstream code can add project-specific rules with :func:`rule`.
+
+The launch set:
+
+- **M4T101** — collective under rank-divergent control flow: a
+  ``cond``/``while`` whose predicate is data-dependent on the rank
+  (``lax.axis_index`` / ``Comm.Get_rank``) guards a collective. Ranks
+  that disagree about the predicate execute different collective
+  sequences: the canonical SPMD deadlock.
+- **M4T102** — branch-sequence mismatch: the branches of one ``cond``
+  emit different collective sequences/fingerprints. Under
+  ``shard_map`` every rank holds different data, so *any* traced
+  predicate can disagree across ranks — differing branch collectives
+  are a deadlock waiting for the first disagreeing batch.
+- **M4T103** — unpaired or self-deadlocking send/recv: a ``send``
+  whose matching ``recv`` never appeared in the trace (the transfer is
+  silently never emitted), or shift arithmetic that degenerates to
+  self-edges (rank sending to itself through a CollectivePermute —
+  almost always ``(r + k) % n`` with ``k % n == 0``).
+- **M4T104** — token-discipline violation: the program emits
+  collectives but contains no ambient ordering chain at all (no
+  ``optimization_barrier`` ties) — ``MPI4JAX_TPU_NO_ORDERING=1`` was
+  set during the lint trace, or the collectives were bound directly on
+  the primitives, bypassing the public API and its
+  ``token.ordered_call`` discipline.
+- **M4T105** — collective over a non-mesh axis: a collective whose
+  communicator resolved to an axis that is not one of the program's
+  mesh axes — typically a ``vmap`` batching axis, where the
+  "collective" silently becomes a *local* reduction across batch
+  elements instead of cross-device communication.
+- **M4T106** — reduction dtype hazard: low-precision (bf16/f16) SUM
+  reductions over enough ranks accumulate O(world) rounding error, and
+  narrow-integer SUMs can overflow; cf. EQuARX (arxiv 2506.17615) on
+  dynamic-range management for quantized TPU allreduce.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+from .sites import REDUCTION_OPS, CollectiveSite
+from .walker import ProgramGraph
+
+
+@dataclasses.dataclass
+class LintConfig:
+    """Rule thresholds / toggles (all overridable per call)."""
+
+    #: world size at/above which a bf16/f16 SUM reduction is flagged
+    low_precision_world: int = 4
+    #: flag integer SUM reductions at/below this itemsize (bytes)
+    int_sum_max_itemsize: int = 2
+    #: rule codes to skip entirely
+    disabled: frozenset = frozenset()
+
+
+@dataclasses.dataclass
+class Finding:
+    code: str
+    severity: str  # "error" | "warning"
+    message: str
+    #: primary site (or None for program-level findings)
+    site: Optional[CollectiveSite] = None
+    #: every implicated site
+    sites: List[CollectiveSite] = dataclasses.field(default_factory=list)
+
+    @property
+    def source(self) -> str:
+        if self.site is not None:
+            return self.site.source
+        if self.sites:
+            return self.sites[0].source
+        return "<program>"
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "code": self.code,
+            "severity": self.severity,
+            "message": self.message,
+            "source": self.source,
+            "fingerprint": None
+            if self.site is None
+            else self.site.fingerprint,
+            "sites": [s.index for s in self.sites],
+        }
+
+
+@dataclasses.dataclass
+class Rule:
+    code: str
+    title: str
+    severity: str
+    check: Callable[[ProgramGraph, LintConfig], List[Finding]]
+
+
+#: code -> Rule, in registration (= documentation) order
+RULES: Dict[str, Rule] = {}
+
+
+def rule(code: str, title: str, severity: str = "error"):
+    def register(fn):
+        RULES[code] = Rule(code, title, severity, fn)
+        return fn
+
+    return register
+
+
+def run_rules(
+    graph: ProgramGraph, config: Optional[LintConfig] = None
+) -> List[Finding]:
+    config = config or LintConfig()
+    findings: List[Finding] = []
+    for r in RULES.values():
+        if r.code in config.disabled:
+            continue
+        findings.extend(r.check(graph, config))
+    return findings
+
+
+def _seq(sites: List[CollectiveSite]) -> str:
+    return " -> ".join(s.fingerprint for s in sites) if sites else "(none)"
+
+
+# ---------------------------------------------------------------------
+# the launch rules
+# ---------------------------------------------------------------------
+
+
+@rule("M4T101", "collective under rank-divergent control flow")
+def _rank_divergent_control_flow(graph, config):
+    findings = []
+    for cond in graph.conds:
+        if not cond.pred_tainted:
+            continue
+        sites = [s for br in cond.branch_sites for s in br]
+        if not sites:
+            continue
+        findings.append(
+            Finding(
+                code="M4T101",
+                severity="error",
+                message=(
+                    f"cond at {cond.source} branches on a rank-derived "
+                    "predicate (lax.axis_index / Comm.Get_rank) and a "
+                    "branch emits collectives "
+                    f"({_seq(sites)}); ranks disagreeing about the "
+                    "predicate will not all join the collective — the "
+                    "classic SPMD deadlock. Make every rank emit the "
+                    "same collective sequence (e.g. jnp.where on the "
+                    "*result*, or a collective in both branches)."
+                ),
+                site=sites[0],
+                sites=sites,
+            )
+        )
+    for wl in graph.whiles:
+        if not wl.pred_tainted or not wl.body_sites:
+            continue
+        findings.append(
+            Finding(
+                code="M4T101",
+                severity="error",
+                message=(
+                    f"while_loop at {wl.source} has a rank-derived "
+                    "termination test and its body emits collectives "
+                    f"({_seq(wl.body_sites)}); ranks will run different "
+                    "iteration counts and stop joining each other's "
+                    "collectives. Derive the trip count from "
+                    "rank-uniform values (e.g. allreduce the predicate)."
+                ),
+                site=wl.body_sites[0],
+                sites=wl.body_sites,
+            )
+        )
+    return findings
+
+
+@rule("M4T102", "cond branches emit different collective sequences")
+def _branch_sequence_mismatch(graph, config):
+    findings = []
+    for cond in graph.conds:
+        seqs = [
+            tuple(s.fingerprint for s in br) for br in cond.branch_sites
+        ]
+        if len(set(seqs)) <= 1:
+            continue
+        detail = "; ".join(
+            f"branch {i}: {_seq(br)}"
+            for i, br in enumerate(cond.branch_sites)
+        )
+        primary = next(s for br in cond.branch_sites for s in br)
+        findings.append(
+            Finding(
+                code="M4T102",
+                severity="error",
+                message=(
+                    f"cond at {cond.source} emits different collective "
+                    f"sequences per branch ({detail}). Under shard_map "
+                    "each rank evaluates the predicate on its own data, "
+                    "so any disagreement deadlocks at the first "
+                    "differing collective; this is exactly the MISMATCH "
+                    "the runtime doctor reports post-mortem."
+                ),
+                site=primary,
+                sites=[s for br in cond.branch_sites for s in br],
+            )
+        )
+    return findings
+
+
+@rule("M4T103", "unpaired or self-deadlocking send/recv")
+def _unpaired_p2p(graph, config):
+    findings = []
+    for rec in graph.pending_sends:
+        findings.append(
+            Finding(
+                code="M4T103",
+                severity="error",
+                message=(
+                    f"send(tag={rec.get('tag')}, edges="
+                    f"{sorted(rec.get('edges', ()))}) was never matched "
+                    "by a recv in the traced program: the transfer is "
+                    "never emitted at all (on the TPU backend a "
+                    "send/recv pair fuses into one CollectivePermute "
+                    "inside one trace — see ops/p2p.py; "
+                    "token.check_no_pending_sends raises for this at "
+                    "parallel.spmd trace exit)."
+                ),
+            )
+        )
+    for site in graph.sites:
+        if site.prim != "tpu_collective_permute" or not site.perm:
+            continue
+        if site.world is not None and site.world <= 1:
+            continue
+        selfies = [(s, d) for s, d in site.perm if s == d]
+        if not selfies:
+            continue
+        findings.append(
+            Finding(
+                code="M4T103",
+                severity="error",
+                message=(
+                    f"point-to-point transfer at {site.source} contains "
+                    f"self-edges {selfies} on a size-{site.world} "
+                    "communicator: a rank 'sending to itself' through a "
+                    "CollectivePermute is almost always shift arithmetic "
+                    "gone degenerate ((r + k) % n with k % n == 0) and "
+                    "pairs with nobody."
+                ),
+                site=site,
+                sites=[site],
+            )
+        )
+    return findings
+
+
+@rule("M4T104", "collectives outside the ambient token chain")
+def _token_discipline(graph, config):
+    if not graph.sites or graph.n_barriers > 0:
+        return []
+    sites = graph.sites
+    return [
+        Finding(
+            code="M4T104",
+            severity="error",
+            message=(
+                f"the program emits {len(sites)} collective(s) but "
+                "contains no ambient ordering-token ties at all (zero "
+                "optimization_barrier equations): either "
+                "MPI4JAX_TPU_NO_ORDERING=1 was set during the lint "
+                "trace, or the collectives were bound directly on the "
+                "primitives, bypassing the public API and "
+                "token.ordered_call. Untied collectives have no "
+                "pinned program order: schedules become "
+                "compiler-version-dependent and profiles stop being "
+                "comparable (mpi4jax_tpu/token.py)."
+            ),
+            site=sites[0],
+            sites=list(sites),
+        )
+    ]
+
+
+@rule("M4T105", "collective over a non-mesh axis", severity="warning")
+def _non_mesh_axis(graph, config):
+    if not graph.mesh_axes:
+        return []  # nothing declared: cannot tell mesh from vmap axes
+    findings = []
+    for site in graph.sites:
+        foreign = [a for a in site.axes if a not in graph.mesh_axes]
+        if not foreign:
+            continue
+        findings.append(
+            Finding(
+                code="M4T105",
+                severity="warning",
+                message=(
+                    f"{site.op} at {site.source} runs over axes "
+                    f"{foreign} which are not mesh axes "
+                    f"(mesh: {sorted(graph.mesh_axes)}): if that is a "
+                    "vmap batching axis the 'collective' is a local "
+                    "reduction across batch elements, not cross-device "
+                    "communication. If intentional, declare the axis "
+                    "via axis_env / --axis."
+                ),
+                site=site,
+                sites=[site],
+            )
+        )
+    return findings
+
+
+@rule("M4T106", "reduction dtype hazard", severity="warning")
+def _reduction_dtype_hazard(graph, config):
+    findings = []
+    for site in graph.sites:
+        if site.op not in REDUCTION_OPS or site.reduce_op != "SUM":
+            continue
+        if site.dtype is None or site.world is None:
+            continue
+        if (
+            site.dtype in ("bfloat16", "float16")
+            and site.world >= config.low_precision_world
+        ):
+            findings.append(
+                Finding(
+                    code="M4T106",
+                    severity="warning",
+                    message=(
+                        f"{site.op} at {site.source} SUMs {site.dtype} "
+                        f"across {site.world} ranks: low-precision "
+                        "accumulation loses ~log2(world) mantissa bits "
+                        "(bf16 has 8), so large payloads drift rank-"
+                        "uniformly wrong. Reduce in f32 and cast back "
+                        "(x.astype(f32) -> allreduce -> astype(bf16)), "
+                        "or use quantized_allreduce's error-bounded "
+                        "path (cf. EQuARX, arxiv 2506.17615)."
+                    ),
+                    site=site,
+                    sites=[site],
+                )
+            )
+            continue
+        if site.dtype.startswith(("int", "uint")):
+            import re
+
+            m = re.search(r"(\d+)$", site.dtype)
+            bits = int(m.group(1)) if m else 64
+            if bits // 8 <= config.int_sum_max_itemsize:
+                findings.append(
+                    Finding(
+                        code="M4T106",
+                        severity="warning",
+                        message=(
+                            f"{site.op} at {site.source} SUMs "
+                            f"{site.dtype} across {site.world} ranks: "
+                            f"int{bits} overflows after summing "
+                            f"{site.world} near-max values and wraps "
+                            "silently (quantized-gradient reduce is the "
+                            "usual culprit). Accumulate in int32/f32 "
+                            "and requantize after the reduction."
+                        ),
+                        site=site,
+                        sites=[site],
+                    )
+                )
+    return findings

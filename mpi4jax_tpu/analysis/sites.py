@@ -1,0 +1,238 @@
+"""CollectiveSite: the static (jaxpr-level) view of one collective.
+
+The flight recorder (``observability/recorder.py``) describes a
+collective *emission* at runtime by an op fingerprint —
+``Op[shape:dtype]@axes`` — compared across ranks at equal sequence
+number. This module produces the same record from a jaxpr *equation*,
+with no devices and no execution: the static analyzer
+(:mod:`.walker`) normalizes every mpi4jax_tpu collective equation it
+finds into a :class:`CollectiveSite` carrying
+
+- the op name in the exact vocabulary ``ops/*.py`` passes to
+  ``emit(opname=...)`` (so static and runtime fingerprints join
+  byte-for-byte for the HLO-collective ops),
+- the payload shape/dtype/bytes of the first operand (the payload by
+  the same convention ``_core._payload_bytes`` uses),
+- the communicator axes and world size from the equation's bound
+  ``comm`` parameter,
+- the control-flow *path* (``cond[1]`` / ``scan`` / ``while[body]`` /
+  ``pjit(f)`` / ``remat`` / ``custom_vjp`` frames) it sits under, and
+- the user source location from the equation's trace metadata —
+  the line the doctor names when a runtime MISMATCH verdict joins a
+  static site by fingerprint (``doctor --static``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+from ..observability.recorder import fingerprint as _fingerprint
+
+#: jaxpr primitive name -> the opname ``emit()`` uses for the same op
+#: (the vocabulary of the flight recorder / doctor fingerprints).
+PRIM_TO_OP = {
+    "tpu_allreduce": "AllReduce",
+    "tpu_allgather": "AllGather",
+    "tpu_alltoall": "AllToAll",
+    "tpu_reduce": "Reduce",
+    "tpu_reduce_scatter": "ReduceScatter",
+    "tpu_bcast": "Bcast",
+    "tpu_barrier": "Barrier",
+    "tpu_scan": "Scan",
+    "tpu_scatter": "Scatter",
+    "tpu_gather": "Gather",
+    "tpu_collective_permute": "CollectivePermute",
+}
+
+#: ops that perform an elementwise reduction (M4T106's subjects)
+REDUCTION_OPS = frozenset(
+    {"AllReduce", "Reduce", "ReduceScatter", "Scan", "QuantizedAllReduce"}
+)
+
+#: the point-to-point family: one HLO CollectivePermute reached through
+#: several API spellings. ``emit`` stamps the runtime record with the
+#: API name (Sendrecv/Recv), the jaxpr only knows the primitive — the
+#: canonical key lets ``doctor --static`` join the two.
+_P2P_FAMILY = frozenset({"CollectivePermute", "Sendrecv", "Send", "Recv"})
+
+
+def canonical_fingerprint(fp: str) -> str:
+    """Collapse the p2p family to one op name so a runtime
+    ``Sendrecv[...]`` record joins a static ``CollectivePermute[...]``
+    site; all other fingerprints pass through unchanged."""
+    op, sep, rest = fp.partition("[")
+    if op in _P2P_FAMILY:
+        return "P2P" + sep + rest
+    return fp
+
+
+@dataclasses.dataclass
+class CollectiveSite:
+    """One collective equation, normalized."""
+
+    #: program-order index over the whole walk (0-based)
+    index: int
+    #: jaxpr primitive name (``tpu_allreduce`` ...)
+    prim: str
+    #: emit-vocabulary op name (``AllReduce`` ...)
+    op: str
+    shape: Optional[Tuple[int, ...]]
+    dtype: Optional[str]
+    nbytes: int
+    axes: Tuple[str, ...]
+    world: Optional[int]
+    #: reduction operator name (``SUM`` ...) for reduction ops
+    reduce_op: Optional[str] = None
+    #: source->dest edges for the p2p primitive
+    perm: Optional[Tuple[Tuple[int, int], ...]] = None
+    #: control-flow frames from the trace root down to this equation
+    path: Tuple[str, ...] = ()
+    #: ``file.py:line (function)`` from the equation's source info
+    source: str = "<unknown>"
+    #: were this equation's operands tied through the ambient
+    #: ``optimization_barrier`` token chain? (advisory; see M4T104)
+    token_tied: bool = False
+
+    @property
+    def fingerprint(self) -> str:
+        """The recorder-schema fingerprint (``Op[shape:dtype]@axes``)."""
+        return _fingerprint(
+            {
+                "op": self.op,
+                "shape": None if self.shape is None else list(self.shape),
+                "bytes": self.nbytes,
+                "dtype": self.dtype,
+                "axes": list(self.axes),
+            }
+        )
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "index": self.index,
+            "prim": self.prim,
+            "op": self.op,
+            "shape": None if self.shape is None else list(self.shape),
+            "dtype": self.dtype,
+            "bytes": self.nbytes,
+            "axes": list(self.axes),
+            "world": self.world,
+            "reduce_op": self.reduce_op,
+            "perm": None if self.perm is None else [list(e) for e in self.perm],
+            "path": list(self.path),
+            "source": self.source,
+            "token_tied": self.token_tied,
+            "fingerprint": self.fingerprint,
+        }
+
+    def __str__(self) -> str:
+        where = "/".join(self.path) or "<root>"
+        return f"{self.fingerprint} at {self.source} [{where}]"
+
+
+_OS_PATH = __import__("os").path
+_PKG_DIR = _OS_PATH.dirname(_OS_PATH.dirname(_OS_PATH.abspath(__file__)))
+#: emission plumbing whose frames never count as the user's line (the
+#: models/, parallel/, examples layers *do* — a halo.exchange frame is
+#: exactly what you want named)
+_PLUMBING = (
+    _OS_PATH.join(_PKG_DIR, "ops"),
+    _OS_PATH.join(_PKG_DIR, "token.py"),
+    _OS_PATH.join(_PKG_DIR, "debug.py"),
+    _OS_PATH.join(_PKG_DIR, "validation.py"),
+)
+
+
+def source_of(eqn) -> str:
+    """Best-effort *user* source location of a jaxpr equation, in the
+    clickable ``file.py:line (function)`` format. JAX's own frames are
+    excluded by its source-info machinery; mpi4jax_tpu's emission
+    plumbing (``ops/``, ``token.py``) is filtered here so the location
+    names the caller's line, not our ``emit``."""
+    info = getattr(eqn, "source_info", None)
+    if info is None:
+        return "<unknown>"
+    try:
+        from jax._src import source_info_util as siu
+
+        frame = None
+        try:
+            for fr in siu.user_frames(info):
+                if not fr.file_name.startswith(_PLUMBING):
+                    frame = fr
+                    break
+        except Exception:
+            pass
+        if frame is None:
+            frame = siu.user_frame(info)
+        if frame is not None:
+            return (
+                f"{frame.file_name}:{frame.start_line} "
+                f"({frame.function_name})"
+            )
+        return siu.summarize(info)
+    except Exception:
+        return "<unknown>"
+
+
+def _aval_of(atom):
+    aval = getattr(atom, "aval", None)
+    if aval is None and hasattr(atom, "val"):  # Literal without aval
+        import numpy as np
+
+        return np.asarray(atom.val)
+    return aval
+
+
+def site_from_eqn(
+    eqn,
+    *,
+    index: int,
+    path: Tuple[str, ...],
+    token_tied: bool,
+) -> CollectiveSite:
+    """Normalize a collective equation into a :class:`CollectiveSite`.
+
+    Payload accounting follows ``ops/_core.py``: the first operand is
+    the payload (p2p's recv template describes the same payload again).
+    """
+    prim = eqn.primitive.name
+    shape: Optional[Tuple[int, ...]] = None
+    dtype: Optional[str] = None
+    nbytes = 0
+    if eqn.invars:
+        aval = _aval_of(eqn.invars[0])
+        if aval is not None:
+            try:
+                shape = tuple(int(d) for d in aval.shape)
+                dtype = str(aval.dtype)
+                nbytes = int(
+                    __import__("math").prod(shape) * aval.dtype.itemsize
+                )
+            except (AttributeError, TypeError):
+                pass
+    comm = eqn.params.get("comm")
+    axes = tuple(getattr(comm, "axes", ()) or ())
+    world = getattr(comm, "size", None)
+    reduce_op = None
+    op_param = eqn.params.get("op")
+    if op_param is not None:
+        reduce_op = getattr(op_param, "name", str(op_param))
+    perm = eqn.params.get("perm")
+    if perm is not None:
+        perm = tuple((int(s), int(d)) for s, d in perm)
+    return CollectiveSite(
+        index=index,
+        prim=prim,
+        op=PRIM_TO_OP.get(prim, prim),
+        shape=shape,
+        dtype=dtype,
+        nbytes=nbytes,
+        axes=axes,
+        world=None if world is None else int(world),
+        reduce_op=reduce_op,
+        perm=perm,
+        path=path,
+        source=source_of(eqn),
+        token_tied=token_tied,
+    )

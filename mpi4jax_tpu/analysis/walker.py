@@ -1,0 +1,373 @@
+"""Recursive jaxpr walker + rank-taint dataflow analysis.
+
+Walks a closed jaxpr and every sub-jaxpr reachable from it —
+``cond`` branches, ``scan``/``while`` bodies, ``pjit``/``remat``/
+``shard_map`` calls, ``custom_jvp``/``custom_vjp`` wrappers, and
+generically any equation parameter that holds a (Closed)Jaxpr — and
+produces a :class:`ProgramGraph`:
+
+- every mpi4jax_tpu collective equation as a :class:`.sites.CollectiveSite`
+  in program order,
+- per-``cond`` branch collective sequences (M4T102's subject),
+- per-``while`` body collective lists,
+- a **rank-taint** verdict for every ``cond``/``while`` predicate.
+
+Rank taint is a forward dataflow property: the outputs of
+``axis_index`` equations (``lax.axis_index`` — how a rank learns who
+it is inside SPMD code; ``comm.Get_rank()`` bottoms out there too) are
+tainted, and taint propagates through every equation from any tainted
+operand to all outputs, across sub-jaxpr boundaries, and around
+``scan``/``while`` carries to a fixpoint. A ``cond`` whose predicate
+is tainted — or a ``while`` whose termination test is — means *ranks
+can disagree about which path executes*: the classic SPMD deadlock
+shape (M4T101) when a collective sits on one of those paths.
+
+Known blind spot, by construction: ``jax.process_index()`` returns a
+Python int at trace time and is invisible in the jaxpr — only traced
+rank values (``lax.axis_index`` / ``Comm.Get_rank``) are tracked. In
+a multi-controller program, branching on the Python-level process
+index produces *different jaxprs per process*, which a single-process
+lint cannot see; lint each variant, or use the runtime doctor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from .sites import PRIM_TO_OP, CollectiveSite, site_from_eqn
+
+#: primitives recorded as collective sites
+COLLECTIVE_PRIMS = frozenset(PRIM_TO_OP)
+
+#: equation-parameter keys that are never worth recursing into (they
+#: hold callables/trees, not program structure)
+_SKIP_PARAM_KEYS = frozenset({"fwd_jaxpr_thunk", "bwd", "out_trees"})
+
+_MAX_FIXPOINT_ITERS = 8
+
+
+@dataclasses.dataclass
+class CondInfo:
+    """One ``cond``/``switch`` equation with collectives in scope."""
+
+    source: str
+    path: Tuple[str, ...]
+    pred_tainted: bool
+    #: per-branch collective sequence (jax branch order; for a boolean
+    #: ``lax.cond`` that is (false-branch, true-branch))
+    branch_sites: List[List[CollectiveSite]] = dataclasses.field(
+        default_factory=list
+    )
+
+
+@dataclasses.dataclass
+class WhileInfo:
+    """One ``while`` equation (``lax.while_loop`` / ``fori_loop``)."""
+
+    source: str
+    path: Tuple[str, ...]
+    pred_tainted: bool
+    #: collectives inside the body *and* the termination test
+    body_sites: List[CollectiveSite] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ProgramGraph:
+    """Everything the rule registry consumes."""
+
+    sites: List[CollectiveSite] = dataclasses.field(default_factory=list)
+    conds: List[CondInfo] = dataclasses.field(default_factory=list)
+    whiles: List[WhileInfo] = dataclasses.field(default_factory=list)
+    #: mesh axis names the program is declared/observed to run over:
+    #: the caller's axis_env plus any ``shard_map`` equation's mesh
+    mesh_axes: Set[str] = dataclasses.field(default_factory=set)
+    #: number of ``optimization_barrier`` equations seen anywhere —
+    #: zero with collectives present means the ambient ordering chain
+    #: is absent (M4T104)
+    n_barriers: int = 0
+    #: unmatched ``send``s left pending when the trace closed
+    #: (populated by the linter from the token channel state)
+    pending_sends: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list
+    )
+
+
+def _is_var(atom) -> bool:
+    # Literals carry .val; Vars (incl. DropVar) do not.
+    return not hasattr(atom, "val")
+
+
+class _Walker:
+    def __init__(self, graph: ProgramGraph):
+        self.graph = graph
+
+    # -- taint plumbing -------------------------------------------------
+
+    def _sub_jaxprs(self, eqn):
+        """Yield (param_key, open_jaxpr, consts) for every jaxpr-valued
+        parameter of ``eqn`` (generic fallback path)."""
+        for key, val in eqn.params.items():
+            if key in _SKIP_PARAM_KEYS:
+                continue
+            vals = val if isinstance(val, (tuple, list)) else (val,)
+            for v in vals:
+                if hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):
+                    yield key, v.jaxpr, tuple(v.consts)  # ClosedJaxpr
+                elif hasattr(v, "eqns"):  # open Jaxpr
+                    yield key, v, ()
+
+    def walk(
+        self,
+        jaxpr,
+        taint_in: Sequence[bool],
+        path: Tuple[str, ...],
+        *,
+        record: bool = True,
+    ) -> List[bool]:
+        """Propagate taint through ``jaxpr`` (and, when ``record``,
+        collect collective sites). Returns per-outvar taint."""
+        tainted: Set[Any] = set()
+        producers: Dict[Any, str] = {}
+        invars = list(jaxpr.invars)
+        for v, t in zip(invars, list(taint_in) + [False] * len(invars)):
+            if t:
+                tainted.add(v)
+
+        def taint_of(atom) -> bool:
+            return _is_var(atom) and atom in tainted
+
+        def mark(outvars, flag: bool) -> None:
+            if flag:
+                tainted.update(outvars)
+
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            in_taint = [taint_of(v) for v in eqn.invars]
+            any_in = any(in_taint)
+
+            if name == "optimization_barrier":
+                self.graph.n_barriers += int(record)
+                for o in eqn.outvars:
+                    producers[o] = name
+                mark(eqn.outvars, any_in)
+                continue
+
+            if name == "axis_index":
+                producers[eqn.outvars[0]] = name
+                mark(eqn.outvars, True)
+                continue
+
+            if name in COLLECTIVE_PRIMS:
+                if record and not eqn.params.get("transpose", False):
+                    # transpose=True allreduce is the identity-with-
+                    # allreduce-grad marker: it lowers to *no*
+                    # communication (ops/allreduce.py), so it is not a
+                    # collective site.
+                    tied = bool(eqn.invars) and all(
+                        _is_var(v)
+                        and producers.get(v) == "optimization_barrier"
+                        for v in eqn.invars
+                    )
+                    self.graph.sites.append(
+                        site_from_eqn(
+                            eqn,
+                            index=len(self.graph.sites),
+                            path=path,
+                            token_tied=tied,
+                        )
+                    )
+                for o in eqn.outvars:
+                    producers[o] = name
+                mark(eqn.outvars, any_in)
+                continue
+
+            if name in ("cond", "switch"):
+                out_taint = self._walk_cond(eqn, in_taint, path, record)
+            elif name == "while":
+                out_taint = self._walk_while(eqn, in_taint, path, record)
+            elif name == "scan":
+                out_taint = self._walk_scan(eqn, in_taint, path, record)
+            else:
+                out_taint = self._walk_generic(
+                    eqn, name, in_taint, any_in, path, record
+                )
+
+            for o in eqn.outvars:
+                producers[o] = name
+            for o, t in zip(eqn.outvars, out_taint):
+                if t:
+                    tainted.add(o)
+
+        return [taint_of(v) for v in jaxpr.outvars]
+
+    # -- structured control flow ---------------------------------------
+
+    def _walk_cond(self, eqn, in_taint, path, record) -> List[bool]:
+        pred_tainted = bool(in_taint[0]) if in_taint else False
+        operand_taint = list(in_taint[1:])
+        branches = eqn.params.get("branches", ())
+        info = CondInfo(
+            source=_src(eqn), path=path, pred_tainted=pred_tainted
+        )
+        out_taint = [False] * len(eqn.outvars)
+        for i, br in enumerate(branches):
+            before = len(self.graph.sites)
+            br_out = self.walk(
+                br.jaxpr,
+                operand_taint,
+                path + (f"cond[{i}]",),
+                record=record,
+            )
+            info.branch_sites.append(self.graph.sites[before:])
+            out_taint = [
+                a or b or pred_tainted
+                for a, b in zip(out_taint, br_out + [False] * len(out_taint))
+            ]
+        if record and any(info.branch_sites):
+            self.graph.conds.append(info)
+        return out_taint
+
+    def _walk_while(self, eqn, in_taint, path, record) -> List[bool]:
+        cond_n = eqn.params["cond_nconsts"]
+        body_n = eqn.params["body_nconsts"]
+        cond_jaxpr = eqn.params["cond_jaxpr"].jaxpr
+        body_jaxpr = eqn.params["body_jaxpr"].jaxpr
+        cond_consts = in_taint[:cond_n]
+        body_consts = in_taint[cond_n : cond_n + body_n]
+        carry = list(in_taint[cond_n + body_n :])
+        # taint fixpoint around the carry (no site recording)
+        for _ in range(_MAX_FIXPOINT_ITERS):
+            new_carry = self.walk(
+                body_jaxpr, list(body_consts) + carry, path, record=False
+            )
+            merged = [a or b for a, b in zip(carry, new_carry)]
+            if merged == carry:
+                break
+            carry = merged
+        pred = self.walk(
+            cond_jaxpr, list(cond_consts) + carry, path, record=False
+        )
+        pred_tainted = bool(pred and pred[0])
+        before = len(self.graph.sites)
+        self.walk(
+            cond_jaxpr,
+            list(cond_consts) + carry,
+            path + ("while[cond]",),
+            record=record,
+        )
+        body_out = self.walk(
+            body_jaxpr,
+            list(body_consts) + carry,
+            path + ("while[body]",),
+            record=record,
+        )
+        body_sites = self.graph.sites[before:]
+        if record and body_sites:
+            self.graph.whiles.append(
+                WhileInfo(
+                    source=_src(eqn),
+                    path=path,
+                    pred_tainted=pred_tainted,
+                    body_sites=body_sites,
+                )
+            )
+        return body_out
+
+    def _walk_scan(self, eqn, in_taint, path, record) -> List[bool]:
+        num_consts = eqn.params["num_consts"]
+        num_carry = eqn.params["num_carry"]
+        body = eqn.params["jaxpr"].jaxpr
+        consts = list(in_taint[:num_consts])
+        carry = list(in_taint[num_consts : num_consts + num_carry])
+        xs = list(in_taint[num_consts + num_carry :])
+        for _ in range(_MAX_FIXPOINT_ITERS):
+            out = self.walk(body, consts + carry + xs, path, record=False)
+            new_carry = out[:num_carry]
+            merged = [a or b for a, b in zip(carry, new_carry)]
+            if merged == carry:
+                break
+            carry = merged
+        out = self.walk(
+            body, consts + carry + xs, path + ("scan",), record=record
+        )
+        return out[:num_carry] + out[num_carry:]
+
+    def _walk_generic(
+        self, eqn, name, in_taint, any_in, path, record
+    ) -> List[bool]:
+        """pjit / shard_map / remat / custom_* / pallas / anything that
+        carries sub-jaxprs in its parameters; plain equations taint all
+        outputs from any tainted input."""
+        subs = list(self._sub_jaxprs(eqn))
+        if not subs:
+            return [any_in] * len(eqn.outvars)
+        if name == "shard_map":
+            mesh = eqn.params.get("mesh")
+            axis_names = getattr(mesh, "axis_names", None)
+            if axis_names and record:
+                self.graph.mesh_axes.update(str(a) for a in axis_names)
+        out_taint = [False] * len(eqn.outvars)
+        for key, sub, _consts in subs:
+            frame = _frame_label(name, eqn, key)
+            n_sub = len(sub.invars)
+            n_eqn = len(in_taint)
+            if n_sub <= n_eqn:
+                # consts-last alignment (pjit/closed_call style: the
+                # trailing invars are the mapped operands)
+                mapped = in_taint[n_eqn - n_sub :]
+            else:
+                mapped = list(in_taint) + [False] * (n_sub - n_eqn)
+            sub_out = self.walk(sub, mapped, path + (frame,), record=record)
+            out_taint = [
+                a or b
+                for a, b in zip(
+                    out_taint, sub_out + [False] * len(out_taint)
+                )
+            ]
+        return out_taint
+
+
+def _frame_label(name: str, eqn, key: str) -> str:
+    if name == "pjit":
+        return f"pjit({eqn.params.get('name', '?')})"
+    if name.startswith("remat"):
+        return "remat"
+    if name.startswith("custom_vjp"):
+        return "custom_vjp"
+    if name.startswith("custom_jvp"):
+        return "custom_jvp"
+    if name == "shard_map":
+        return "shard_map"
+    if key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        return name
+    return f"{name}:{key}"
+
+
+def _src(eqn) -> str:
+    from .sites import source_of
+
+    return source_of(eqn)
+
+
+def walk_closed_jaxpr(
+    closed,
+    *,
+    axis_env: Optional[Dict[str, int]] = None,
+    graph: Optional[ProgramGraph] = None,
+) -> ProgramGraph:
+    """Walk a ``ClosedJaxpr`` into a :class:`ProgramGraph`.
+
+    ``axis_env`` declares the mesh axes the program is meant to run
+    over (``{"ranks": 8}``); ``shard_map`` equations found during the
+    walk contribute their mesh axes too. Collectives over any *other*
+    bound axis (a ``vmap`` batching axis, typically) are M4T105's
+    subject.
+    """
+    if graph is None:
+        graph = ProgramGraph()
+    if axis_env:
+        graph.mesh_axes.update(axis_env)
+    jaxpr = getattr(closed, "jaxpr", closed)
+    _Walker(graph).walk(jaxpr, [False] * len(jaxpr.invars), ())
+    return graph

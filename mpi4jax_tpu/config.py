@@ -38,6 +38,15 @@ prefix matching the bench/watch driver family, long prefix accepted):
   events through the sink from a daemon thread (the doctor's
   liveness signal distinguishing a hung rank from a slow one).
 
+Static analysis (``analysis/``):
+
+- ``M4T_STATIC_CHECK``: ``1``/``warn`` -> screen every op emission at
+  trace time with the site-local static rules (self-edge p2p
+  transfers, reduction dtype hazards) and warn once per violation;
+  ``error``/``raise`` -> raise at the offending trace site instead.
+  Off by default; the full-program linter is
+  ``python -m mpi4jax_tpu.analysis``.
+
 Flight recorder (``observability/recorder.py``):
 
 - ``M4T_FLIGHT_RECORDER``: set falsy to disable the always-cheap
@@ -140,6 +149,22 @@ TELEMETRY_RESERVOIR = max(1, env_int("M4T_TELEMETRY_RESERVOIR", 256))
 TELEMETRY_FSYNC = env_flag2("M4T_TELEMETRY_FSYNC", "MPI4JAX_TPU_TELEMETRY_FSYNC")
 #: heartbeat period in seconds (0 = no heartbeat thread)
 HEARTBEAT_S = max(0.0, env_float("M4T_HEARTBEAT", 0.0))
+
+def _static_check_mode() -> str:
+    """Normalize M4T_STATIC_CHECK into '' | 'warn' | 'error'."""
+    value = os.environ.get(
+        "M4T_STATIC_CHECK", os.environ.get("MPI4JAX_TPU_STATIC_CHECK", "")
+    ).lower()
+    if not value or is_falsy(value):
+        return ""
+    if value in ("error", "raise"):
+        return "error"
+    return "warn"  # 1/true/on/warn and anything else truthy
+
+
+#: emission-time static screening mode ('' = off, 'warn', 'error');
+#: see analysis/emit_check.py
+STATIC_CHECK = _static_check_mode()
 
 #: flight recorder: always-cheap in-memory ring of recent collective
 #: emissions (observability/recorder.py); on unless explicitly off

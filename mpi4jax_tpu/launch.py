@@ -91,6 +91,13 @@ def main(argv=None):
         "just on failure (requires --events-dir); a mismatch the "
         "backend happened to survive still gets named",
     )
+    parser.add_argument(
+        "--static-check", choices=("off", "warn", "error"), default="off",
+        help="set M4T_STATIC_CHECK for every rank: screen each op "
+        "emission at trace time with the site-local static-analysis "
+        "rules (analysis/emit_check.py) and warn or raise; the full "
+        "jaxpr linter is `python -m mpi4jax_tpu.analysis`",
+    )
     parser.add_argument("cmd", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
 
@@ -127,6 +134,8 @@ def main(argv=None):
                 M4T_LAUNCHER_PID=str(os.getpid()),
                 JAX_PLATFORMS="cpu",
             )
+            if args.static_check != "off":
+                env["M4T_STATIC_CHECK"] = args.static_check
             if events_dir:
                 # literal {rank} on purpose: each child resolves the
                 # template from its own M4T_RANK (events.py), so the

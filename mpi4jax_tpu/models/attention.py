@@ -172,3 +172,36 @@ def train_step(config: TransformerConfig, params, tokens, targets, n_dp: int = 1
         lambda p, g: p - config.learning_rate * g, params, grads
     )
     return new_params, loss
+
+
+# ---------------------------------------------------------------------
+# static-analysis entry point (python -m mpi4jax_tpu.analysis ...attention)
+# ---------------------------------------------------------------------
+
+
+def _lint_train_step(attention: str = "ring", sp_size: int = 8):
+    """Abstract sequence-parallel training step for the SPMD
+    collective linter (ring attention by default — the
+    CollectivePermute-heavy path)."""
+    from ..analysis import LintTarget
+
+    config = TransformerConfig(
+        vocab=64, d_model=64, n_heads=8, n_layers=2, d_ff=128,
+        sp_axis="sp", sp_size=sp_size, attention=attention,
+    )
+    params = jax.eval_shape(
+        lambda k: init_params(config, k), jax.random.PRNGKey(0)
+    )
+    t_local = 16
+    tokens = jax.ShapeDtypeStruct((t_local,), jnp.int32)
+    return LintTarget(
+        fn=lambda p, tk, tg: train_step(config, p, tk, tg),
+        args=(params, tokens, tokens),
+        axis_env={"sp": sp_size},
+    )
+
+
+M4T_LINT_TARGETS = {
+    "train_step_ring": lambda: _lint_train_step("ring"),
+    "train_step_ulysses": lambda: _lint_train_step("ulysses"),
+}

@@ -128,3 +128,31 @@ def train_step(config: MLPConfig, params, batch, n_dp: int = 1):
         lambda p, g: p - config.learning_rate * g, params, grads
     )
     return new_params, loss
+
+
+# ---------------------------------------------------------------------
+# static-analysis entry point (python -m mpi4jax_tpu.analysis ...mlp)
+# ---------------------------------------------------------------------
+
+
+def _lint_train_step(n_dp: int = 4, tp_size: int = 2):
+    """Abstract dp+tp training step for the SPMD collective linter:
+    shapes only, no devices (analysis.linter.LintTarget)."""
+    from ..analysis import LintTarget
+
+    config = MLPConfig(tp_axis="tp", dp_axis="dp", tp_size=tp_size)
+    params = jax.eval_shape(
+        lambda k: init_params(config, k), jax.random.PRNGKey(0)
+    )
+    batch = (
+        jax.ShapeDtypeStruct((16, config.in_dim), config.dtype),
+        jax.ShapeDtypeStruct((16, config.out_dim), config.dtype),
+    )
+    return LintTarget(
+        fn=lambda p, b: train_step(config, p, b, n_dp=n_dp),
+        args=(params, batch),
+        axis_env={"dp": n_dp, "tp": tp_size},
+    )
+
+
+M4T_LINT_TARGETS = {"train_step": _lint_train_step}

@@ -434,3 +434,31 @@ class ShallowWaterModel:
             ]
             rows.append(np.concatenate(row, axis=1))
         return np.concatenate(rows, axis=0)
+
+
+# ---------------------------------------------------------------------
+# static-analysis entry point (python -m mpi4jax_tpu.analysis ...)
+# ---------------------------------------------------------------------
+
+
+def _lint_step(dims: Tuple[int, int] = (2, 4)):
+    """Abstract per-rank step over a (2, 4) process grid for the SPMD
+    collective linter: the four halo sendrecvs trace with no devices."""
+    import jax as _jax
+
+    from ..analysis import LintTarget
+
+    config = ShallowWaterConfig(nx=16, ny=8, dims=dims)
+    model = ShallowWaterModel(config)
+    block = _jax.ShapeDtypeStruct(
+        (config.ny_local, config.nx_local), config.dtype
+    )
+    state = ModelState(*([block] * 6))
+    return LintTarget(
+        fn=lambda s: model.step(s, first_step=True),
+        args=(state,),
+        axis_env={"ranks": config.n_ranks},
+    )
+
+
+M4T_LINT_TARGETS = {"step": _lint_step}

@@ -30,6 +30,12 @@ CLI::
     python -m mpi4jax_tpu.observability.doctor RUNDIR
     python -m mpi4jax_tpu.observability.doctor rank0.jsonl rank1.jsonl \
         --json --hang-gap 2 --trace merged-trace.json
+    python -m mpi4jax_tpu.observability.doctor RUNDIR \
+        --static train.py:step --static-arg 'f32[1024]'
+
+``--static`` cross-references runtime verdicts against the static
+linter's CollectiveSites (``mpi4jax_tpu/analysis/``) by fingerprint:
+a MISMATCH then names the source line of each diverging collective.
 
 Exit status: 0 clean, 1 findings, 2 no usable input. Used by the
 launcher's hang watchdog (``launch.py --hang-timeout``) to print a
@@ -349,6 +355,85 @@ def diagnose(
 
 
 # ---------------------------------------------------------------------
+# static cross-reference (doctor --static)
+# ---------------------------------------------------------------------
+
+
+def collect_static_sites(
+    target: str,
+    *,
+    arg_specs: Iterable[str] = (),
+    axis_specs: Iterable[str] = (),
+):
+    """Lint ``target`` (``module:fn`` / ``file.py`` / a module with
+    ``M4T_LINT_TARGETS``) and return its CollectiveSites. Imports jax —
+    only reached through ``--static``."""
+    from ..analysis import lint, lint_module
+    from ..analysis.__main__ import (
+        _import_target,
+        _parse_arg_spec,
+        parse_axis_env,
+    )
+
+    module, fn = _import_target(target)
+    axis_env = parse_axis_env(axis_specs)
+    if fn is not None:
+        reports = [
+            lint(
+                fn,
+                tuple(_parse_arg_spec(s) for s in arg_specs),
+                axis_env=axis_env,
+                name=target,
+            )
+        ]
+    else:
+        reports = lint_module(module)
+    for r in reports:
+        if r.error is not None:
+            raise RuntimeError(f"--static {r.target}: {r.error}")
+    return [s for r in reports for s in r.sites]
+
+
+def attach_static_sites(report: Dict[str, Any], sites) -> int:
+    """Join runtime verdicts to static sites by fingerprint (the
+    recorder schema both layers share; the p2p family is canonicalized
+    so a runtime ``Sendrecv`` record matches a static
+    ``CollectivePermute`` equation). Mutates mismatch groups and hang
+    findings in place, adding ``static_sites`` lists; returns how many
+    joins landed."""
+    from ..analysis.sites import canonical_fingerprint
+
+    by_fp: Dict[str, List[Any]] = defaultdict(list)
+    for s in sites:
+        by_fp[canonical_fingerprint(s.fingerprint)].append(s)
+
+    def describe(s):
+        return {
+            "index": s.index,
+            "source": s.source,
+            "path": list(s.path),
+            "fingerprint": s.fingerprint,
+        }
+
+    joined = 0
+    for f in report.get("findings", []):
+        if f.get("kind") == "mismatch":
+            for group in f.get("groups", []):
+                matches = by_fp.get(
+                    canonical_fingerprint(group["fingerprint"]), []
+                )
+                group["static_sites"] = [describe(s) for s in matches]
+                joined += len(matches)
+        elif f.get("kind") == "hang" and f.get("stuck_before"):
+            matches = by_fp.get(
+                canonical_fingerprint(f["stuck_before"]), []
+            )
+            f["static_sites"] = [describe(s) for s in matches]
+            joined += len(matches)
+    return joined
+
+
+# ---------------------------------------------------------------------
 # report formatting
 # ---------------------------------------------------------------------
 
@@ -360,6 +445,16 @@ def _fmt_finding(f: Dict[str, Any]) -> str:
         for group in f["groups"]:
             ranks = ",".join(str(r) for r in group["ranks"])
             lines.append(f"  rank(s) {ranks}: {group['fingerprint']}")
+            for site in group.get("static_sites", ()):
+                where = "/".join(site["path"]) or "<root>"
+                lines.append(
+                    f"    declared at {site['source']} [{where}]"
+                )
+            if "static_sites" in group and not group["static_sites"]:
+                lines.append(
+                    "    (no static site with this fingerprint — "
+                    "different shapes/axes at lint time?)"
+                )
         return "\n".join(lines)
     if kind == "hang":
         head = {
@@ -374,6 +469,9 @@ def _fmt_finding(f: Dict[str, Any]) -> str:
         )
         if f.get("stuck_before"):
             txt += f"\n  peers' next collective was: {f['stuck_before']}"
+        for site in f.get("static_sites", ()):
+            where = "/".join(site["path"]) or "<root>"
+            txt += f"\n    declared at {site['source']} [{where}]"
         return txt
     if kind == "missing_rank":
         return (
@@ -444,6 +542,32 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--json", action="store_true", help="print the report as JSON"
     )
     parser.add_argument(
+        "--static",
+        metavar="TARGET",
+        default=None,
+        help="cross-reference verdicts against the static linter's "
+        "collective sites for TARGET (module:fn, file.py, or a module "
+        "with M4T_LINT_TARGETS): a MISMATCH fingerprint join names the "
+        "offending source line",
+    )
+    parser.add_argument(
+        "--static-arg",
+        action="append",
+        default=[],
+        metavar="SPEC",
+        help="abstract argument for a --static module:fn target "
+        "(e.g. 'f32[64,128]'; repeatable, positional order)",
+    )
+    parser.add_argument(
+        "--static-axis",
+        action="append",
+        default=[],
+        metavar="NAME=SIZE",
+        help="axis binding for the --static lint trace "
+        "(default ranks=8; repeatable; 'none' lints with no bound "
+        "axes — matches launcher-world/shm runtime fingerprints)",
+    )
+    parser.add_argument(
         "--trace",
         metavar="OUT.json",
         default=None,
@@ -460,6 +584,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     if report is None:
         print("doctor: no usable records in the given inputs", file=sys.stderr)
         return 2
+    if args.static:
+        try:
+            sites = collect_static_sites(
+                args.static,
+                arg_specs=args.static_arg,
+                axis_specs=args.static_axis,
+            )
+        except Exception as e:
+            print(f"doctor: --static failed: {e}", file=sys.stderr)
+            return 2
+        joined = attach_static_sites(report, sites)
+        print(
+            f"# static: {len(sites)} site(s) from {args.static}, "
+            f"{joined} fingerprint join(s)",
+            file=sys.stderr,
+        )
     if args.trace:
         from . import trace
 

@@ -34,10 +34,24 @@ import jax
 import jax.extend as jex
 from jax.interpreters import batching, mlir, xla
 
+from .. import config
 from .. import debug
 from .. import observability as _obs
 from ..token import ordered_call
 from ..utils.profiling import emission_scope
+
+
+def _static_check(opname: str, inputs: Tuple, params, bound_comm) -> None:
+    """Opt-in (``M4T_STATIC_CHECK=1|warn|error``) emission-time static
+    screening: the site-local subset of the analysis rules (self-edge
+    p2p transfers, reduction dtype hazards) runs inside the user's
+    first trace, warning or raising per config. The whole-program
+    rules live in ``python -m mpi4jax_tpu.analysis``."""
+    if not config.STATIC_CHECK:
+        return
+    from ..analysis import emit_check
+
+    emit_check.check_emission(opname, inputs, params, bound_comm)
 
 
 def define_primitive(
@@ -223,6 +237,7 @@ def emit_shm(
     Used by op wrappers whose shm path cannot go through the primitive
     (rank-dependent output shapes — gather/scatter root-only semantics —
     or per-process scalar arguments, reference execution model)."""
+    _static_check(opname, inputs, None, bound_comm)
     ident, scope = _telemetry_prologue(
         inputs,
         opname=opname,
@@ -257,6 +272,7 @@ def emit(
 
     Returns a tuple of outputs (even for single-result primitives).
     """
+    _static_check(opname, inputs, params, bound_comm)
     ident, scope = _telemetry_prologue(
         inputs,
         opname=opname,

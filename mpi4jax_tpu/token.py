@@ -184,6 +184,27 @@ def pending_sends() -> List[Dict[str, Any]]:
     return _current_state().pending_sends
 
 
+def drain_pending_sends() -> List[Tuple[Any, List[Dict[str, Any]]]]:
+    """Return and clear *every* trace state's unmatched sends (and any
+    pending poison markers), as ``(trace_key, [send records])`` pairs.
+
+    Two consumers: the test harness's teardown leak check (a test that
+    leaks a send must fail itself, not poison whichever later test
+    next touches the evicted state), and the static linter, which
+    reports sends left pending when its trace closed as M4T103
+    findings. Unlike :func:`check_no_pending_sends` this inspects all
+    registered states, not just the caller's current trace — a leaked
+    send lives under the *traced program's* key, which the caller (in
+    eager context at teardown time) no longer occupies."""
+    leaks: List[Tuple[Any, List[Dict[str, Any]]]] = []
+    for st in _states:
+        if st.pending_sends:
+            leaks.append((st.key, list(st.pending_sends)))
+            st.pending_sends.clear()
+    _poisoned.clear()
+    return leaks
+
+
 def shm_wire():
     """Current shm-backend wire value for this trace (or None).
 

@@ -12,6 +12,8 @@ from __future__ import annotations
 import functools
 import inspect
 
+import numpy as np
+
 import jax
 
 
@@ -21,11 +23,34 @@ def _type_names(types) -> str:
     return " or ".join(t.__name__ for t in types)
 
 
+def _normalize_numpy_scalar(value, types):
+    """Map a numpy scalar onto the matching allowed Python type:
+    ``np.bool_`` -> ``bool`` where ``bool`` is accepted, ``np.integer``
+    -> ``int`` where ``int`` is accepted (``np.int64`` does **not**
+    subclass ``int`` on 64-bit Linux, so a bare isinstance check
+    rejects the most common numpy scalar). Returns the normalized
+    value, or None when no normalization applies. bool is checked
+    first: ``np.bool_`` is not an ``np.integer``, but ``bool`` *is* a
+    subclass of ``int``, so the order here keeps True from turning
+    into 1 unless only ``int`` is accepted."""
+    if isinstance(value, np.bool_):
+        if bool in types:
+            return bool(value)
+        if int in types:
+            return int(value)
+    elif isinstance(value, np.integer) and int in types:
+        return int(value)
+    return None
+
+
 def enforce_types(**argtypes):
     """Decorator: ``@enforce_types(root=int, comm=(type(None), Comm))``.
 
     Accepts numpy-style scalar ints transparently by normalizing with
-    ``int``/``bool`` checks where the expected type allows it.
+    ``int``/``bool`` checks where the expected type allows it: the
+    wrapped function sees a real ``int``/``bool``, so downstream
+    static-parameter hashing and comparisons behave identically no
+    matter whether the caller passed ``3`` or ``np.int64(3)``.
     """
 
     def decorator(fn):
@@ -46,6 +71,10 @@ def enforce_types(**argtypes):
                     types = (types,)
                 if isinstance(value, types):
                     continue
+                normalized = _normalize_numpy_scalar(value, types)
+                if normalized is not None:
+                    bound.arguments[name] = normalized
+                    continue
                 if isinstance(value, jax.core.Tracer):
                     raise TypeError(
                         f"{fn.__name__}: argument {name!r} must be static "
@@ -58,7 +87,7 @@ def enforce_types(**argtypes):
                     f"{fn.__name__}: argument {name!r} must be of type "
                     f"{_type_names(types)}, got {type(value).__name__}"
                 )
-            return fn(*args, **kwargs)
+            return fn(*bound.args, **bound.kwargs)
 
         return wrapped
 

@@ -74,6 +74,31 @@ needs_supported_jax = pytest.mark.skipif(
 )
 
 
+from mpi4jax_tpu import token as _token  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fail_on_leaked_sends(request):
+    """Token-discipline teardown check: a test that issues a ``send``
+    whose ``recv`` never appears leaves the transfer silently
+    unemitted, and (pre-this-fixture) the failure would surface as a
+    confusing RuntimeWarning/poisoned-trace error in whichever *later*
+    test evicted the stale trace state. Drain the channel state around
+    every test so the leaking test fails itself. Tests that leak on
+    purpose opt out with ``@pytest.mark.allow_pending_sends``."""
+    _token.drain_pending_sends()  # isolate from anything earlier
+    yield
+    leaks = _token.drain_pending_sends()
+    if leaks and request.node.get_closest_marker("allow_pending_sends") is None:
+        tags = [rec["tag"] for _key, recs in leaks for rec in recs]
+        n = sum(len(recs) for _key, recs in leaks)
+        pytest.fail(
+            f"test leaked {n} unmatched send(s) (tags {tags}): every "
+            "send must pair with a recv in the same traced program "
+            "(mpi4jax_tpu/ops/p2p.py; token.check_no_pending_sends)"
+        )
+
+
 def pytest_report_header(config):
     # Analog of the reference's vendor/rank/size header
     # (tests/conftest.py:1-9 in the reference).

@@ -1,0 +1,60 @@
+"""Fixed lint-fixture module for the golden JSON schema pin.
+
+tests/test_analysis_selflint.py lints this module's targets and
+compares the full JSON report against tests/data/lint_golden.json
+(same pattern as the Perfetto trace_golden.json pin): any schema
+drift must be an intentional, reviewed change — regenerate with
+
+    python tests/test_analysis_selflint.py --regen
+
+Do not edit casually: source line numbers of this file are part of
+the pinned output.
+"""
+
+N = 4
+
+
+def _target_clean():
+    import jax
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as m4t
+    from mpi4jax_tpu.analysis import LintTarget
+
+    def step(x):
+        y = m4t.allreduce(x)
+        return m4t.allgather(y)
+
+    return LintTarget(
+        fn=step,
+        args=(jax.ShapeDtypeStruct((8,), jnp.float32),),
+        axis_env={"ranks": N},
+    )
+
+
+def _target_divergent():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    import mpi4jax_tpu as m4t
+    from mpi4jax_tpu.analysis import LintTarget
+
+    def step(x):
+        r = lax.axis_index("ranks")
+        y = lax.cond(
+            r == 0, lambda v: m4t.allreduce(v), lambda v: v, x
+        )
+        return m4t.allreduce(y.astype(jnp.bfloat16))
+
+    return LintTarget(
+        fn=step,
+        args=(jax.ShapeDtypeStruct((8,), jnp.float32),),
+        axis_env={"ranks": N},
+    )
+
+
+M4T_LINT_TARGETS = {
+    "clean": _target_clean,
+    "divergent": _target_divergent,
+}

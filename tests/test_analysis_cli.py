@@ -1,0 +1,254 @@
+"""CLI contract (exit 0 clean / 1 findings / 2 error) and the
+``doctor --static`` fingerprint join between runtime MISMATCH
+verdicts and static CollectiveSites."""
+
+import json
+import os
+
+import pytest
+
+from mpi4jax_tpu.analysis.__main__ import main as lint_main
+from mpi4jax_tpu.observability import doctor
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURE = os.path.join(HERE, "data", "lint_fixture.py")
+
+CLEAN_SRC = '''
+import jax.numpy as jnp
+import mpi4jax_tpu as m4t
+
+def step(x):
+    return m4t.allreduce(x)
+'''
+
+BAD_SRC = '''
+import jax.numpy as jnp
+from jax import lax
+import mpi4jax_tpu as m4t
+
+def step(x):
+    r = lax.axis_index("ranks")
+    return lax.cond(r == 0, lambda v: m4t.allreduce(v), lambda v: v, x)
+'''
+
+
+def _write(tmp_path, name, src):
+    path = tmp_path / name
+    path.write_text(src)
+    return str(path)
+
+
+# -- python -m mpi4jax_tpu.analysis -----------------------------------
+
+
+def test_cli_clean_exits_0(tmp_path, capsys):
+    target = _write(tmp_path, "clean_mod.py", CLEAN_SRC)
+    rc = lint_main([f"{target}:step", "--arg", "f32[16]"])
+    assert rc == 0
+    assert "clean: no findings" in capsys.readouterr().out
+
+
+def test_cli_findings_exit_1_and_name_the_line(tmp_path, capsys):
+    target = _write(tmp_path, "bad_mod.py", BAD_SRC)
+    rc = lint_main([f"{target}:step", "--arg", "f32[16]"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "M4T101" in out
+    assert "bad_mod.py:8" in out  # the cond line
+
+
+def test_cli_json_report(tmp_path, capsys):
+    target = _write(tmp_path, "bad_mod2.py", BAD_SRC)
+    rc = lint_main([f"{target}:step", "--arg", "f32[16]", "--json"])
+    assert rc == 1
+    obj = json.loads(capsys.readouterr().out)
+    assert obj["version"] == 1
+    assert obj["n_findings"] >= 1
+    assert obj["reports"][0]["findings"][0]["code"] == "M4T101"
+
+
+def test_cli_axis_override(tmp_path, capsys):
+    target = _write(tmp_path, "clean_mod2.py", CLEAN_SRC)
+    rc = lint_main(
+        [f"{target}:step", "--arg", "f32[16]", "--axis", "ranks=4"]
+    )
+    assert rc == 0
+    assert "'ranks': 4" in capsys.readouterr().out
+
+
+def test_cli_axis_none_lints_launcher_world_resolution(tmp_path, capsys):
+    # --axis none: no bound axes, the multi-controller/shm resolution;
+    # fingerprints carry @<none> like the shm backend's runtime records
+    target = _write(tmp_path, "clean_mod5.py", CLEAN_SRC)
+    rc = lint_main([f"{target}:step", "--arg", "f32[16]", "--axis", "none"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "@<none>" in out
+
+
+def test_cli_axis_none_exclusive(tmp_path, capsys):
+    target = _write(tmp_path, "clean_mod6.py", CLEAN_SRC)
+    rc = lint_main(
+        [f"{target}:step", "--axis", "none", "--axis", "ranks=8"]
+    )
+    assert rc == 2
+
+
+def test_cli_module_targets_registry(capsys):
+    rc = lint_main([FIXTURE])
+    assert rc == 1  # the fixture's divergent target has findings
+    out = capsys.readouterr().out
+    assert "lint_fixture:clean" in out
+    assert "lint_fixture:divergent" in out
+
+
+def test_cli_unimportable_target_exits_2(tmp_path, capsys):
+    rc = lint_main([str(tmp_path / "nope.py")])
+    assert rc == 2
+    assert "cannot resolve" in capsys.readouterr().err
+
+
+def test_cli_missing_function_exits_2(tmp_path, capsys):
+    target = _write(tmp_path, "clean_mod3.py", CLEAN_SRC)
+    rc = lint_main([f"{target}:no_such_fn"])
+    assert rc == 2
+
+
+def test_cli_untraceable_exits_2(tmp_path, capsys):
+    target = _write(tmp_path, "clean_mod4.py", CLEAN_SRC)
+    # wrong rank: bad arg spec shape triggers a trace error, not findings
+    rc = lint_main([f"{target}:step", "--arg", "zzz[16]"])
+    assert rc == 2
+
+
+def test_cli_rules_listing(capsys):
+    rc = lint_main(["--rules"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    for code in ("M4T101", "M4T102", "M4T103", "M4T104", "M4T105", "M4T106"):
+        assert code in out
+
+
+def test_cli_no_registry_module_exits_2(tmp_path, capsys):
+    target = _write(tmp_path, "bare_mod.py", "x = 1\n")
+    rc = lint_main([target])
+    assert rc == 2
+    assert "M4T_LINT_TARGETS" in capsys.readouterr().err
+
+
+# -- doctor --static ---------------------------------------------------
+
+
+def _emission(rank, seq, op, t):
+    return {
+        "kind": "emission", "rank": rank, "seq": seq, "op": op,
+        "shape": [8], "dtype": "float32", "axes": ["ranks"],
+        "world": 3, "bytes": 32, "cid": f"c{rank:02d}{seq:04d}", "t": t,
+    }
+
+
+def _mismatch_rundir(tmp_path):
+    """3 ranks; rank 2 diverges at seq 2 (AllGather vs AllReduce) —
+    both fingerprints exist as static sites in lint_fixture's clean
+    target, so the join can name their source lines."""
+    logs = {
+        0: [_emission(0, 1, "AllReduce", 100.0),
+            _emission(0, 2, "AllReduce", 101.0)],
+        1: [_emission(1, 1, "AllReduce", 100.0),
+            _emission(1, 2, "AllReduce", 101.0)],
+        2: [_emission(2, 1, "AllReduce", 100.0),
+            _emission(2, 2, "AllGather", 101.0)],
+    }
+    for rank, records in logs.items():
+        with open(tmp_path / f"events-rank{rank}.jsonl", "w") as f:
+            for rec in records:
+                f.write(json.dumps(rec) + "\n")
+    return str(tmp_path)
+
+
+def test_doctor_static_joins_mismatch_to_source_line(tmp_path, capsys):
+    d = _mismatch_rundir(tmp_path)
+    rc = doctor.main([d, "--static", FIXTURE])
+    captured = capsys.readouterr()
+    assert rc == 1  # findings
+    assert "MISMATCH at seq 2" in captured.out
+    # both fingerprint groups resolve to lint_fixture source lines
+    assert "declared at" in captured.out
+    assert "lint_fixture.py:25" in captured.out  # allreduce line
+    assert "lint_fixture.py:26" in captured.out  # allgather line
+    assert "fingerprint join" in captured.err
+
+
+def test_doctor_static_json_carries_static_sites(tmp_path, capsys):
+    d = _mismatch_rundir(tmp_path)
+    rc = doctor.main([d, "--static", FIXTURE, "--json"])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    (mismatch,) = [
+        f for f in report["findings"] if f["kind"] == "mismatch"
+    ]
+    for group in mismatch["groups"]:
+        assert "static_sites" in group
+        assert group["static_sites"], group
+        assert "lint_fixture.py" in group["static_sites"][0]["source"]
+
+
+def test_doctor_static_unmatched_fingerprint_says_so(tmp_path, capsys):
+    logs = {
+        0: [_emission(0, 1, "AllReduce", 100.0)],
+        1: [dict(_emission(1, 1, "AllReduce", 100.0), shape=[999])],
+    }
+    for rank, records in logs.items():
+        with open(tmp_path / f"events-rank{rank}.jsonl", "w") as f:
+            for rec in records:
+                f.write(json.dumps(rec) + "\n")
+    rc = doctor.main([str(tmp_path), "--static", FIXTURE])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "no static site with this fingerprint" in captured.out
+
+
+def test_doctor_static_bad_target_exits_2(tmp_path, capsys):
+    d = _mismatch_rundir(tmp_path)
+    rc = doctor.main([d, "--static", str(tmp_path / "missing_mod.py")])
+    assert rc == 2
+    assert "--static failed" in capsys.readouterr().err
+
+
+def test_doctor_without_static_unchanged(tmp_path, capsys):
+    d = _mismatch_rundir(tmp_path)
+    rc = doctor.main([d])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "declared at" not in captured.out
+
+
+# -- conftest leak fixture (the teardown token-discipline check) ------
+
+
+@pytest.mark.allow_pending_sends
+def test_leak_optout_marker_allows_pending_sends():
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as m4t
+    from mpi4jax_tpu import token
+
+    n = 8
+    dest = [(r + 1) % n for r in range(n)]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        jax.make_jaxpr(
+            lambda x: (m4t.send(x, dest), x)[1], axis_env=[("ranks", n)]
+        )(jnp.zeros((4,), jnp.float32))
+    # the leak exists now; the autouse fixture must swallow it because
+    # of the marker (and drain it so nothing bleeds into later tests)
+    assert any(st.pending_sends for st in token._states)
+
+
+def test_drain_pending_sends_clears_all_states():
+    from mpi4jax_tpu import token
+
+    assert token.drain_pending_sends() == []

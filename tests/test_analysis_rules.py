@@ -1,0 +1,384 @@
+"""Rule registry: each seeded-bad fixture is flagged with the correct
+M4T rule code and a source location, clean programs lint with zero
+findings, and the emit-time hook (M4T_STATIC_CHECK) screens the
+site-local subset."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import lax
+
+import mpi4jax_tpu as m4t
+from mpi4jax_tpu.analysis import LintConfig, RULES, lint
+from mpi4jax_tpu.analysis.emit_check import (
+    M4TStaticCheckWarning,
+    StaticCheckError,
+    reset_seen,
+)
+
+N = 8
+X = jnp.zeros((4,), jnp.float32)
+RING_DEST = [(r + 1) % N for r in range(N)]
+RING_SRC = [(r - 1) % N for r in range(N)]
+
+
+def codes(report):
+    return sorted({f.code for f in report.findings})
+
+
+def test_rule_catalog_is_complete():
+    assert list(RULES) == [
+        "M4T101",
+        "M4T102",
+        "M4T103",
+        "M4T104",
+        "M4T105",
+        "M4T106",
+    ]
+
+
+# -- M4T101: rank-divergent control flow ------------------------------
+
+
+def test_m4t101_rank_divergent_cond_around_allreduce():
+    def bad(x):
+        r = lax.axis_index("ranks")
+        return lax.cond(r == 0, lambda v: m4t.allreduce(v), lambda v: v, x)
+
+    rep = lint(bad, (X,), axis_env={"ranks": N})
+    assert "M4T101" in codes(rep)
+    (f,) = [f for f in rep.findings if f.code == "M4T101"]
+    assert f.severity == "error"
+    assert "test_analysis_rules.py" in f.message  # names the cond line
+    assert f.site is not None and f.site.op == "AllReduce"
+
+
+def test_m4t101_rank_divergent_while():
+    def bad(x):
+        r = lax.axis_index("ranks")
+
+        def cond(state):
+            v, it = state
+            return it < r  # per-rank trip count
+
+        def body(state):
+            v, it = state
+            return m4t.allreduce(v), it + 1
+
+        v, _ = lax.while_loop(cond, body, (x, jnp.asarray(0, jnp.int32)))
+        return v
+
+    rep = lint(bad, (X,), axis_env={"ranks": N})
+    assert "M4T101" in codes(rep)
+
+
+def test_m4t101_not_fired_for_uniform_predicate():
+    def ok(x):
+        s = x.sum()  # data-dependent but rank-free dataflow
+        return lax.cond(
+            s > 0, lambda v: m4t.allreduce(v), lambda v: m4t.allreduce(v), x
+        )
+
+    rep = lint(ok, (X,), axis_env={"ranks": N})
+    assert "M4T101" not in codes(rep)
+
+
+# -- M4T102: branch-sequence mismatch ---------------------------------
+
+
+def test_m4t102_branch_sequence_mismatch():
+    def bad(x):
+        # data-dependent (not rank-derived) predicate, diverging
+        # collective sequences: allgather vs allreduce
+        return lax.cond(
+            x.sum() > 0,
+            lambda v: m4t.allreduce(v),
+            lambda v: m4t.allgather(v)[0],
+            x,
+        )
+
+    rep = lint(bad, (X,), axis_env={"ranks": N})
+    assert codes(rep) == ["M4T102"]
+    (f,) = rep.findings
+    assert "AllReduce" in f.message and "AllGather" in f.message
+    assert "test_analysis_rules.py" in f.message
+
+
+def test_m4t102_matching_branches_clean():
+    def ok(x):
+        return lax.cond(
+            x.sum() > 0,
+            lambda v: m4t.allreduce(v),
+            lambda v: m4t.allreduce(v * 2),
+            x,
+        )
+
+    rep = lint(ok, (X,), axis_env={"ranks": N})
+    assert rep.findings == []
+
+
+# -- M4T103: unpaired / self-deadlocking send-recv --------------------
+
+
+def test_m4t103_unpaired_ring_send():
+    def bad(x):
+        m4t.send(x, RING_DEST, tag=5)
+        return x  # no recv: the transfer is silently never emitted
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rep = lint(bad, (X,), axis_env={"ranks": N})
+    assert codes(rep) == ["M4T103"]
+    (f,) = rep.findings
+    assert "tag=5" in f.message and "never matched" in f.message
+
+
+def test_m4t103_self_edge_ring():
+    def bad(x):
+        # shift arithmetic gone degenerate: (r + N) % N == r
+        table = [(r + N) % N for r in range(N)]
+        return m4t.sendrecv(x, x, table, table)
+
+    rep = lint(bad, (X,), axis_env={"ranks": N})
+    assert codes(rep) == ["M4T103"]
+    (f,) = rep.findings
+    assert "self-edges" in f.message
+    assert f.site is not None and "test_analysis_rules.py" in f.site.source
+
+
+def test_m4t103_mirror_mismatch_trace_error_becomes_finding():
+    def bad(x):
+        bad_src = [(r + 1) % N for r in range(N)]  # should be -1 ring
+        return m4t.sendrecv(x, x, bad_src, RING_DEST)
+
+    rep = lint(bad, (X,), axis_env={"ranks": N})
+    assert codes(rep) == ["M4T103"]
+    assert rep.error is None
+
+
+def test_m4t103_proper_ring_clean():
+    def ok(x):
+        m4t.send(x, RING_DEST, tag=1)
+        return m4t.recv(x, RING_SRC, tag=1)
+
+    rep = lint(ok, (X,), axis_env={"ranks": N})
+    assert rep.findings == []
+    assert [s.op for s in rep.sites] == ["CollectivePermute"]
+
+
+# -- M4T104: token discipline -----------------------------------------
+
+
+def test_m4t104_direct_bind_bypasses_token_chain():
+    from mpi4jax_tpu.comm import BoundComm, SUM
+    from mpi4jax_tpu.ops.allreduce import mpi_allreduce_p
+
+    def bad(x):
+        bound = BoundComm(axes=("ranks",), size=N)
+        return mpi_allreduce_p.bind(x, op=SUM, comm=bound, transpose=False)
+
+    rep = lint(bad, (X,), axis_env={"ranks": N})
+    assert codes(rep) == ["M4T104"]
+    assert "optimization_barrier" in rep.findings[0].message
+
+
+def test_m4t104_emitted_ops_are_tied():
+    rep = lint(lambda x: m4t.allreduce(x), (X,), axis_env={"ranks": N})
+    assert rep.findings == []
+    assert rep.sites[0].token_tied
+
+
+# -- M4T105: collective over a non-mesh axis --------------------------
+
+
+def test_m4t105_vmap_over_non_mesh_axis():
+    def inner(x):
+        return m4t.allreduce(x, comm=m4t.Comm("batch"))
+
+    rep = lint(
+        jax.vmap(inner, axis_name="batch"),
+        (jnp.zeros((3, 4), jnp.float32),),
+        axis_env={"ranks": N},
+    )
+    assert codes(rep) == ["M4T105"]
+    (f,) = rep.findings
+    assert f.severity == "warning"
+    assert "batch" in f.message
+
+
+def test_m4t105_declared_axis_is_fine():
+    def inner(x):
+        return m4t.allreduce(x, comm=m4t.Comm("batch"))
+
+    rep = lint(
+        jax.vmap(inner, axis_name="batch"),
+        (jnp.zeros((3, 4), jnp.float32),),
+        axis_env={"ranks": N, "batch": 3},
+    )
+    assert rep.findings == []
+
+
+# -- M4T106: reduction dtype hazards ----------------------------------
+
+
+def test_m4t106_bf16_psum():
+    def bad(x):
+        return m4t.allreduce(x.astype(jnp.bfloat16))
+
+    rep = lint(bad, (X,), axis_env={"ranks": N})
+    assert codes(rep) == ["M4T106"]
+    (f,) = rep.findings
+    assert f.severity == "warning"
+    assert "bfloat16" in f.message
+    assert f.site is not None and "test_analysis_rules.py" in f.site.source
+
+
+def test_m4t106_int8_sum_overflow():
+    def bad(x):
+        return m4t.allreduce(x.astype(jnp.int8))
+
+    rep = lint(bad, (X,), axis_env={"ranks": N})
+    assert codes(rep) == ["M4T106"]
+
+
+def test_m4t106_threshold_config():
+    def f(x):
+        return m4t.allreduce(x.astype(jnp.bfloat16))
+
+    rep = lint(
+        f,
+        (X,),
+        axis_env={"ranks": N},
+        config=LintConfig(low_precision_world=16),
+    )
+    assert rep.findings == []
+
+
+def test_m4t106_max_min_not_flagged():
+    # only SUM accumulates error; MAX/MIN are exact in any dtype
+    def f(x):
+        return m4t.allreduce(x.astype(jnp.bfloat16), op=m4t.MAX)
+
+    rep = lint(f, (X,), axis_env={"ranks": N})
+    assert rep.findings == []
+
+
+# -- disabled rules, report plumbing ----------------------------------
+
+
+def test_rule_disable():
+    def bad(x):
+        return m4t.allreduce(x.astype(jnp.bfloat16))
+
+    rep = lint(
+        bad,
+        (X,),
+        axis_env={"ranks": N},
+        config=LintConfig(disabled=frozenset({"M4T106"})),
+    )
+    assert rep.findings == []
+
+
+def test_report_json_schema_fields():
+    def bad(x):
+        r = lax.axis_index("ranks")
+        return lax.cond(r == 0, lambda v: m4t.allreduce(v), lambda v: v, x)
+
+    rep = lint(bad, (X,), axis_env={"ranks": N})
+    js = rep.to_json()
+    assert js["version"] == 1
+    assert js["axis_env"] == {"ranks": N}
+    assert js["n_sites"] == len(js["sites"]) == 1
+    site = js["sites"][0]
+    for key in (
+        "index", "prim", "op", "shape", "dtype", "bytes", "axes",
+        "world", "path", "source", "fingerprint", "token_tied",
+    ):
+        assert key in site
+    finding = js["findings"][0]
+    for key in ("code", "severity", "message", "source", "sites"):
+        assert key in finding
+
+
+def test_untraceable_function_reports_error_not_crash():
+    def broken(x):
+        raise ValueError("unrelated user bug")
+
+    rep = lint(broken, (X,), axis_env={"ranks": N})
+    assert rep.error is not None and "unrelated user bug" in rep.error
+    assert rep.findings == []
+    assert not rep.clean
+
+
+# -- the emit-time hook (M4T_STATIC_CHECK) ----------------------------
+
+
+@pytest.fixture()
+def static_check_mode(monkeypatch):
+    from mpi4jax_tpu import config
+
+    def set_mode(mode):
+        monkeypatch.setattr(config, "STATIC_CHECK", mode)
+        reset_seen()
+
+    yield set_mode
+    reset_seen()
+
+
+@pytest.mark.telemetry
+def test_emit_check_warns_on_bf16_sum(static_check_mode):
+    static_check_mode("warn")
+    with pytest.warns(M4TStaticCheckWarning, match="M4T106"):
+        jax.make_jaxpr(
+            lambda x: m4t.allreduce(x), axis_env=[("ranks", N)]
+        )(jnp.zeros((4,), jnp.bfloat16))
+
+
+@pytest.mark.telemetry
+def test_emit_check_warns_once_per_site(static_check_mode):
+    static_check_mode("warn")
+
+    def trace():
+        return jax.make_jaxpr(
+            lambda x: m4t.allreduce(x), axis_env=[("ranks", N)]
+        )(jnp.zeros((4,), jnp.bfloat16))
+
+    with pytest.warns(M4TStaticCheckWarning):
+        trace()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # a second warning would raise
+        trace()
+
+
+@pytest.mark.telemetry
+def test_emit_check_error_mode_raises_at_trace(static_check_mode):
+    static_check_mode("error")
+    with pytest.raises(StaticCheckError, match="M4T106"):
+        jax.make_jaxpr(
+            lambda x: m4t.allreduce(x), axis_env=[("ranks", N)]
+        )(jnp.zeros((4,), jnp.bfloat16))
+
+
+@pytest.mark.telemetry
+def test_emit_check_self_edge(static_check_mode):
+    static_check_mode("warn")
+    table = list(range(N))
+    with pytest.warns(M4TStaticCheckWarning, match="M4T103"):
+        jax.make_jaxpr(
+            lambda x: m4t.sendrecv(x, x, table, table),
+            axis_env=[("ranks", N)],
+        )(X)
+
+
+@pytest.mark.telemetry
+def test_emit_check_off_by_default():
+    from mpi4jax_tpu import config
+
+    assert config.STATIC_CHECK == ""
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", M4TStaticCheckWarning)
+        jax.make_jaxpr(
+            lambda x: m4t.allreduce(x), axis_env=[("ranks", N)]
+        )(jnp.zeros((4,), jnp.bfloat16))

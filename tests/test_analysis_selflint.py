@@ -1,0 +1,112 @@
+"""Tier-1 self-lint smoke test + golden JSON schema pin.
+
+ISSUE 3 satellites: ``examples/`` and ``mpi4jax_tpu/models/`` must
+lint clean (their ``M4T_LINT_TARGETS`` declare the per-rank entry
+points with abstract shapes), and the JSON report schema is pinned by
+``tests/data/lint_golden.json`` — the exact reports for the fixed
+fixture module ``tests/data/lint_fixture.py``. Regenerate after an
+intentional schema change::
+
+    python tests/test_analysis_selflint.py --regen
+"""
+
+import importlib
+import json
+import os
+import sys
+
+import pytest
+
+from mpi4jax_tpu.analysis import lint_module, reports_to_json
+from mpi4jax_tpu.analysis.__main__ import _import_target
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+FIXTURE = os.path.join(HERE, "data", "lint_fixture.py")
+GOLDEN = os.path.join(HERE, "data", "lint_golden.json")
+
+MODEL_MODULES = (
+    "mpi4jax_tpu.models.mlp",
+    "mpi4jax_tpu.models.attention",
+    "mpi4jax_tpu.models.shallow_water",
+)
+
+EXAMPLE_FILES = (
+    "examples/cg_solver.py",
+    "examples/zero_optimizer.py",
+    "examples/train_transformer.py",
+    "examples/shallow_water.py",
+)
+
+
+@pytest.mark.parametrize("modname", MODEL_MODULES)
+def test_models_lint_clean(modname):
+    reports = lint_module(importlib.import_module(modname))
+    assert reports, f"{modname} declares no M4T_LINT_TARGETS"
+    for rep in reports:
+        assert rep.error is None, f"{rep.target}: {rep.error}"
+        assert rep.findings == [], (
+            f"{rep.target} is not lint-clean:\n{rep.to_text()}"
+        )
+        assert rep.sites, f"{rep.target} traced no collectives at all?"
+
+
+@pytest.mark.parametrize("relpath", EXAMPLE_FILES)
+def test_examples_lint_clean(relpath):
+    module, _fn = _import_target(os.path.join(REPO, relpath))
+    reports = lint_module(module)
+    assert reports, f"{relpath} declares no M4T_LINT_TARGETS"
+    for rep in reports:
+        assert rep.error is None, f"{rep.target}: {rep.error}"
+        assert rep.findings == [], (
+            f"{rep.target} is not lint-clean:\n{rep.to_text()}"
+        )
+
+
+def _normalize(obj, root):
+    """Strip machine-specific path prefixes from every string so the
+    golden file is location-independent."""
+    if isinstance(obj, str):
+        return obj.replace(root + os.sep, "")
+    if isinstance(obj, list):
+        return [_normalize(v, root) for v in obj]
+    if isinstance(obj, dict):
+        return {k: _normalize(v, root) for k, v in obj.items()}
+    return obj
+
+
+def _fixture_reports_json():
+    module, _fn = _import_target(FIXTURE)
+    obj = reports_to_json(lint_module(module))
+    return json.loads(json.dumps(_normalize(obj, REPO), sort_keys=True))
+
+
+def test_lint_golden_file():
+    """The exact JSON report for the fixed fixture is pinned by a
+    golden file — any schema drift must be an intentional, reviewed
+    change (same pattern as tests/data/trace_golden.json)."""
+    produced = _fixture_reports_json()
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    assert produced == golden
+
+
+def test_fixture_reports_expected_shape():
+    # belt and braces beyond the byte-level pin: the fixture's bad
+    # target trips exactly M4T101 (+102 necessarily) and M4T106
+    module, _fn = _import_target(FIXTURE)
+    reports = {r.target.split(":")[-1]: r for r in lint_module(module)}
+    assert reports["clean"].findings == []
+    bad_codes = sorted({f.code for f in reports["divergent"].findings})
+    assert bad_codes == ["M4T101", "M4T102", "M4T106"]
+
+
+if __name__ == "__main__":
+    # regenerate the golden file after an intentional schema change
+    if "--regen" in sys.argv:
+        with open(GOLDEN, "w") as f:
+            json.dump(_fixture_reports_json(), f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"golden rewritten: {GOLDEN}")
+    else:
+        print("usage: python tests/test_analysis_selflint.py --regen")

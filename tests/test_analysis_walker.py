@@ -1,0 +1,218 @@
+"""Jaxpr walker: nested control flow yields the expected
+CollectiveSite sequences (ISSUE 3 satellite: scan-of-cond, while
+body, remat, pjit-inside-pjit, custom-vjp wrapped collectives)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+import mpi4jax_tpu as m4t
+from mpi4jax_tpu.analysis import lint, trace_sites
+
+N = 8
+X = jnp.zeros((4,), jnp.float32)
+
+
+def _ops(graph):
+    return [s.op for s in graph.sites]
+
+
+def _paths(graph):
+    return [s.path for s in graph.sites]
+
+
+def test_flat_sequence_in_program_order():
+    def f(x):
+        y = m4t.allreduce(x)
+        z = m4t.allgather(y)
+        return m4t.bcast(z, 0)
+
+    g = trace_sites(f, (X,), axis_env={"ranks": N})
+    assert _ops(g) == ["AllReduce", "AllGather", "Bcast"]
+    assert all(p == () for p in _paths(g))
+    # fingerprints carry shape/dtype/axes in the recorder schema
+    assert g.sites[0].fingerprint == "AllReduce[4:float32]@ranks"
+    assert g.sites[0].world == N
+
+
+def test_scan_of_cond_nesting():
+    def f(x):
+        def body(c, _):
+            c = lax.cond(
+                c.sum() > 0,
+                lambda v: m4t.allreduce(v),
+                lambda v: m4t.allreduce(v),
+                c,
+            )
+            return c, None
+
+        y, _ = lax.scan(body, x, None, length=3)
+        return y
+
+    g = trace_sites(f, (X,), axis_env={"ranks": N})
+    # one site per branch, each nested scan -> cond
+    assert _ops(g) == ["AllReduce", "AllReduce"]
+    assert _paths(g) == [("scan", "cond[0]"), ("scan", "cond[1]")]
+    # identical branch sequences: a cond is recorded but matches
+    assert len(g.conds) == 1
+    seqs = [
+        tuple(s.fingerprint for s in br) for br in g.conds[0].branch_sites
+    ]
+    assert seqs[0] == seqs[1]
+
+
+def test_while_body_sites():
+    def f(x):
+        def cond(state):
+            v, it = state
+            return it < 3
+
+        def body(state):
+            v, it = state
+            return m4t.allreduce(v), it + 1
+
+        v, _ = lax.while_loop(cond, body, (x, jnp.asarray(0, jnp.int32)))
+        return v
+
+    g = trace_sites(f, (X,), axis_env={"ranks": N})
+    assert _ops(g) == ["AllReduce"]
+    assert _paths(g) == [("while[body]",)]
+    assert len(g.whiles) == 1
+    assert not g.whiles[0].pred_tainted
+
+
+def test_remat_sites():
+    def f(x):
+        return jax.checkpoint(lambda v: m4t.allreduce(v) * 2.0)(x)
+
+    g = trace_sites(f, (X,), axis_env={"ranks": N})
+    assert _ops(g) == ["AllReduce"]
+    assert _paths(g) == [("remat",)]
+
+
+def test_pjit_inside_pjit():
+    def f(x):
+        inner = jax.jit(lambda q: m4t.allgather(q))
+        return jax.jit(lambda v: inner(v) + 1.0)(x)
+
+    g = trace_sites(f, (X,), axis_env={"ranks": N})
+    assert _ops(g) == ["AllGather"]
+    (path,) = _paths(g)
+    assert len(path) == 2 and all(p.startswith("pjit(") for p in path)
+
+
+def test_custom_vjp_wrapped_collective():
+    @jax.custom_vjp
+    def cv(x):
+        return m4t.allreduce(x)
+
+    cv.defvjp(lambda x: (cv(x), None), lambda res, g: (g,))
+
+    g = trace_sites(cv, (X,), axis_env={"ranks": N})
+    assert _ops(g) == ["AllReduce"]
+    assert _paths(g) == [("custom_vjp",)]
+
+
+def test_grad_through_collective_records_tangent_sites():
+    # AD introduces extra binds (JVP of allreduce is allreduce of the
+    # tangents); the walker must see them all and M4T104 must NOT fire
+    # (the forward emissions carry the barrier chain).
+    def f(x):
+        return m4t.allreduce(x).sum()
+
+    rep = lint(jax.grad(f), (X,), axis_env={"ranks": N})
+    assert [f_.code for f_ in rep.findings] == []
+    assert len(rep.sites) >= 1
+
+
+def test_rank_taint_through_carry_fixpoint():
+    # rank enters the while carry through an arithmetic detour; the
+    # fixpoint must still mark the predicate tainted
+    def f(x):
+        r = lax.axis_index("ranks").astype(jnp.float32)
+
+        def cond(state):
+            v, acc = state
+            return acc < 10.0
+
+        def body(state):
+            v, acc = state
+            return m4t.allreduce(v), acc + r
+
+        v, _ = lax.while_loop(cond, body, (x, jnp.zeros(())))
+        return v
+
+    g = trace_sites(f, (X,), axis_env={"ranks": N})
+    assert len(g.whiles) == 1
+    assert g.whiles[0].pred_tainted
+
+
+def test_rank_uniform_predicate_not_tainted():
+    # a predicate derived from an allreduced value is rank-uniform in
+    # *value*, but the dataflow still passes through the rank-free
+    # path here: no axis_index involved at all
+    def f(x):
+        s = m4t.allreduce(x).sum()
+
+        def cond(state):
+            v, it = state
+            return it < 2
+
+        def body(state):
+            v, it = state
+            return m4t.allreduce(v), it + 1
+
+        v, _ = lax.while_loop(cond, body, (x + s, jnp.asarray(0, jnp.int32)))
+        return v
+
+    g = trace_sites(f, (X,), axis_env={"ranks": N})
+    assert not g.whiles[0].pred_tainted
+
+
+def test_comm_get_rank_taints():
+    def f(x):
+        r = m4t.get_default_comm().Get_rank()
+        return lax.cond(
+            r == 0, lambda v: m4t.allreduce(v), lambda v: v, x
+        )
+
+    g = trace_sites(f, (X,), axis_env={"ranks": N})
+    assert len(g.conds) == 1
+    assert g.conds[0].pred_tainted
+
+
+def test_source_location_points_at_user_code():
+    def f(x):
+        return m4t.allreduce(x)  # the line the site must name
+
+    g = trace_sites(f, (X,), axis_env={"ranks": N})
+    assert "test_analysis_walker.py" in g.sites[0].source
+
+
+def test_transpose_identity_is_not_a_site():
+    # identity_with_allreduce_grad lowers to no communication; its
+    # forward bind must not count as a collective site
+    from mpi4jax_tpu.ops.allreduce import identity_with_allreduce_grad
+
+    g = trace_sites(
+        lambda x: identity_with_allreduce_grad(x),
+        (X,),
+        axis_env={"ranks": N},
+    )
+    assert g.sites == []
+
+
+def test_shard_map_contributes_mesh_axes(mesh):
+    from mpi4jax_tpu.parallel import spmd
+
+    rep = lint(
+        spmd(lambda x: m4t.allreduce(x), mesh=mesh),
+        (np.zeros((N, 4), np.float32),),
+        axis_env={},
+    )
+    assert rep.findings == []
+    (site,) = rep.sites
+    assert site.path[-1] == "shard_map"
+    assert site.axes == ("ranks",)

@@ -1,0 +1,90 @@
+"""``validation.enforce_types``: numpy-scalar normalization regression
+(ISSUE 3 satellite).
+
+The docstring always promised numpy-style scalar ints are "accepted
+transparently by normalizing", but the check was a bare ``isinstance``
+— and ``np.int64`` does **not** subclass ``int`` on 64-bit Linux, so
+``bcast(x, root=np.int64(0))`` (the result of any numpy index
+arithmetic) raised TypeError. Now the wrapper really normalizes:
+the wrapped function receives genuine ``int``/``bool`` values."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m4t
+from mpi4jax_tpu.validation import enforce_types
+
+
+@enforce_types(root=int, flag=bool, comm=(type(None), m4t.Comm))
+def probe(root, flag=False, comm=None):
+    return root, flag
+
+
+def test_python_scalars_pass_through():
+    assert probe(3, flag=True) == (3, True)
+
+
+@pytest.mark.parametrize(
+    "value", [np.int8(3), np.int32(3), np.int64(3), np.uint16(3)]
+)
+def test_numpy_ints_normalized_where_int_allowed(value):
+    root, _ = probe(value)
+    assert root == 3
+    assert type(root) is int  # really normalized, not just accepted
+
+
+def test_numpy_bool_normalized_where_bool_allowed():
+    _, flag = probe(0, flag=np.bool_(True))
+    assert flag is True
+    assert type(flag) is bool
+
+
+def test_numpy_bool_normalizes_to_int_when_only_int_allowed():
+    @enforce_types(n=int)
+    def g(n):
+        return n
+
+    out = g(np.bool_(True))
+    assert out == 1 and type(out) is int
+
+
+def test_numpy_float_still_rejected():
+    with pytest.raises(TypeError, match="must be of type"):
+        probe(np.float32(3.0))
+
+
+def test_traced_value_still_gets_dedicated_error():
+    with pytest.raises(TypeError, match="static"):
+        jax.jit(lambda r: probe(r))(jnp.asarray(0))
+
+
+def test_wrong_type_still_rejected():
+    with pytest.raises(TypeError, match="must be of type"):
+        probe("zero")
+
+
+def test_numpy_int_not_accepted_where_only_bool_allowed():
+    @enforce_types(flag=bool)
+    def g(flag):
+        return flag
+
+    with pytest.raises(TypeError, match="must be of type"):
+        g(np.int32(1))
+
+
+def test_bcast_accepts_numpy_root_end_to_end(run_spmd, per_rank):
+    # the real-world shape of the bug: a root index produced by numpy
+    # arithmetic (np.argmax and friends return np.int64)
+    arr = per_rank(lambda r: np.float32(r))
+    out = run_spmd(
+        lambda x: m4t.bcast(x, root=np.int64(2)), arr.astype(np.float32)
+    )
+    np.testing.assert_allclose(out, np.full_like(arr, 2.0))
+
+
+def test_unknown_argument_name_rejected_at_decoration():
+    with pytest.raises(ValueError, match="no argument"):
+        enforce_types(nope=int)(lambda x: x)
