@@ -60,9 +60,11 @@ sys.path.insert(0, REPO)
 # minimal same-schema fallback writer is kept behind the import guard.
 try:
     from mpi4jax_tpu.observability import events as _events
+    from mpi4jax_tpu.observability import perf as _perf
     from mpi4jax_tpu.observability.events import EventLog
 except Exception:  # pragma: no cover — degraded-host fallback
     _events = None
+    _perf = None
 
     class EventLog:  # type: ignore[no-redef]
         def __init__(self, path, echo=False):
@@ -155,6 +157,27 @@ def log_probe(record):
     return _probe_sink.append(record)
 
 
+#: local perf anomaly watch over probe/stage wall-clock (EWMA+MAD per
+#: key, observability/perf.py): a probe or battery stage that suddenly
+#: takes z-sigma longer than its own baseline is logged as an
+#: ``anomaly`` record in the probe log — mid-run forensics for "the
+#: tunnel got slower before it wedged". Private instance (emit=False):
+#: the verdict belongs in PROBE_LOG, not the default telemetry sink.
+_duration_watch = (
+    _perf.PerfWatch(warmup=5, emit=False) if _perf is not None else None
+)
+
+
+def note_duration(key, seconds, **context):
+    """Feed one probe/stage duration into the local anomaly watch."""
+    if _duration_watch is None:
+        return None
+    anomaly = _duration_watch.observe(key, seconds, **context)
+    if anomaly is not None:
+        log_probe(dict(anomaly))
+    return anomaly
+
+
 def emit_heartbeat(**fields):
     """Periodic liveness record through the shared event layer's
     default sink (``M4T_TELEMETRY_EVENTS``; no-op when unset or when
@@ -212,6 +235,10 @@ def probe(attempt, prev_outcome):
             "last_battery_activity": dict(_last_activity),
         }
     log_probe(record)
+    # healthy-probe latency through the anomaly watch: a chip that
+    # still answers but ever slower is a wedge announcing itself
+    if outcome == "ok":
+        note_duration("probe.ok", elapsed, attempt=attempt)
     return outcome, info, variant
 
 
@@ -248,9 +275,16 @@ def stage(results, name, cmd, env, timeout=None, expect=None):
         if os.path.exists(path):
             os.replace(path, path + ".prev")
             moved.append(rel)
+    t0 = time.perf_counter()
     rc, out = _run(cmd, env, timeout or STAGE_TIMEOUT_S)
     note_activity(name, rc)
     emit_heartbeat(stage=name, exit_code=rc)
+    if rc == 0:
+        # successful-stage wall-clock through the anomaly watch (a
+        # failed/wedged stage has its own record; only healthy runs
+        # define the baseline)
+        note_duration(f"stage.{name}", time.perf_counter() - t0,
+                      exit_code=rc)
     rec = {
         "exit_code": rc,
         "tail": None if rc == 0 else (out or "")[-2000:],

@@ -47,6 +47,24 @@ Static analysis (``analysis/``):
   Off by default; the full-program linter is
   ``python -m mpi4jax_tpu.analysis``.
 
+Performance attribution (``observability/{costmodel,perf}.py``):
+
+- ``M4T_PEAK_GBPS``: float -> peak link bandwidth (GB/s) the cost
+  model measures achieved bandwidth against. Unset: per-generation
+  ICI defaults by ``device_kind`` (``costmodel.ICI_PEAK_GBPS``, the
+  companion of ``benchmarks/roofline.py``'s HBM table), falling back
+  to a conservative single-host default.
+- ``M4T_ALPHA_US``: float -> per-step latency term (microseconds) of
+  the alpha-beta expected-time model (default 1.0).
+- ``M4T_PERF_WATCH``: truthy -> live anomaly watch: runtime latency
+  samples stream through a per-fingerprint EWMA+MAD baseline and
+  regressions beyond the z-threshold emit ``anomaly`` events and a
+  one-line warning (requires ``M4T_TELEMETRY_RUNTIME`` for the
+  samples to exist; the watch itself is host-side only).
+- ``M4T_PERF_Z``: float -> anomaly z-score threshold (default 6.0).
+- ``M4T_PERF_WARMUP``: int -> samples per fingerprint before the
+  watch may flag anything (default 10).
+
 Flight recorder (``observability/recorder.py``):
 
 - ``M4T_FLIGHT_RECORDER``: set falsy to disable the always-cheap
@@ -149,6 +167,18 @@ TELEMETRY_RESERVOIR = max(1, env_int("M4T_TELEMETRY_RESERVOIR", 256))
 TELEMETRY_FSYNC = env_flag2("M4T_TELEMETRY_FSYNC", "MPI4JAX_TPU_TELEMETRY_FSYNC")
 #: heartbeat period in seconds (0 = no heartbeat thread)
 HEARTBEAT_S = max(0.0, env_float("M4T_HEARTBEAT", 0.0))
+
+#: cost-model peak link bandwidth override in GB/s (0 = auto: match
+#: the device generation, else costmodel.DEFAULT_PEAK_GBPS)
+PEAK_GBPS = max(0.0, env_float("M4T_PEAK_GBPS", 0.0))
+#: alpha-beta model per-step latency term, microseconds
+ALPHA_US = max(0.0, env_float("M4T_ALPHA_US", 1.0))
+#: live perf anomaly watch over runtime latency samples
+PERF_WATCH = env_flag2("M4T_PERF_WATCH", "MPI4JAX_TPU_PERF_WATCH")
+#: anomaly z-score threshold
+PERF_Z = max(1.0, env_float("M4T_PERF_Z", 6.0))
+#: per-fingerprint warmup sample count before anomalies can fire
+PERF_WARMUP = max(2, env_int("M4T_PERF_WARMUP", 10))
 
 def _static_check_mode() -> str:
     """Normalize M4T_STATIC_CHECK into '' | 'warn' | 'error'."""

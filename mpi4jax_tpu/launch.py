@@ -58,6 +58,28 @@ def _run_doctor(events_dir):
         sys.stderr.write(f"mpi4jax_tpu.launch: doctor failed: {exc!r}\n")
 
 
+def _run_perf_report(events_dir):
+    """``--perf``: join the per-rank latency events against the
+    analytic cost model and print the achieved-bandwidth table.
+    Best-effort like the doctor."""
+    try:
+        from .observability import doctor, perf
+
+        by_rank = doctor.load([events_dir])
+        if not by_rank:
+            sys.stderr.write(
+                f"mpi4jax_tpu.launch: no telemetry records in "
+                f"{events_dir}; no perf attribution\n"
+            )
+            return
+        sys.stderr.write(
+            "mpi4jax_tpu.launch: perf attribution "
+            f"({events_dir}):\n{perf.format_table(perf.attribute(by_rank))}\n"
+        )
+    except Exception as exc:  # pragma: no cover — attribution best-effort
+        sys.stderr.write(f"mpi4jax_tpu.launch: perf report failed: {exc!r}\n")
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="python -m mpi4jax_tpu.launch", description=__doc__
@@ -92,6 +114,15 @@ def main(argv=None):
         "backend happened to survive still gets named",
     )
     parser.add_argument(
+        "--perf", action="store_true",
+        help="performance attribution mode (requires --events-dir): "
+        "every rank samples per-op runtime latency "
+        "(M4T_TELEMETRY_RUNTIME) and runs the live anomaly watch "
+        "(M4T_PERF_WATCH — a collective regressing mid-run warns "
+        "immediately); at the end the launcher prints the per-op "
+        "achieved-bandwidth / %%-of-peak table",
+    )
+    parser.add_argument(
         "--static-check", choices=("off", "warn", "error"), default="off",
         help="set M4T_STATIC_CHECK for every rank: screen each op "
         "emission at trace time with the site-local static-analysis "
@@ -113,6 +144,9 @@ def main(argv=None):
         parser.error("missing script")
 
     events_dir = args.events_dir
+    if args.perf and not events_dir:
+        parser.error("--perf requires --events-dir (it reads the "
+                     "per-rank latency events back)")
     if events_dir:
         events_dir = os.path.abspath(events_dir)
         os.makedirs(events_dir, exist_ok=True)
@@ -149,6 +183,11 @@ def main(argv=None):
                     M4T_FLIGHT_RECORDER_DIR=events_dir,
                     M4T_HEARTBEAT=str(args.heartbeat),
                 )
+                if args.perf:
+                    env.update(
+                        M4T_TELEMETRY_RUNTIME="1",
+                        M4T_PERF_WATCH="1",
+                    )
             cmd = [sys.executable]
             if os.environ.get("M4T_LAUNCH_COVERAGE"):
                 # Run each rank under parallel-mode coverage so CI can
@@ -220,6 +259,8 @@ def main(argv=None):
             time.sleep(0.02)
         if events_dir and (hung or exit_code != 0 or args.doctor):
             _run_doctor(events_dir)
+        if events_dir and args.perf:
+            _run_perf_report(events_dir)
         return exit_code
     except KeyboardInterrupt:
         for p in procs:

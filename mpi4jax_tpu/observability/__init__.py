@@ -28,7 +28,19 @@ Cross-rank (offline, artifact-driven):
    stragglers.
 6. **trace** (:mod:`.trace`) — export merged logs to Chrome
    trace-event JSON (Perfetto): one track per rank, latency slices,
-   payload-byte counters.
+   payload-byte and achieved-bandwidth counters.
+
+Performance attribution (:mod:`.costmodel` + :mod:`.perf`):
+
+7. **cost model** — analytic per-op expected wire bytes / algorithm
+   steps / alpha-beta expected time from the emission fingerprints
+   the layers above already record.
+8. **perf** — achieved-bandwidth / %-of-peak attribution
+   (:func:`perf_report` live, ``python -m
+   mpi4jax_tpu.observability.perf report`` offline, ``doctor
+   --perf``), a live EWMA+MAD anomaly watch over runtime latency
+   samples (``M4T_PERF_WATCH=1``), and the ``perf
+   {history,gate,compare}`` bench-trajectory regression CLI.
 
 Layers 1–3 are no-ops unless enabled (``M4T_TELEMETRY=1`` or
 :func:`enable`); the flight recorder stays on (one dict append per
@@ -54,6 +66,23 @@ from .metrics import (  # noqa: F401
 from .recorder import FlightRecorder  # noqa: F401
 from .recorder import recorder as flight_recorder  # noqa: F401
 
+
+def __getattr__(name):
+    # costmodel/perf resolve lazily (like doctor/trace they are
+    # offline-analysis modules; eager import here would also make
+    # `python -m mpi4jax_tpu.observability.perf` warn about the
+    # module pre-existing in sys.modules)
+    if name in ("costmodel", "perf"):
+        import importlib
+
+        return importlib.import_module(f".{name}", __name__)
+    if name in ("PerfWatch", "perf_report"):
+        from . import perf as _perf
+
+        return getattr(_perf, {"PerfWatch": "PerfWatch",
+                               "perf_report": "perf_report"}[name])
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 # doctor/trace are import-light (no jax) but only needed offline;
 # imported lazily by their CLIs and by launch.py's watchdog.
 
@@ -68,13 +97,17 @@ if _config.HEARTBEAT_S > 0 and events.get_sink() is not None:
 __all__ = [
     "FlightRecorder",
     "MetricsRegistry",
+    "PerfWatch",
     "Reservoir",
+    "costmodel",
     "disable",
     "enable",
     "enabled",
     "events",
     "flight_recorder",
     "metrics",
+    "perf",
+    "perf_report",
     "recorder",
     "registry",
     "report",

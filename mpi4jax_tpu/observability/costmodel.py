@@ -1,0 +1,236 @@
+"""Analytic collective cost model: fingerprint -> wire bytes, steps,
+expected time.
+
+The telemetry layers record *what* communicated (op, payload bytes,
+dtype, mesh axes, world size — ``metrics.py`` / ``recorder.py``
+emission fingerprints) and, with runtime sampling, *how long* it took.
+This module supplies the missing third column: how long it *should*
+take, so achieved bandwidth and %-of-peak fall out of a join
+(:mod:`.perf`) instead of a profiler session.
+
+Per op the model gives the **per-rank bytes on the wire** and the
+**algorithm step count** of the standard algorithm XLA/this package
+uses (topology-aware collective cost modelling in the Cloud
+Collectives sense, arXiv:2105.14088):
+
+====================  =======================  ==================
+op                    wire bytes (per rank)    steps
+====================  =======================  ==================
+AllReduce             2 (n-1)/n * B            2 (n-1)   ring RS+AG
+ReduceScatter         (n-1)/n * B              n-1       ring
+AllGather             (n-1) * B                n-1       ring (B = shard)
+AllToAll              (n-1)/n * B              n-1       pairwise
+Bcast / Reduce        B                        ceil(log2 n)  tree
+Gather / Scatter      (n-1) * B                n-1       linear @ root
+Scan                  B                        n-1       chain
+Barrier               0                        ceil(log2 n)
+Send/Recv/Sendrecv/
+CollectivePermute     B                        1
+QuantizedAllReduce    2 (n-1) * q(B/n)         2 (n-1)   int8 ring
+====================  =======================  ==================
+
+where ``B`` is the recorded payload bytes of the emission and
+``q(...)`` is the quantized wire format (int8 + one f32 scale per
+256-value block; the canonical implementation lives beside the kernel
+in ``ops/quantized.py`` — ``wire_format_bytes`` / ``ring_chunk_elems``
+— and a test pins this module's mirror to it so the two cannot
+drift). Expected time is the alpha-beta model
+
+    t = steps * alpha + wire_bytes / (peak_gbps * 1e9)
+
+with ``alpha`` from ``M4T_ALPHA_US`` (default 1 us/step) and
+``peak_gbps`` from ``M4T_PEAK_GBPS`` or the per-generation ICI table
+below (the companion of ``benchmarks/roofline.py``'s HBM table).
+
+Import-light on purpose (no jax): the offline consumers (doctor,
+perf CLI) parse logs on hosts where importing a backend is either
+slow or impossible.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Any, Dict, Optional
+
+from .. import config
+
+#: nominal aggregate ICI bandwidth by TPU generation, GB/s per chip
+#: (public TPU system-architecture docs: v4 2400 Gbit/s, v5e 1600,
+#: v5p 4800, v6e 3584). Substring-matched on ``device_kind``, same
+#: convention as ``benchmarks/roofline.py:HBM_PEAK_GBPS``.
+ICI_PEAK_GBPS = {
+    "v5 lite": 200.0,  # v5e reports device_kind "TPU v5 lite"
+    "v5litepod": 200.0,
+    "v5e": 200.0,
+    "v5p": 600.0,
+    "v4": 300.0,
+    "v6 lite": 448.0,
+    "v6e": 448.0,
+}
+
+#: fallback peak when no generation matches (CPU container / shm
+#: backend: a conservative single-host memory-channel figure — the
+#: point of the default is a finite, explicit denominator, not a
+#: hardware claim; override with M4T_PEAK_GBPS)
+DEFAULT_PEAK_GBPS = 25.0
+
+#: quantized wire format mirror (ops/quantized.py: _BLOCK, int8
+#: payload + one f32 scale per block); pinned by
+#: tests/test_perf.py::test_quantized_mirror_matches_kernel
+_QUANT_BLOCK = 256
+
+
+def peak_gbps(device_kind: Optional[str] = None) -> float:
+    """The peak link bandwidth the attribution divides by:
+    ``M4T_PEAK_GBPS`` when set, else the generation table keyed by
+    ``device_kind``, else :data:`DEFAULT_PEAK_GBPS`."""
+    # read the env dynamically (not the import-time snapshot) so the
+    # CLI and tests can retarget without reloading the module
+    raw = os.environ.get("M4T_PEAK_GBPS", "")
+    if raw:
+        try:
+            value = float(raw)
+            if value > 0:
+                return value
+        except ValueError:
+            pass
+    elif config.PEAK_GBPS > 0:
+        return config.PEAK_GBPS
+    if device_kind:
+        kind = device_kind.lower()
+        for key, gbps in ICI_PEAK_GBPS.items():
+            if key in kind:
+                return gbps
+    return DEFAULT_PEAK_GBPS
+
+
+def alpha_s() -> float:
+    """Per-step latency term of the alpha-beta model, seconds."""
+    raw = os.environ.get("M4T_ALPHA_US", "")
+    if raw:
+        try:
+            return max(0.0, float(raw)) * 1e-6
+        except ValueError:
+            pass
+    return config.ALPHA_US * 1e-6
+
+
+#: dtype -> itemsize for the dtypes the op layer records; numpy is
+#: deliberately not consulted (bfloat16 needs ml_dtypes registration)
+_ITEMSIZE = {
+    "float64": 8, "int64": 8, "uint64": 8, "complex64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "float16": 2, "bfloat16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool": 1,
+    "complex128": 16,
+}
+
+
+def itemsize(dtype: Optional[str]) -> int:
+    return _ITEMSIZE.get(str(dtype or ""), 4)
+
+
+def _quant_wire_format_bytes(n_elems: int) -> int:
+    if n_elems <= 0:
+        return 0
+    return int(n_elems) + 4 * (-(-int(n_elems) // _QUANT_BLOCK))
+
+
+def _quant_ring_chunk_elems(total_elems: int, world: int) -> int:
+    if world <= 1:
+        return 0
+    chunk = -(-int(total_elems) // int(world))
+    return -(-chunk // _QUANT_BLOCK) * _QUANT_BLOCK
+
+
+def cost(
+    op: str,
+    *,
+    nbytes: int,
+    world: Optional[int],
+    dtype: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Expected per-rank wire bytes and algorithm steps for one
+    emission. Returns ``{"op", "wire_bytes", "steps", "algorithm"}``;
+    unknown ops get the conservative identity model (wire = payload,
+    1 step) with ``algorithm: "unknown"``."""
+    n = int(world) if world else 1
+    b = max(0, int(nbytes))
+    if n <= 1:
+        return {"op": op, "wire_bytes": 0, "steps": 0,
+                "algorithm": "local (world size 1)"}
+    log2n = int(math.ceil(math.log2(n)))
+    if op == "AllReduce":
+        return {"op": op, "wire_bytes": int(round(2 * (n - 1) * b / n)),
+                "steps": 2 * (n - 1),
+                "algorithm": "ring reduce-scatter + all-gather"}
+    if op == "ReduceScatter":
+        return {"op": op, "wire_bytes": int(round((n - 1) * b / n)),
+                "steps": n - 1, "algorithm": "ring"}
+    if op == "AllGather":
+        # B is the local shard (the op's input operand): each rank
+        # forwards its shard around the whole ring
+        return {"op": op, "wire_bytes": (n - 1) * b, "steps": n - 1,
+                "algorithm": "ring"}
+    if op == "AllToAll":
+        return {"op": op, "wire_bytes": int(round((n - 1) * b / n)),
+                "steps": n - 1, "algorithm": "pairwise exchange"}
+    if op in ("Bcast", "Reduce"):
+        return {"op": op, "wire_bytes": b, "steps": log2n,
+                "algorithm": "binomial tree"}
+    if op in ("Gather", "Scatter"):
+        # root-link bottleneck: the root moves every peer's block
+        return {"op": op, "wire_bytes": (n - 1) * b, "steps": n - 1,
+                "algorithm": "linear at root"}
+    if op == "Scan":
+        return {"op": op, "wire_bytes": b, "steps": n - 1,
+                "algorithm": "chain"}
+    if op == "Barrier":
+        return {"op": op, "wire_bytes": 0, "steps": log2n,
+                "algorithm": "dissemination"}
+    if op in ("Send", "Recv", "Sendrecv", "CollectivePermute",
+              "PallasRing"):
+        return {"op": op, "wire_bytes": b, "steps": 1,
+                "algorithm": "point-to-point"}
+    if op == "QuantizedAllReduce":
+        elems = b // itemsize(dtype)
+        chunk = _quant_ring_chunk_elems(elems, n)
+        hop = _quant_wire_format_bytes(chunk)
+        return {"op": op, "wire_bytes": 2 * (n - 1) * hop,
+                "steps": 2 * (n - 1),
+                "algorithm": "int8 ring (absmax/256 block scales)"}
+    return {"op": op, "wire_bytes": b, "steps": 1, "algorithm": "unknown"}
+
+
+def record_cost(record: Dict[str, Any]) -> Dict[str, Any]:
+    """Cost of one emission/recorder record (the JSONL schema both
+    sinks share)."""
+    return cost(
+        record.get("op", "?"),
+        nbytes=record.get("bytes") or 0,
+        world=record.get("world"),
+        dtype=record.get("dtype"),
+    )
+
+
+def expected_time_s(
+    c: Dict[str, Any],
+    *,
+    gbps: Optional[float] = None,
+    alpha: Optional[float] = None,
+) -> float:
+    """Alpha-beta expected time for a cost dict: steps * alpha +
+    wire_bytes / peak."""
+    gbps = peak_gbps() if gbps is None else float(gbps)
+    alpha = alpha_s() if alpha is None else float(alpha)
+    beta = c["wire_bytes"] / (gbps * 1e9) if gbps > 0 else 0.0
+    return c["steps"] * alpha + beta
+
+
+def achieved_gbps(c: Dict[str, Any], seconds: float) -> Optional[float]:
+    """Achieved wire bandwidth for a measured latency (None when the
+    op moved no bytes or the measurement is unusable)."""
+    if seconds <= 0 or c["wire_bytes"] <= 0:
+        return None
+    return c["wire_bytes"] / seconds / 1e9

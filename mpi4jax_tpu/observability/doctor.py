@@ -63,8 +63,13 @@ from .recorder import fingerprint
 DEFAULT_HANG_GAP = 1
 
 #: a rank is a straggler when its mean op latency exceeds the median
-#: of the per-rank means by this factor (with >= 3 samples)
+#: of the per-rank means by this factor
 DEFAULT_STRAGGLER_RATIO = 2.0
+
+#: minimum per-op latency samples a rank needs before it may be
+#: compared at all: a single slow sample (first-execution warmup, a
+#: page fault) must not brand a rank a straggler
+DEFAULT_STRAGGLER_MIN_SAMPLES = 5
 
 _RANK_RE = re.compile(r"rank[-_]?(\d+)")
 
@@ -271,9 +276,17 @@ def _find_hang(
 
 
 def _find_stragglers(
-    by_rank: Dict[int, List[Dict[str, Any]]], ratio: float
+    by_rank: Dict[int, List[Dict[str, Any]]],
+    ratio: float,
+    min_samples: int = DEFAULT_STRAGGLER_MIN_SAMPLES,
 ) -> List[Dict[str, Any]]:
-    """Per-op, per-rank mean runtime latency vs the median rank."""
+    """Per-op, per-rank mean runtime latency vs the median rank.
+    Ranks with fewer than ``min_samples`` samples for an op are not
+    compared (in either direction): one noisy sample is not evidence,
+    and a rank with thin data must not serve as a peer baseline
+    either. The finding payload carries every compared rank's sample
+    count so the verdict's statistical footing is auditable."""
+    min_samples = max(1, int(min_samples))
     samples: Dict[str, Dict[int, List[float]]] = defaultdict(lambda: defaultdict(list))
     for rank, recs in by_rank.items():
         for rec in recs:
@@ -286,7 +299,7 @@ def _find_stragglers(
         means = {
             rank: sum(vals) / len(vals)
             for rank, vals in per_rank.items()
-            if len(vals) >= 3
+            if len(vals) >= min_samples
         }
         if len(means) < 2:
             continue
@@ -307,6 +320,12 @@ def _find_stragglers(
                         "peer_median_s": peer_median,
                         "ratio": mean / peer_median,
                         "samples": len(per_rank[rank]),
+                        "min_samples": min_samples,
+                        "peer_samples": {
+                            str(r): len(per_rank[r])
+                            for r in sorted(means)
+                            if r != rank
+                        },
                     }
                 )
     return findings
@@ -317,6 +336,7 @@ def analyze(
     *,
     hang_gap: int = DEFAULT_HANG_GAP,
     straggler_ratio: float = DEFAULT_STRAGGLER_RATIO,
+    straggler_min_samples: int = DEFAULT_STRAGGLER_MIN_SAMPLES,
 ) -> Dict[str, Any]:
     """Run every cross-rank analysis; returns a plain-JSON report:
     ``{"ranks": [...], "seqs": {rank: last_seq}, "findings": [...]}``
@@ -327,7 +347,7 @@ def analyze(
     findings = (
         _find_mismatch(streams)
         + _find_hang(streams, by_rank, hang_gap)
-        + _find_stragglers(by_rank, straggler_ratio)
+        + _find_stragglers(by_rank, straggler_ratio, straggler_min_samples)
     )
     return {
         "ranks": sorted(by_rank),
@@ -344,13 +364,17 @@ def diagnose(
     *,
     hang_gap: int = DEFAULT_HANG_GAP,
     straggler_ratio: float = DEFAULT_STRAGGLER_RATIO,
+    straggler_min_samples: int = DEFAULT_STRAGGLER_MIN_SAMPLES,
 ) -> Optional[Dict[str, Any]]:
     """Load + analyze; None when the inputs held no usable records."""
     by_rank = load(inputs)
     if not by_rank:
         return None
     return analyze(
-        by_rank, hang_gap=hang_gap, straggler_ratio=straggler_ratio
+        by_rank,
+        hang_gap=hang_gap,
+        straggler_ratio=straggler_ratio,
+        straggler_min_samples=straggler_min_samples,
     )
 
 
@@ -539,6 +563,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         "exceeds the peer median by Rx (default %(default)s)",
     )
     parser.add_argument(
+        "--straggler-min-samples",
+        type=int,
+        default=DEFAULT_STRAGGLER_MIN_SAMPLES,
+        metavar="N",
+        help="per-op latency samples a rank needs before straggler "
+        "comparison considers it at all (default %(default)s; guards "
+        "against single-sample noise)",
+    )
+    parser.add_argument(
+        "--perf",
+        action="store_true",
+        help="append the perf attribution section: per-op achieved "
+        "bandwidth and %%-of-peak from the same logs, via the "
+        "analytic cost model (observability/perf.py)",
+    )
+    parser.add_argument(
         "--json", action="store_true", help="print the report as JSON"
     )
     parser.add_argument(
@@ -580,6 +620,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.inputs,
         hang_gap=args.hang_gap,
         straggler_ratio=args.straggler_ratio,
+        straggler_min_samples=args.straggler_min_samples,
     )
     if report is None:
         print("doctor: no usable records in the given inputs", file=sys.stderr)
@@ -609,6 +650,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(json.dumps(report, indent=1, default=str))
     else:
         print(format_report(report))
+    if args.perf:
+        from . import perf
+
+        print()
+        print(perf.format_table(perf.attribute(load(args.inputs))))
     return 1 if report["findings"] else 0
 
 

@@ -163,10 +163,11 @@ class MetricsRegistry:
         self._seq = 0
         #: cid -> host-clock start mark for in-flight runtime samples
         self._inflight: Dict[str, float] = {}
-        #: cid -> global seq of the emission, bounded alongside the
-        #: emission ring, so runtime latency samples inherit their
-        #: emission's alignment key in the event stream
-        self._cid_seq: Dict[str, int] = {}
+        #: cid -> emission record, bounded alongside the emission
+        #: ring, so runtime latency samples inherit their emission's
+        #: alignment key (seq) in the event stream and the perf watch
+        #: can key its baseline by the full fingerprint
+        self._cid_rec: Dict[str, Dict[str, Any]] = {}
         self._created = time.time()
 
     # -- recording ---------------------------------------------------
@@ -221,8 +222,8 @@ class MetricsRegistry:
             record["op_seq"] = m.seq
             if len(self._emissions) == self._emissions.maxlen:
                 evicted = self._emissions[0]
-                self._cid_seq.pop(evicted["cid"], None)
-            self._cid_seq[cid] = self._seq
+                self._cid_rec.pop(evicted["cid"], None)
+            self._cid_rec[cid] = record
             self._emissions.append(record)
         return record
 
@@ -238,7 +239,9 @@ class MetricsRegistry:
         the callbacks arrived out of order). The sample is mirrored as
         a ``latency`` event through the default sink (no-op without
         one) so the doctor can see per-rank runtime behavior —
-        straggler detection — from the log files alone."""
+        straggler detection — from the log files alone. The sample
+        also feeds the live perf anomaly watch (inert unless
+        ``M4T_PERF_WATCH``), keyed by the emission's fingerprint."""
         now = time.perf_counter()
         with self._lock:
             start = self._inflight.pop(cid, None)
@@ -249,19 +252,20 @@ class MetricsRegistry:
             if m is None:
                 m = self._ops[op] = OpMetrics(op, self._reservoir)
             m.latency.add(sample)
-            seq = self._cid_seq.get(cid)
-        from . import events
+            rec = self._cid_rec.get(cid)
+        from . import events, perf
 
         events.emit(
             {
                 "kind": "latency",
                 "cid": cid,
                 "op": op,
-                "seq": seq,
+                "seq": rec["seq"] if rec else None,
                 "seconds": sample,
                 "t": time.time(),
             }
         )
+        perf.observe_runtime(op, sample, record=rec, cid=cid)
         return sample
 
     def record_latency(self, op: str, seconds: float) -> None:
@@ -271,6 +275,16 @@ class MetricsRegistry:
             if m is None:
                 m = self._ops[op] = OpMetrics(op, self._reservoir)
             m.latency.add(seconds)
+
+    def latency_samples(self) -> Dict[str, List[float]]:
+        """Per-op copies of the latency reservoirs (the attribution
+        join input for :func:`..perf.perf_report`)."""
+        with self._lock:
+            return {
+                op: list(m.latency.samples)
+                for op, m in self._ops.items()
+                if m.latency.count
+            }
 
     # -- reading -----------------------------------------------------
 
@@ -295,7 +309,7 @@ class MetricsRegistry:
             self._ops.clear()
             self._emissions.clear()
             self._inflight.clear()
-            self._cid_seq.clear()
+            self._cid_rec.clear()
             self._seq = 0
             self._created = time.time()
 

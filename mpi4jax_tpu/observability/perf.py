@@ -1,0 +1,922 @@
+"""Achieved-bandwidth attribution, live anomaly watch, and the perf
+history/regression CLI.
+
+Three layers, all built on the analytic cost model
+(:mod:`.costmodel`) and the artifacts the telemetry subsystem already
+writes:
+
+1. **Attribution** — join expected wire bytes against measured
+   latency (runtime-sampling ``latency`` records in event logs, or
+   the in-process latency reservoirs) and report per-op /
+   per-mesh-axis achieved bandwidth and %-of-peak:
+   ``obs.perf_report()`` live, ``perf report RUNDIR`` offline,
+   ``doctor --perf`` as a post-mortem section, and an
+   "achieved GB/s" counter track in the Perfetto export.
+
+2. **Anomaly watch** (:class:`PerfWatch`) — a streaming EWMA + MAD
+   baseline per emission fingerprint, fed from the runtime latency
+   callback (``metrics.mark_runtime_end``) when ``M4T_PERF_WATCH=1``.
+   A sample more than z (``M4T_PERF_Z``, default 6) robust standard
+   deviations *above* its fingerprint's baseline emits an ``anomaly``
+   event through the default sink and prints a one-line warning (once
+   per fingerprint) — the mid-run "this collective just got slower"
+   signal. ``benchmarks/tpu_watch.py`` runs a private instance over
+   its probe/stage durations.
+
+3. **History / regression gate** — ``perf {report,compare,history,
+   gate}`` parses run event dirs and the repo's ``BENCH_r*.json``
+   trajectory (the ``{n, cmd, rc, tail, parsed}`` wrapper schema, or
+   bare ``{"metric", "value", ...}`` records), writes
+   ``PERF_REPORT.md``, and ``gate`` exits non-zero when the latest
+   comparable benchmark regresses beyond a noise band — the CI hook
+   for perf PRs.
+
+Everything here is host-side and import-light (no jax); the runtime
+paths are inert unless telemetry is enabled.
+
+CLI::
+
+    python -m mpi4jax_tpu.observability.perf report RUNDIR [-o PERF_REPORT.md]
+    python -m mpi4jax_tpu.observability.perf history [--dir REPO]
+    python -m mpi4jax_tpu.observability.perf compare RUNDIR_A RUNDIR_B
+    python -m mpi4jax_tpu.observability.perf gate [--dir REPO] [--tolerance 0.25]
+    python -m mpi4jax_tpu.observability.perf --selftest
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+from .. import config
+from . import costmodel, events
+from .recorder import fingerprint
+
+#: default noise band for the regression gate: the BENCH trajectory
+#: mixes container-CPU runs whose wall clock wobbles with host load
+DEFAULT_TOLERANCE = 0.25
+
+#: prior comparable rounds required before the gate may fail anything
+DEFAULT_MIN_HISTORY = 2
+
+_BENCH_RE = re.compile(r"BENCH_r(\d+)(?:_([A-Za-z0-9_]+))?\.json$")
+
+
+# ---------------------------------------------------------------------
+# attribution: cost model x measured latency
+# ---------------------------------------------------------------------
+
+
+def _axes_key(axes: Optional[Sequence[str]]) -> str:
+    if not axes:
+        return "<none>"
+    return ",".join(str(a) for a in axes)
+
+
+def attribute(
+    by_rank: Dict[int, List[Dict[str, Any]]],
+    *,
+    peak: Optional[float] = None,
+    alpha: Optional[float] = None,
+    extra_latency_by_op: Optional[Dict[str, List[float]]] = None,
+) -> Dict[str, Any]:
+    """Join emission fingerprints to latency samples and the cost
+    model. ``by_rank`` is the :func:`..doctor.load` shape (rank ->
+    records); pass ``{0: snapshot["emissions"]}`` for in-process use.
+
+    Returns ``{"peak_gbps", "alpha_s", "rows": [...]}`` where each row
+    describes one (op, axes, world, payload, dtype) fingerprint group:
+    emission count, modelled wire bytes / steps / expected time, and —
+    when latency samples joined (by correlation id, else op-level) —
+    sample count, p50 latency, achieved GB/s, %-of-peak, and the
+    measured/expected slowdown factor.
+    """
+    from . import doctor  # local: doctor imports perf only lazily
+
+    peak = costmodel.peak_gbps() if peak is None else float(peak)
+    alpha = costmodel.alpha_s() if alpha is None else float(alpha)
+
+    groups: Dict[tuple, Dict[str, Any]] = {}
+    cid_to_key: Dict[str, tuple] = {}
+    for rank in sorted(by_rank):
+        for rec in doctor.collective_stream(by_rank[rank]):
+            key = (
+                rec.get("op", "?"),
+                _axes_key(rec.get("axes")),
+                rec.get("world"),
+                int(rec.get("bytes") or 0),
+                rec.get("dtype"),
+            )
+            g = groups.get(key)
+            if g is None:
+                g = groups[key] = {"emissions": 0, "samples": []}
+            g["emissions"] += 1
+            cid = rec.get("cid")
+            if cid:
+                cid_to_key[cid] = key
+
+    def _op_fallback_key(op: Optional[str]) -> Optional[tuple]:
+        cands = [k for k in groups if k[0] == op]
+        if not cands:
+            return None
+        # dominant fingerprint: most emissions wins
+        return max(cands, key=lambda k: groups[k]["emissions"])
+
+    for rank in sorted(by_rank):
+        for rec in by_rank[rank]:
+            if rec.get("kind") != "latency":
+                continue
+            seconds = rec.get("seconds")
+            if not isinstance(seconds, (int, float)) or seconds < 0:
+                continue
+            key = cid_to_key.get(rec.get("cid") or "")
+            if key is None:
+                key = _op_fallback_key(rec.get("op"))
+            if key is not None:
+                groups[key]["samples"].append(float(seconds))
+
+    for op, samples in (extra_latency_by_op or {}).items():
+        key = _op_fallback_key(op)
+        if key is not None:
+            groups[key]["samples"].extend(float(s) for s in samples)
+
+    rows: List[Dict[str, Any]] = []
+    for (op, axes, world, nbytes, dtype), g in groups.items():
+        c = costmodel.cost(op, nbytes=nbytes, world=world, dtype=dtype)
+        expected = costmodel.expected_time_s(c, gbps=peak, alpha=alpha)
+        row = {
+            "op": op,
+            "axes": axes,
+            "world": world,
+            "bytes": nbytes,
+            "dtype": dtype,
+            "emissions": g["emissions"],
+            "wire_bytes": c["wire_bytes"],
+            "steps": c["steps"],
+            "algorithm": c["algorithm"],
+            "expected_s": expected,
+        }
+        if g["samples"]:
+            p50 = statistics.median(g["samples"])
+            gbps = costmodel.achieved_gbps(c, p50)
+            row.update(
+                samples=len(g["samples"]),
+                lat_p50_s=p50,
+                achieved_gbps=gbps,
+                pct_of_peak=(
+                    None if gbps is None else 100.0 * gbps / peak
+                ),
+                slowdown=(p50 / expected) if expected > 0 else None,
+            )
+        rows.append(row)
+    rows.sort(key=lambda r: -(r["wire_bytes"] * r["emissions"]))
+    return {"peak_gbps": peak, "alpha_s": alpha, "rows": rows}
+
+
+def _fmt_bytes(n: Optional[int]) -> str:
+    if n is None:
+        return "-"
+    value = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(value) < 1024 or unit == "GiB":
+            return f"{value:.1f}{unit}" if unit != "B" else f"{int(value)}B"
+        value /= 1024
+    return f"{value}B"
+
+
+def _fmt_s(s: Optional[float]) -> str:
+    if s is None:
+        return "-"
+    if s < 1e-3:
+        return f"{s * 1e6:.1f}us"
+    if s < 1:
+        return f"{s * 1e3:.2f}ms"
+    return f"{s:.2f}s"
+
+
+def format_table(result: Dict[str, Any]) -> str:
+    """Human-readable attribution table (also the ``doctor --perf``
+    section body)."""
+    lines = [
+        f"perf attribution vs peak {result['peak_gbps']:g} GB/s "
+        f"(alpha {result['alpha_s'] * 1e6:g} us/step; "
+        "M4T_PEAK_GBPS / M4T_ALPHA_US to retarget)"
+    ]
+    if not result["rows"]:
+        lines.append("no collective emissions to attribute")
+        return "\n".join(lines)
+    lines.append(
+        f"{'op':<20} {'axes':<8} {'n':>3} {'payload':>9} {'emits':>5} "
+        f"{'wire/emit':>10} {'expect':>8} {'p50':>8} "
+        f"{'GB/s':>8} {'%peak':>6} {'slow':>6}"
+    )
+    for r in result["rows"]:
+        gbps = r.get("achieved_gbps")
+        pct = r.get("pct_of_peak")
+        slow = r.get("slowdown")
+        lines.append(
+            f"{r['op']:<20} {r['axes']:<8} "
+            f"{r['world'] if r['world'] else '-':>3} "
+            f"{_fmt_bytes(r['bytes']):>9} {r['emissions']:>5} "
+            f"{_fmt_bytes(r['wire_bytes']):>10} "
+            f"{_fmt_s(r['expected_s']):>8} "
+            f"{_fmt_s(r.get('lat_p50_s')):>8} "
+            f"{f'{gbps:.3g}' if gbps is not None else '-':>8} "
+            f"{f'{pct:.1f}' if pct is not None else '-':>6} "
+            f"{f'{slow:.1f}x' if slow is not None else '-':>6}"
+        )
+    return "\n".join(lines)
+
+
+def perf_report(
+    *,
+    peak: Optional[float] = None,
+    alpha: Optional[float] = None,
+    file=None,
+) -> str:
+    """Attribution table for the *live* process: the metrics
+    registry's emission ring joined against its latency reservoirs
+    (runtime sampling) through the cost model. Returns the table text
+    (and writes it to ``file`` when given)."""
+    from . import metrics
+
+    snap = metrics.registry.snapshot()
+    result = attribute(
+        {0: snap["emissions"]},
+        peak=peak,
+        alpha=alpha,
+        extra_latency_by_op=metrics.registry.latency_samples(),
+    )
+    text = format_table(result)
+    if file is not None:
+        file.write(text + "\n")
+    return text
+
+
+def write_markdown(
+    path: str,
+    result: Dict[str, Any],
+    *,
+    inputs: Sequence[str] = (),
+    history_rows: Optional[List[Dict[str, Any]]] = None,
+) -> str:
+    """Write the attribution (and optionally the bench trajectory) as
+    ``PERF_REPORT.md``."""
+    lines = [
+        "# Performance report",
+        "",
+        f"Generated by `python -m mpi4jax_tpu.observability.perf report"
+        f"{' ' + ' '.join(inputs) if inputs else ''}`.",
+        "",
+        f"Peak link bandwidth: **{result['peak_gbps']:g} GB/s** "
+        f"(`M4T_PEAK_GBPS` to retarget); alpha "
+        f"{result['alpha_s'] * 1e6:g} us/step.",
+        "",
+        "## Achieved bandwidth by collective",
+        "",
+        "| op | axes | world | payload | emits | wire/emit | steps | "
+        "algorithm | expected | p50 | GB/s | % peak |",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for r in result["rows"]:
+        gbps = r.get("achieved_gbps")
+        pct = r.get("pct_of_peak")
+        lines.append(
+            f"| {r['op']} | {r['axes']} | {r['world'] or '-'} "
+            f"| {_fmt_bytes(r['bytes'])} | {r['emissions']} "
+            f"| {_fmt_bytes(r['wire_bytes'])} | {r['steps']} "
+            f"| {r['algorithm']} | {_fmt_s(r['expected_s'])} "
+            f"| {_fmt_s(r.get('lat_p50_s'))} "
+            f"| {f'{gbps:.3g}' if gbps is not None else '-'} "
+            f"| {f'{pct:.1f}' if pct is not None else '-'} |"
+        )
+    if history_rows:
+        lines += [
+            "",
+            "## Benchmark trajectory",
+            "",
+            "| round | file | value (s) | vs_baseline | nproc | rc |",
+            "|---|---|---|---|---|---|",
+        ]
+        for row in history_rows:
+            lines.append(
+                f"| {row['round']} | {os.path.basename(row['file'])} "
+                f"| {row['value']} | {row.get('vs_baseline') or '-'} "
+                f"| {row.get('nproc') or '-'} | {row.get('rc')} |"
+            )
+    text = "\n".join(lines) + "\n"
+    with open(path, "w") as f:
+        f.write(text)
+    return text
+
+
+# ---------------------------------------------------------------------
+# live anomaly watch (EWMA + MAD per fingerprint)
+# ---------------------------------------------------------------------
+
+
+class PerfWatch:
+    """Streaming per-key latency baseline: exponentially weighted mean
+    plus exponentially weighted mean absolute deviation (a streaming
+    stand-in for the MAD). A sample more than ``z`` robust sigmas
+    (``1.4826 * ewmad``) *above* the mean after ``warmup`` samples is
+    an anomaly — slow regressions only; getting faster is never
+    flagged. The baseline keeps updating through anomalies, so a
+    legitimate step change re-baselines instead of alarming forever.
+    """
+
+    def __init__(
+        self,
+        *,
+        z: Optional[float] = None,
+        warmup: Optional[int] = None,
+        smoothing: float = 0.1,
+        emit: bool = True,
+    ):
+        self.z = float(z if z is not None else config.PERF_Z)
+        self.warmup = int(warmup if warmup is not None else config.PERF_WARMUP)
+        self.smoothing = float(smoothing)
+        self.emit = bool(emit)
+        self._lock = threading.Lock()
+        #: key -> [count, ewma, ewmad]
+        self._state: Dict[str, List[float]] = {}
+        self._warned: set = set()
+        self.anomalies: List[Dict[str, Any]] = []
+
+    def observe(
+        self, key: str, value: float, **context: Any
+    ) -> Optional[Dict[str, Any]]:
+        """Feed one latency sample; returns the anomaly record when
+        this sample regressed beyond the z-threshold, else None."""
+        value = float(value)
+        anomaly = None
+        warn = False
+        with self._lock:
+            st = self._state.get(key)
+            if st is None:
+                self._state[key] = [1, value, 0.0]
+                return None
+            count, ewma, ewmad = st
+            dev = abs(value - ewma)
+            if count >= self.warmup and value > ewma:
+                # robust sigma with a 1%-of-baseline floor: a stream
+                # with near-zero jitter must not hair-trigger on the
+                # first nanosecond of noise, yet a genuine spike over
+                # a flat baseline still scores enormous
+                sigma = 1.4826 * ewmad + 0.01 * abs(ewma) + 1e-12
+                zscore = dev / sigma
+                if zscore >= self.z:
+                    anomaly = {
+                        "kind": "anomaly",
+                        "key": key,
+                        "seconds": value,
+                        "baseline_s": ewma,
+                        "mad_s": ewmad,
+                        "z": round(zscore, 2),
+                        "n": int(count),
+                        "t": time.time(),
+                    }
+                    anomaly.update(context)
+                    self.anomalies.append(anomaly)
+                    if len(self.anomalies) > 256:
+                        del self.anomalies[:-256]
+                    if key not in self._warned:
+                        self._warned.add(key)
+                        warn = True
+            a = self.smoothing
+            st[0] = count + 1
+            st[1] = (1 - a) * ewma + a * value
+            st[2] = (1 - a) * ewmad + a * dev
+        if anomaly is not None:
+            if self.emit:
+                events.emit(dict(anomaly))
+            if warn:
+                print(
+                    f"# m4t perf watch: {key}: {value:.4g}s is "
+                    f"{anomaly['z']:g} sigma above its "
+                    f"{anomaly['baseline_s']:.4g}s baseline "
+                    f"(n={anomaly['n']}); further anomalies for this "
+                    "fingerprint go to the event sink only",
+                    file=sys.stderr,
+                )
+        return anomaly
+
+    def reset(self) -> None:
+        with self._lock:
+            self._state.clear()
+            self._warned.clear()
+            self.anomalies.clear()
+
+
+#: process-global watch fed by metrics.mark_runtime_end; None until
+#: first enabled observation (no state unless the watch is on)
+_watch: Optional[PerfWatch] = None
+_watch_lock = threading.Lock()
+_enabled = bool(config.PERF_WATCH)
+
+
+def watch_enabled() -> bool:
+    return _enabled
+
+
+def enable_watch(**kwargs: Any) -> PerfWatch:
+    """Turn the live watch on programmatically (analog of
+    ``M4T_PERF_WATCH=1``); kwargs go to :class:`PerfWatch`."""
+    global _enabled, _watch
+    with _watch_lock:
+        _enabled = True
+        if kwargs or _watch is None:
+            _watch = PerfWatch(**kwargs)
+        return _watch
+
+
+def disable_watch() -> None:
+    global _enabled
+    _enabled = False
+
+
+def get_watch() -> Optional[PerfWatch]:
+    return _watch
+
+
+def observe_runtime(
+    op: str,
+    seconds: float,
+    *,
+    record: Optional[Dict[str, Any]] = None,
+    cid: Optional[str] = None,
+) -> Optional[Dict[str, Any]]:
+    """Runtime-latency hook (called by ``metrics.mark_runtime_end``):
+    no-op unless the watch is enabled. Keys the baseline by the
+    emission fingerprint when the emission record is known, else by
+    op name."""
+    if not _enabled:
+        return None
+    global _watch
+    if _watch is None:
+        with _watch_lock:
+            if _watch is None:
+                _watch = PerfWatch()
+    key = fingerprint(record) if record else str(op)
+    context: Dict[str, Any] = {"op": op}
+    if cid:
+        context["cid"] = cid
+    if record:
+        if record.get("bytes") is not None:
+            context["bytes"] = record["bytes"]
+        if record.get("world") is not None:
+            context["world"] = record["world"]
+        if record.get("seq") is not None:
+            context["seq"] = record["seq"]
+    return _watch.observe(key, seconds, **context)
+
+
+# ---------------------------------------------------------------------
+# bench history (BENCH_r*.json trajectory)
+# ---------------------------------------------------------------------
+
+
+def parse_bench_file(path: str) -> Optional[Dict[str, Any]]:
+    """One BENCH_*.json -> a history row, accepting both the round
+    wrapper ``{n, cmd, rc, tail, parsed}`` (the driver's probe
+    schema; ``parsed`` holds the benchmark's own JSON line) and a
+    bare ``{"metric", "value", ...}`` record. None when unparseable
+    or holding no finished measurement."""
+    m = _BENCH_RE.search(os.path.basename(path))
+    if not m:
+        return None
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(data, dict):
+        return None
+    if isinstance(data.get("parsed"), dict):
+        rec = data["parsed"]
+        rc = data.get("rc")
+        rnd = data.get("n")
+    elif "metric" in data:
+        rec = data
+        rc = 0
+        rnd = None
+    else:
+        return None
+    value = rec.get("value")
+    if not isinstance(value, (int, float)):
+        return None
+    if rnd is None:
+        rnd = int(m.group(1))
+    return {
+        "round": int(rnd),
+        "variant": m.group(2) or "",
+        "file": path,
+        "metric": rec.get("metric"),
+        "value": float(value),
+        "unit": rec.get("unit"),
+        "vs_baseline": rec.get("vs_baseline"),
+        "nproc": rec.get("nproc"),
+        "rc": rc,
+    }
+
+
+def load_history(
+    directory: str, *, variant: str = ""
+) -> List[Dict[str, Any]]:
+    """All parseable BENCH rows of one variant (``""`` = the main
+    ``BENCH_rNN.json`` trajectory), ordered by round."""
+    rows = []
+    for path in sorted(glob.glob(os.path.join(directory, "BENCH_*.json"))):
+        row = parse_bench_file(path)
+        if row is not None and row["variant"] == variant:
+            rows.append(row)
+    rows.sort(key=lambda r: r["round"])
+    return rows
+
+
+def _cohort(row: Dict[str, Any]) -> tuple:
+    """Comparability key: only rows measuring the same metric under
+    the same conditions may gate each other. ``vs_baseline`` is
+    non-null exactly for genuine on-chip runs (bench.py), so it
+    separates chip windows from CPU-fallback rounds; missing nproc
+    (pre-PR1 rows) means single device."""
+    return (
+        row.get("metric"),
+        row.get("vs_baseline") is not None,
+        row.get("nproc") or 1,
+    )
+
+
+def gate_history(
+    rows: List[Dict[str, Any]],
+    *,
+    tolerance: float = DEFAULT_TOLERANCE,
+    min_history: int = DEFAULT_MIN_HISTORY,
+) -> Dict[str, Any]:
+    """Regression verdict over a bench trajectory: the latest row is
+    compared against the median of the *prior* rows in its cohort
+    (same metric / platform class / device count). Verdict "regressed"
+    iff latest > median * (1 + tolerance), or the latest run itself
+    failed (rc != 0). Fewer than ``min_history`` comparable priors:
+    verdict "insufficient_history" (passes — a gate that fails on the
+    first run of a new configuration would block every new config)."""
+    if not rows:
+        return {"verdict": "no_history", "ok": False}
+    latest = max(rows, key=lambda r: r["round"])
+    if latest.get("rc") not in (0, None):
+        return {
+            "verdict": "latest_run_failed",
+            "ok": False,
+            "latest": latest,
+        }
+    cohort = _cohort(latest)
+    prior = [
+        r for r in rows
+        if r["round"] < latest["round"] and _cohort(r) == cohort
+    ]
+    result = {
+        "latest": latest,
+        "cohort": {
+            "metric": cohort[0],
+            "on_chip": cohort[1],
+            "nproc": cohort[2],
+        },
+        "prior_rounds": [r["round"] for r in prior],
+        "tolerance": tolerance,
+    }
+    if len(prior) < min_history:
+        result.update(verdict="insufficient_history", ok=True)
+        return result
+    baseline = statistics.median(r["value"] for r in prior)
+    limit = baseline * (1.0 + tolerance)
+    result.update(
+        baseline=baseline,
+        limit=limit,
+        verdict=("regressed" if latest["value"] > limit else "ok"),
+        ok=latest["value"] <= limit,
+    )
+    return result
+
+
+def format_history(rows: List[Dict[str, Any]]) -> str:
+    if not rows:
+        return "no BENCH_*.json rows found"
+    lines = [
+        f"{'round':>5} {'file':<24} {'value':>10} {'unit':<4} "
+        f"{'vs_base':>8} {'nproc':>5} {'rc':>3}"
+    ]
+    for r in rows:
+        lines.append(
+            f"{r['round']:>5} {os.path.basename(r['file']):<24} "
+            f"{r['value']:>10.3f} {r['unit'] or '':<4} "
+            f"{r['vs_baseline'] if r['vs_baseline'] is not None else '-':>8} "
+            f"{r['nproc'] if r['nproc'] is not None else '-':>5} "
+            f"{r['rc'] if r['rc'] is not None else '-':>3}"
+        )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------
+
+
+def _load_rank_records(inputs: Iterable[str]) -> Dict[int, List[Dict[str, Any]]]:
+    from . import doctor
+
+    return doctor.load(inputs)
+
+
+def _cmd_report(args: argparse.Namespace) -> int:
+    by_rank = _load_rank_records(args.inputs)
+    if not by_rank:
+        print("perf: no usable records in the given inputs", file=sys.stderr)
+        return 2
+    result = attribute(by_rank, peak=args.peak_gbps, alpha=args.alpha_s)
+    if args.json:
+        print(json.dumps(result, indent=1, default=str))
+    else:
+        print(format_table(result))
+    if args.output:
+        history_rows = (
+            load_history(args.history_dir) if args.history_dir else None
+        )
+        write_markdown(
+            args.output, result, inputs=args.inputs,
+            history_rows=history_rows,
+        )
+        print(f"# markdown report written to {args.output}", file=sys.stderr)
+    return 0
+
+
+def _cmd_history(args: argparse.Namespace) -> int:
+    rows = load_history(args.dir, variant=args.variant)
+    if args.json:
+        print(json.dumps(rows, indent=1))
+    else:
+        print(format_history(rows))
+    return 0 if rows else 2
+
+
+def _attribution_or_bench(path: str):
+    """compare operand: a BENCH_*.json file -> ("bench", row); a file
+    or directory of event logs -> ("events", attribution result)."""
+    if os.path.isfile(path) and _BENCH_RE.search(os.path.basename(path)):
+        row = parse_bench_file(path)
+        if row is not None:
+            return "bench", row
+    by_rank = _load_rank_records([path])
+    if not by_rank:
+        return None, None
+    return "events", attribute(by_rank)
+
+
+def _cmd_compare(args: argparse.Namespace) -> int:
+    kind_a, a = _attribution_or_bench(args.a)
+    kind_b, b = _attribution_or_bench(args.b)
+    if a is None or b is None or kind_a != kind_b:
+        print(
+            "perf compare: operands must both be BENCH_*.json files or "
+            "both be event logs/dirs with records",
+            file=sys.stderr,
+        )
+        return 2
+    if kind_a == "bench":
+        delta = b["value"] - a["value"]
+        pct = (100.0 * delta / a["value"]) if a["value"] else 0.0
+        print(
+            f"{a['metric']}: {a['value']:g}s -> {b['value']:g}s "
+            f"({pct:+.1f}%)"
+        )
+        regressed = b["value"] > a["value"] * (1 + args.tolerance)
+        print("verdict:", "REGRESSED" if regressed else "ok")
+        return 1 if regressed else 0
+    rows_a = {
+        (r["op"], r["axes"]): r for r in a["rows"]
+    }
+    regressed = False
+    for r in b["rows"]:
+        prev = rows_a.get((r["op"], r["axes"]))
+        cur_p50, prev_p50 = r.get("lat_p50_s"), (
+            prev.get("lat_p50_s") if prev else None
+        )
+        if prev is None or cur_p50 is None or prev_p50 is None:
+            note = "(new)" if prev is None else "(no samples)"
+            print(f"{r['op']}@{r['axes']}: {note}")
+            continue
+        pct = 100.0 * (cur_p50 - prev_p50) / prev_p50 if prev_p50 else 0.0
+        worse = cur_p50 > prev_p50 * (1 + args.tolerance)
+        regressed |= worse
+        print(
+            f"{r['op']}@{r['axes']}: p50 {_fmt_s(prev_p50)} -> "
+            f"{_fmt_s(cur_p50)} ({pct:+.1f}%)"
+            f"{'  REGRESSED' if worse else ''}"
+        )
+    return 1 if regressed else 0
+
+
+def _cmd_gate(args: argparse.Namespace) -> int:
+    rows = load_history(args.dir, variant=args.variant)
+    verdict = gate_history(
+        rows, tolerance=args.tolerance, min_history=args.min_history
+    )
+    if args.json:
+        print(json.dumps(verdict, indent=1, default=str))
+    else:
+        latest = verdict.get("latest")
+        if latest:
+            print(
+                f"gate: latest round {latest['round']} "
+                f"({os.path.basename(latest['file'])}) value "
+                f"{latest['value']:g}{latest.get('unit') or ''} vs "
+                f"prior rounds {verdict.get('prior_rounds')}"
+            )
+            if "baseline" in verdict:
+                print(
+                    f"gate: baseline median {verdict['baseline']:g}, "
+                    f"limit {verdict['limit']:g} "
+                    f"(+{int(args.tolerance * 100)}% noise band)"
+                )
+        print(f"gate: {verdict['verdict']}")
+    if verdict["verdict"] == "no_history":
+        return 2
+    return 0 if verdict["ok"] else 1
+
+
+def selftest() -> int:
+    """Device-free smoke over synthetic artifacts: attribution from
+    synthetic 2-rank event records, markdown writing, history parsing,
+    and both gate verdicts (clean passes, synthetic regression fails).
+    Invoked by CI (tests/test_perf.py) so the CLI cannot silently rot.
+    """
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        # -- synthetic 2-rank run: 3 allreduces + latency samples ------
+        for rank in (0, 1):
+            path = os.path.join(tmp, f"events-rank{rank}.jsonl")
+            with open(path, "w") as f:
+                for seq in range(1, 4):
+                    cid = f"c{rank}{seq}"
+                    f.write(json.dumps({
+                        "kind": "emission", "rank": rank, "seq": seq,
+                        "op": "AllReduce", "bytes": 4096,
+                        "dtype": "float32", "axes": ["ranks"],
+                        "world": 2, "cid": cid, "t": 100.0 + seq,
+                    }) + "\n")
+                    f.write(json.dumps({
+                        "kind": "latency", "rank": rank, "op": "AllReduce",
+                        "cid": cid, "seq": seq,
+                        "seconds": 0.001 * (1 + rank),
+                        "t": 100.1 + seq,
+                    }) + "\n")
+        by_rank = _load_rank_records([tmp])
+        assert sorted(by_rank) == [0, 1], by_rank
+        result = attribute(by_rank, peak=100.0)
+        (row,) = result["rows"]
+        assert row["op"] == "AllReduce" and row["emissions"] == 6
+        assert row["wire_bytes"] == 4096  # 2*(n-1)/n * 4096, n=2
+        assert row["samples"] == 6
+        for field in ("achieved_gbps", "pct_of_peak", "lat_p50_s"):
+            value = row[field]
+            assert isinstance(value, float) and value > 0, (field, value)
+        md = os.path.join(tmp, "PERF_REPORT.md")
+        write_markdown(md, result, inputs=[tmp])
+        assert "Achieved bandwidth" in open(md).read()
+
+        # -- synthetic bench trajectory: clean passes, regression fails -
+        hist = os.path.join(tmp, "hist")
+        os.makedirs(hist)
+        for n, value in ((1, 100.0), (2, 90.0), (3, 85.0)):
+            with open(os.path.join(hist, f"BENCH_r{n:02d}.json"), "w") as f:
+                json.dump({
+                    "n": n, "cmd": "python bench.py", "rc": 0, "tail": "",
+                    "parsed": {"metric": "m", "value": value, "unit": "s",
+                               "vs_baseline": None, "nproc": 1},
+                }, f)
+        rows = load_history(hist)
+        assert [r["round"] for r in rows] == [1, 2, 3]
+        good = gate_history(rows)
+        assert good["verdict"] == "ok" and good["ok"], good
+        with open(os.path.join(hist, "BENCH_r04.json"), "w") as f:
+            json.dump({
+                "n": 4, "cmd": "python bench.py", "rc": 0, "tail": "",
+                "parsed": {"metric": "m", "value": 400.0, "unit": "s",
+                           "vs_baseline": None, "nproc": 1},
+            }, f)
+        bad = gate_history(load_history(hist))
+        assert bad["verdict"] == "regressed" and not bad["ok"], bad
+
+        # -- the watch flags a slow outlier and only that --------------
+        watch = PerfWatch(z=6.0, warmup=5, emit=False)
+        anomalies = []
+        for i in range(20):
+            a = watch.observe("AllReduce[1Kx4:f32]@ranks", 0.001)
+            assert a is None, a
+        anomalies.append(watch.observe("AllReduce[1Kx4:f32]@ranks", 0.5))
+        assert anomalies[-1] is not None and anomalies[-1]["z"] >= 6.0
+    print("perf selftest ok")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--selftest" in argv:
+        return selftest()
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi4jax_tpu.observability.perf",
+        description=(
+            "Collective performance attribution (achieved bandwidth vs "
+            "the analytic cost model) and bench-history regression "
+            "gating. `--selftest` runs a device-free smoke."
+        ),
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_report = sub.add_parser(
+        "report", help="achieved-bandwidth table from run event logs"
+    )
+    p_report.add_argument(
+        "inputs", nargs="+",
+        help="per-rank .jsonl files / directories (launch --events-dir)",
+    )
+    p_report.add_argument(
+        "-o", "--output", default=None, metavar="PERF_REPORT.md",
+        help="additionally write a markdown report here",
+    )
+    p_report.add_argument(
+        "--history-dir", default=None, metavar="DIR",
+        help="include the BENCH_*.json trajectory from DIR in the "
+        "markdown report",
+    )
+    p_report.add_argument("--json", action="store_true")
+    p_report.add_argument(
+        "--peak-gbps", type=float, default=None, metavar="G",
+        help="peak link bandwidth (default: M4T_PEAK_GBPS, else the "
+        "generation table, else the conservative fallback)",
+    )
+    p_report.add_argument(
+        "--alpha-s", type=float, default=None, metavar="S",
+        help="per-step latency term in seconds (default: M4T_ALPHA_US)",
+    )
+    p_report.set_defaults(func=_cmd_report)
+
+    p_hist = sub.add_parser(
+        "history", help="parse the BENCH_*.json benchmark trajectory"
+    )
+    p_hist.add_argument(
+        "--dir", default=".", help="directory holding BENCH_*.json "
+        "(default: cwd)",
+    )
+    p_hist.add_argument(
+        "--variant", default="", metavar="V",
+        help="trajectory variant: '' = BENCH_rNN.json, 'tpu' = "
+        "BENCH_rNN_tpu.json, ...",
+    )
+    p_hist.add_argument("--json", action="store_true")
+    p_hist.set_defaults(func=_cmd_history)
+
+    p_cmp = sub.add_parser(
+        "compare", help="compare two runs (event dirs or BENCH files)"
+    )
+    p_cmp.add_argument("a")
+    p_cmp.add_argument("b")
+    p_cmp.add_argument(
+        "--tolerance", type=float, default=DEFAULT_TOLERANCE,
+        help="relative noise band (default %(default)s)",
+    )
+    p_cmp.set_defaults(func=_cmd_compare)
+
+    p_gate = sub.add_parser(
+        "gate",
+        help="exit 1 when the latest comparable BENCH round regressed "
+        "beyond the noise band (the CI hook)",
+    )
+    p_gate.add_argument("--dir", default=".")
+    p_gate.add_argument("--variant", default="", metavar="V")
+    p_gate.add_argument(
+        "--tolerance", type=float, default=DEFAULT_TOLERANCE,
+        help="relative noise band (default %(default)s)",
+    )
+    p_gate.add_argument(
+        "--min-history", type=int, default=DEFAULT_MIN_HISTORY,
+        help="prior comparable rounds required before the gate may "
+        "fail (default %(default)s)",
+    )
+    p_gate.add_argument("--json", action="store_true")
+    p_gate.set_defaults(func=_cmd_gate)
+
+    args = parser.parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,7 +12,12 @@ of a multi-rank run:
   heartbeat, so ranks with runtime sampling off still show their
   collective stream,
 - a **counter track** (``ph: "C"``) of cumulative payload bytes per
-  rank — the at-a-glance "who moved how much" view.
+  rank — the at-a-glance "who moved how much" view,
+- an **achieved-bandwidth counter track** per rank: each latency
+  sample that joins its emission (by cid, else seq) is divided into
+  the cost model's expected wire bytes
+  (``observability/costmodel.py``), so a degrading link shows up as
+  a falling "achieved GB/s" curve right in the timeline.
 
 Timestamps are microseconds relative to the earliest record across
 all ranks, so unsynchronized-but-same-host ranks line up the way they
@@ -33,6 +38,8 @@ import argparse
 import json
 import sys
 from typing import Any, Dict, Iterable, List, Optional
+
+from . import costmodel
 
 #: trace-event "thread" ids within each rank's process track
 TID_EMISSIONS = 0
@@ -82,6 +89,17 @@ def build_trace(by_rank: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
                     "args": {"name": tname},
                 }
             )
+
+        # latency -> emission join keys for the achieved-GB/s counter
+        # (cid is exact; seq is the fallback for older latency logs)
+        by_cid: Dict[str, Dict[str, Any]] = {}
+        by_seq: Dict[Any, Dict[str, Any]] = {}
+        for rec in by_rank[rank]:
+            if rec.get("kind") in ("emission", "recorder"):
+                if rec.get("cid"):
+                    by_cid.setdefault(rec["cid"], rec)
+                if rec.get("seq") is not None:
+                    by_seq.setdefault(rec["seq"], rec)
 
         cumulative_bytes = 0
         for rec in by_rank[rank]:
@@ -138,6 +156,23 @@ def build_trace(by_rank: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
                         "args": args,
                     }
                 )
+                emission = by_cid.get(rec.get("cid") or "") or by_seq.get(
+                    rec.get("seq")
+                )
+                if emission is not None and seconds > 0:
+                    gbps = costmodel.achieved_gbps(
+                        costmodel.record_cost(emission), seconds
+                    )
+                    if gbps is not None:
+                        trace_events.append(
+                            {
+                                "name": "achieved GB/s",
+                                "ph": "C",
+                                "pid": rank,
+                                "ts": _micros(t, t0),
+                                "args": {"gbps": round(gbps, 6)},
+                            }
+                        )
             elif kind == "heartbeat":
                 trace_events.append(
                     {

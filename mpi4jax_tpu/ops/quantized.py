@@ -35,6 +35,28 @@ from ._core import _telemetry_prologue
 _BLOCK = 256
 
 
+def ring_chunk_elems(total_elems: int, world: int) -> int:
+    """Per-hop chunk size (elements) of the quantized ring: the
+    per-rank chunk, rounded up to whole quantization blocks — the
+    exact padding rule of :func:`_quantized_ring`. The cost model
+    (``observability/costmodel.py``) uses this to predict wire bytes
+    from an emission fingerprint alone."""
+    if world <= 1:
+        return 0
+    chunk = -(-int(total_elems) // int(world))
+    return -(-chunk // _BLOCK) * _BLOCK
+
+
+def wire_format_bytes(n_elems: int) -> int:
+    """Bytes on the wire for ``n_elems`` values in this collective's
+    wire format: int8 payload plus one float32 absmax scale per
+    ``_BLOCK``-value block (both forwarded every hop)."""
+    if n_elems <= 0:
+        return 0
+    n_blocks = -(-int(n_elems) // _BLOCK)
+    return int(n_elems) + 4 * n_blocks
+
+
 def _quantize(x):
     """Block-wise absmax int8 quantization. x: (c,) f32, c % _BLOCK == 0.
     Returns (q int8 (c,), scales f32 (c/_BLOCK,))."""

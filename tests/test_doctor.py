@@ -193,6 +193,38 @@ def test_straggler_needs_enough_samples(tmp_path):
     assert doctor.diagnose([d])["findings"] == []
 
 
+def test_straggler_min_samples_default_and_tunable(tmp_path):
+    """4 slow samples are below the default floor of 5 (1-sample or
+    few-sample noise must not brand a straggler), but the floor is
+    flag-tunable down when a short run is all the evidence there is."""
+    logs = clean_world()
+    logs[0].extend(latency(0, "AllReduce", 0.001, 105.0 + i)
+                   for i in range(6))
+    logs[1].extend(latency(1, "AllReduce", 0.05, 105.0 + i)
+                   for i in range(4))  # 50x slower, but only 4 samples
+    d = write_logs(tmp_path, logs)
+    assert doctor.diagnose([d])["findings"] == []
+    (f,) = doctor.diagnose([d], straggler_min_samples=3)["findings"]
+    assert f["kind"] == "straggler" and f["rank"] == 1
+    # the payload names its statistical footing
+    assert f["samples"] == 4 and f["min_samples"] == 3
+    assert f["peer_samples"] == {"0": 6}
+
+
+def test_straggler_finding_reports_sample_counts(tmp_path):
+    logs = clean_world(n_ranks=3)
+    for r in range(3):
+        per = 0.08 if r == 2 else 0.002
+        for i in range(5 + r):
+            logs[r].append(latency(r, "AllReduce", per, 105.0 + i))
+    d = write_logs(tmp_path, logs)
+    (f,) = [x for x in doctor.diagnose([d])["findings"]
+            if x["kind"] == "straggler"]
+    assert f["rank"] == 2 and f["samples"] == 7
+    assert f["peer_samples"] == {"0": 5, "1": 6}
+    assert f["min_samples"] == doctor.DEFAULT_STRAGGLER_MIN_SAMPLES
+
+
 def test_rank_from_filename_fallback(tmp_path):
     # records without a rank field are attributed via the filename
     for rank in (0, 1):
@@ -359,8 +391,16 @@ def test_trace_schema_is_valid_chrome_trace(tmp_path):
     assert slice0["dur"] == pytest.approx(2000.0)  # 2 ms in micros
     # counters accumulate payload bytes
     counters = [ev["args"]["cumulative"] for ev in obj["traceEvents"]
-                if ev["ph"] == "C" and ev["pid"] == 0]
+                if ev["ph"] == "C" and ev["pid"] == 0
+                and ev["name"] == "payload bytes"]
     assert counters == [16, 48]
+    # each latency sample that joins its emission (here: by seq) gets
+    # an achieved-bandwidth counter from the cost model: 16B payload,
+    # world 2 -> 16B on the wire, over 2ms on rank 0
+    (ach0,) = [ev for ev in obj["traceEvents"]
+               if ev["ph"] == "C" and ev["pid"] == 0
+               and ev["name"] == "achieved GB/s"]
+    assert ach0["args"]["gbps"] == pytest.approx(16 / 0.002 / 1e9)
 
 
 def test_trace_golden_file():

@@ -476,3 +476,78 @@ def test_annotation_plain_when_disabled():
         .as_text()
     )
     assert "m4t.allreduce." not in hlo  # no per-emission suffix
+
+
+# ---------------------------------------------------------------------------
+# Reservoir properties (algorithm R) — the attribution layer
+# (observability/perf.py) trusts these summaries, so they are pinned
+# ---------------------------------------------------------------------------
+
+
+def test_reservoir_exact_aggregates_on_long_stream():
+    """count/sum/min/max are exact over the whole stream no matter how
+    small the reservoir."""
+    import random as _random
+
+    _random.seed(1234)
+    r = Reservoir(16)
+    values = [_random.uniform(0.001, 5.0) for _ in range(5000)]
+    for v in values:
+        r.add(v)
+    assert r.count == 5000
+    assert len(r.samples) == 16  # capacity never exceeded
+    assert r.total == pytest.approx(sum(values))
+    assert r.minimum == pytest.approx(min(values))
+    assert r.maximum == pytest.approx(max(values))
+    s = r.summary()
+    assert s["count"] == 5000
+    assert s["mean"] == pytest.approx(sum(values) / 5000)
+    # every retained sample is a real member of the stream
+    assert all(v in values for v in r.samples)
+
+
+def test_reservoir_empty_and_singleton_summaries():
+    r = Reservoir(8)
+    s = r.summary()
+    assert s == {"count": 0, "mean": None, "min": None, "max": None,
+                 "p50": None, "p90": None, "p99": None}
+    assert r.quantile(0.5) is None
+    r.add(0.25)
+    s = r.summary()
+    assert s["count"] == 1
+    assert s["mean"] == s["min"] == s["max"] == 0.25
+    assert s["p50"] == s["p90"] == s["p99"] == 0.25
+
+
+def test_reservoir_quantile_monotonicity_and_bounds():
+    """p50 <= p90 <= p99, and every quantile lies within [min, max] —
+    for streams shorter and longer than the capacity."""
+    import random as _random
+
+    _random.seed(99)
+    for n in (3, 7, 64, 256, 2000):
+        r = Reservoir(64)
+        for _ in range(n):
+            r.add(_random.expovariate(10.0))
+        s = r.summary()
+        assert s["p50"] <= s["p90"] <= s["p99"]
+        assert r.minimum <= s["p50"] and s["p99"] <= r.maximum
+        # quantiles over the full grid are monotone too
+        qs = [r.quantile(q / 10) for q in range(11)]
+        assert qs == sorted(qs)
+        assert qs[0] == min(r.samples) and qs[-1] == max(r.samples)
+
+
+def test_reservoir_uniform_sample_is_plausible():
+    """Distribution sanity for the algorithm-R replacement policy: the
+    retained sample of a long uniform stream should cover the range,
+    not cluster at either end (a biased j-index would)."""
+    import random as _random
+
+    _random.seed(7)
+    r = Reservoir(128)
+    for i in range(10000):
+        r.add(float(i))
+    mean_sample = sum(r.samples) / len(r.samples)
+    assert 3000 < mean_sample < 7000
+    assert r.quantile(0.0) >= 0 and r.quantile(1.0) <= 9999

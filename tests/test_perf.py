@@ -1,0 +1,552 @@
+"""Collective cost model, achieved-bandwidth attribution, anomaly
+watch, and the bench regression gate
+(``mpi4jax_tpu/observability/{costmodel,perf}.py``).
+
+Covers the ISSUE-4 acceptance surface:
+
+- golden table pinning expected wire bytes / steps for every op in the
+  emit vocabulary x ring sizes {2,4,8} x {f32, bf16} (+ the quantized
+  wire format), as literal numbers — the model is tested against the
+  algorithm math, not against itself;
+- the costmodel's quantized mirror pinned to the canonical helpers
+  beside the kernel (``ops/quantized.py``);
+- attribution: cid joins, op-level fallback, axes grouping, finite
+  achieved bandwidth / %-of-peak;
+- the EWMA+MAD anomaly watch: warmup, slow-only flagging,
+  re-baselining, and the zero-overhead disabled path;
+- BENCH_*.json history parsing (wrapper + bare schemas) and the gate:
+  exit 0 on a copy of the repo's current trajectory, non-zero on a
+  synthetically regressed copy;
+- CLI smoke: ``--selftest`` (the tier-1 hook that keeps the CLI from
+  rotting), ``report -o`` markdown, ``doctor --perf``;
+- end-to-end: a real 2-rank ``launch --events-dir --perf`` run on CPU
+  produces a finite per-op achieved-bandwidth table.
+"""
+
+import glob
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from mpi4jax_tpu.observability import costmodel, doctor, perf
+
+pytestmark = [pytest.mark.telemetry, pytest.mark.perf]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------
+# golden cost table: 1024-element payloads
+# ---------------------------------------------------------------------
+
+#: op -> {n -> (wire_bytes_f32, wire_bytes_bf16, steps)} for a
+#: 1024-element payload (f32: 4096 B, bf16: 2048 B). Literal numbers,
+#: derived by hand from the algorithm table in costmodel's docstring.
+GOLDEN = {
+    "AllReduce": {2: (4096, 2048, 2), 4: (6144, 3072, 6),
+                  8: (7168, 3584, 14)},
+    "ReduceScatter": {2: (2048, 1024, 1), 4: (3072, 1536, 3),
+                      8: (3584, 1792, 7)},
+    "AllGather": {2: (4096, 2048, 1), 4: (12288, 6144, 3),
+                  8: (28672, 14336, 7)},
+    "AllToAll": {2: (2048, 1024, 1), 4: (3072, 1536, 3),
+                 8: (3584, 1792, 7)},
+    "Bcast": {2: (4096, 2048, 1), 4: (4096, 2048, 2),
+              8: (4096, 2048, 3)},
+    "Reduce": {2: (4096, 2048, 1), 4: (4096, 2048, 2),
+               8: (4096, 2048, 3)},
+    "Gather": {2: (4096, 2048, 1), 4: (12288, 6144, 3),
+               8: (28672, 14336, 7)},
+    "Scatter": {2: (4096, 2048, 1), 4: (12288, 6144, 3),
+                8: (28672, 14336, 7)},
+    "Scan": {2: (4096, 2048, 1), 4: (4096, 2048, 3),
+             8: (4096, 2048, 7)},
+    "Barrier": {2: (0, 0, 1), 4: (0, 0, 2), 8: (0, 0, 3)},
+    "Send": {2: (4096, 2048, 1), 4: (4096, 2048, 1),
+             8: (4096, 2048, 1)},
+    "Recv": {2: (4096, 2048, 1), 4: (4096, 2048, 1),
+             8: (4096, 2048, 1)},
+    "Sendrecv": {2: (4096, 2048, 1), 4: (4096, 2048, 1),
+                 8: (4096, 2048, 1)},
+}
+
+#: quantized: wire format is int8 + one f32 scale per 256-value block,
+#: per hop on a block-aligned per-rank chunk; 2(n-1) hops. For 1024
+#: elements: chunks 512/256/256 -> hops 520/260/260 bytes.
+GOLDEN_QUANTIZED = {2: (1040, 2), 4: (1560, 6), 8: (3640, 14)}
+
+
+@pytest.mark.parametrize("op", sorted(GOLDEN))
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_golden_wire_bytes_and_steps(op, n):
+    wire_f32, wire_bf16, steps = GOLDEN[op][n]
+    c32 = costmodel.cost(op, nbytes=4096, world=n, dtype="float32")
+    assert (c32["wire_bytes"], c32["steps"]) == (wire_f32, steps)
+    c16 = costmodel.cost(op, nbytes=2048, world=n, dtype="bfloat16")
+    assert (c16["wire_bytes"], c16["steps"]) == (wire_bf16, steps)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_golden_quantized_wire_bytes(n):
+    wire, steps = GOLDEN_QUANTIZED[n]
+    # 1024 f32 elements on the wire as int8 + block scales: the same
+    # element count regardless of the input dtype's width
+    c = costmodel.cost("QuantizedAllReduce", nbytes=4096, world=n,
+                       dtype="float32")
+    assert (c["wire_bytes"], c["steps"]) == (wire, steps)
+    c16 = costmodel.cost("QuantizedAllReduce", nbytes=2048, world=n,
+                         dtype="bfloat16")
+    assert (c16["wire_bytes"], c16["steps"]) == (wire, steps)
+
+
+def test_world_one_and_unknown_ops():
+    for op in list(GOLDEN) + ["QuantizedAllReduce"]:
+        c = costmodel.cost(op, nbytes=4096, world=1, dtype="float32")
+        assert c["wire_bytes"] == 0 and c["steps"] == 0
+    c = costmodel.cost("FrobnicateAll", nbytes=100, world=4)
+    assert c["algorithm"] == "unknown" and c["wire_bytes"] == 100
+
+
+def test_quantized_mirror_matches_kernel():
+    """The costmodel's import-light mirror of the quantized wire
+    format must agree with the canonical helpers that live beside the
+    kernel — this is the drift pin."""
+    quantized = pytest.importorskip("mpi4jax_tpu.ops.quantized")
+    for elems in (1, 255, 256, 257, 1024, 5000, 65536):
+        assert costmodel._quant_wire_format_bytes(elems) == (
+            quantized.wire_format_bytes(elems)
+        )
+        for n in (2, 3, 4, 8):
+            assert costmodel._quant_ring_chunk_elems(elems, n) == (
+                quantized.ring_chunk_elems(elems, n)
+            )
+
+
+def test_expected_time_alpha_beta():
+    c = costmodel.cost("AllReduce", nbytes=4096, world=2)
+    t = costmodel.expected_time_s(c, gbps=1.0, alpha=1e-6)
+    assert t == pytest.approx(2 * 1e-6 + 4096 / 1e9)
+    assert costmodel.achieved_gbps(c, 4096e-9) == pytest.approx(1.0)
+    assert costmodel.achieved_gbps(c, 0.0) is None
+
+
+def test_peak_gbps_resolution(monkeypatch):
+    monkeypatch.setenv("M4T_PEAK_GBPS", "123.5")
+    assert costmodel.peak_gbps() == 123.5
+    monkeypatch.delenv("M4T_PEAK_GBPS")
+    assert costmodel.peak_gbps("TPU v5 lite") == 200.0
+    assert costmodel.peak_gbps("TPU v4") == 300.0
+    assert costmodel.peak_gbps("cpu") == costmodel.DEFAULT_PEAK_GBPS
+    assert costmodel.peak_gbps() == costmodel.DEFAULT_PEAK_GBPS
+
+
+# ---------------------------------------------------------------------
+# attribution
+# ---------------------------------------------------------------------
+
+
+def _emission(rank, seq, op, *, nbytes=4096, world=2, cid=None,
+              axes=("ranks",), dtype="float32"):
+    return {"kind": "emission", "rank": rank, "seq": seq, "op": op,
+            "bytes": nbytes, "dtype": dtype, "axes": list(axes),
+            "world": world, "cid": cid or f"c{rank}{seq}", "t": 100.0 + seq}
+
+
+def _latency(rank, op, seconds, *, cid=None, seq=None):
+    return {"kind": "latency", "rank": rank, "op": op, "cid": cid,
+            "seq": seq, "seconds": seconds, "t": 101.0}
+
+
+def test_attribute_joins_by_cid_and_groups_by_fingerprint():
+    by_rank = {
+        0: [
+            _emission(0, 1, "AllReduce", cid="a"),
+            _emission(0, 2, "AllReduce", nbytes=8192, cid="b"),
+            _latency(0, "AllReduce", 0.001, cid="a"),
+            _latency(0, "AllReduce", 0.002, cid="b"),
+        ],
+        1: [
+            _emission(1, 1, "AllReduce", cid="c"),
+            _emission(1, 2, "AllReduce", nbytes=8192, cid="d"),
+            _latency(1, "AllReduce", 0.003, cid="c"),
+        ],
+    }
+    result = perf.attribute(by_rank, peak=100.0)
+    rows = {(r["bytes"]): r for r in result["rows"]}
+    assert set(rows) == {4096, 8192}
+    small, big = rows[4096], rows[8192]
+    assert small["emissions"] == 2 and small["samples"] == 2
+    assert big["emissions"] == 2 and big["samples"] == 1
+    assert small["wire_bytes"] == 4096 and big["wire_bytes"] == 8192
+    # p50 of [0.001, 0.003] = 0.002 -> 4096B / 2ms
+    assert small["lat_p50_s"] == pytest.approx(0.002)
+    assert small["achieved_gbps"] == pytest.approx(4096 / 0.002 / 1e9)
+    assert small["pct_of_peak"] == pytest.approx(
+        100 * small["achieved_gbps"] / 100.0
+    )
+    assert small["slowdown"] > 1
+
+
+def test_attribute_op_level_fallback_for_unjoined_latency():
+    # latency with no cid attaches to the dominant fingerprint group
+    by_rank = {0: [
+        _emission(0, 1, "AllGather"),
+        _emission(0, 2, "AllGather"),
+        _latency(0, "AllGather", 0.004),
+    ]}
+    (row,) = perf.attribute(by_rank)["rows"]
+    assert row["samples"] == 1 and row["lat_p50_s"] == pytest.approx(0.004)
+
+
+def test_attribute_without_samples_still_models():
+    (row,) = perf.attribute({0: [_emission(0, 1, "Bcast")]})["rows"]
+    assert row["wire_bytes"] == 4096 and "lat_p50_s" not in row
+    text = perf.format_table(perf.attribute({0: [_emission(0, 1, "Bcast")]}))
+    assert "Bcast" in text and "%peak" in text
+
+
+def test_perf_report_live_registry():
+    from mpi4jax_tpu import observability as obs
+
+    obs.enable()
+    obs.reset()
+    try:
+        obs.registry.record_emission(
+            "AllReduce", nbytes=1 << 20, dtype="float32",
+            axes=["ranks"], world=8, cid="liv1",
+        )
+        obs.registry.record_latency("AllReduce", 0.010)
+        text = obs.perf_report()
+    finally:
+        obs.reset()
+        obs.disable()
+    assert "AllReduce" in text
+    # 2*(7/8)*1MiB over 10ms, finite and positive
+    assert "GB/s" in text and "-" not in text.splitlines()[-1].split()[-3]
+
+
+# ---------------------------------------------------------------------
+# anomaly watch
+# ---------------------------------------------------------------------
+
+
+def test_watch_warmup_then_flags_slow_only():
+    watch = perf.PerfWatch(z=6.0, warmup=5, emit=False)
+    jitter = [1.00, 1.02, 0.98, 1.01, 0.99]
+    for i in range(30):
+        assert watch.observe("k", 0.001 * jitter[i % 5]) is None
+    # a fast outlier never flags
+    assert watch.observe("k", 1e-6) is None
+    anomaly = watch.observe("k", 0.1)
+    assert anomaly is not None and anomaly["z"] >= 6.0
+    assert anomaly["seconds"] == 0.1 and anomaly["kind"] == "anomaly"
+    assert watch.anomalies[-1] is anomaly
+
+
+def test_watch_rebaselines_after_step_change():
+    watch = perf.PerfWatch(z=6.0, warmup=3, smoothing=0.5, emit=False)
+    for _ in range(10):
+        watch.observe("k", 0.001)
+    assert watch.observe("k", 0.1) is not None
+    # the new level keeps feeding the baseline: it stops being an
+    # anomaly instead of alarming forever
+    flagged = [watch.observe("k", 0.1) is not None for _ in range(10)]
+    assert not flagged[-1]
+
+
+def test_watch_anomaly_emitted_to_sink(tmp_path):
+    from mpi4jax_tpu.observability import events
+
+    sink = str(tmp_path / "anomalies.jsonl")
+    prev = events.get_sink()
+    events.set_sink(sink)
+    try:
+        watch = perf.PerfWatch(z=6.0, warmup=3, emit=True)
+        for _ in range(10):
+            watch.observe("AllReduce[8:f32]@ranks", 0.001)
+        assert watch.observe("AllReduce[8:f32]@ranks", 0.5, op="AllReduce")
+    finally:
+        events.set_sink(prev.path if prev else None)
+    (rec,) = [r for r in events.read(sink) if r["kind"] == "anomaly"]
+    assert rec["key"] == "AllReduce[8:f32]@ranks"
+    assert rec["op"] == "AllReduce" and rec["z"] >= 6.0
+
+
+def test_observe_runtime_disabled_is_inert():
+    """Zero-overhead disabled path: without M4T_PERF_WATCH the runtime
+    hook does nothing and allocates nothing."""
+    assert not perf.watch_enabled()
+    assert perf.observe_runtime("AllReduce", 0.001) is None
+    assert perf.get_watch() is None
+
+
+def test_observe_runtime_enabled_keys_by_fingerprint():
+    watch = perf.enable_watch(z=6.0, warmup=3, emit=False)
+    try:
+        rec = {"op": "AllReduce", "bytes": 4096, "dtype": "float32",
+               "shape": [1024], "axes": ["ranks"], "world": 2, "seq": 7}
+        for _ in range(10):
+            assert perf.observe_runtime(
+                "AllReduce", 0.001, record=rec, cid="x"
+            ) is None
+        anomaly = perf.observe_runtime("AllReduce", 0.5, record=rec, cid="x")
+        assert anomaly is not None
+        assert anomaly["key"] == "AllReduce[1024:float32]@ranks"
+        assert anomaly["world"] == 2 and anomaly["seq"] == 7
+    finally:
+        perf.disable_watch()
+        watch.reset()
+
+
+# ---------------------------------------------------------------------
+# bench history + gate
+# ---------------------------------------------------------------------
+
+
+def _write_round(directory, n, value, *, rc=0, vs_baseline=None, nproc=1,
+                 variant=""):
+    name = f"BENCH_r{n:02d}{'_' + variant if variant else ''}.json"
+    with open(os.path.join(directory, name), "w") as f:
+        json.dump({
+            "n": n, "cmd": "if [ -f bench.py ]; then python bench.py; fi",
+            "rc": rc, "tail": "...",
+            "parsed": {"metric": "shallow_water_100x_solve", "value": value,
+                       "unit": "s", "vs_baseline": vs_baseline,
+                       "nproc": nproc},
+        }, f)
+
+
+def test_history_parses_wrapper_and_bare_schemas(tmp_path):
+    _write_round(tmp_path, 1, 100.0)
+    # bare record (the BENCH_rNN_tpu.json shape tpu_watch writes)
+    with open(tmp_path / "BENCH_r02_tpu.json", "w") as f:
+        json.dump({"metric": "m", "value": 0.5, "unit": "s",
+                   "vs_baseline": 12.0, "nproc": 1}, f)
+    main = perf.load_history(str(tmp_path))
+    assert [r["round"] for r in main] == [1]
+    assert main[0]["value"] == 100.0 and main[0]["rc"] == 0
+    tpu = perf.load_history(str(tmp_path), variant="tpu")
+    assert [r["round"] for r in tpu] == [2]
+    assert tpu[0]["vs_baseline"] == 12.0
+
+
+def test_gate_passes_on_copy_of_repo_trajectory(tmp_path):
+    """Acceptance: gate exits 0 on the repo's current BENCH_*.json
+    trajectory (tested on a copy so the test stays hermetic)."""
+    files = glob.glob(os.path.join(REPO, "BENCH_r*.json"))
+    assert files, "repo lost its BENCH trajectory?"
+    for path in files:
+        shutil.copy(path, tmp_path)
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.observability.perf",
+         "gate", "--dir", str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "gate: ok" in res.stdout or "insufficient_history" in res.stdout
+
+
+def test_gate_fails_on_synthetically_regressed_copy(tmp_path):
+    for path in glob.glob(os.path.join(REPO, "BENCH_r*.json")):
+        shutil.copy(path, tmp_path)
+    _write_round(tmp_path, 97, 10_000.0)  # the regression
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.observability.perf",
+         "gate", "--dir", str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "regressed" in res.stdout
+
+
+def test_gate_cohorts_and_verdicts(tmp_path):
+    # improving trajectory passes
+    for n, v in ((1, 100.0), (2, 90.0), (3, 88.0)):
+        _write_round(tmp_path, n, v)
+    assert perf.gate_history(perf.load_history(str(tmp_path)))["ok"]
+    # an on-chip round does not gate against CPU rounds
+    _write_round(tmp_path, 4, 0.5, vs_baseline=12.0)
+    verdict = perf.gate_history(perf.load_history(str(tmp_path)))
+    assert verdict["verdict"] == "insufficient_history" and verdict["ok"]
+    # within the noise band is ok; beyond it fails
+    _write_round(tmp_path, 5, 95.0)
+    assert perf.gate_history(perf.load_history(str(tmp_path)))["ok"]
+    _write_round(tmp_path, 6, 200.0)
+    verdict = perf.gate_history(perf.load_history(str(tmp_path)))
+    assert verdict["verdict"] == "regressed" and not verdict["ok"]
+    # a failed latest run fails regardless of its value
+    _write_round(tmp_path, 7, 1.0, rc=2)
+    verdict = perf.gate_history(perf.load_history(str(tmp_path)))
+    assert verdict["verdict"] == "latest_run_failed" and not verdict["ok"]
+
+
+def test_gate_no_history_exit_2(tmp_path):
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.observability.perf",
+         "gate", "--dir", str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert res.returncode == 2
+
+
+# ---------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------
+
+
+def _write_run_dir(tmp_path):
+    rundir = tmp_path / "run"
+    rundir.mkdir()
+    for rank in (0, 1):
+        with open(rundir / f"events-rank{rank}.jsonl", "w") as f:
+            for seq in (1, 2, 3):
+                cid = f"c{rank}{seq}"
+                f.write(json.dumps(_emission(rank, seq, "AllReduce",
+                                             cid=cid)) + "\n")
+                f.write(json.dumps(_latency(rank, "AllReduce",
+                                            0.001 * seq, cid=cid)) + "\n")
+    return str(rundir)
+
+
+def test_cli_selftest():
+    """The tier-1 hook: the CLI's device-free smoke must keep passing
+    (synthetic events, markdown, both gate verdicts, the watch)."""
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.observability.perf",
+         "--selftest"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "perf selftest ok" in res.stdout
+
+
+def test_cli_report_writes_markdown(tmp_path):
+    rundir = _write_run_dir(tmp_path)
+    md = str(tmp_path / "PERF_REPORT.md")
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.observability.perf",
+         "report", rundir, "-o", md, "--peak-gbps", "50"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "AllReduce" in res.stdout
+    content = open(md).read()
+    assert "# Performance report" in content
+    assert "ring reduce-scatter + all-gather" in content
+
+
+def test_cli_report_json_finite(tmp_path):
+    rundir = _write_run_dir(tmp_path)
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.observability.perf",
+         "report", rundir, "--json"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert res.returncode == 0, res.stderr
+    (row,) = json.loads(res.stdout)["rows"]
+    assert row["samples"] == 6
+    assert row["achieved_gbps"] > 0 and row["pct_of_peak"] > 0
+
+
+def test_cli_report_no_input_exit_2(tmp_path):
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.observability.perf",
+         "report", str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert res.returncode == 2
+
+
+def test_cli_compare_event_dirs(tmp_path):
+    a = tmp_path / "a"
+    b = tmp_path / "b"
+    for d, scale in ((a, 1.0), (b, 10.0)):  # b is 10x slower
+        d.mkdir()
+        with open(d / "events-rank0.jsonl", "w") as f:
+            for seq in (1, 2, 3, 4):
+                cid = f"c{seq}"
+                f.write(json.dumps(_emission(0, seq, "AllReduce",
+                                             cid=cid)) + "\n")
+                f.write(json.dumps(_latency(0, "AllReduce", 0.001 * scale,
+                                            cid=cid)) + "\n")
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.observability.perf",
+         "compare", str(a), str(b)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "REGRESSED" in res.stdout
+
+
+def test_doctor_perf_section(tmp_path):
+    rundir = _write_run_dir(tmp_path)
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.observability.doctor",
+         rundir, "--perf"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "no findings" in res.stdout
+    assert "perf attribution vs peak" in res.stdout
+    assert "AllReduce" in res.stdout
+
+
+# ---------------------------------------------------------------------
+# end-to-end: real 2-rank launch --events-dir --perf on CPU
+# ---------------------------------------------------------------------
+
+needs_native = pytest.mark.skipif(
+    subprocess.run(["which", "g++"], capture_output=True).returncode != 0,
+    reason="no C++ toolchain",
+)
+
+
+@needs_native
+def test_launch_perf_roundtrip(tmp_path):
+    """Acceptance: ``launch --events-dir --perf`` on the CPU container
+    -> per-rank latency events -> a finite per-op achieved-bandwidth
+    table from both the launcher's inline section and the offline
+    ``perf report``."""
+    script = tmp_path / "case.py"
+    with open(script, "w") as f:
+        f.write(f"import sys; sys.path.insert(0, {REPO!r})\n")
+        f.write(textwrap.dedent(
+            """
+            import jax.numpy as jnp
+            import mpi4jax_tpu as m4t
+            from mpi4jax_tpu.runtime import shm
+            x = jnp.arange(1024.0) + shm.rank()
+            for _ in range(4):
+                x = m4t.allreduce(x)
+            print(f"OK{shm.rank()}")
+            """
+        ))
+    rundir = str(tmp_path / "run")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.launch", "-n", "2",
+         "--events-dir", rundir, "--perf", str(script)],
+        env=env, capture_output=True, text=True, timeout=180, cwd=REPO,
+    )
+    assert res.returncode == 0, res.stderr
+    assert "OK0" in res.stdout and "OK1" in res.stdout
+    # the launcher printed its inline attribution section
+    assert "perf attribution" in res.stderr
+    assert "AllReduce" in res.stderr
+    # offline round trip over the same artifacts
+    by_rank = doctor.load([rundir])
+    assert sorted(by_rank) == [0, 1]
+    result = perf.attribute(by_rank)
+    (row,) = [r for r in result["rows"] if r["op"] == "AllReduce"]
+    assert row["emissions"] == 8  # 4 collectives x 2 ranks
+    assert row["samples"] >= 1
+    for field in ("lat_p50_s", "achieved_gbps", "pct_of_peak"):
+        value = row[field]
+        assert isinstance(value, float) and value > 0, (field, value)
+    assert row["wire_bytes"] == 4096  # 2*(n-1)/n * 4KiB at n=2
