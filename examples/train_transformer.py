@@ -9,6 +9,14 @@ CollectivePermute rings / AllToAll instead of halo exchanges.
 
     python examples/train_transformer.py --nproc 8 --steps 20 --platform cpu
     python examples/train_transformer.py --attention ulysses
+
+Resume-aware (``--ckpt-dir DIR``): checkpoints land in a
+``resilience.CheckpointManager`` every ``--ckpt-every`` steps, and a
+restart under the self-healing supervisor (``launch --retries K
+--resume-dir DIR``, which exports ``M4T_RESUME_STEP``) — or a manual
+``--resume`` — continues from the newest valid checkpoint instead of
+step 0. Training is deterministic given (params, step), so a resumed
+run reproduces the uninterrupted one exactly.
 """
 
 import argparse
@@ -58,6 +66,25 @@ def main():
     p.add_argument("--seq-per-rank", type=int, default=16)
     p.add_argument("--attention", choices=["ring", "ulysses"], default="ring")
     p.add_argument("--platform", default=None)
+    p.add_argument(
+        "--ckpt-dir", default=None, metavar="DIR",
+        help="checkpoint root (resilience.CheckpointManager layout); "
+        "enables periodic saves and resume",
+    )
+    p.add_argument(
+        "--ckpt-every", type=int, default=5, metavar="K",
+        help="save a checkpoint every K steps (default %(default)s)",
+    )
+    p.add_argument(
+        "--ckpt-keep", type=int, default=3, metavar="N",
+        help="retain the newest N checkpoints (default %(default)s)",
+    )
+    p.add_argument(
+        "--resume", action="store_true",
+        help="resume from the newest valid checkpoint in --ckpt-dir "
+        "(M4T_RESUME_STEP, exported by the launch supervisor, resumes "
+        "a specific validated step and wins over this flag)",
+    )
     args = p.parse_args()
 
     if args.platform == "cpu" and (args.nproc or 0) > 1:
@@ -116,24 +143,65 @@ def main():
         step = (lambda f: (lambda p: f(p, tok_sp, tgt_sp)))(step)
         get_loss = lambda out: float(np.asarray(out[1])[0])
 
+    mgr = None
+    start_step = 0
+    if args.ckpt_dir:
+        from mpi4jax_tpu.resilience import CheckpointManager, resume_step
+        from mpi4jax_tpu.resilience.ckpt import pytree_fingerprint
+
+        mgr = CheckpointManager(args.ckpt_dir, keep=args.ckpt_keep)
+        fp = pytree_fingerprint({"params": params})
+        rstep = resume_step()
+        if rstep is not None:
+            # the supervisor validated this exact step before the
+            # restart; every rank must restore it, not whatever is
+            # newest by the time it looks
+            info = mgr.at_step(rstep, fingerprint=fp)
+        else:
+            info = mgr.latest_valid(fingerprint=fp) if args.resume else None
+        if info is not None:
+            restored = mgr.restore(info, {"params": params})["params"]
+            # decommit: orbax pins restored leaves to one device, but
+            # the spmd step wants the same uncommitted host arrays the
+            # fresh-init path produces (jit reshards those freely)
+            params = jax.tree.map(
+                lambda a: jnp.asarray(np.asarray(a)), restored
+            )
+            start_step = info.step + 1
+            print(
+                f"resumed from checkpoint step {info.step} "
+                f"({info.path})", file=sys.stderr,
+            )
+
     start = time.perf_counter()
     first = last = None
-    for i in range(args.steps):
+    loss = None
+    for i in range(start_step, args.steps):
         params, loss = step(params)
         lval = get_loss((params, loss))
-        if i == 0:
+        if i == start_step:
             first = lval
         last = lval
         if i % 5 == 0 or i == args.steps - 1:
             print(f"step {i:3d}  loss {lval:.4f}", file=sys.stderr)
+        if mgr is not None and (
+            (i + 1) % args.ckpt_every == 0 or i == args.steps - 1
+        ):
+            mgr.save(i, {"params": params})
+    if loss is None:
+        print("nothing to do: checkpoint is already past --steps",
+              file=sys.stderr)
+        return
     jax.block_until_ready(loss)
     elapsed = time.perf_counter() - start
+    n_steps = args.steps - start_step
     print(
-        f"{args.steps} steps in {elapsed:.2f}s "
-        f"({args.steps / elapsed:.1f} steps/s); loss {first:.4f} -> {last:.4f}",
+        f"{n_steps} steps in {elapsed:.2f}s "
+        f"({n_steps / elapsed:.1f} steps/s); loss {first:.4f} -> {last:.4f}",
         file=sys.stderr,
     )
-    assert last < first, "loss did not decrease"
+    if start_step == 0:
+        assert last < first, "loss did not decrease"
 
 
 if __name__ == "__main__":

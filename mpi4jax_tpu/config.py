@@ -65,6 +65,22 @@ Performance attribution (``observability/{costmodel,perf}.py``):
 - ``M4T_PERF_WARMUP``: int -> samples per fingerprint before the
   watch may flag anything (default 10).
 
+Resilience (``resilience/``):
+
+- ``M4T_FAULT_PLAN``: path to (or inline) JSON fault-injection plan
+  (``resilience/faults.py``; ``launch --fault-plan`` sets it for every
+  rank). Armed rules inject delay/hang/crash/slowdown at the Nth
+  emission of an op on a rank; zero overhead when unset.
+- ``M4T_FAULT_ATTEMPT``: supervisor attempt index (set by the
+  launcher's retry loop) — fault rules carrying an ``attempt`` field
+  only fire on that attempt.
+- ``M4T_RESUME_STEP``: checkpoint step the supervisor validated before
+  restarting this world (``resilience/supervisor.resume_step()``);
+  resume-aware training loops continue from step+1 instead of 0.
+- ``M4T_SHM_GEN``: per-launch generation nonce validated in the shm
+  segment header (``runtime/shm.py``; closes the stale-segment TOCTOU
+  of ADVICE.md round 5).
+
 Flight recorder (``observability/recorder.py``):
 
 - ``M4T_FLIGHT_RECORDER``: set falsy to disable the always-cheap
@@ -195,6 +211,11 @@ def _static_check_mode() -> str:
 #: emission-time static screening mode ('' = off, 'warn', 'error');
 #: see analysis/emit_check.py
 STATIC_CHECK = _static_check_mode()
+
+#: fault-injection plan spec — path or inline JSON ('' = unarmed);
+#: gates the per-emission hook in ops/_core.py so the unarmed cost is
+#: one falsy check (see resilience/faults.py)
+FAULT_PLAN = os.environ.get("M4T_FAULT_PLAN", "")
 
 #: flight recorder: always-cheap in-memory ring of recent collective
 #: emissions (observability/recorder.py); on unless explicitly off

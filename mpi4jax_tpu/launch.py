@@ -23,12 +23,25 @@ the artifact layout the cross-rank doctor consumes. On any failure
 ``MPI_Abort``-less failure mode mpirun never diagnoses) the launcher
 tears the world down and prints the doctor's diagnosis: which rank
 diverged/hung at which collective sequence number.
+
+Resilience (``resilience/``): ``--fault-plan`` arms a deterministic
+fault-injection plan in every rank (chaos testing); ``--retries K
+--backoff S --resume-dir CKPTROOT`` runs the world under the
+self-healing supervisor — failed attempts are diagnosed by the doctor
+and classified: transient failures (hang, dead rank, plain crash)
+restart from the latest valid checkpoint with exponential backoff
+(``M4T_RESUME_STEP`` exported to the children), deterministic ones
+(MISMATCH) fail fast with the diagnosis. With retries, each attempt
+gets its own ``DIR/attempt<k>`` artifact directory and every verdict
+lands in ``DIR/supervisor.jsonl``. ``--retries 0`` (the default) is
+byte-for-byte the old single-attempt behavior.
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import random
 import signal
 import subprocess
 import sys
@@ -80,6 +93,170 @@ def _run_perf_report(events_dir):
         sys.stderr.write(f"mpi4jax_tpu.launch: perf report failed: {exc!r}\n")
 
 
+def _spawn_world(
+    args,
+    events_dir,
+    *,
+    attempt=0,
+    resume_step=None,
+    fault_plan_env=None,
+):
+    """Spawn and babysit one N-rank world; returns its exit code.
+
+    One *attempt* in supervisor terms: a fresh shm segment name and
+    generation nonce every time, so a restarted world can never attach
+    a dead predecessor's segment (the ADVICE round-5 TOCTOU — the
+    nonce is validated in the segment header by ``runtime/shmcc.cpp``).
+    On the first nonzero rank exit the world is terminated, given a
+    grace period to dump flight recorders, then killed — a surviving
+    rank wedged inside a native collective must not hold the launcher
+    (or the retry loop) hostage.
+    """
+    shm_name = f"/m4t_{os.getpid()}_{attempt}_{uuid.uuid4().hex[:8]}"
+    # nonzero u32: 0 means "no generation check" to the extension
+    shm_gen = random.getrandbits(32) | 1
+    procs = []
+    try:
+        for rank in range(args.nproc):
+            env = dict(os.environ)
+            env.update(
+                M4T_SHM_NAME=shm_name,
+                M4T_RANK=str(rank),
+                M4T_SIZE=str(args.nproc),
+                M4T_SHM_GEN=str(shm_gen),
+                # world membership is for *direct* children only:
+                # runtime/shm.py refuses to join when the parent pid
+                # doesn't match, so a rank's own subprocesses (pytest
+                # spawning helper scripts) never attach as duplicate
+                # ranks of the live world
+                M4T_LAUNCHER_PID=str(os.getpid()),
+                JAX_PLATFORMS="cpu",
+            )
+            if args.static_check != "off":
+                env["M4T_STATIC_CHECK"] = args.static_check
+            if fault_plan_env:
+                env["M4T_FAULT_PLAN"] = fault_plan_env
+                env["M4T_FAULT_ATTEMPT"] = str(attempt)
+            if resume_step is not None:
+                env["M4T_RESUME_STEP"] = str(resume_step)
+            if events_dir:
+                # literal {rank} on purpose: each child resolves the
+                # template from its own M4T_RANK (events.py), so the
+                # launcher and any grandchildren agree on the layout
+                env.update(
+                    M4T_TELEMETRY="1",
+                    M4T_TELEMETRY_EVENTS=os.path.join(
+                        events_dir, "events-rank{rank}.jsonl"
+                    ),
+                    M4T_TELEMETRY_FSYNC="1",
+                    M4T_FLIGHT_RECORDER_DIR=events_dir,
+                    M4T_HEARTBEAT=str(args.heartbeat),
+                )
+                if args.perf:
+                    env.update(
+                        M4T_TELEMETRY_RUNTIME="1",
+                        M4T_PERF_WATCH="1",
+                    )
+            cmd = [sys.executable]
+            if os.environ.get("M4T_LAUNCH_COVERAGE"):
+                # Run each rank under parallel-mode coverage so CI can
+                # `coverage combine` the per-rank data files with the
+                # single-process run (the reference's
+                # covecov-coverage.yml merges 1-rank and mpirun runs
+                # the same way).
+                cmd += ["-m", "coverage", "run", "-p"]
+            if args.module:
+                cmd += ["-m", args.module]
+            cmd += args.cmd
+            procs.append(subprocess.Popen(cmd, env=env))
+
+        exit_code = 0
+        done = [False] * len(procs)
+        deadline = (
+            time.monotonic() + args.hang_timeout if args.hang_timeout > 0
+            else None
+        )
+        # armed when the world is being torn down after a rank failure:
+        # survivors get this long to run signal handlers (flight-
+        # recorder dumps), then SIGKILL — a rank wedged in a native
+        # collective spin can't run Python handlers at all
+        term_deadline = None
+        while not all(done):
+            for i, p in enumerate(procs):
+                if done[i]:
+                    continue
+                rc = p.poll()
+                if rc is None:
+                    continue
+                done[i] = True
+                if rc != 0 and exit_code == 0:
+                    exit_code = rc
+                    sys.stderr.write(
+                        f"mpi4jax_tpu.launch: rank {i} exited with code "
+                        f"{rc}; terminating world\n"
+                    )
+                    term_deadline = time.monotonic() + 10.0
+                    for q in procs:
+                        if q.poll() is None:
+                            q.terminate()
+            if term_deadline is not None and not all(done) and (
+                time.monotonic() > term_deadline
+            ):
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                for p in procs:
+                    p.wait()
+                break
+            if deadline is not None and not all(done) and (
+                time.monotonic() > deadline
+            ):
+                alive = [i for i, p in enumerate(procs) if p.poll() is None]
+                sys.stderr.write(
+                    f"mpi4jax_tpu.launch: hang watchdog fired after "
+                    f"{args.hang_timeout:g}s; rank(s) "
+                    f"{','.join(map(str, alive))} still running — "
+                    "terminating world\n"
+                )
+                # SIGTERM first: a rank blocked in Python dumps its
+                # flight recorder from the handler; a rank wedged in a
+                # native collective wait can't run the handler and
+                # needs the SIGKILL below (its trace-time events are
+                # already fsync'd on disk).
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                grace = time.monotonic() + 5.0
+                while time.monotonic() < grace and any(
+                    p.poll() is None for p in procs
+                ):
+                    time.sleep(0.05)
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                for p in procs:
+                    p.wait()
+                exit_code = 124
+                break
+            time.sleep(0.02)
+        return exit_code
+    except KeyboardInterrupt:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        for p in procs:
+            p.wait()
+        return 130
+    finally:
+        # shm_unlink parity: rank 0's atexit unlinks; sweep in case it
+        # died before doing so.
+        path = "/dev/shm" + shm_name
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="python -m mpi4jax_tpu.launch", description=__doc__
@@ -129,6 +306,31 @@ def main(argv=None):
         "rules (analysis/emit_check.py) and warn or raise; the full "
         "jaxpr linter is `python -m mpi4jax_tpu.analysis`",
     )
+    parser.add_argument(
+        "--fault-plan", default=None, metavar="SPEC",
+        help="chaos mode: arm a deterministic fault-injection plan "
+        "(path to, or inline, JSON — resilience/faults.py) in every "
+        "rank via M4T_FAULT_PLAN; validated against -n before any "
+        "rank spawns",
+    )
+    parser.add_argument(
+        "--retries", type=int, default=0, metavar="K",
+        help="self-healing supervisor: restart the world up to K times "
+        "after *transient* failures (hang/dead rank/plain crash per "
+        "the doctor's verdict); deterministic failures (MISMATCH) "
+        "fail fast. 0 (default) = today's single-attempt behavior",
+    )
+    parser.add_argument(
+        "--backoff", type=float, default=1.0, metavar="S",
+        help="first restart delay in seconds; doubles per retry with "
+        "jitter, capped at 60s (default %(default)s)",
+    )
+    parser.add_argument(
+        "--resume-dir", default=None, metavar="CKPTROOT",
+        help="CheckpointManager root (resilience/ckpt.py): before each "
+        "restart the newest *valid* checkpoint step is found here and "
+        "exported to every rank as M4T_RESUME_STEP",
+    )
     parser.add_argument("cmd", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
 
@@ -143,6 +345,11 @@ def main(argv=None):
     if not args.cmd and not args.module:
         parser.error("missing script")
 
+    if args.retries < 0:
+        parser.error("--retries must be >= 0")
+    if args.backoff < 0:
+        parser.error("--backoff must be >= 0")
+
     events_dir = args.events_dir
     if args.perf and not events_dir:
         parser.error("--perf requires --events-dir (it reads the "
@@ -151,132 +358,119 @@ def main(argv=None):
         events_dir = os.path.abspath(events_dir)
         os.makedirs(events_dir, exist_ok=True)
 
-    shm_name = f"/m4t_{os.getpid()}_{uuid.uuid4().hex[:8]}"
-    procs = []
-    try:
-        for rank in range(args.nproc):
-            env = dict(os.environ)
-            env.update(
-                M4T_SHM_NAME=shm_name,
-                M4T_RANK=str(rank),
-                M4T_SIZE=str(args.nproc),
-                # world membership is for *direct* children only:
-                # runtime/shm.py refuses to join when the parent pid
-                # doesn't match, so a rank's own subprocesses (pytest
-                # spawning helper scripts) never attach as duplicate
-                # ranks of the live world
-                M4T_LAUNCHER_PID=str(os.getpid()),
-                JAX_PLATFORMS="cpu",
-            )
-            if args.static_check != "off":
-                env["M4T_STATIC_CHECK"] = args.static_check
-            if events_dir:
-                # literal {rank} on purpose: each child resolves the
-                # template from its own M4T_RANK (events.py), so the
-                # launcher and any grandchildren agree on the layout
-                env.update(
-                    M4T_TELEMETRY="1",
-                    M4T_TELEMETRY_EVENTS=os.path.join(
-                        events_dir, "events-rank{rank}.jsonl"
-                    ),
-                    M4T_TELEMETRY_FSYNC="1",
-                    M4T_FLIGHT_RECORDER_DIR=events_dir,
-                    M4T_HEARTBEAT=str(args.heartbeat),
-                )
-                if args.perf:
-                    env.update(
-                        M4T_TELEMETRY_RUNTIME="1",
-                        M4T_PERF_WATCH="1",
-                    )
-            cmd = [sys.executable]
-            if os.environ.get("M4T_LAUNCH_COVERAGE"):
-                # Run each rank under parallel-mode coverage so CI can
-                # `coverage combine` the per-rank data files with the
-                # single-process run (the reference's
-                # covecov-coverage.yml merges 1-rank and mpirun runs
-                # the same way).
-                cmd += ["-m", "coverage", "run", "-p"]
-            if args.module:
-                cmd += ["-m", args.module]
-            cmd += args.cmd
-            procs.append(subprocess.Popen(cmd, env=env))
+    fault_plan_env = None
+    if args.fault_plan:
+        from .resilience import faults
 
-        exit_code = 0
-        done = [False] * len(procs)
-        deadline = (
-            time.monotonic() + args.hang_timeout if args.hang_timeout > 0
-            else None
+        spec = args.fault_plan
+        if os.path.exists(spec):
+            spec = os.path.abspath(spec)
+        try:
+            faults.FaultPlan.load(spec).validate_world(args.nproc)
+        except faults.FaultPlanError as e:
+            parser.error(f"--fault-plan: {e}")
+        fault_plan_env = spec
+
+    resume_dir = args.resume_dir
+    if resume_dir:
+        resume_dir = os.path.abspath(resume_dir)
+        os.makedirs(resume_dir, exist_ok=True)
+
+    if args.retries == 0:
+        # the pre-supervisor contract, preserved exactly: one attempt,
+        # flat artifact layout, same exit codes
+        exit_code = _spawn_world(
+            args, events_dir, fault_plan_env=fault_plan_env
         )
-        hung = False
-        while not all(done):
-            for i, p in enumerate(procs):
-                if done[i]:
-                    continue
-                rc = p.poll()
-                if rc is None:
-                    continue
-                done[i] = True
-                if rc != 0 and exit_code == 0:
-                    exit_code = rc
-                    sys.stderr.write(
-                        f"mpi4jax_tpu.launch: rank {i} exited with code "
-                        f"{rc}; terminating world\n"
-                    )
-                    for q in procs:
-                        if q.poll() is None:
-                            q.terminate()
-            if deadline is not None and not all(done) and (
-                time.monotonic() > deadline
-            ):
-                hung = True
-                alive = [i for i, p in enumerate(procs) if p.poll() is None]
-                sys.stderr.write(
-                    f"mpi4jax_tpu.launch: hang watchdog fired after "
-                    f"{args.hang_timeout:g}s; rank(s) "
-                    f"{','.join(map(str, alive))} still running — "
-                    "terminating world\n"
-                )
-                # SIGTERM first: a rank blocked in Python dumps its
-                # flight recorder from the handler; a rank wedged in a
-                # native collective wait can't run the handler and
-                # needs the SIGKILL below (its trace-time events are
-                # already fsync'd on disk).
-                for p in procs:
-                    if p.poll() is None:
-                        p.terminate()
-                grace = time.monotonic() + 5.0
-                while time.monotonic() < grace and any(
-                    p.poll() is None for p in procs
-                ):
-                    time.sleep(0.05)
-                for p in procs:
-                    if p.poll() is None:
-                        p.kill()
-                for p in procs:
-                    p.wait()
-                exit_code = 124
-                break
-            time.sleep(0.02)
-        if events_dir and (hung or exit_code != 0 or args.doctor):
+        if events_dir and (exit_code != 0 or args.doctor):
             _run_doctor(events_dir)
         if events_dir and args.perf:
             _run_perf_report(events_dir)
         return exit_code
-    except KeyboardInterrupt:
-        for p in procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGINT)
-        for p in procs:
-            p.wait()
-        return 130
-    finally:
-        # shm_unlink parity: rank 0's atexit unlinks; sweep in case it
-        # died before doing so.
-        path = "/dev/shm" + shm_name
+
+    # -- supervised path (--retries K) --------------------------------
+    from .resilience.supervisor import RetryPolicy, Supervisor
+
+    state = {"dir": events_dir}
+
+    def attempt_dir(attempt):
+        if not events_dir:
+            return None
+        d = os.path.join(events_dir, f"attempt{attempt:02d}")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def run_fn(attempt, resume_step):
+        d = attempt_dir(attempt)
+        state["dir"] = d
+        sys.stderr.write(
+            f"mpi4jax_tpu.launch: attempt {attempt}"
+            + (f" (resuming from step {resume_step})"
+               if resume_step is not None else "")
+            + (f" [{d}]" if d else "")
+            + "\n"
+        )
+        return _spawn_world(
+            args, d,
+            attempt=attempt,
+            resume_step=resume_step,
+            fault_plan_env=fault_plan_env,
+        )
+
+    def diagnose_fn(attempt):
+        d = state.get("dir")
+        if not d:
+            return None
         try:
-            os.unlink(path)
-        except OSError:
-            pass
+            from .observability import doctor
+
+            report = doctor.diagnose([d])
+        except Exception as exc:
+            sys.stderr.write(
+                f"mpi4jax_tpu.launch: doctor failed: {exc!r}\n"
+            )
+            return None
+        if report is not None:
+            sys.stderr.write(
+                "mpi4jax_tpu.launch: post-mortem diagnosis "
+                f"({d}):\n{doctor.format_report(report)}\n"
+            )
+        return report
+
+    def resume_fn():
+        if not resume_dir:
+            return None
+        try:
+            from .resilience.ckpt import CheckpointManager
+
+            info = CheckpointManager(
+                resume_dir, world=args.nproc
+            ).latest_valid(world=args.nproc)
+            return None if info is None else info.step
+        except Exception as exc:
+            sys.stderr.write(
+                f"mpi4jax_tpu.launch: checkpoint scan failed: {exc!r}\n"
+            )
+            return None
+
+    audit_root = events_dir or resume_dir
+    sup = Supervisor(
+        run_fn,
+        policy=RetryPolicy(retries=args.retries, backoff_s=args.backoff),
+        diagnose_fn=diagnose_fn,
+        resume_fn=resume_fn,
+        audit_path=(
+            os.path.join(audit_root, "supervisor.jsonl")
+            if audit_root else None
+        ),
+        log=lambda msg: sys.stderr.write(f"mpi4jax_tpu.launch: {msg}\n"),
+    )
+    exit_code = sup.run()
+    if events_dir and args.doctor and exit_code == 0:
+        _run_doctor(state["dir"])
+    if events_dir and args.perf and state.get("dir"):
+        _run_perf_report(state["dir"])
+    return exit_code
 
 
 if __name__ == "__main__":

@@ -40,6 +40,35 @@ a MISMATCH then names the source line of each diverging collective.
 Exit status: 0 clean, 1 findings, 2 no usable input. Used by the
 launcher's hang watchdog (``launch.py --hang-timeout``) to print a
 diagnosis the moment a world is torn down.
+
+``--json`` output is a **stable machine contract** (consumed by the
+resilience supervisor and CI, not scraped from text), versioned by the
+top-level ``schema`` field (:data:`SCHEMA`). The ``m4t-doctor/1``
+schema::
+
+    {"schema": "m4t-doctor/1",
+     "ranks": [int, ...],             # ranks that produced any log
+     "records": {"<rank>": int},      # raw records loaded per rank
+     "seqs": {"<rank>": int},         # last collective seq per rank
+     "findings": [ ... ]}             # ordered most- to least-causal
+
+Finding kinds and their stable fields:
+
+- ``mismatch`` — ``seq``, ``fingerprints`` {rank: fp},
+  ``groups`` [{``fingerprint``, ``ranks``, and — when ``--static``
+  joined — ``static_sites`` [{``index``, ``source``, ``path``,
+  ``fingerprint``}]}];
+- ``hang`` — ``rank``, ``verdict`` (``hung``/``dead``/``behind``),
+  ``last_seq``, ``front_seq``, ``gap``, ``front_ranks``,
+  ``stuck_before`` (fingerprint or null), ``last_heartbeat_t``,
+  ``last_emission_t``, optional ``static_sites``;
+- ``missing_rank`` — ``rank``, ``world``, ``note``;
+- ``straggler`` — ``op``, ``rank``, ``mean_s``, ``peer_median_s``,
+  ``ratio``, ``samples``, ``min_samples``, ``peer_samples``.
+
+New fields may be added within a schema version; existing ones are
+renamed or removed only with a version bump. Exit codes are part of
+the contract and unchanged by ``--json``.
 """
 
 from __future__ import annotations
@@ -55,6 +84,11 @@ from typing import Any, Dict, Iterable, List, Optional
 
 from . import events
 from .recorder import fingerprint
+
+#: report-schema version tag: the supervisor/CI contract for ``--json``
+#: (and the dict ``analyze``/``diagnose`` return); bump only on
+#: renames/removals, never for additive fields
+SCHEMA = "m4t-doctor/1"
 
 #: a rank is reported hung/behind when it trails the front rank by at
 #: least this many seqs (1: any divergence in stream length matters —
@@ -350,6 +384,7 @@ def analyze(
         + _find_stragglers(by_rank, straggler_ratio, straggler_min_samples)
     )
     return {
+        "schema": SCHEMA,
         "ranks": sorted(by_rank),
         "records": {str(r): len(recs) for r, recs in sorted(by_rank.items())},
         "seqs": {

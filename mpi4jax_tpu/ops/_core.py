@@ -37,6 +37,7 @@ from jax.interpreters import batching, mlir, xla
 from .. import config
 from .. import debug
 from .. import observability as _obs
+from ..resilience import faults as _faults
 from ..token import ordered_call
 from ..utils.profiling import emission_scope
 
@@ -182,6 +183,20 @@ def _telemetry_prologue(
         shape=shape,
     )
     debug.log_runtime(bound_comm, ident, opname, details)
+    # Fault injection LAST (resilience/faults.py): the recorder ring
+    # and event sink above already hold this emission, so an injected
+    # crash/hang leaves exactly the artifact trail an organic one
+    # would. Unarmed (the default) this is one falsy check.
+    if config.FAULT_PLAN or _faults.active_plan is not None:
+        _faults.on_emission(
+            opname,
+            cid=ident,
+            nbytes=nbytes,
+            dtype=dtype,
+            shape=shape,
+            axes=axes,
+            world=world,
+        )
     return ident, scope
 
 

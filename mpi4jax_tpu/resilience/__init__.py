@@ -1,0 +1,56 @@
+"""Resilience subsystem: provoke failures, survive them.
+
+PRs 1–4 built detection — flight recorder, cross-rank doctor, static
+linter, perf anomaly watch. This package closes the loop from
+*detection* to *recovery*, the robustness shape real TPU/cloud fleets
+need (preemptions, slow hosts, and transient hangs are weather, not
+incidents — see PAPERS.md, Cloud Collectives):
+
+- :mod:`.faults` — deterministic, seeded fault-injection plans
+  (``M4T_FAULT_PLAN`` / ``launch --fault-plan``): delay / hang /
+  crash / slowdown at the Nth emission of an op on a rank, logged as
+  ``fault`` JSONL events so injected and observed failures can be
+  overlaid. Chaos testing for everything below.
+- :mod:`.ckpt` — :class:`~.ckpt.CheckpointManager`: step-tagged
+  atomic checkpoint commits (tmp dir + rename, manifest written
+  last), retention of the last K, and ``latest_valid()`` that skips
+  torn or mismatched checkpoints on resume.
+- :mod:`.supervisor` — restart policy over the doctor's verdicts:
+  transient failures (hang, dead/missing rank, plain crash) restart
+  from the latest valid checkpoint with exponential backoff + jitter
+  and ``M4T_RESUME_STEP`` exported to the children; deterministic
+  failures (MISMATCH, statically attributable) fail fast with the
+  diagnosis. Every attempt is recorded in a ``supervisor.jsonl``
+  audit log. Driven by ``python -m mpi4jax_tpu.launch --retries K
+  --backoff S --resume-dir DIR``.
+
+``python -m mpi4jax_tpu.resilience --selftest`` is the device-free CI
+smoke (no jax, no orbax, no subprocesses). See ``docs/resilience.md``.
+"""
+
+from . import ckpt  # noqa: F401
+from . import faults  # noqa: F401
+from . import supervisor  # noqa: F401
+from .ckpt import CheckpointInfo, CheckpointManager  # noqa: F401
+from .faults import FaultPlan, FaultPlanError, InjectedFault  # noqa: F401
+from .supervisor import (  # noqa: F401
+    RetryPolicy,
+    Supervisor,
+    classify,
+    resume_step,
+)
+
+__all__ = [
+    "CheckpointInfo",
+    "CheckpointManager",
+    "FaultPlan",
+    "FaultPlanError",
+    "InjectedFault",
+    "RetryPolicy",
+    "Supervisor",
+    "ckpt",
+    "classify",
+    "faults",
+    "resume_step",
+    "supervisor",
+]

@@ -1,0 +1,171 @@
+"""``python -m mpi4jax_tpu.resilience --selftest``: device-free smoke.
+
+Mirrors ``observability.perf --selftest``: a CI-runnable exercise of
+the subsystem's pure-Python core — fault-plan parsing and matching,
+the checkpoint commit/validity protocol (via a JSON storage layer, so
+no jax/orbax), verdict classification, and the supervisor retry loop —
+with no devices, no subprocess worlds, no network. Wired into tier-1
+by ``tests/test_resilience.py`` so the CLI cannot silently rot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+from .ckpt import CheckpointManager
+from .faults import FaultPlan, FaultPlanError, faults_selftest_hook
+from .supervisor import RetryPolicy, Supervisor, classify
+
+
+def _json_save(path: str, state) -> None:
+    with open(path, "w") as f:
+        json.dump(state, f)
+
+
+def _json_restore(path: str, template):
+    with open(path) as f:
+        return json.load(f)
+
+
+def selftest() -> int:
+    # -- fault plans: parse, validate, count, inject -------------------
+    plan = FaultPlan.parse(json.dumps({
+        "seed": 7,
+        "faults": [
+            {"rank": 0, "op": "AllReduce", "nth": 2, "action": "delay",
+             "ms": 1},
+            {"rank": 1, "op": "*", "nth": 1, "action": "crash"},
+        ],
+    }))
+    assert len(plan.rules) == 2 and plan.seed == 7
+    plan.validate_world(2)
+    for bad, needle in (
+        ("{not json", "not valid JSON"),
+        ('{"faults": []}', "non-empty"),
+        ('[{"rank": 0, "op": "NoSuchOp", "action": "hang"}]', "unknown op"),
+        ('[{"rank": 0, "op": "Barrier", "action": "explode"}]', "action"),
+        ('[{"rank": -1, "op": "Barrier", "action": "hang"}]', "rank"),
+        ('[{"rank": 0, "op": "Barrier", "action": "delay"}]', "ms"),
+        ('[{"rank": 0, "action": "hang"}]', "'op' or 'fingerprint'"),
+    ):
+        try:
+            FaultPlan.parse(bad)
+        except FaultPlanError as e:
+            assert needle in str(e), (bad, e)
+        else:
+            raise AssertionError(f"plan {bad!r} should not parse")
+    try:
+        FaultPlan.parse(
+            '[{"rank": 5, "op": "Barrier", "action": "hang"}]'
+        ).validate_world(2)
+    except FaultPlanError as e:
+        assert "out of range" in str(e)
+    else:
+        raise AssertionError("rank 5 of world 2 should not validate")
+    fired = faults_selftest_hook(plan)
+    assert fired == ["delay@AllReduce#2"], fired
+
+    # -- checkpoint manager: atomicity, retention, validity ------------
+    with tempfile.TemporaryDirectory() as tmp:
+        mgr = CheckpointManager(
+            os.path.join(tmp, "ckpt"), keep=2, world=2,
+            save_fn=_json_save, restore_fn=_json_restore,
+        )
+        for step in (1, 2, 3, 4):
+            mgr.save(step, {"w": [step, step], "step": step},
+                     fingerprint="fp0")
+        assert mgr.steps() == [3, 4], mgr.steps()  # retention keep=2
+        info = mgr.latest_valid(fingerprint="fp0", world=2)
+        assert info is not None and info.step == 4
+        # torn checkpoint (no manifest) is skipped, older one wins
+        os.unlink(os.path.join(info.path, "manifest.json"))
+        info2 = mgr.latest_valid(fingerprint="fp0", world=2)
+        assert info2 is not None and info2.step == 3, info2
+        # wrong fingerprint / world are skipped too
+        assert mgr.latest_valid(fingerprint="other") is None
+        assert mgr.latest_valid(fingerprint="fp0", world=4) is None
+        state = mgr.restore(info2, template=None)
+        assert state == {"w": [3, 3], "step": 3}
+
+    # -- classification ------------------------------------------------
+    assert classify(None, 0)["klass"] == "clean"
+    assert classify(None, 1) == {
+        "klass": "transient", "reason": "crash_no_telemetry", "kinds": [],
+    }
+    mismatch = {"findings": [{"kind": "mismatch", "seq": 3, "groups": []}]}
+    assert classify(mismatch, 1)["klass"] == "deterministic"
+    hang = {"findings": [{"kind": "hang", "rank": 1, "verdict": "hung"}]}
+    assert classify(hang, 124) == {
+        "klass": "transient", "reason": "hang", "kinds": ["hang"],
+    }
+    both = {"findings": mismatch["findings"] + hang["findings"]}
+    assert classify(both, 124)["klass"] == "deterministic"
+    clean_crash = {"findings": []}
+    assert classify(clean_crash, 1)["reason"] == "crash_without_mismatch"
+
+    # -- retry policy + supervisor loop --------------------------------
+    policy = RetryPolicy(retries=3, backoff_s=1.0, jitter=0.0)
+    assert [policy.delay(a) for a in range(4)] == [0.0, 1.0, 2.0, 4.0]
+    capped = RetryPolicy(retries=9, backoff_s=1.0, max_backoff_s=4.0,
+                         jitter=0.0)
+    assert capped.delay(9) == 4.0
+
+    # transient failures retry (with the resumed step advancing), then
+    # succeed
+    calls = []
+    sup = Supervisor(
+        lambda attempt, resume: calls.append((attempt, resume)) or (
+            0 if attempt == 2 else 1
+        ),
+        policy=RetryPolicy(retries=3, backoff_s=0.0, jitter=0.0),
+        diagnose_fn=lambda attempt: {"findings": []},
+        resume_fn=lambda: 10 * (len(calls)),
+        sleep_fn=lambda s: None,
+    )
+    assert sup.run() == 0
+    assert calls == [(0, None), (1, 10), (2, 20)], calls
+    assert [a["action"] for a in sup.attempts] == ["retry", "retry", "done"]
+
+    # deterministic failure is never retried
+    calls2 = []
+    sup2 = Supervisor(
+        lambda attempt, resume: calls2.append(attempt) or 1,
+        policy=RetryPolicy(retries=5, backoff_s=0.0, jitter=0.0),
+        diagnose_fn=lambda attempt: {
+            "findings": [{"kind": "mismatch", "seq": 1, "groups": []}]
+        },
+        sleep_fn=lambda s: None,
+    )
+    assert sup2.run() == 1
+    assert calls2 == [0], calls2
+    assert sup2.attempts[-1]["action"] == "give_up"
+    assert sup2.attempts[-1]["klass"] == "deterministic"
+
+    # retry budget is bounded
+    calls3 = []
+    sup3 = Supervisor(
+        lambda attempt, resume: calls3.append(attempt) or 7,
+        policy=RetryPolicy(retries=2, backoff_s=0.0, jitter=0.0),
+        diagnose_fn=lambda attempt: {"findings": []},
+        sleep_fn=lambda s: None,
+    )
+    assert sup3.run() == 7
+    assert calls3 == [0, 1, 2], calls3
+
+    print("resilience selftest ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--selftest" in argv:
+        return selftest()
+    print(__doc__)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

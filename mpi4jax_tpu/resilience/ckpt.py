@@ -1,0 +1,305 @@
+"""Step-tagged, crash-safe checkpoint management for elastic restart.
+
+``utils/checkpoint.py`` knows how to save/restore one pytree at one
+path; recovery needs more: a *history* of step-tagged checkpoints, an
+atomic commit protocol so a rank killed mid-save can never leave a
+checkpoint that half-parses, and a validity scan so resume picks the
+newest checkpoint that is actually whole. That is this module:
+
+- **Layout** — ``root/step_00000042/`` holds the saved pytree under
+  ``data`` plus a ``manifest.json`` recording step, world size, the
+  pytree fingerprint (structure + shapes + dtypes), and timestamps.
+- **Atomicity** — a save is built in ``root/.tmp-*`` and
+  ``os.replace``'d into place; the manifest is written (and fsync'd)
+  *last* inside the staging dir, so a directory whose manifest parses
+  is a directory whose data was fully written first. Torn saves are
+  ``.tmp-*`` litter, swept by the next :meth:`CheckpointManager.save`.
+- **Retention** — the newest ``keep`` checkpoints survive; older step
+  dirs are deleted after each successful save.
+- **Validity** — :meth:`CheckpointManager.latest_valid` walks steps
+  newest-first and returns the first one whose manifest parses, whose
+  step tag matches its directory, whose data exists, and (when asked)
+  whose world size / pytree fingerprint match the resuming program —
+  a checkpoint from a differently-shaped model or a different world
+  must not be silently loaded into this one.
+
+The storage layer is pluggable (``save_fn``/``restore_fn``): the
+default is ``utils/checkpoint.py`` (orbax), and the device-free
+``--selftest`` (``__main__.py``) swaps in a JSON saver so the commit
+protocol is testable with no jax, no orbax, no devices.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import shutil
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional
+
+MANIFEST_NAME = "manifest.json"
+DATA_NAME = "data"
+MANIFEST_SCHEMA = "m4t-ckpt/1"
+
+_STEP_RE = re.compile(r"^step_(\d{8,})$")
+
+
+def step_dirname(step: int) -> str:
+    return f"step_{int(step):08d}"
+
+
+def pytree_fingerprint(tree: Any) -> str:
+    """Stable identity of a pytree's *shape*: sha256 over the sorted
+    (path, shape, dtype) leaf descriptions. Two trees with the same
+    fingerprint can restore into each other's templates; values do not
+    participate. Leaves without shape/dtype (plain Python scalars in a
+    state dict) hash their type name."""
+    import jax
+
+    leaves = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        leaves.append((
+            jax.tree_util.keystr(path),
+            None if shape is None else [int(d) for d in shape],
+            type(leaf).__name__ if dtype is None else str(dtype),
+        ))
+    leaves.sort()
+    blob = json.dumps(leaves, separators=(",", ":")).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+@dataclass
+class CheckpointInfo:
+    """One valid on-disk checkpoint."""
+
+    step: int
+    path: str          # the step directory
+    manifest: dict
+
+    @property
+    def data_path(self) -> str:
+        return os.path.join(self.path, DATA_NAME)
+
+
+def _default_save(path: str, state: Any) -> None:
+    from ..utils import checkpoint
+
+    checkpoint.save(path, state)
+
+
+def _default_restore(path: str, template: Any) -> Any:
+    from ..utils import checkpoint
+
+    return checkpoint.restore(path, template)
+
+
+class CheckpointManager:
+    """Step-tagged atomic saves with retention and validity scanning.
+
+    ``fingerprint=False`` skips the pytree fingerprint (the default
+    computes it via jax at save time); pass a string to pin one
+    explicitly (the device-free selftest path).
+    """
+
+    def __init__(
+        self,
+        root: str,
+        *,
+        keep: int = 3,
+        world: Optional[int] = None,
+        save_fn: Callable[[str, Any], None] = _default_save,
+        restore_fn: Callable[[str, Any], Any] = _default_restore,
+    ):
+        self.root = os.path.abspath(root)
+        self.keep = max(1, int(keep))
+        self.world = None if world is None else int(world)
+        self._save_fn = save_fn
+        self._restore_fn = restore_fn
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- scanning -----------------------------------------------------
+
+    def steps(self) -> List[int]:
+        """Step tags present on disk (committed dirs only), ascending."""
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        for name in names:
+            m = _STEP_RE.match(name)
+            if m and os.path.isdir(os.path.join(self.root, name)):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def _validate(
+        self,
+        step: int,
+        *,
+        fingerprint: Optional[str] = None,
+        world: Optional[int] = None,
+    ) -> Optional[CheckpointInfo]:
+        path = os.path.join(self.root, step_dirname(step))
+        manifest_path = os.path.join(path, MANIFEST_NAME)
+        try:
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None  # torn: no/unparseable manifest
+        if not isinstance(manifest, dict) or manifest.get("step") != step:
+            return None  # renamed/copied dir whose tag lies
+        data = os.path.join(path, DATA_NAME)
+        if not os.path.exists(data) or (
+            os.path.isdir(data) and not os.listdir(data)
+        ):
+            return None  # manifest without data: truncated by hand
+        want_world = self.world if world is None else int(world)
+        if want_world is not None and manifest.get("world") not in (
+            None, want_world
+        ):
+            return None  # checkpoint from a differently-sized world
+        if fingerprint is not None and manifest.get("fingerprint") not in (
+            None, fingerprint
+        ):
+            return None  # different model shape: do not resume into it
+        return CheckpointInfo(step=step, path=path, manifest=manifest)
+
+    def at_step(
+        self,
+        step: int,
+        *,
+        fingerprint: Optional[str] = None,
+        world: Optional[int] = None,
+    ) -> Optional[CheckpointInfo]:
+        """The committed checkpoint at exactly ``step``, if valid —
+        how a restarted rank resolves the ``M4T_RESUME_STEP`` the
+        supervisor validated (every rank must restore the *same* step,
+        not whatever is newest by the time it looks)."""
+        return self._validate(
+            int(step), fingerprint=fingerprint, world=world
+        )
+
+    def latest_valid(
+        self,
+        *,
+        fingerprint: Optional[str] = None,
+        world: Optional[int] = None,
+        template: Any = None,
+    ) -> Optional[CheckpointInfo]:
+        """Newest checkpoint that passes validation; torn or
+        mismatched ones are skipped, not fatal — resume prefers an
+        older good checkpoint over dying on a bad new one.
+        ``template`` computes the wanted fingerprint for you."""
+        if template is not None and fingerprint is None:
+            fingerprint = pytree_fingerprint(template)
+        for step in reversed(self.steps()):
+            info = self._validate(
+                step, fingerprint=fingerprint, world=world
+            )
+            if info is not None:
+                return info
+        return None
+
+    # -- saving -------------------------------------------------------
+
+    def save(
+        self,
+        step: int,
+        state: Any,
+        *,
+        fingerprint: Optional[str] = None,
+        extra: Optional[dict] = None,
+    ) -> CheckpointInfo:
+        """Atomically commit ``state`` as the step-``step`` checkpoint
+        and prune beyond the retention window. An existing checkpoint
+        at the same step is replaced."""
+        step = int(step)
+        self._sweep_tmp()
+        if fingerprint is None:
+            try:
+                fingerprint = pytree_fingerprint(state)
+            except Exception:
+                fingerprint = None  # non-jax state (selftest saver)
+        final = os.path.join(self.root, step_dirname(step))
+        tmp = os.path.join(
+            self.root, f".tmp-{step_dirname(step)}.{os.getpid()}"
+        )
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        try:
+            self._save_fn(os.path.join(tmp, DATA_NAME), state)
+            manifest = {
+                "schema": MANIFEST_SCHEMA,
+                "step": step,
+                "world": self.world,
+                "fingerprint": fingerprint,
+                "t": time.time(),
+            }
+            if extra:
+                manifest.update(extra)
+            # manifest last, fsync'd: its presence certifies the data
+            mpath = os.path.join(tmp, MANIFEST_NAME)
+            with open(mpath, "w") as f:
+                json.dump(manifest, f, indent=1, default=str)
+                f.flush()
+                os.fsync(f.fileno())
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            os.replace(tmp, final)
+        finally:
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp, ignore_errors=True)
+        self.prune()
+        return CheckpointInfo(step=step, path=final, manifest=manifest)
+
+    def _sweep_tmp(self) -> None:
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return
+        for name in names:
+            if name.startswith(".tmp-"):
+                shutil.rmtree(
+                    os.path.join(self.root, name), ignore_errors=True
+                )
+
+    def prune(self) -> List[int]:
+        """Drop committed checkpoints beyond the newest ``keep``;
+        returns the pruned steps."""
+        steps = self.steps()
+        doomed = steps[:-self.keep] if len(steps) > self.keep else []
+        for step in doomed:
+            shutil.rmtree(
+                os.path.join(self.root, step_dirname(step)),
+                ignore_errors=True,
+            )
+        return doomed
+
+    # -- restoring ----------------------------------------------------
+
+    def restore(self, info: CheckpointInfo, template: Any) -> Any:
+        return self._restore_fn(info.data_path, template)
+
+    def restore_latest(
+        self, template: Any, *, world: Optional[int] = None,
+        match_fingerprint: bool = True,
+    ) -> Optional[tuple]:
+        """``(step, state)`` from the newest valid checkpoint matching
+        ``template``'s fingerprint (and ``world``), or None when there
+        is nothing to resume from."""
+        fingerprint = None
+        if match_fingerprint:
+            try:
+                fingerprint = pytree_fingerprint(template)
+            except Exception:
+                fingerprint = None
+        info = self.latest_valid(fingerprint=fingerprint, world=world)
+        if info is None:
+            return None
+        return info.step, self.restore(info, template)

@@ -1,0 +1,460 @@
+"""Deterministic fault injection at collective-emission sites.
+
+Chaos testing for the diagnosis pipeline: every failure mode the
+doctor can name (``observability/doctor.py`` — mismatch, hang, dead
+rank, straggler) and the supervisor can recover from
+(``resilience/supervisor.py``) must be *provokable on demand*, or the
+recovery path is tested only by production incidents. Cloud
+Collectives (PAPERS.md) makes the same argument from the other side:
+cloud fleets see preemptions and slow hosts as a matter of course, so
+the communication layer has to be designed — and exercised — against
+them.
+
+A **fault plan** is a JSON spec of injection rules, armed through
+``M4T_FAULT_PLAN=<path-or-inline-json>`` (``launch --fault-plan`` sets
+it for every rank). Each rule names *where* (rank, op or fingerprint,
+Nth matching emission) and *what* (the action)::
+
+    {"seed": 0, "faults": [
+      {"rank": 1, "op": "AllReduce", "nth": 6,
+       "action": "crash", "mode": "exception"},
+      {"rank": 0, "op": "*", "nth": 3, "action": "delay", "ms": 250},
+      {"rank": "*", "op": "Barrier", "nth": 2, "action": "hang"},
+      {"rank": 1, "fingerprint": "AllGather[4:float32]@<none>",
+       "nth": 1, "action": "slowdown", "ms": 50}
+    ]}
+
+(A bare JSON list is accepted as shorthand for ``{"faults": [...]}``.)
+
+Actions:
+
+- ``delay`` — sleep ``ms`` once, at the Nth matching emission;
+- ``slowdown`` — sleep ``ms`` at *every* matching emission from the
+  Nth on (a synthetic straggler);
+- ``hang`` — stop emitting forever (heartbeats continue from their
+  daemon thread, so the doctor's verdict is *hung*, not *dead*);
+- ``crash`` — ``mode: "exception"`` (default) raises
+  :class:`InjectedFault` at the emission site, ``mode: "sigkill"``
+  sends this process SIGKILL (no atexit, no recorder dump — the
+  doctor's *dead/missing* evidence path).
+
+Determinism: matching is by exact per-rank emission counting (token
+ordering serializes emissions, so "the Nth AllReduce on rank 1" names
+one specific program point), and the optional per-rule probability
+``p`` draws from ``random.Random(seed ^ rank)`` — the same plan on the
+same rank always injects at the same sites. ``attempt`` scopes a rule
+to one supervisor attempt (``M4T_FAULT_ATTEMPT``, set by the
+launcher's retry loop; default ``null`` = every attempt).
+
+The hook lives at the end of ``ops/_core.py``'s telemetry prologue —
+*after* the flight recorder and event sink record the emission, so an
+injected crash leaves exactly the artifact trail a real one would,
+plus one ``fault`` JSONL record naming the injection (the doctor and
+trace viewer can then overlay injected vs observed failures). Unarmed
+(no ``M4T_FAULT_PLAN``, the default) the hook is a single
+module-attribute ``is None`` check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+#: emission-vocabulary op names (ops/_core.emit callers); a rule naming
+#: an op outside this set is a typo caught at parse time, not a rule
+#: that silently never fires
+KNOWN_OPS = frozenset({
+    "AllGather", "AllReduce", "AllToAll", "Barrier", "Bcast", "Gather",
+    "QuantizedAllReduce", "Recv", "Reduce", "ReduceScatter", "Scan",
+    "Scatter", "Send", "Sendrecv",
+})
+
+ACTIONS = ("delay", "hang", "crash", "slowdown")
+CRASH_MODES = ("exception", "sigkill")
+
+
+class FaultPlanError(ValueError):
+    """A fault-plan spec that cannot mean what was written."""
+
+
+class InjectedFault(RuntimeError):
+    """Raised at an emission site by a ``crash``-action rule
+    (``mode: "exception"``)."""
+
+
+@dataclass
+class FaultRule:
+    """One armed injection site."""
+
+    action: str
+    rank: Any = "*"              # int | list[int] | "*"
+    op: Optional[str] = None     # emission op name | "*" | None
+    fingerprint: Optional[str] = None  # exact recorder fingerprint
+    nth: int = 1                 # 1-based Nth matching emission
+    ms: float = 0.0              # delay/slowdown sleep
+    mode: str = "exception"      # crash mode
+    p: float = 1.0               # injection probability (seeded)
+    attempt: Optional[int] = None  # only on this supervisor attempt
+    index: int = 0               # position in the plan (audit key)
+    # runtime state (per-process):
+    matches: int = field(default=0, compare=False)
+    fired: int = field(default=0, compare=False)
+
+    def applies_to_rank(self, rank: int) -> bool:
+        if self.rank == "*":
+            return True
+        if isinstance(self.rank, list):
+            return rank in self.rank
+        return rank == self.rank
+
+    def matches_emission(self, op: str, fingerprint: str) -> bool:
+        if self.fingerprint is not None:
+            return fingerprint == self.fingerprint
+        return self.op == "*" or op == self.op
+
+
+def _parse_rank(value: Any, where: str) -> Any:
+    if value == "*":
+        return "*"
+    if isinstance(value, bool):
+        raise FaultPlanError(f"{where}: rank must be an int, list, or '*'")
+    if isinstance(value, int):
+        if value < 0:
+            raise FaultPlanError(f"{where}: rank {value} is negative")
+        return value
+    if isinstance(value, list) and value and all(
+        isinstance(v, int) and not isinstance(v, bool) and v >= 0
+        for v in value
+    ):
+        return value
+    raise FaultPlanError(
+        f"{where}: rank must be a non-negative int, a non-empty list of "
+        f"them, or '*' (got {value!r})"
+    )
+
+
+def _parse_rule(obj: Any, index: int) -> FaultRule:
+    where = f"faults[{index}]"
+    if not isinstance(obj, dict):
+        raise FaultPlanError(f"{where}: each fault must be a JSON object")
+    unknown = set(obj) - {
+        "rank", "op", "fingerprint", "nth", "action", "ms", "mode", "p",
+        "attempt",
+    }
+    if unknown:
+        raise FaultPlanError(
+            f"{where}: unknown field(s) {sorted(unknown)}"
+        )
+    action = obj.get("action")
+    if action not in ACTIONS:
+        raise FaultPlanError(
+            f"{where}: action must be one of {list(ACTIONS)} "
+            f"(got {action!r})"
+        )
+    op = obj.get("op")
+    fingerprint = obj.get("fingerprint")
+    if op is None and fingerprint is None:
+        raise FaultPlanError(f"{where}: needs 'op' or 'fingerprint'")
+    if op is not None and fingerprint is not None:
+        raise FaultPlanError(
+            f"{where}: 'op' and 'fingerprint' are mutually exclusive"
+        )
+    if op is not None and op != "*" and op not in KNOWN_OPS:
+        raise FaultPlanError(
+            f"{where}: unknown op {op!r}; emission vocabulary is "
+            f"{sorted(KNOWN_OPS)} (or '*')"
+        )
+    if fingerprint is not None and not isinstance(fingerprint, str):
+        raise FaultPlanError(f"{where}: fingerprint must be a string")
+    nth = obj.get("nth", 1)
+    if not isinstance(nth, int) or isinstance(nth, bool) or nth < 1:
+        raise FaultPlanError(
+            f"{where}: nth must be a positive integer (got {nth!r})"
+        )
+    ms = obj.get("ms", 0.0)
+    if not isinstance(ms, (int, float)) or isinstance(ms, bool) or ms < 0:
+        raise FaultPlanError(
+            f"{where}: ms must be a non-negative number (got {ms!r})"
+        )
+    if action in ("delay", "slowdown") and ms <= 0:
+        raise FaultPlanError(
+            f"{where}: action {action!r} needs 'ms' > 0"
+        )
+    mode = obj.get("mode", "exception")
+    if mode not in CRASH_MODES:
+        raise FaultPlanError(
+            f"{where}: crash mode must be one of {list(CRASH_MODES)} "
+            f"(got {mode!r})"
+        )
+    p = obj.get("p", 1.0)
+    if not isinstance(p, (int, float)) or isinstance(p, bool) or not (
+        0.0 <= p <= 1.0
+    ):
+        raise FaultPlanError(
+            f"{where}: p must be a probability in [0, 1] (got {p!r})"
+        )
+    attempt = obj.get("attempt")
+    if attempt is not None and (
+        not isinstance(attempt, int) or isinstance(attempt, bool)
+        or attempt < 0
+    ):
+        raise FaultPlanError(
+            f"{where}: attempt must be a non-negative integer or absent"
+        )
+    return FaultRule(
+        action=action,
+        rank=_parse_rank(obj.get("rank", "*"), where),
+        op=op,
+        fingerprint=fingerprint,
+        nth=nth,
+        ms=float(ms),
+        mode=mode,
+        p=float(p),
+        attempt=attempt,
+        index=index,
+    )
+
+
+@dataclass
+class FaultPlan:
+    rules: List[FaultRule]
+    seed: int = 0
+
+    @classmethod
+    def parse(cls, spec: Any) -> "FaultPlan":
+        """Parse a plan from a JSON string or an already-decoded
+        object; raises :class:`FaultPlanError` with the field that is
+        wrong, never a bare JSON traceback."""
+        if isinstance(spec, (str, bytes)):
+            try:
+                spec = json.loads(spec)
+            except json.JSONDecodeError as e:
+                raise FaultPlanError(f"fault plan is not valid JSON: {e}")
+        if isinstance(spec, list):
+            spec = {"faults": spec}
+        if not isinstance(spec, dict):
+            raise FaultPlanError(
+                "fault plan must be a JSON object {'faults': [...]} or a "
+                "bare list of fault rules"
+            )
+        unknown = set(spec) - {"faults", "seed"}
+        if unknown:
+            raise FaultPlanError(
+                f"fault plan: unknown top-level field(s) {sorted(unknown)}"
+            )
+        seed = spec.get("seed", 0)
+        if not isinstance(seed, int) or isinstance(seed, bool):
+            raise FaultPlanError("fault plan: seed must be an integer")
+        faults = spec.get("faults")
+        if not isinstance(faults, list) or not faults:
+            raise FaultPlanError(
+                "fault plan: 'faults' must be a non-empty list"
+            )
+        return cls(
+            rules=[_parse_rule(obj, i) for i, obj in enumerate(faults)],
+            seed=seed,
+        )
+
+    @classmethod
+    def load(cls, spec: str) -> "FaultPlan":
+        """Parse from a file path (if one exists at ``spec``) or an
+        inline JSON string — the ``M4T_FAULT_PLAN`` convention."""
+        text = spec
+        if os.path.exists(spec):
+            with open(spec) as f:
+                text = f.read()
+        return cls.parse(text)
+
+    def validate_world(self, world: int) -> None:
+        """Reject rules naming ranks the world does not have (the
+        launcher knows ``-n``; a plan targeting rank 5 of a 2-rank
+        world would otherwise silently never fire)."""
+        for rule in self.rules:
+            ranks = (
+                [] if rule.rank == "*"
+                else rule.rank if isinstance(rule.rank, list)
+                else [rule.rank]
+            )
+            for r in ranks:
+                if r >= world:
+                    raise FaultPlanError(
+                        f"faults[{rule.index}]: rank {r} out of range for "
+                        f"world size {world}"
+                    )
+
+
+# ---------------------------------------------------------------------
+# arming and the per-emission hook
+# ---------------------------------------------------------------------
+
+#: the armed plan, or None. ``ops/_core.py`` gates its per-emission
+#: call on ``faults.active_plan is not None`` — the whole unarmed cost.
+active_plan: Optional[FaultPlan] = None
+
+_rank: int = 0
+_attempt: int = 0
+_rng: Optional[random.Random] = None
+_env_checked = False
+
+
+def arm(
+    plan: FaultPlan, *, rank: Optional[int] = None,
+    attempt: Optional[int] = None,
+) -> None:
+    """Activate ``plan`` for this process (tests and chaos harnesses;
+    launched ranks arm from ``M4T_FAULT_PLAN`` automatically)."""
+    global active_plan, _rank, _attempt, _rng, _env_checked
+    from ..observability import events
+
+    _rank = events.current_rank() if rank is None else int(rank)
+    _attempt = (
+        int(os.environ.get("M4T_FAULT_ATTEMPT", "0") or 0)
+        if attempt is None else int(attempt)
+    )
+    _rng = random.Random(plan.seed ^ (_rank * 0x9E3779B1))
+    for rule in plan.rules:
+        rule.matches = rule.fired = 0
+    active_plan = plan
+    _env_checked = True
+
+
+def disarm() -> None:
+    global active_plan
+    active_plan = None
+
+
+def arm_from_env() -> Optional[FaultPlan]:
+    """Arm from ``M4T_FAULT_PLAN`` if set; called once lazily from the
+    first emission (import order must not matter for launched ranks).
+    A malformed plan is a hard error: a chaos run whose faults silently
+    never arm would certify nothing."""
+    global _env_checked
+    _env_checked = True
+    spec = os.environ.get("M4T_FAULT_PLAN", "")
+    if not spec:
+        return None
+    plan = FaultPlan.load(spec)
+    arm(plan)
+    return plan
+
+
+def _emit_fault_event(rule: FaultRule, op: str, fp: str, cid: str) -> None:
+    from ..observability import events
+
+    events.emit(events.event(
+        "fault",
+        action=rule.action,
+        rule=rule.index,
+        op=op,
+        fingerprint=fp,
+        nth=rule.nth,
+        match=rule.matches,
+        cid=cid,
+        attempt=_attempt,
+        t=time.time(),
+    ))
+    sys.stderr.write(
+        f"m4t.faults: rank {_rank} injecting {rule.action} at {op} "
+        f"(match {rule.matches}, rule {rule.index}, cid {cid})\n"
+    )
+    sys.stderr.flush()
+
+
+def on_emission(
+    op: str,
+    *,
+    cid: str = "",
+    nbytes: int = 0,
+    dtype: Optional[str] = None,
+    shape: Optional[Sequence[int]] = None,
+    axes: Optional[Sequence[str]] = None,
+    world: Optional[int] = None,
+) -> None:
+    """The ``ops/_core.py`` hook: count this emission against every
+    armed rule and perform whatever actions come due. Runs *after* the
+    flight recorder / event sink saw the emission, so injected
+    failures leave the same artifact trail organic ones do."""
+    plan = active_plan
+    if plan is None:
+        if _env_checked:
+            return
+        plan = arm_from_env()
+        if plan is None:
+            return
+    from ..observability.recorder import fingerprint as _fingerprint
+
+    fp = _fingerprint({
+        "op": op, "bytes": nbytes, "dtype": dtype,
+        "shape": None if shape is None else list(shape),
+        "axes": list(axes) if axes else [],
+    })
+    for rule in plan.rules:
+        if rule.attempt is not None and rule.attempt != _attempt:
+            continue
+        if not rule.applies_to_rank(_rank):
+            continue
+        if not rule.matches_emission(op, fp):
+            continue
+        rule.matches += 1
+        due = (
+            rule.matches == rule.nth
+            if rule.action in ("delay", "hang", "crash")
+            else rule.matches >= rule.nth  # slowdown: every one from Nth
+        )
+        if not due:
+            continue
+        if rule.p < 1.0 and _rng is not None and _rng.random() >= rule.p:
+            continue
+        rule.fired += 1
+        _emit_fault_event(rule, op, fp, cid)
+        _perform(rule, op, fp)
+
+
+def faults_selftest_hook(plan: FaultPlan) -> List[str]:
+    """Device-free exercise of arm/match/fire used by the package
+    ``--selftest``: arms ``plan`` as rank 0, simulates three AllReduce
+    emissions, and returns ``action@op#nth`` labels of the rules that
+    fired. Only safe for plans whose rank-0 rules are delays."""
+    arm(plan, rank=0, attempt=0)
+    try:
+        for _ in range(3):
+            on_emission(
+                "AllReduce", cid="selftest", nbytes=16,
+                dtype="float32", shape=(4,), axes=[], world=2,
+            )
+        return [
+            f"{rule.action}@{rule.op}#{rule.nth}"
+            for rule in plan.rules
+            if rule.fired
+        ]
+    finally:
+        disarm()
+
+
+def _perform(rule: FaultRule, op: str, fp: str) -> None:
+    if rule.action in ("delay", "slowdown"):
+        time.sleep(rule.ms / 1000.0)
+        return
+    if rule.action == "hang":
+        # stop emitting forever; the heartbeat daemon thread keeps
+        # running, so the doctor sees "alive but stuck" — the verdict
+        # a rank wedged inside a collective would earn
+        while True:
+            time.sleep(3600.0)
+    if rule.action == "crash":
+        if rule.mode == "sigkill":
+            # no atexit, no recorder dump: the "rank vanished" failure
+            # mode (preemption, OOM-kill) — only the fsync'd events
+            # above survive as evidence
+            os.kill(os.getpid(), signal.SIGKILL)
+            time.sleep(3600.0)  # pragma: no cover — death is async
+        raise InjectedFault(
+            f"fault plan rule {rule.index}: injected crash at {op} "
+            f"(match {rule.matches}, fingerprint {fp})"
+        )

@@ -1,0 +1,250 @@
+"""Self-healing restart supervisor: diagnose, classify, resume.
+
+Closes the loop PRs 1–4 left open. The diagnosis pipeline can *name*
+a failure (``observability/doctor.py``: MISMATCH / HANG / STRAGGLER /
+missing rank); this module decides what the name *means* for recovery
+and acts on it:
+
+============================  ==============  =======================
+doctor verdict                class           supervisor action
+============================  ==============  =======================
+MISMATCH (ranks diverged)     deterministic   fail fast — a program
+                                              that forked will fork
+                                              again; print diagnosis
+MISMATCH w/ static site join  deterministic   fail fast (the bug has
+                                              a source line)
+HANG / RANK DIED / BEHIND     transient       restart from the latest
+                                              valid checkpoint
+MISSING RANK                  transient       restart (preemption /
+                                              kill shape)
+STRAGGLER only                transient       restart (slow host)
+crash, no findings            transient       restart (the crash left
+                                              no cross-rank disagree-
+                                              ment — env/infra shape)
+no telemetry at all           transient       restart blind
+============================  ==============  =======================
+
+Restarts are bounded (``retries``) with exponential backoff plus
+jitter (thundering-herd hygiene — all of a fleet's supervisors backing
+off in lockstep re-collide forever). Before each restart the newest
+*valid* checkpoint (``resilience/ckpt.py``) is located and exported to
+every child via ``M4T_RESUME_STEP``; a training loop that honors
+:func:`resume_step` continues from there instead of step 0.
+
+Every attempt's outcome — exit code, doctor verdict classification,
+chosen action, backoff, resume step — is appended to a
+``supervisor.jsonl`` audit log (the JSONL schema everything else in
+this repo speaks), so a run that restarted three times at 2 a.m.
+explains itself in the morning.
+
+Driven by ``python -m mpi4jax_tpu.launch --retries K --backoff S
+--resume-dir CKPTROOT``; importable directly for custom harnesses.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+#: finding kinds that mean "the program itself diverged" — re-running
+#: deterministically reproduces them, so retrying is burning compute
+DETERMINISTIC_KINDS = frozenset({"mismatch"})
+
+#: finding kinds consistent with infrastructure trouble — worth a retry
+TRANSIENT_KINDS = frozenset({"hang", "missing_rank", "straggler"})
+
+#: launcher exit code when the hang watchdog tore the world down
+WATCHDOG_EXIT = 124
+
+
+def resume_step() -> Optional[int]:
+    """The step the supervisor resumed this process from
+    (``M4T_RESUME_STEP``), or None on a cold start. Training loops
+    call this and skip to step+1."""
+    raw = os.environ.get("M4T_RESUME_STEP", "")
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+def classify(
+    report: Optional[Dict[str, Any]], exit_code: int
+) -> Dict[str, Any]:
+    """Map a doctor report (``doctor.analyze`` output, or None when no
+    telemetry was readable) plus the world's exit code to a recovery
+    class::
+
+        {"klass": "clean" | "transient" | "deterministic",
+         "reason": <short machine-readable tag>,
+         "kinds": [finding kinds seen]}
+
+    Deterministic wins over transient when both appear: a mismatch
+    usually *causes* the hang recorded beside it.
+    """
+    if exit_code == 0:
+        return {"klass": "clean", "reason": "exit_zero", "kinds": []}
+    findings = list(report.get("findings", [])) if report else []
+    kinds = sorted({f.get("kind", "?") for f in findings})
+    det = [f for f in findings if f.get("kind") in DETERMINISTIC_KINDS]
+    if det:
+        reason = "mismatch"
+        if any(
+            site
+            for f in det
+            for g in f.get("groups", [])
+            for site in g.get("static_sites", ())
+        ):
+            reason = "mismatch_static_attributed"
+        return {"klass": "deterministic", "reason": reason, "kinds": kinds}
+    if report is None:
+        return {
+            "klass": "transient", "reason": "crash_no_telemetry",
+            "kinds": kinds,
+        }
+    if any(f.get("kind") in TRANSIENT_KINDS for f in findings):
+        reason = "hang" if exit_code == WATCHDOG_EXIT else "transient_findings"
+        return {"klass": "transient", "reason": reason, "kinds": kinds}
+    return {
+        "klass": "transient", "reason": "crash_without_mismatch",
+        "kinds": kinds,
+    }
+
+
+@dataclass
+class RetryPolicy:
+    """Bounded exponential backoff with jitter."""
+
+    retries: int = 0          # restarts after the first attempt
+    backoff_s: float = 1.0    # first delay
+    max_backoff_s: float = 60.0
+    jitter: float = 0.25      # +- fraction of the delay
+
+    def delay(self, attempt: int, rng: Optional[random.Random] = None) -> float:
+        """Delay before launching attempt ``attempt`` (attempt 0 never
+        waits)."""
+        if attempt <= 0:
+            return 0.0
+        base = min(
+            self.backoff_s * (2.0 ** (attempt - 1)), self.max_backoff_s
+        )
+        if self.jitter <= 0:
+            return base
+        r = (rng or random).uniform(-self.jitter, self.jitter)
+        return max(0.0, base * (1.0 + r))
+
+
+class Supervisor:
+    """Run a world-launching callable under the retry policy.
+
+    ``run_fn(attempt, resume_step) -> exit_code`` launches one world
+    attempt (the launcher passes a closure over its own spawn loop).
+    ``diagnose_fn(attempt) -> report|None`` produces the doctor report
+    for that attempt's artifacts. ``resume_fn() -> step|None`` names
+    the newest valid checkpoint step (queried fresh before every
+    restart — the failed attempt may have committed new checkpoints
+    before dying).
+    """
+
+    def __init__(
+        self,
+        run_fn: Callable[[int, Optional[int]], int],
+        *,
+        policy: RetryPolicy,
+        diagnose_fn: Optional[Callable[[int], Optional[Dict[str, Any]]]] = None,
+        resume_fn: Optional[Callable[[], Optional[int]]] = None,
+        audit_path: Optional[str] = None,
+        sleep_fn: Callable[[float], None] = time.sleep,
+        log: Optional[Callable[[str], None]] = None,
+    ):
+        self.run_fn = run_fn
+        self.policy = policy
+        self.diagnose_fn = diagnose_fn or (lambda attempt: None)
+        self.resume_fn = resume_fn or (lambda: None)
+        self.audit_path = audit_path
+        self.sleep_fn = sleep_fn
+        self.log = log or (lambda msg: None)
+        self._rng = random.Random(0xC0FFEE)
+        self.attempts: list = []
+
+    def _audit(self, record: Dict[str, Any]) -> None:
+        self.attempts.append(record)
+        if not self.audit_path:
+            return
+        from ..observability import events
+
+        try:
+            events.EventLog(self.audit_path).append(
+                events.event("supervisor", **record)
+            )
+        except OSError:
+            pass  # auditing must not mask the run's own outcome
+
+    def run(self) -> int:
+        resume: Optional[int] = resume_step()  # inherit if nested
+        exit_code = 0
+        for attempt in range(self.policy.retries + 1):
+            exit_code = self.run_fn(attempt, resume)
+            if exit_code == 0:
+                self._audit({
+                    "attempt": attempt, "exit_code": 0,
+                    "klass": "clean", "reason": "exit_zero",
+                    "action": "done", "resume_step": resume,
+                })
+                return 0
+            if exit_code == 130:
+                # SIGINT is the operator, not the infrastructure:
+                # never retried, never reclassified
+                self._audit({
+                    "attempt": attempt, "exit_code": 130,
+                    "klass": "interrupted", "reason": "sigint",
+                    "action": "give_up", "resume_step": resume,
+                })
+                return 130
+            report = self.diagnose_fn(attempt)
+            verdict = classify(report, exit_code)
+            last = attempt == self.policy.retries
+            retrying = verdict["klass"] == "transient" and not last
+            delay = self.policy.delay(attempt + 1, self._rng) if retrying else 0.0
+            next_resume = self.resume_fn() if retrying else None
+            self._audit({
+                "attempt": attempt,
+                "exit_code": exit_code,
+                "klass": verdict["klass"],
+                "reason": verdict["reason"],
+                "finding_kinds": verdict["kinds"],
+                "action": "retry" if retrying else "give_up",
+                "backoff_s": round(delay, 3),
+                "resume_step": next_resume,
+            })
+            if verdict["klass"] == "deterministic":
+                self.log(
+                    f"supervisor: attempt {attempt} failed "
+                    f"deterministically ({verdict['reason']}); not "
+                    "retrying — rerunning a diverged program reproduces "
+                    "the divergence"
+                )
+                return exit_code
+            if not retrying:
+                self.log(
+                    f"supervisor: attempt {attempt} failed "
+                    f"({verdict['reason']}); retry budget exhausted"
+                )
+                return exit_code
+            resume = next_resume
+            self.log(
+                f"supervisor: attempt {attempt} failed transiently "
+                f"({verdict['reason']}); restarting in {delay:.1f}s"
+                + (
+                    f" from checkpoint step {resume}"
+                    if resume is not None else " from step 0"
+                )
+            )
+            if delay > 0:
+                self.sleep_fn(delay)
+        return exit_code

@@ -119,10 +119,28 @@ def init_from_env() -> bool:
     # shm backend is CPU-only; pin the platform before any backend use.
     jax.config.update("jax_platforms", "cpu")
 
+    # Per-launch generation nonce (M4T_SHM_GEN, minted by launch.py):
+    # validated in the segment header beside magic/world_size, closing
+    # the stale-segment TOCTOU of ADVICE.md round 5 (an attacher
+    # opening a crashed same-sized world's leftover segment in the
+    # window before the creator's recreate). Passed only when the
+    # extension reports the capability, so a stale prebuilt .so keeps
+    # working on name uniqueness alone (the documented fallback
+    # guarantee).
+    gen = 0
+    if ext.abi_info().get("shm_gen"):
+        try:
+            gen = int(os.environ.get("M4T_SHM_GEN", "0") or 0) & 0xFFFFFFFF
+        except ValueError:
+            gen = 0
+
     deadline = time.time() + 30.0
     while True:
         try:
-            ext.init(name, rank_, size_, 1 if rank_ == 0 else 0)
+            if gen:
+                ext.init(name, rank_, size_, 1 if rank_ == 0 else 0, gen)
+            else:
+                ext.init(name, rank_, size_, 1 if rank_ == 0 else 0)
             break
         except RuntimeError as e:
             # only (code -2) — creator hasn't created/sized the segment

@@ -101,6 +101,15 @@ struct SharedHeader {
   std::atomic<uint32_t> barrier_count;
   std::atomic<uint32_t> barrier_sense;
   std::atomic<uint32_t> abort_flag;
+  // per-launch generation nonce (M4T_SHM_GEN, minted by launch.py and
+  // stamped before magic): closes the stale-segment TOCTOU where an
+  // attacher shm_opens a leftover segment from a crashed *same-sized*
+  // world in the window before the creator's shm_unlink + O_EXCL
+  // recreate — magic and world_size both look valid there, but the
+  // generation cannot (ADVICE.md round 5, shmcc.cpp:905). 0 = no
+  // generation check (a directly-driven world without the launcher);
+  // name uniqueness (pid+uuid shm names) is then the only guarantee.
+  std::atomic<uint32_t> generation;
 };
 
 constexpr uint32_t kMagic = 0x4d34544aU;  // "M4TJ"
@@ -852,7 +861,8 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(kSendrecv, SendrecvImpl,
 // world setup
 // ---------------------------------------------------------------------------
 
-static int world_init(const char* name, int rank, int size, int create) {
+static int world_init(const char* name, int rank, int size, int create,
+                      uint32_t gen) {
   if (size < 1 || size > kMaxRanks || rank < 0 || rank >= size) return -1;
   if (const char* t = getenv("M4T_SHM_SPIN_TIMEOUT_US")) {
     char* end = nullptr;
@@ -896,14 +906,20 @@ static int world_init(const char* name, int rank, int size, int create) {
   if (create) {
     auto* sh = reinterpret_cast<SharedHeader*>(mem);
     sh->world_size.store((uint32_t)size, std::memory_order_release);
+    sh->generation.store(gen, std::memory_order_release);
     sh->magic.store(kMagic, std::memory_order_release);
   } else {
     // the magic is the creator's "segment initialized" signal; a
-    // missing stamp or a size mismatch both mean "not our world (yet)"
-    // — unmap and let the caller retry against the current name
+    // missing stamp, a size mismatch, or a generation-nonce mismatch
+    // (a leftover segment from a crashed same-sized world — the
+    // TOCTOU window before the creator's recreate) all mean "not our
+    // world (yet)" — unmap and let the caller retry against the
+    // current name
     auto* sh = reinterpret_cast<SharedHeader*>(mem);
     if (sh->magic.load(std::memory_order_acquire) != kMagic ||
-        sh->world_size.load(std::memory_order_acquire) != (uint32_t)size) {
+        sh->world_size.load(std::memory_order_acquire) != (uint32_t)size ||
+        (gen != 0 &&
+         sh->generation.load(std::memory_order_acquire) != gen)) {
       munmap(mem, seg);
       return -2;
     }
@@ -944,9 +960,10 @@ extern "C" {
 static PyObject* py_init(PyObject*, PyObject* args) {
   const char* name;
   int rank, size, create;
-  if (!PyArg_ParseTuple(args, "siii", &name, &rank, &size, &create))
+  unsigned int gen = 0;  // optional 5th arg: launch generation nonce
+  if (!PyArg_ParseTuple(args, "siii|I", &name, &rank, &size, &create, &gen))
     return nullptr;
-  int rc = shmcc::world_init(name, rank, size, create);
+  int rc = shmcc::world_init(name, rank, size, create, (uint32_t)gen);
   if (rc != 0) {
     PyErr_Format(PyExc_RuntimeError, "shmcc init failed (code %d)", rc);
     return nullptr;
@@ -988,12 +1005,15 @@ static PyObject* py_abi_info(PyObject*, PyObject*) {
   // native layout assumptions.
   // shared_bytes is the live world's mapped segment (runtime-sized
   // from the rank count); before init it reports the 1-rank size.
+  // shm_gen: this build validates the per-launch generation nonce in
+  // the segment header (runtime/shm.py passes M4T_SHM_GEN only when
+  // the capability is reported, so stale .so files degrade gracefully)
   return Py_BuildValue(
-      "{s:i,s:n,s:n,s:n,s:L}", "max_ranks", shmcc::kMaxRanks,
+      "{s:i,s:n,s:n,s:n,s:L,s:i}", "max_ranks", shmcc::kMaxRanks,
       "coll_chunk_bytes", (Py_ssize_t)shmcc::kCollChunk, "p2p_chunk_bytes",
       (Py_ssize_t)shmcc::kP2PChunk, "shared_bytes",
       (Py_ssize_t)shmcc::segment_bytes(shmcc::g.size > 0 ? shmcc::g.size : 1),
-      "tag_base", (long long)shmcc::kTagBase);
+      "tag_base", (long long)shmcc::kTagBase, "shm_gen", 1);
 }
 
 static PyObject* capsule(XLA_FFI_Handler* h) {

@@ -5,11 +5,20 @@ resume: None anywhere in the tree"), so this is a superset subsystem:
 a thin wrapper over `orbax.checkpoint` that saves/restores the pytrees
 our models train (params, solver states), preserving shardings on
 restore when a mesh is supplied.
+
+Saves are atomic: the checkpoint is written to ``path + ".tmp"`` and
+renamed into place only once fully on disk, so a process killed
+mid-save (the supervisor's SIGKILL, a preemption) can never leave a
+half-written directory at ``path`` — it leaves ``path`` untouched (old
+checkpoint intact, or absent) plus ``.tmp`` litter that the next save
+sweeps. The step-tagged history/retention/validity layer above this is
+``resilience/ckpt.py``'s CheckpointManager.
 """
 
 from __future__ import annotations
 
 import os
+import shutil
 from typing import Any
 
 import jax
@@ -22,11 +31,19 @@ def _checkpointer():
 
 
 def save(path: str, state: Any) -> None:
-    """Save a pytree of arrays to ``path`` (a directory)."""
+    """Save a pytree of arrays to ``path`` (a directory), atomically:
+    the data lands in ``path + ".tmp"`` first and is renamed over
+    ``path`` only when complete."""
     path = os.path.abspath(path)
+    tmp = path + ".tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
     ckpt = _checkpointer()
-    ckpt.save(path, state, force=True)
+    ckpt.save(tmp, state, force=True)
     ckpt.wait_until_finished()
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    os.replace(tmp, path)
 
 
 def restore(path: str, template: Any) -> Any:

@@ -27,6 +27,60 @@ def test_roundtrip_plain(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_save_is_atomic_tmp_then_rename(tmp_path):
+    """Saves stage into ``path + ".tmp"`` and rename into place: no
+    tmp litter survives a successful save, stale litter from a killed
+    previous save is swept, and an interrupted re-save can never
+    corrupt the committed checkpoint it was replacing."""
+    state = {"w": jnp.arange(6.0)}
+    path = os.path.join(tmp_path, "ckpt")
+    # stale litter from a "killed mid-save" predecessor
+    os.makedirs(path + ".tmp")
+    with open(os.path.join(path + ".tmp", "junk"), "w") as f:
+        f.write("torn")
+    checkpoint.save(path, state)
+    assert not os.path.exists(path + ".tmp")
+    restored = checkpoint.restore(path, {"w": jnp.zeros(6)})
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(6.0))
+    # overwrite in place: the new value wins, still no litter
+    checkpoint.save(path, {"w": jnp.ones(6)})
+    assert not os.path.exists(path + ".tmp")
+    restored = checkpoint.restore(path, {"w": jnp.zeros(6)})
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.ones(6))
+
+
+def test_tmp_litter_is_invisible_to_restore(tmp_path):
+    """A committed checkpoint stays readable even while a failed
+    re-save's ``.tmp`` staging dir sits beside it."""
+    state = {"w": jnp.arange(4.0)}
+    path = os.path.join(tmp_path, "ckpt")
+    checkpoint.save(path, state)
+    os.makedirs(path + ".tmp")  # an in-flight (or dead) writer
+    restored = checkpoint.restore(path, {"w": jnp.zeros(4)})
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(4.0))
+
+
+def test_manager_latest_valid_skips_truncated_dir(tmp_path):
+    """ISSUE-5 satellite: a deliberately truncated checkpoint
+    directory (manifest gone) is skipped by
+    ``CheckpointManager.latest_valid``/``restore_latest`` in favor of
+    the older intact one."""
+    from mpi4jax_tpu.resilience import CheckpointManager
+
+    mgr = CheckpointManager(os.path.join(tmp_path, "root"), keep=4)
+    mgr.save(1, {"w": jnp.full(3, 1.0)})
+    mgr.save(2, {"w": jnp.full(3, 2.0)})
+    newest = os.path.join(mgr.root, "step_00000002")
+    os.unlink(os.path.join(newest, "manifest.json"))
+    info = mgr.latest_valid()
+    assert info is not None and info.step == 1
+    step, restored = mgr.restore_latest({"w": jnp.zeros(3)})
+    assert step == 1
+    np.testing.assert_array_equal(
+        np.asarray(restored["w"]), np.full(3, 1.0)
+    )
+
+
 def test_roundtrip_sharded(tmp_path, mesh):
     from jax.sharding import NamedSharding, PartitionSpec as P
 

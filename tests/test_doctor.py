@@ -467,6 +467,47 @@ def test_doctor_cli_exit_codes_and_json(tmp_path):
     assert res.returncode == 2
 
 
+def test_doctor_json_schema_contract(tmp_path):
+    """``--json`` is a stable machine contract (the resilience
+    supervisor and CI parse it): top-level keys, the schema version
+    tag, and the per-kind finding fields documented in doctor.py must
+    not drift without a version bump."""
+    logs = clean_world(n_ranks=3, n_seq=4)
+    logs[1][2] = emission(1, 3, "Bcast", [8], 103.0)     # mismatch @3
+    logs[2] = logs[2][:2] + [heartbeat(2, 120.0)]        # hung @2
+    d = write_logs(tmp_path, logs)
+
+    report = doctor.diagnose([d])
+    assert report["schema"] == doctor.SCHEMA == "m4t-doctor/1"
+    assert set(report) == {"schema", "ranks", "records", "seqs",
+                           "findings"}
+    kinds = {f["kind"] for f in report["findings"]}
+    assert kinds == {"mismatch", "hang"}
+    (m,) = [f for f in report["findings"] if f["kind"] == "mismatch"]
+    assert {"kind", "seq", "fingerprints", "groups"} <= set(m)
+    for g in m["groups"]:
+        assert {"fingerprint", "ranks"} <= set(g)
+    (h,) = [f for f in report["findings"] if f["kind"] == "hang"]
+    assert {"kind", "rank", "verdict", "last_seq", "front_seq", "gap",
+            "front_ranks", "stuck_before", "last_heartbeat_t",
+            "last_emission_t"} <= set(h)
+    assert h["verdict"] in ("hung", "dead", "behind")
+
+    # the CLI emits the same contract, with the exit codes unchanged
+    res = _run_cli("mpi4jax_tpu.observability.doctor", d, "--json")
+    assert res.returncode == 1
+    cli_report = json.loads(res.stdout)
+    assert cli_report["schema"] == "m4t-doctor/1"
+    assert cli_report["findings"] == json.loads(
+        json.dumps(report["findings"], default=str)
+    )
+
+    # and the supervisor's classifier consumes it directly
+    from mpi4jax_tpu.resilience import classify
+
+    assert classify(report, 1)["klass"] == "deterministic"
+
+
 def test_trace_cli_smoke(tmp_path):
     d = write_logs(tmp_path, clean_world())
     out = str(tmp_path / "trace.json")

@@ -72,6 +72,33 @@ def test_transformer_example_ring():
     assert "steps/s" in res.stderr
 
 
+def test_transformer_example_checkpoint_resume(tmp_path):
+    """The resume-aware loop: run to step 7 with checkpoints, then a
+    second invocation with --resume continues from the newest valid
+    checkpoint instead of step 0 (the M4T_RESUME_STEP path is driven
+    by the launch supervisor; tests/test_resilience.py covers it)."""
+    pytest.importorskip("orbax.checkpoint")
+    ckpt = str(tmp_path / "ckpt")
+    res = run_example(
+        "train_transformer.py",
+        "--nproc", "2", "--steps", "7", "--platform", "cpu",
+        "--ckpt-dir", ckpt, "--ckpt-every", "3",
+    )
+    assert res.returncode == 0, res.stderr
+    saved = sorted(os.listdir(ckpt))
+    assert "step_00000002" in saved and "step_00000006" in saved
+    res2 = run_example(
+        "train_transformer.py",
+        "--nproc", "2", "--steps", "10", "--platform", "cpu",
+        "--ckpt-dir", ckpt, "--resume",
+    )
+    assert res2.returncode == 0, res2.stderr
+    assert "resumed from checkpoint step 6" in res2.stderr
+    assert "step   9" in res2.stderr  # continued to the new horizon
+    assert "step   0" not in res2.stderr  # ...without restarting
+    assert "3 steps in" in res2.stderr  # exactly steps 7..9 ran
+
+
 def test_bench_smoke():
     env = dict(os.environ)
     env.update(M4T_BENCH_PLATFORM="cpu", M4T_BENCH_SCALE="1")

@@ -1,0 +1,789 @@
+"""Resilience subsystem (``mpi4jax_tpu/resilience/``): fault
+injection, checkpoint management, and the self-healing supervisor.
+
+Covers the ISSUE-5 acceptance surface:
+
+- fault-plan parsing: every malformed spec class gets a clear
+  ``FaultPlanError`` (bad JSON, unknown op, out-of-range rank, bad
+  action/nth/ms/p), and matching/arming semantics (rank scoping, Nth
+  emission, fingerprint rules, attempt scoping, seeded probability);
+- injection through the real emission path (``ops/_core.py``): armed
+  delay rules fire at the Nth ``m4t.allreduce``, are logged as
+  ``fault`` JSONL events, and cost nothing when unarmed;
+- CheckpointManager: atomic commit protocol (manifest-last), retention
+  of the newest K, ``latest_valid()``/``at_step()`` skipping torn,
+  truncated, or world/fingerprint-mismatched checkpoints — both on the
+  device-free JSON storage layer and on the real orbax one;
+- supervisor: verdict classification (transient vs deterministic),
+  bounded exponential backoff, audit-log records, fail-fast on
+  MISMATCH, interrupt passthrough;
+- the launcher: ``--retries 0`` backward compat (single attempt, flat
+  artifact layout, same exit codes, no supervisor.jsonl), supervised
+  retry layout (per-attempt dirs + audit log), ``--fault-plan``
+  validation at spawn time;
+- chaos e2e (slow, ``-m chaos``): a 2-rank run with an injected rank-1
+  crash at step N is restarted by the supervisor, resumes from the
+  latest valid checkpoint, and reproduces the fault-free run's final
+  parameters bit-for-bit; a MISMATCH-class failure is *not* retried.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m4t
+from mpi4jax_tpu.observability import events
+from mpi4jax_tpu.resilience import (
+    CheckpointManager,
+    FaultPlan,
+    FaultPlanError,
+    InjectedFault,
+    RetryPolicy,
+    Supervisor,
+    classify,
+    faults,
+    resume_step,
+)
+from mpi4jax_tpu.resilience.ckpt import pytree_fingerprint
+
+pytestmark = pytest.mark.resilience
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    yield
+    faults.disarm()
+    events.set_sink(None)
+
+
+# ---------------------------------------------------------------------
+# fault-plan parsing
+# ---------------------------------------------------------------------
+
+
+def test_plan_parses_full_form():
+    plan = FaultPlan.parse(json.dumps({
+        "seed": 3,
+        "faults": [
+            {"rank": 1, "op": "AllReduce", "nth": 6, "action": "crash"},
+            {"rank": [0, 2], "op": "*", "action": "delay", "ms": 10},
+            {"rank": "*", "fingerprint": "Barrier[scalar:uint32]@<none>",
+             "action": "hang"},
+            {"rank": 0, "op": "AllGather", "action": "slowdown",
+             "ms": 5, "nth": 2, "p": 0.5, "attempt": 1},
+        ],
+    }))
+    assert plan.seed == 3
+    assert [r.action for r in plan.rules] == [
+        "crash", "delay", "hang", "slowdown"]
+    assert plan.rules[0].mode == "exception"
+    assert plan.rules[3].attempt == 1
+
+
+def test_plan_parses_bare_list_shorthand():
+    plan = FaultPlan.parse(
+        '[{"rank": 0, "op": "Barrier", "action": "hang"}]'
+    )
+    assert len(plan.rules) == 1 and plan.seed == 0
+
+
+def test_plan_load_from_file(tmp_path):
+    p = tmp_path / "plan.json"
+    p.write_text('[{"rank": 0, "op": "Barrier", "action": "hang"}]')
+    assert len(FaultPlan.load(str(p)).rules) == 1
+    # and inline JSON when no such file exists
+    assert len(FaultPlan.load(
+        '[{"rank": 0, "op": "Barrier", "action": "hang"}]'
+    ).rules) == 1
+
+
+@pytest.mark.parametrize("spec,needle", [
+    ("{oops", "not valid JSON"),
+    ("42", "must be a JSON object"),
+    ('{"faults": []}', "non-empty"),
+    ('{"faults": [{}], "extra": 1}', "unknown top-level"),
+    ('[{"rank": 0, "op": "FooBar", "action": "hang"}]', "unknown op"),
+    ('[{"rank": 0, "op": "Barrier", "action": "fizzle"}]', "action"),
+    ('[{"rank": -2, "op": "Barrier", "action": "hang"}]', "negative"),
+    ('[{"rank": "x", "op": "Barrier", "action": "hang"}]', "rank"),
+    ('[{"rank": 0, "action": "hang"}]', "'op' or 'fingerprint'"),
+    ('[{"rank": 0, "op": "Barrier", "fingerprint": "f", '
+     '"action": "hang"}]', "mutually exclusive"),
+    ('[{"rank": 0, "op": "Barrier", "action": "delay"}]', "ms"),
+    ('[{"rank": 0, "op": "Barrier", "action": "hang", "nth": 0}]', "nth"),
+    ('[{"rank": 0, "op": "Barrier", "action": "hang", "p": 2}]', "p"),
+    ('[{"rank": 0, "op": "Barrier", "action": "hang", "typo": 1}]',
+     "unknown field"),
+    ('[{"rank": 0, "op": "Barrier", "action": "crash", '
+     '"mode": "panic"}]', "mode"),
+], ids=lambda v: (v[:24] if isinstance(v, str) else v))
+def test_plan_parse_errors_are_clear(spec, needle):
+    with pytest.raises(FaultPlanError) as exc:
+        FaultPlan.parse(spec)
+    assert needle in str(exc.value), (
+        f"error {exc.value} should mention {needle!r}"
+    )
+
+
+def test_plan_world_validation():
+    plan = FaultPlan.parse(
+        '[{"rank": 3, "op": "Barrier", "action": "hang"}]'
+    )
+    plan.validate_world(4)
+    with pytest.raises(FaultPlanError, match="out of range"):
+        plan.validate_world(2)
+    # wildcard ranks validate against any world
+    FaultPlan.parse(
+        '[{"rank": "*", "op": "Barrier", "action": "hang"}]'
+    ).validate_world(1)
+
+
+# ---------------------------------------------------------------------
+# matching + injection (direct hook calls)
+# ---------------------------------------------------------------------
+
+
+def _emit_n(op, n, **kw):
+    for _ in range(n):
+        faults.on_emission(op, cid="t", nbytes=16, dtype="float32",
+                           shape=(4,), axes=[], world=2, **kw)
+
+
+def test_rank_scoping_and_nth():
+    plan = FaultPlan.parse(
+        '[{"rank": 1, "op": "AllReduce", "nth": 2, "action": "delay",'
+        ' "ms": 1}]'
+    )
+    faults.arm(plan, rank=0)
+    _emit_n("AllReduce", 5)
+    assert plan.rules[0].fired == 0  # wrong rank: never fires
+    faults.arm(plan, rank=1)
+    _emit_n("AllReduce", 5)
+    assert plan.rules[0].matches == 5
+    assert plan.rules[0].fired == 1  # nth=2 exactly once
+
+
+def test_slowdown_fires_from_nth_on():
+    plan = FaultPlan.parse(
+        '[{"rank": 0, "op": "AllReduce", "nth": 3, "action": "slowdown",'
+        ' "ms": 1}]'
+    )
+    faults.arm(plan, rank=0)
+    _emit_n("AllReduce", 6)
+    assert plan.rules[0].fired == 4  # emissions 3,4,5,6
+
+
+def test_fingerprint_rule_matches_exactly():
+    fp = "AllReduce[4:float32]@<none>"
+    plan = FaultPlan.parse(json.dumps([
+        {"rank": 0, "fingerprint": fp, "action": "delay", "ms": 1},
+    ]))
+    faults.arm(plan, rank=0)
+    # different shape -> different fingerprint -> no match
+    faults.on_emission("AllReduce", cid="t", nbytes=32, dtype="float32",
+                       shape=(8,), axes=[], world=2)
+    assert plan.rules[0].matches == 0
+    _emit_n("AllReduce", 1)
+    assert plan.rules[0].fired == 1
+
+
+def test_crash_raises_injected_fault():
+    plan = FaultPlan.parse(
+        '[{"rank": 0, "op": "Barrier", "action": "crash"}]'
+    )
+    faults.arm(plan, rank=0)
+    with pytest.raises(InjectedFault, match="injected crash at Barrier"):
+        _emit_n("Barrier", 1)
+
+
+def test_attempt_scoped_rule():
+    spec = ('[{"rank": 0, "op": "AllReduce", "action": "delay", '
+            '"ms": 1, "attempt": 1}]')
+    plan = FaultPlan.parse(spec)
+    faults.arm(plan, rank=0, attempt=0)
+    _emit_n("AllReduce", 3)
+    assert plan.rules[0].fired == 0  # rule wants attempt 1
+    faults.arm(plan, rank=0, attempt=1)
+    _emit_n("AllReduce", 3)
+    assert plan.rules[0].fired == 1
+
+
+def test_probability_zero_never_fires_and_is_seeded():
+    plan = FaultPlan.parse(
+        '[{"rank": 0, "op": "AllReduce", "action": "delay", "ms": 1,'
+        ' "p": 0.0}]'
+    )
+    faults.arm(plan, rank=0)
+    _emit_n("AllReduce", 10)
+    assert plan.rules[0].fired == 0
+    # p=1 always fires; and a fixed seed gives reproducible decisions
+    # for fractional p (same plan, same rank -> same outcome)
+    spec = ('{"seed": 11, "faults": [{"rank": 0, "op": "AllReduce",'
+            ' "action": "slowdown", "ms": 1, "p": 0.5}]}')
+    outcomes = []
+    for _ in range(2):
+        plan2 = FaultPlan.parse(spec)
+        faults.arm(plan2, rank=0)
+        _emit_n("AllReduce", 8)
+        outcomes.append(plan2.rules[0].fired)
+    assert outcomes[0] == outcomes[1]
+
+
+def test_delay_actually_sleeps():
+    plan = FaultPlan.parse(
+        '[{"rank": 0, "op": "AllReduce", "action": "delay", "ms": 120}]'
+    )
+    faults.arm(plan, rank=0)
+    t0 = time.perf_counter()
+    _emit_n("AllReduce", 1)
+    assert time.perf_counter() - t0 >= 0.1
+
+
+def test_injection_logs_fault_event(tmp_path):
+    sink_path = str(tmp_path / "events.jsonl")
+    events.set_sink(sink_path, fsync=False)
+    plan = FaultPlan.parse(
+        '[{"rank": 0, "op": "AllReduce", "nth": 2, "action": "delay",'
+        ' "ms": 1}]'
+    )
+    faults.arm(plan, rank=0)
+    _emit_n("AllReduce", 3)
+    events.set_sink(None)
+    recs = [r for r in events.read(sink_path) if r["kind"] == "fault"]
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["action"] == "delay" and rec["op"] == "AllReduce"
+    assert rec["nth"] == 2 and rec["match"] == 2 and rec["rule"] == 0
+    assert "AllReduce[4:float32]" in rec["fingerprint"]
+
+
+# ---------------------------------------------------------------------
+# injection through the real emission path (ops/_core.py)
+# ---------------------------------------------------------------------
+
+
+def test_armed_plan_fires_on_real_allreduce():
+    plan = FaultPlan.parse(
+        '[{"rank": 0, "op": "AllReduce", "nth": 2, "action": "delay",'
+        ' "ms": 1}]'
+    )
+    faults.arm(plan, rank=0)
+    m4t.allreduce(jnp.ones(3))
+    assert plan.rules[0].matches == 1 and plan.rules[0].fired == 0
+    m4t.allreduce(jnp.ones(3))
+    assert plan.rules[0].fired == 1
+
+
+def test_crash_through_real_emission_path():
+    plan = FaultPlan.parse(
+        '[{"rank": 0, "op": "AllReduce", "action": "crash"}]'
+    )
+    faults.arm(plan, rank=0)
+    with pytest.raises(InjectedFault):
+        m4t.allreduce(jnp.ones(3))
+    faults.disarm()
+    # disarmed: the same call is clean again
+    np.testing.assert_array_equal(
+        np.asarray(m4t.allreduce(jnp.ones(3))), np.ones(3)
+    )
+
+
+def test_unarmed_hook_is_inert():
+    assert faults.active_plan is None
+    m4t.allreduce(jnp.ones(3))  # no plan, no env: nothing to observe
+
+
+# ---------------------------------------------------------------------
+# CheckpointManager — device-free JSON storage layer
+# ---------------------------------------------------------------------
+
+
+def _json_save(path, state):
+    with open(path, "w") as f:
+        json.dump(state, f)
+
+
+def _json_restore(path, template):
+    with open(path) as f:
+        return json.load(f)
+
+
+def _json_mgr(root, **kw):
+    kw.setdefault("save_fn", _json_save)
+    kw.setdefault("restore_fn", _json_restore)
+    return CheckpointManager(str(root), **kw)
+
+
+def test_manager_save_restore_retention(tmp_path):
+    mgr = _json_mgr(tmp_path / "ckpt", keep=2, world=2)
+    for step in (1, 3, 7, 9):
+        mgr.save(step, {"w": step}, fingerprint="fp")
+    assert mgr.steps() == [7, 9]
+    info = mgr.latest_valid(fingerprint="fp", world=2)
+    assert info.step == 9
+    assert info.manifest["world"] == 2
+    assert mgr.restore(info, None) == {"w": 9}
+    at7 = mgr.at_step(7, fingerprint="fp")
+    assert at7 is not None and mgr.restore(at7, None) == {"w": 7}
+    assert mgr.at_step(3) is None  # pruned
+
+
+def test_manager_skips_torn_checkpoints(tmp_path):
+    mgr = _json_mgr(tmp_path / "ckpt", keep=5)
+    for step in (1, 2, 3):
+        mgr.save(step, {"w": step}, fingerprint="fp")
+    # step 3: manifest deleted (killed between data write and commit
+    # cannot happen — rename is atomic — but operators truncate dirs)
+    os.unlink(os.path.join(mgr.root, "step_00000003", "manifest.json"))
+    # step 2: data removed, manifest intact
+    os.unlink(os.path.join(mgr.root, "step_00000002", "data"))
+    info = mgr.latest_valid(fingerprint="fp")
+    assert info is not None and info.step == 1
+    # corrupt manifest JSON is also skipped, not fatal
+    with open(os.path.join(mgr.root, "step_00000001", "manifest.json"),
+              "w") as f:
+        f.write("{torn")
+    assert mgr.latest_valid(fingerprint="fp") is None
+
+
+def test_manager_world_and_fingerprint_mismatch_skipped(tmp_path):
+    mgr = _json_mgr(tmp_path / "ckpt", keep=5, world=2)
+    mgr.save(5, {"w": 5}, fingerprint="fpA")
+    assert mgr.latest_valid(fingerprint="fpB") is None
+    assert mgr.latest_valid(fingerprint="fpA", world=4) is None
+    assert mgr.latest_valid(fingerprint="fpA", world=2).step == 5
+    # unspecified fingerprint/world: manifest is not interrogated
+    assert mgr.latest_valid().step == 5
+
+
+def test_manager_step_tag_must_match_dirname(tmp_path):
+    mgr = _json_mgr(tmp_path / "ckpt", keep=5)
+    mgr.save(4, {"w": 4})
+    os.rename(
+        os.path.join(mgr.root, "step_00000004"),
+        os.path.join(mgr.root, "step_00000009"),
+    )
+    # a renamed/copied dir whose manifest disagrees with its name is
+    # not trusted at either address
+    assert mgr.latest_valid() is None
+
+
+def test_manager_sweeps_tmp_litter(tmp_path):
+    mgr = _json_mgr(tmp_path / "ckpt", keep=5)
+    litter = os.path.join(mgr.root, ".tmp-step_00000002.999")
+    os.makedirs(litter)
+    mgr.save(1, {"w": 1})
+    assert not os.path.exists(litter)
+    assert mgr.steps() == [1]
+
+
+def test_manager_atomic_layout(tmp_path):
+    """The commit protocol's observable invariant: a committed step
+    dir holds data + manifest, and the manifest certifies the step."""
+    mgr = _json_mgr(tmp_path / "ckpt", keep=5, world=1)
+    info = mgr.save(12, {"w": 1}, fingerprint="fp")
+    names = sorted(os.listdir(info.path))
+    assert names == ["data", "manifest.json"]
+    manifest = json.load(open(os.path.join(info.path, "manifest.json")))
+    assert manifest["step"] == 12
+    assert manifest["schema"] == "m4t-ckpt/1"
+    assert manifest["fingerprint"] == "fp"
+    assert manifest["world"] == 1
+
+
+# ---------------------------------------------------------------------
+# CheckpointManager — real (orbax) storage layer
+# ---------------------------------------------------------------------
+
+
+@pytest.fixture
+def orbax():
+    return pytest.importorskip("orbax.checkpoint")
+
+
+def test_manager_orbax_roundtrip_and_fingerprint(tmp_path, orbax):
+    state = {"w": jnp.arange(6.0).reshape(2, 3),
+             "b": jnp.ones(3, jnp.float32)}
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep=2, world=1)
+    info = mgr.save(3, state)
+    assert info.manifest["fingerprint"] == pytree_fingerprint(state)
+    step, restored = mgr.restore_latest(
+        {"w": jnp.zeros((2, 3)), "b": jnp.zeros(3, jnp.float32)}
+    )
+    assert step == 3
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(state["w"]))
+    # a template with a different structure refuses to resume
+    assert mgr.restore_latest({"other": jnp.zeros(4)}) is None
+
+
+def test_manager_orbax_truncated_checkpoint_skipped(tmp_path, orbax):
+    state = {"w": jnp.arange(4.0)}
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep=5)
+    mgr.save(1, state)
+    mgr.save(2, state)
+    # truncate the newest: drop its manifest (simulating a dir copied
+    # mid-write); resume must fall back to step 1, not die
+    os.unlink(os.path.join(mgr.root, "step_00000002", "manifest.json"))
+    step, restored = mgr.restore_latest({"w": jnp.zeros(4)})
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.arange(4.0))
+
+
+# ---------------------------------------------------------------------
+# classification + retry policy + supervisor loop
+# ---------------------------------------------------------------------
+
+
+def test_classify_matrix():
+    assert classify(None, 0)["klass"] == "clean"
+    assert classify({"findings": []}, 0)["klass"] == "clean"
+    assert classify(None, 1) == {
+        "klass": "transient", "reason": "crash_no_telemetry", "kinds": [],
+    }
+    assert classify({"findings": []}, 1)["reason"] == (
+        "crash_without_mismatch")
+    hang = {"findings": [{"kind": "hang", "rank": 1, "verdict": "hung"}]}
+    assert classify(hang, 124) == {
+        "klass": "transient", "reason": "hang", "kinds": ["hang"]}
+    assert classify(hang, 1)["reason"] == "transient_findings"
+    missing = {"findings": [{"kind": "missing_rank", "rank": 1}]}
+    assert classify(missing, 1)["klass"] == "transient"
+    strag = {"findings": [{"kind": "straggler", "rank": 0, "op": "X"}]}
+    assert classify(strag, 1)["klass"] == "transient"
+    mm = {"findings": [{"kind": "mismatch", "seq": 2, "groups": []}]}
+    assert classify(mm, 1)["klass"] == "deterministic"
+    # mismatch beats a hang recorded beside it (causality order)
+    assert classify(
+        {"findings": mm["findings"] + hang["findings"]}, 124
+    )["klass"] == "deterministic"
+    # a static-site join upgrades the reason (same class)
+    mm_static = {"findings": [{
+        "kind": "mismatch", "seq": 2,
+        "groups": [{"fingerprint": "f", "ranks": [0], "static_sites": [
+            {"source": "a.py:3"}]}],
+    }]}
+    assert classify(mm_static, 1)["reason"] == "mismatch_static_attributed"
+
+
+def test_retry_policy_backoff():
+    p = RetryPolicy(retries=4, backoff_s=0.5, jitter=0.0)
+    assert [p.delay(a) for a in range(5)] == [0.0, 0.5, 1.0, 2.0, 4.0]
+    assert RetryPolicy(backoff_s=1.0, max_backoff_s=3.0,
+                       jitter=0.0).delay(10) == 3.0
+    # jitter stays within +-25% of the base
+    jp = RetryPolicy(backoff_s=1.0, jitter=0.25)
+    for attempt in range(1, 6):
+        base = min(1.0 * 2 ** (attempt - 1), 60.0)
+        d = jp.delay(attempt)
+        assert 0.74 * base <= d <= 1.26 * base
+
+
+def test_supervisor_transient_retries_then_success(tmp_path):
+    audit = str(tmp_path / "supervisor.jsonl")
+    calls = []
+    sup = Supervisor(
+        lambda attempt, resume: calls.append((attempt, resume)) or (
+            0 if attempt == 2 else 1),
+        policy=RetryPolicy(retries=4, backoff_s=0.0, jitter=0.0),
+        diagnose_fn=lambda attempt: {"findings": []},
+        resume_fn=lambda: 5,
+        audit_path=audit,
+        sleep_fn=lambda s: None,
+    )
+    assert sup.run() == 0
+    assert calls == [(0, None), (1, 5), (2, 5)]
+    recs = events.read(audit)
+    assert [r["action"] for r in recs] == ["retry", "retry", "done"]
+    assert all(r["kind"] == "supervisor" for r in recs)
+    assert recs[0]["klass"] == "transient"
+
+
+def test_supervisor_fails_fast_on_mismatch():
+    calls = []
+    sup = Supervisor(
+        lambda attempt, resume: calls.append(attempt) or 1,
+        policy=RetryPolicy(retries=9, backoff_s=0.0),
+        diagnose_fn=lambda attempt: {
+            "findings": [{"kind": "mismatch", "seq": 1, "groups": []}]},
+        sleep_fn=lambda s: None,
+    )
+    assert sup.run() == 1
+    assert calls == [0]
+    assert sup.attempts[-1]["klass"] == "deterministic"
+    assert sup.attempts[-1]["action"] == "give_up"
+
+
+def test_supervisor_bounded_and_interrupt():
+    calls = []
+    sup = Supervisor(
+        lambda attempt, resume: calls.append(attempt) or 3,
+        policy=RetryPolicy(retries=2, backoff_s=0.0, jitter=0.0),
+        diagnose_fn=lambda attempt: None,
+        sleep_fn=lambda s: None,
+    )
+    assert sup.run() == 3
+    assert calls == [0, 1, 2]
+    # SIGINT (130) is the operator: never retried
+    calls2 = []
+    sup2 = Supervisor(
+        lambda attempt, resume: calls2.append(attempt) or 130,
+        policy=RetryPolicy(retries=5, backoff_s=0.0),
+        sleep_fn=lambda s: None,
+    )
+    assert sup2.run() == 130
+    assert calls2 == [0]
+    assert sup2.attempts[-1]["klass"] == "interrupted"
+
+
+def test_resume_step_reads_env(monkeypatch):
+    monkeypatch.delenv("M4T_RESUME_STEP", raising=False)
+    assert resume_step() is None
+    monkeypatch.setenv("M4T_RESUME_STEP", "17")
+    assert resume_step() == 17
+    monkeypatch.setenv("M4T_RESUME_STEP", "bogus")
+    assert resume_step() is None
+
+
+# ---------------------------------------------------------------------
+# CLI selftest smoke (tier-1 hook, mirrors perf --selftest)
+# ---------------------------------------------------------------------
+
+
+def test_cli_selftest():
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.resilience", "--selftest"],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    assert res.returncode == 0, res.stderr
+    assert "resilience selftest ok" in res.stdout
+
+
+# ---------------------------------------------------------------------
+# launcher integration (real worlds; native toolchain required)
+# ---------------------------------------------------------------------
+
+needs_native = pytest.mark.skipif(
+    subprocess.run(["which", "g++"], capture_output=True).returncode != 0,
+    reason="no C++ toolchain",
+)
+
+
+def _launch(tmp_path, n, script, *launch_args, timeout=240,
+            script_args=()):
+    path = str(tmp_path / "case.py")
+    with open(path, "w") as f:
+        f.write(f"import sys; sys.path.insert(0, {REPO!r})\n")
+        f.write(textwrap.dedent(script))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    return subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.launch", "-n", str(n),
+         *launch_args, path, *script_args],
+        env=env, capture_output=True, text=True, timeout=timeout, cwd=REPO,
+    )
+
+
+@needs_native
+def test_launch_retries_zero_is_single_attempt_backcompat(tmp_path):
+    """``--retries 0`` (the default) must preserve the pre-supervisor
+    contract: one attempt, flat --events-dir layout, the failing
+    rank's exit code, and no supervisor audit artifacts."""
+    rundir = str(tmp_path / "run")
+    res = _launch(
+        tmp_path, 1,
+        """
+        import sys
+        import jax.numpy as jnp
+        import mpi4jax_tpu as m4t
+        m4t.allreduce(jnp.ones(3))
+        sys.exit(3)
+        """,
+        "--events-dir", rundir,
+    )
+    assert res.returncode == 3, (res.returncode, res.stderr)
+    produced = sorted(os.listdir(rundir))
+    assert "events-rank0.jsonl" in produced  # flat, not attempt00/
+    assert "supervisor.jsonl" not in produced
+    assert not any(p.startswith("attempt") for p in produced)
+    # failure still gets the inline doctor diagnosis (old behavior)
+    assert "post-mortem diagnosis" in res.stderr
+
+
+@needs_native
+def test_launch_supervised_layout_and_audit(tmp_path):
+    """--retries K: per-attempt artifact dirs, a supervisor.jsonl
+    audit trail, and the transient crash is retried exactly K times."""
+    rundir = str(tmp_path / "run")
+    res = _launch(
+        tmp_path, 1,
+        """
+        import sys
+        import jax.numpy as jnp
+        import mpi4jax_tpu as m4t
+        m4t.allreduce(jnp.ones(3))
+        sys.exit(2)
+        """,
+        "--events-dir", rundir, "--retries", "2", "--backoff", "0.05",
+    )
+    assert res.returncode == 2, (res.returncode, res.stderr)
+    produced = sorted(os.listdir(rundir))
+    assert {"attempt00", "attempt01", "attempt02"} <= set(produced)
+    recs = events.read(os.path.join(rundir, "supervisor.jsonl"))
+    assert [r["attempt"] for r in recs] == [0, 1, 2]
+    assert [r["action"] for r in recs] == ["retry", "retry", "give_up"]
+    assert all(r["klass"] == "transient" for r in recs)
+
+
+@needs_native
+def test_launch_rejects_bad_fault_plan(tmp_path):
+    res = _launch(
+        tmp_path, 1, "print('unreachable')",
+        "--fault-plan", '[{"rank": 5, "op": "Barrier", "action": "hang"}]',
+    )
+    assert res.returncode == 2
+    assert "out of range" in res.stderr
+    res2 = _launch(
+        tmp_path, 1, "print('unreachable')",
+        "--fault-plan", '[{"rank": 0, "op": "Typo", "action": "hang"}]',
+    )
+    assert res2.returncode == 2
+    assert "unknown op" in res2.stderr
+
+
+# the resume-aware eager training loop the chaos tests drive; saves a
+# checkpoint every step (rank 0), prints the final params as hex
+_TRAIN = """
+import sys
+import numpy as np
+import jax.numpy as jnp
+import mpi4jax_tpu as m4t
+from mpi4jax_tpu.runtime import shm
+from mpi4jax_tpu.resilience import CheckpointManager, resume_step
+
+STEPS = 8
+rank = shm.rank()
+mgr = CheckpointManager(sys.argv[1], keep=3, world=shm.size())
+w = jnp.zeros(4)
+start = 0
+r = resume_step()
+if r is not None:
+    info = mgr.at_step(r, world=shm.size())
+    if info is not None:
+        w = mgr.restore(info, {"w": w})["w"]
+        start = info.step + 1
+        print(f"RESUMED{rank}@{info.step}", file=sys.stderr)
+for step in range(start, STEPS):
+    g = jnp.full(4, float(step + 1))
+    g = m4t.allreduce(g)
+    w = w + 0.1 * g
+    if rank == 0:
+        mgr.save(step, {"w": w})
+m4t.barrier()
+print(f"FINAL{rank} " + np.asarray(w).tobytes().hex())
+"""
+
+
+def _finals(stdout):
+    # two ranks share the captured stdout pipe and their final lines
+    # can interleave without newline boundaries; the hex payload is
+    # lowercase, so FINAL<rank> markers stay parseable regardless
+    return dict(re.findall(r"FINAL(\d) ([0-9a-f]+)", stdout))
+
+
+@needs_native
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_crash_resume_bitwise_identical(tmp_path):
+    """ISSUE-5 acceptance: rank 1 crashes at its 6th AllReduce
+    (step 5) on attempt 0; the supervisor diagnoses a transient crash,
+    restarts with --resume-dir, both ranks resume from the latest
+    valid checkpoint, and the final parameters are bit-for-bit the
+    fault-free run's."""
+    pytest.importorskip("orbax.checkpoint")
+    clean_ckpt = str(tmp_path / "ckpt_clean")
+    res_clean = _launch(
+        tmp_path, 2, _TRAIN, script_args=(clean_ckpt,),
+    )
+    assert res_clean.returncode == 0, res_clean.stderr
+    clean = _finals(res_clean.stdout)
+    assert set(clean) == {"0", "1"}, res_clean.stdout
+
+    chaos_ckpt = str(tmp_path / "ckpt_chaos")
+    rundir = str(tmp_path / "run")
+    plan = (tmp_path / "plan.json")
+    plan.write_text(json.dumps([{
+        "rank": 1, "op": "AllReduce", "nth": 6,
+        "action": "crash", "mode": "exception", "attempt": 0,
+    }]))
+    res = _launch(
+        tmp_path, 2, _TRAIN,
+        "--events-dir", rundir,
+        "--fault-plan", str(plan),
+        "--retries", "2", "--backoff", "0.1",
+        "--resume-dir", chaos_ckpt,
+        script_args=(chaos_ckpt,),
+    )
+    assert res.returncode == 0, res.stderr
+    assert "injecting crash" in res.stderr
+    assert "RESUMED0@" in res.stderr and "RESUMED1@" in res.stderr
+    assert _finals(res.stdout) == clean  # bit-for-bit
+    # audit trail: one failed transient attempt, one clean one
+    recs = events.read(os.path.join(rundir, "supervisor.jsonl"))
+    assert [r["action"] for r in recs] == ["retry", "done"]
+    assert recs[0]["klass"] == "transient"
+    assert isinstance(recs[0]["resume_step"], int)
+    # the injection is on the record for the doctor/trace overlay
+    fault_recs = [
+        r
+        for r in events.read(
+            os.path.join(rundir, "attempt00", "events-rank1.jsonl"))
+        if r["kind"] == "fault"
+    ]
+    assert len(fault_recs) == 1 and fault_recs[0]["action"] == "crash"
+
+
+@needs_native
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_mismatch_is_not_retried(tmp_path):
+    """ISSUE-5 acceptance: a MISMATCH-class failure is deterministic —
+    the supervisor prints the doctor's diagnosis and gives up with
+    retries still in the budget."""
+    rundir = str(tmp_path / "run")
+    res = _launch(
+        tmp_path, 2,
+        """
+        import jax.numpy as jnp
+        import mpi4jax_tpu as m4t
+        from mpi4jax_tpu.runtime import shm
+        r = shm.rank()
+        x = m4t.allreduce(jnp.arange(4.0) + r)
+        if r == 0:
+            m4t.barrier()       # diverges: deadlocks against...
+        else:
+            m4t.allreduce(x)    # ...rank 1's allreduce at seq 2
+        """,
+        "--events-dir", rundir, "--retries", "3", "--backoff", "0.1",
+        "--hang-timeout", "20",
+    )
+    assert res.returncode != 0
+    assert "MISMATCH at seq 2" in res.stderr
+    assert "not retrying" in res.stderr
+    recs = events.read(os.path.join(rundir, "supervisor.jsonl"))
+    assert len(recs) == 1  # exactly one attempt
+    assert recs[0]["klass"] == "deterministic"
+    assert recs[0]["action"] == "give_up"
+    assert sorted(os.listdir(rundir)) == ["attempt00", "supervisor.jsonl"]
