@@ -80,11 +80,13 @@ def build_cg(nproc: int, tol: float = 1e-6, max_iters: int = 2000):
     return cg
 
 
-def _lint_cg(nproc: int = 8, n_loc: int = 16):
+def _lint_cg(nproc: int = 8, n_loc: int = 16, world: int = None):
     import jax
 
     from mpi4jax_tpu.analysis import LintTarget
 
+    if world is not None:
+        nproc = world
     return LintTarget(
         fn=build_cg(nproc),
         args=(jax.ShapeDtypeStruct((n_loc,), "float32"),),

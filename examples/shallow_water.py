@@ -77,7 +77,7 @@ def parse_args():
     return p.parse_args()
 
 
-def _lint_step(nproc_y: int = 2, nproc_x: int = 4):
+def _lint_step(nproc_y: int = 2, nproc_x: int = 4, world: int = None):
     """Static-linter entry: the composable per-rank step over the same
     2-D process grid main() builds for --nproc 8 (abstract shapes, no
     devices); the fused deep-halo variants are TPU-kernel paths gated
@@ -91,6 +91,9 @@ def _lint_step(nproc_y: int = 2, nproc_x: int = 4):
         ShallowWaterModel,
     )
 
+    if world is not None:
+        nproc_y = 1 if world < 4 else 2
+        nproc_x = world // nproc_y
     config = ShallowWaterConfig(nx=32, ny=16, dims=(nproc_y, nproc_x))
     model = ShallowWaterModel(config)
     block = jax.ShapeDtypeStruct(

@@ -27,7 +27,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _lint_train_step(attention: str, nproc: int = 8, t_local: int = 16):
+def _lint_train_step(attention: str, nproc: int = 8, t_local: int = 16,
+                     world: int = None):
     """Static-linter entry: the exact per-rank step main() hands to
     ``parallel.spmd`` (same config shape, abstract arrays, no
     devices)."""
@@ -37,6 +38,8 @@ def _lint_train_step(attention: str, nproc: int = 8, t_local: int = 16):
     from mpi4jax_tpu.analysis import LintTarget
     from mpi4jax_tpu.models import attention as tfm
 
+    if world is not None:
+        nproc = world
     cfg = tfm.TransformerConfig(
         vocab=64, d_model=64, n_heads=8, n_layers=2, d_ff=128,
         sp_axis="ranks", sp_size=nproc, attention=attention,
@@ -54,8 +57,12 @@ def _lint_train_step(attention: str, nproc: int = 8, t_local: int = 16):
 
 
 M4T_LINT_TARGETS = {
-    "train_step_ring": lambda: _lint_train_step("ring"),
-    "train_step_ulysses": lambda: _lint_train_step("ulysses"),
+    "train_step_ring": lambda world=None: _lint_train_step(
+        "ring", world=world
+    ),
+    "train_step_ulysses": lambda world=None: _lint_train_step(
+        "ulysses", world=world
+    ),
 }
 
 

@@ -116,11 +116,13 @@ def build_workload(nproc: int, d_in: int = 32, lr: float = 0.05):
     )
 
 
-def _lint_step(which: str, nproc: int = 8):
+def _lint_step(which: str, nproc: int = 8, world: int = None):
     import jax
 
     from mpi4jax_tpu.analysis import LintTarget
 
+    if world is not None:
+        nproc = world
     ns = build_workload(nproc)
     return LintTarget(
         fn=getattr(ns, which),
@@ -134,8 +136,10 @@ def _lint_step(which: str, nproc: int = 8):
 
 
 M4T_LINT_TARGETS = {
-    "zero_step": lambda: _lint_step("zero_step"),
-    "allreduce_step": lambda: _lint_step("allreduce_step"),
+    "zero_step": lambda world=None: _lint_step("zero_step", world=world),
+    "allreduce_step": lambda world=None: _lint_step(
+        "allreduce_step", world=world
+    ),
 }
 
 

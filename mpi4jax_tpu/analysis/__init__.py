@@ -24,7 +24,17 @@ Layers:
 - :mod:`.emit_check` — the opt-in ``M4T_STATIC_CHECK=1`` hook run by
   ``ops/_core.py`` at every emission's first trace (the subset of
   rules decidable from one call site).
-- CLI: ``python -m mpi4jax_tpu.analysis <module:fn|file> [--json]``
+- :mod:`.schedule` — per-rank **concrete** collective schedules by
+  partial evaluation (``axis_index`` folded per rank, p2p partner
+  tables evaluated to global edges, scan/while resolved), plus the
+  static cost report joining ``observability/costmodel.py``.
+- :mod:`.simulate` — blocking-semantics simulator over those
+  schedules: proves a program deadlock-free or produces an M4T201
+  deadlock witness / M4T202 cross-rank mismatch / M4T203 redundant
+  collective — the pre-flight verifier behind ``launch --verify``.
+- :mod:`.sarif` — SARIF 2.1.0 export for code-scanning annotations.
+- CLI: ``python -m mpi4jax_tpu.analysis <module:fn|file> [--json]
+  [--simulate] [--cost] [--ranks 2,4,8] [--sarif out.sarif]``
   (exit 0 clean / 1 findings / 2 error).
 
 Rule catalog with examples: ``docs/static-analysis.md``.
@@ -40,6 +50,23 @@ from .linter import (  # noqa: F401
     trace_sites,
 )
 from .rules import RULES, Finding, LintConfig, rule, run_rules  # noqa: F401
+from .schedule import (  # noqa: F401
+    ProgramSchedule,
+    ScheduleEvent,
+    cost_report,
+    enumerate_schedule,
+    trace_schedule,
+)
+from .simulate import (  # noqa: F401
+    SIM_RULES,
+    SimFinding,
+    SimReport,
+    sim_reports_to_json,
+    simulate,
+    simulate_events,
+    verify,
+    verify_module,
+)
 from .sites import (  # noqa: F401
     CollectiveSite,
     PRIM_TO_OP,
@@ -54,15 +81,28 @@ __all__ = [
     "LintTarget",
     "PRIM_TO_OP",
     "ProgramGraph",
+    "ProgramSchedule",
     "RULES",
     "Report",
+    "SIM_RULES",
+    "ScheduleEvent",
+    "SimFinding",
+    "SimReport",
     "canonical_fingerprint",
+    "cost_report",
+    "enumerate_schedule",
     "lint",
     "lint_module",
     "reports_to_json",
     "rule",
     "rule_catalog",
     "run_rules",
+    "sim_reports_to_json",
+    "simulate",
+    "simulate_events",
+    "trace_schedule",
     "trace_sites",
+    "verify",
+    "verify_module",
     "walk_closed_jaxpr",
 ]

@@ -167,6 +167,41 @@ def main(argv: Optional[List[str]] = None) -> int:
         action="store_true",
         help="print the rule catalog and exit",
     )
+    parser.add_argument(
+        "--simulate",
+        action="store_true",
+        help="additionally enumerate every rank's concrete collective "
+        "schedule (partial evaluation of axis_index-dependent control "
+        "flow) and simulate it under blocking semantics: prove the "
+        "program deadlock-free or report M4T201 (deadlock, with a "
+        "rank-cycle witness) / M4T202 (cross-rank order mismatch) / "
+        "M4T203 (redundant collective)",
+    )
+    parser.add_argument(
+        "--cost",
+        action="store_true",
+        help="static cost report (implies schedule enumeration): "
+        "predicted per-rank wire bytes, algorithm steps, and "
+        "alpha-beta time from the analytic cost model "
+        "(observability/costmodel.py), with the top-k dominant "
+        "collectives — the planner's static seed",
+    )
+    parser.add_argument(
+        "--ranks",
+        default=None,
+        metavar="N[,N...]",
+        help="world size(s) to analyze at (e.g. '2,4,8'): overrides a "
+        "single-axis env / re-instantiates module targets whose "
+        "thunks accept world=; the self-verify gate runs 2,4,8",
+    )
+    parser.add_argument(
+        "--sarif",
+        default=None,
+        metavar="FILE",
+        help="write findings as SARIF 2.1.0 (for GitHub code-scanning "
+        "annotations); '-' prints the SARIF log to stdout instead of "
+        "the normal report",
+    )
     args = parser.parse_args(argv)
 
     if args.rules:
@@ -191,48 +226,117 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         axis_env = parse_axis_env(args.axis)
         arg_structs = tuple(_parse_arg_spec(s) for s in args.arg)
+        worlds: List[Optional[int]] = [None]
+        if args.ranks:
+            worlds = [int(tok) for tok in args.ranks.split(",") if tok]
+            if not worlds or any(w < 1 for w in worlds):
+                raise ValueError(f"bad --ranks spec {args.ranks!r}")
+            if axis_env is not None and len(axis_env) != 1:
+                raise ValueError(
+                    "--ranks can only rescale a single-axis env; drop "
+                    "--ranks or pass one --axis"
+                )
     except (TypeError, ValueError) as e:  # incl. np.dtype on bad names
         print(f"error: {e}", file=sys.stderr)
         return 2
 
     from .linter import lint, lint_module, reports_to_json
 
-    reports = []
+    want_sim = args.simulate or args.cost
+    if want_sim:
+        from .simulate import (
+            sim_reports_to_json,
+            verify,
+            verify_module,
+        )
+
+    def env_at(world: Optional[int]) -> Optional[dict]:
+        if world is None:
+            return axis_env
+        if axis_env is None:
+            return {"ranks": world}
+        return {next(iter(axis_env)): world}
+
+    lint_reports = []
+    sim_reports = []
     for target in args.targets:
         try:
             module, fn = _import_target(target)
         except Exception as e:
             print(f"error: cannot resolve {target!r}: {e}", file=sys.stderr)
             return 2
-        if fn is not None:
-            reports.append(
-                lint(fn, arg_structs, axis_env=axis_env, name=target)
+        found_any = False
+        for world in worlds:
+            if fn is not None:
+                env = env_at(world)
+                name = target if world is None else f"{target}@n{world}"
+                lint_reports.append(
+                    lint(fn, arg_structs, axis_env=env, name=name)
+                )
+                if want_sim:
+                    sim_reports.append(
+                        verify(
+                            fn,
+                            arg_structs,
+                            axis_env=env,
+                            name=name,
+                            with_cost=args.cost,
+                        )
+                    )
+                found_any = True
+            else:
+                module_reports = lint_module(module, world=world)
+                lint_reports.extend(module_reports)
+                if want_sim:
+                    sim_reports.extend(
+                        verify_module(
+                            module, world=world, with_cost=args.cost
+                        )
+                    )
+                found_any = found_any or bool(module_reports)
+        if not found_any:
+            print(
+                f"error: {target!r} declares no M4T_LINT_TARGETS "
+                "and no :fn was given",
+                file=sys.stderr,
             )
+            return 2
+
+    if args.sarif:
+        from .sarif import to_sarif
+
+        sarif_log = to_sarif(lint_reports, sim_reports, root=os.getcwd())
+        if args.sarif == "-":
+            print(json.dumps(sarif_log, indent=1))
         else:
-            module_reports = lint_module(module)
-            if not module_reports:
-                print(
-                    f"error: {target!r} declares no M4T_LINT_TARGETS "
-                    "and no :fn was given",
-                    file=sys.stderr,
-                )
-                return 2
-            reports.extend(module_reports)
+            with open(args.sarif, "w") as f:
+                json.dump(sarif_log, f, indent=1)
+            print(f"# SARIF written to {args.sarif}", file=sys.stderr)
 
-    if args.json:
-        print(json.dumps(reports_to_json(reports), indent=1, default=str))
-    else:
-        for r in reports:
-            print(r.to_text())
+    if args.sarif != "-":
+        if args.json:
+            obj = reports_to_json(lint_reports)
+            if want_sim:
+                obj["simulate"] = sim_reports_to_json(sim_reports)
+            print(json.dumps(obj, indent=1, default=str))
+        else:
+            for r in lint_reports:
+                print(r.to_text())
+            for sr in sim_reports:
+                print(sr.to_text())
 
-    if any(r.error is not None for r in reports):
-        for r in reports:
-            if r.error is not None:
-                print(
-                    f"error: {r.target}: {r.error}", file=sys.stderr
-                )
+    errors = [r for r in lint_reports if r.error is not None] + [
+        r for r in sim_reports if r.verdict == "error"
+    ]
+    if errors:
+        for r in errors:
+            reason = getattr(r, "error", None) or getattr(r, "reason", "?")
+            print(f"error: {r.target}: {reason}", file=sys.stderr)
         return 2
-    return 1 if any(r.findings for r in reports) else 0
+    bad = any(r.findings for r in lint_reports) or any(
+        r.findings or r.verdict == "unprovable" for r in sim_reports
+    )
+    return 1 if bad else 0
 
 
 if __name__ == "__main__":

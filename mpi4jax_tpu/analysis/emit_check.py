@@ -76,19 +76,23 @@ def check_emission(
         d = getattr(inputs[0], "dtype", None)
         dtype = None if d is None else str(d)
 
-    # M4T103 (site-local): degenerate self-edges in a p2p transfer
+    # M4T103 (site-local): a transfer degenerating *entirely* to
+    # self-edges ((r + k) % n with k % n == 0 — no data moves at all).
+    # Mixed perms with a deliberate identity edge are legal routing
+    # and are checked per-rank by the schedule simulator instead.
     perm = params.get("perm")
     if perm and world and world > 1:
         selfies = [(s, d) for s, d in perm if s == d]
-        if selfies:
+        if selfies and len(selfies) == len(perm):
             _report(
                 "M4T103",
                 opname,
                 str(sorted(selfies)),
-                f"{opname} transfer contains self-edges {selfies} on a "
-                f"size-{world} communicator — shift arithmetic gone "
-                "degenerate ((r + k) % n with k % n == 0)? The rank "
-                "pairs with nobody (docs/static-analysis.md#m4t103).",
+                f"{opname} transfer consists entirely of self-edges "
+                f"{selfies} on a size-{world} communicator — shift "
+                "arithmetic gone degenerate ((r + k) % n with "
+                "k % n == 0)? No data moves between ranks "
+                "(docs/static-analysis.md#m4t103).",
             )
 
     # M4T106: reduction dtype hazards

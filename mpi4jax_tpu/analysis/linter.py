@@ -215,25 +215,60 @@ class LintTarget:
     axis_env: Optional[Dict[str, int]] = None
 
 
-def iter_module_targets(module) -> Iterable[Tuple[str, LintTarget]]:
+def _thunk_accepts_world(thunk) -> bool:
+    import inspect
+
+    try:
+        return "world" in inspect.signature(thunk).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+def iter_module_targets(
+    module, *, world: Optional[int] = None
+) -> Iterable[Tuple[str, LintTarget]]:
+    """Yield a module's declared lint targets.
+
+    With ``world``, targets are re-instantiated at that world size:
+    thunks accepting a ``world`` keyword get it passed (the convention
+    every ``models/``/``examples/`` target follows, so the self-verify
+    gate can sweep ranks ∈ {2, 4, 8}); thunks without one are yielded
+    only when their declared axis env already multiplies out to
+    ``world`` (shape-dependent tables cannot be rescaled from outside).
+    """
     registry = getattr(module, TARGETS_ATTR, None)
     if not registry:
         return
     for tname in sorted(registry):
         thunk = registry[tname]
-        target = thunk() if callable(thunk) else thunk
+        if callable(thunk):
+            if world is not None and _thunk_accepts_world(thunk):
+                target = thunk(world=world)
+            else:
+                target = thunk()
+        else:
+            target = thunk
         if not isinstance(target, LintTarget):
             target = LintTarget(*target)
+        if world is not None:
+            import math
+
+            env_world = int(math.prod((target.axis_env or {"ranks": 8}).values()))
+            if env_world != world:
+                continue
         yield tname, target
 
 
 def lint_module(
-    module, *, config: Optional[LintConfig] = None
+    module,
+    *,
+    config: Optional[LintConfig] = None,
+    world: Optional[int] = None,
 ) -> List[Report]:
     """Lint every declared target of a module (``M4T_LINT_TARGETS``)."""
     modname = getattr(module, "__name__", str(module))
     reports = []
-    for tname, target in iter_module_targets(module):
+    for tname, target in iter_module_targets(module, world=world):
         reports.append(
             lint(
                 target.fn,
@@ -256,7 +291,11 @@ def reports_to_json(reports: List[Report]) -> Dict[str, Any]:
 
 
 def rule_catalog() -> str:
-    """One line per registered rule (the ``--rules`` CLI listing)."""
-    return "\n".join(
+    """One line per registered rule (the ``--rules`` CLI listing):
+    the M4T1xx lint rules plus the M4T2xx simulation verdicts."""
+    from .simulate import sim_rule_catalog
+
+    lint_lines = "\n".join(
         f"{r.code} [{r.severity}] {r.title}" for r in RULES.values()
     )
+    return lint_lines + "\n" + sim_rule_catalog()

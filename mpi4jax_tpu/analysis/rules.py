@@ -239,20 +239,32 @@ def _unpaired_p2p(graph, config):
             continue
         if site.world is not None and site.world <= 1:
             continue
+        # Per-rank precision (evaluated partner tables, not symbolic
+        # pattern matching): each rank's send/recv partners are already
+        # concrete in the perm table, so the rule only fires when the
+        # *whole transfer* degenerates to self-edges — the
+        # ((r + k) % n, k % n == 0) bug, where every rank "pairs" with
+        # itself and no data moves anywhere. A transfer where *some*
+        # rank keeps its own value while others shift (a boundary rank
+        # in a non-periodic shift composed with a wrap, an identity
+        # edge in a deliberate partial permutation) is legal
+        # CollectivePermute routing and used to false-positive here;
+        # the schedule simulator (analysis/simulate.py) now checks the
+        # actual per-rank pairing instead.
         selfies = [(s, d) for s, d in site.perm if s == d]
-        if not selfies:
+        if not selfies or len(selfies) != len(site.perm):
             continue
         findings.append(
             Finding(
                 code="M4T103",
                 severity="error",
                 message=(
-                    f"point-to-point transfer at {site.source} contains "
-                    f"self-edges {selfies} on a size-{site.world} "
-                    "communicator: a rank 'sending to itself' through a "
-                    "CollectivePermute is almost always shift arithmetic "
-                    "gone degenerate ((r + k) % n with k % n == 0) and "
-                    "pairs with nobody."
+                    f"point-to-point transfer at {site.source} consists "
+                    f"entirely of self-edges {selfies} on a "
+                    f"size-{site.world} communicator: shift arithmetic "
+                    "gone degenerate ((r + k) % n with k % n == 0) — "
+                    "every rank 'sends to itself' and no data moves "
+                    "between ranks at all."
                 ),
                 site=site,
                 sites=[site],

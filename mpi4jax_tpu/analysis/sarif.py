@@ -1,0 +1,217 @@
+"""SARIF 2.1.0 export for lint + simulation findings.
+
+SARIF (Static Analysis Results Interchange Format, OASIS 2.1.0) is
+what code-scanning UIs ingest — GitHub's ``upload-sarif`` action turns
+it into inline PR annotations. This module maps the linter's M4T1xx
+findings and the schedule simulator's M4T2xx verdicts onto one SARIF
+``run``:
+
+- every rule (lint + simulation) is declared in the tool's
+  ``driver.rules`` with its stable id and help text;
+- each finding becomes a ``result`` whose location is parsed from the
+  finding's ``file.py:line (function)`` source string (repo-relative
+  URIs, so annotations land on the right file in CI);
+- program-level findings (no source line) anchor to the lint target's
+  file when known, else to the repository root.
+
+Produced by ``python -m mpi4jax_tpu.analysis ... --sarif out.sarif``
+(see the self-verify CI step in ``.github/workflows/lint.yml``).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+_SRC_RE = re.compile(r"^(?P<file>.+?):(?P<line>\d+)(?:\s+\(.*\))?$")
+
+_LEVELS = {"error": "error", "warning": "warning"}
+
+
+def _rules_meta() -> List[Dict[str, Any]]:
+    from .rules import RULES
+    from .simulate import SIM_RULES
+
+    rules = []
+    for r in RULES.values():
+        rules.append(
+            {
+                "id": r.code,
+                "name": r.title,
+                "shortDescription": {"text": r.title},
+                "defaultConfiguration": {
+                    "level": _LEVELS.get(r.severity, "warning")
+                },
+                "helpUri": (
+                    "https://github.com/mpi4jax/mpi4jax"
+                    f"#static-analysis-{r.code.lower()}"
+                ),
+            }
+        )
+    for r in SIM_RULES.values():
+        rules.append(
+            {
+                "id": r.code,
+                "name": r.title,
+                "shortDescription": {"text": r.title},
+                "defaultConfiguration": {
+                    "level": _LEVELS.get(r.severity, "warning")
+                },
+            }
+        )
+    return rules
+
+
+def _location(source: Optional[str], root: str) -> Dict[str, Any]:
+    """A SARIF physicalLocation from a ``file.py:line (fn)`` source
+    string; repo-relative when the file sits under ``root``."""
+    uri = "."
+    line = 1
+    if source:
+        m = _SRC_RE.match(source.strip())
+        if m:
+            path = m.group("file")
+            line = max(1, int(m.group("line")))
+            abspath = os.path.abspath(path)
+            rootabs = os.path.abspath(root)
+            if abspath.startswith(rootabs + os.sep):
+                uri = os.path.relpath(abspath, rootabs)
+            else:
+                uri = path
+    return {
+        "physicalLocation": {
+            "artifactLocation": {"uri": uri.replace(os.sep, "/")},
+            "region": {"startLine": line},
+        }
+    }
+
+
+def _result(
+    code: str,
+    severity: str,
+    message: str,
+    source: Optional[str],
+    root: str,
+    extra: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    res = {
+        "ruleId": code,
+        "level": _LEVELS.get(severity, "warning"),
+        "message": {"text": message},
+        "locations": [_location(source, root)],
+    }
+    if extra:
+        res["properties"] = extra
+    return res
+
+
+def to_sarif(
+    lint_reports=(),
+    sim_reports=(),
+    *,
+    root: Optional[str] = None,
+    tool_version: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Build one SARIF 2.1.0 log from lint Reports and SimReports."""
+    if root is None:
+        root = os.getcwd()
+    if tool_version is None:
+        try:
+            from .. import __version__ as tool_version
+        except Exception:
+            tool_version = "0"
+    results: List[Dict[str, Any]] = []
+    for rep in lint_reports:
+        for f in rep.findings:
+            results.append(
+                _result(
+                    f.code,
+                    f.severity,
+                    f"[{rep.target}] {f.message}",
+                    f.source if f.source != "<program>" else None,
+                    root,
+                )
+            )
+        if rep.error is not None:
+            results.append(
+                _result(
+                    "M4T000",
+                    "error",
+                    f"[{rep.target}] lint trace failed: {rep.error}",
+                    None,
+                    root,
+                )
+            )
+    for rep in sim_reports:
+        for f in rep.findings:
+            src = None
+            ranks = f.witness.get("ranks") if f.witness else None
+            if ranks:
+                src = next(
+                    (r.get("source") for r in ranks if r.get("source")),
+                    None,
+                )
+            if src is None and f.witness:
+                src = f.witness.get("second_source")
+            results.append(
+                _result(
+                    f.code,
+                    f.severity,
+                    f"[{rep.target} @ world={rep.world}] {f.message}",
+                    src,
+                    root,
+                    extra={"witness": f.witness} if f.witness else None,
+                )
+            )
+        if rep.verdict in ("unprovable", "error"):
+            results.append(
+                _result(
+                    "M4T200",
+                    "warning",
+                    f"[{rep.target} @ world={rep.world}] schedule not "
+                    f"statically provable: {rep.reason}",
+                    None,
+                    root,
+                )
+            )
+    rules = _rules_meta()
+    rules.append(
+        {
+            "id": "M4T000",
+            "name": "lint target failed to trace",
+            "defaultConfiguration": {"level": "error"},
+        }
+    )
+    rules.append(
+        {
+            "id": "M4T200",
+            "name": "schedule not statically provable",
+            "defaultConfiguration": {"level": "warning"},
+        }
+    )
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "mpi4jax_tpu.analysis",
+                        "informationUri": (
+                            "https://github.com/mpi4jax/mpi4jax"
+                        ),
+                        "version": str(tool_version),
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }

@@ -1,0 +1,999 @@
+"""Per-rank concrete collective schedules by partial evaluation.
+
+The walker (:mod:`.walker`) sees *one abstract rank*: it can say a
+``cond`` predicate is rank-tainted (M4T101) but not which branch rank
+3 takes. This module closes that gap: for every concrete rank in the
+axis env it **partially evaluates** the jaxpr — ``lax.axis_index``
+becomes that rank's coordinate, rank arithmetic (``(r + 1) % n``,
+``r == 0``) is folded with numpy, ``cond``/``switch`` predicates that
+depend only on the rank resolve to one branch, ``scan`` bodies unroll
+over their static length, ``while`` loops with concretely evaluable
+predicates run to termination — and records the sequence of
+collective events **that rank actually executes**, with point-to-point
+partner expressions evaluated to concrete global-rank edges.
+
+The result (:class:`ProgramSchedule`) is what the simulator
+(:mod:`.simulate`) needs to prove a program deadlock-free or exhibit
+a concrete witness, and what the static cost report joins against
+``observability/costmodel.py``.
+
+Value lattice (per rank): a traced value is either **known** (a
+concrete numpy array, e.g. anything derived from ``axis_index`` and
+constants), **uniform** (unknown, but provably identical on every
+rank — e.g. an ``allreduce`` output, so rank-uniform control flow
+stays provable: cg_solver's convergence loop), or **divergent**
+(unknown and possibly different per rank — e.g. the rank's own data
+shard). Each value also carries whether it is *rank-invariant*, so
+``uniform ⊕ constant`` stays uniform while ``uniform ⊕ axis_index``
+degrades to divergent.
+
+Control flow that cannot be resolved statically — a data-divergent
+predicate guarding *different* collective sequences — makes the
+schedule :class:`unprovable <ScheduleNotStatic>` rather than wrong;
+the linter's M4T101/M4T102 findings already name those sites.
+
+Fingerprints are byte-identical to ``observability/recorder.fingerprint``
+and ``sites.CollectiveSite.fingerprint`` (pinned by tests), so
+schedules join runtime doctor verdicts and the PR 4 cost golden table
+with no translation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..observability import costmodel
+from .sites import PRIM_TO_OP, site_from_eqn, source_of
+
+#: unroll / interpretation safety caps (a static tool must terminate
+#: on adversarial input; hitting a cap makes the schedule unprovable,
+#: never silently truncated)
+MAX_EVENTS_PER_RANK = 32768
+MAX_WHILE_ITERS = 4096
+#: largest concrete array the evaluator keeps; bigger results degrade
+#: to unknown (rank arithmetic is scalar/table-sized, payloads are not)
+MAX_VALUE_ELEMS = 4096
+#: value-only scan unrolling budget when the body emits no collectives
+MAX_SILENT_SCAN_ITERS = 64
+
+
+class ScheduleNotStatic(Exception):
+    """The per-rank schedule cannot be enumerated statically.
+
+    Carries a human-readable ``reason`` naming the source location of
+    the unresolvable construct; the caller reports the program as
+    *unprovable* (distinct from both clean and deadlocking)."""
+
+
+# ---------------------------------------------------------------------
+# events
+# ---------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ScheduleEvent:
+    """One collective emission in one rank's concrete schedule."""
+
+    #: emit-vocabulary op name (``AllReduce`` ...)
+    op: str
+    #: recorder-schema fingerprint (``Op[shape:dtype]@axes``)
+    fingerprint: str
+    #: ``"collective"`` — group-synchronizing (every HLO collective,
+    #: including the fused CollectivePermute every p2p lowers to) —
+    #: or ``"p2p"`` — blocking point-to-point rendezvous (the shm
+    #: backend / synthetic-schedule model used by the simulator's
+    #: property tests)
+    kind: str
+    #: global ranks that must co-execute this event
+    group: Tuple[int, ...]
+    #: concrete global-rank edges of a point-to-point transfer
+    #: (empty for pure collectives)
+    edges: Tuple[Tuple[int, int], ...] = ()
+    #: global ranks this rank sends to / receives from (derived from
+    #: ``edges``; meaningful for p2p matching and M4T103 precision)
+    sends: Tuple[int, ...] = ()
+    recvs: Tuple[int, ...] = ()
+    nbytes: int = 0
+    dtype: Optional[str] = None
+    #: communicator size (the cost model's ``world``)
+    world: Optional[int] = None
+    reduce_op: Optional[str] = None
+    source: str = "<unknown>"
+    path: Tuple[str, ...] = ()
+
+    @property
+    def match_key(self) -> Tuple:
+        """What must agree across the group for the event to complete:
+        fingerprint *and* concrete edges (crossed permutes share a
+        fingerprint but not edges)."""
+        return (self.fingerprint, self.group, self.edges)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "op": self.op,
+            "fingerprint": self.fingerprint,
+            "kind": self.kind,
+            "group": list(self.group),
+            "edges": [list(e) for e in self.edges],
+            "sends": list(self.sends),
+            "recvs": list(self.recvs),
+            "bytes": self.nbytes,
+            "dtype": self.dtype,
+            "world": self.world,
+            "reduce_op": self.reduce_op,
+            "source": self.source,
+            "path": list(self.path),
+        }
+
+    def __str__(self) -> str:
+        extra = f" edges={list(self.edges)}" if self.edges else ""
+        return f"{self.fingerprint} grp={list(self.group)}{extra}"
+
+
+@dataclasses.dataclass
+class RedundantPair:
+    """M4T203 witness: a collective consuming the unmodified output of
+    an identical earlier collective."""
+
+    fingerprint: str
+    first_source: str
+    second_source: str
+    reduce_op: Optional[str]
+    rank: int
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class ProgramSchedule:
+    """Concrete per-rank schedules for one program at one axis env."""
+
+    axis_env: Dict[str, int]
+    world: int
+    #: rank -> ordered events (only when provable)
+    events: Dict[int, List[ScheduleEvent]]
+    #: reason the schedule could not be enumerated (None = provable)
+    unprovable: Optional[str] = None
+    #: M4T203 redundant-collective witnesses found during enumeration
+    redundant: List[RedundantPair] = dataclasses.field(default_factory=list)
+    #: advisory notes (uniform-trip loops counted once, etc.)
+    notes: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def provable(self) -> bool:
+        return self.unprovable is None
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "axis_env": dict(sorted(self.axis_env.items())),
+            "world": self.world,
+            "unprovable": self.unprovable,
+            "n_events": {str(r): len(ev) for r, ev in sorted(self.events.items())},
+            "events": {
+                str(r): [e.to_json() for e in ev]
+                for r, ev in sorted(self.events.items())
+            },
+            "redundant": [p.to_json() for p in self.redundant],
+            "notes": list(self.notes),
+        }
+
+
+# ---------------------------------------------------------------------
+# axis-space bookkeeping
+# ---------------------------------------------------------------------
+
+
+class AxisSpace:
+    """Global rank space of an axis env: row-major over the env's
+    axis order (the same linearization ``BoundComm.global_rank`` uses
+    over a communicator's own axes)."""
+
+    def __init__(self, axis_env: Dict[str, int]):
+        self.names: Tuple[str, ...] = tuple(axis_env)
+        self.sizes: Tuple[int, ...] = tuple(int(axis_env[n]) for n in self.names)
+        self.world: int = int(math.prod(self.sizes)) if self.sizes else 1
+
+    def coords(self, rank: int) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        rem = rank
+        for name, size in zip(reversed(self.names), reversed(self.sizes)):
+            out[name] = rem % size
+            rem //= size
+        return out
+
+    def axis_linear(self, rank: int, axes: Sequence[str]) -> int:
+        """Linear rank over ``axes`` (row-major over their order) —
+        matches ``BoundComm.global_rank``."""
+        c = self.coords(rank)
+        r = 0
+        for a in axes:
+            r = r * self._size(a) + c[a]
+        return r
+
+    def _size(self, axis: str) -> int:
+        return self.sizes[self.names.index(axis)]
+
+    def slice_ranks(self, rank: int, axes: Sequence[str]) -> List[int]:
+        """All global ranks sharing ``rank``'s coordinates on every env
+        axis *not* in ``axes``, ordered by their ``axes`` linear rank
+        (so ``slice[axis_linear(r, axes)] == r``)."""
+        base = self.coords(rank)
+        members = []
+        for r in range(self.world):
+            c = self.coords(r)
+            if all(c[a] == base[a] for a in self.names if a not in axes):
+                members.append((self.axis_linear(r, axes), r))
+        return [r for _, r in sorted(members)]
+
+
+# ---------------------------------------------------------------------
+# the value lattice
+# ---------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class _Val:
+    #: concrete numpy value, or None when unknown
+    val: Optional[np.ndarray]
+    #: provably identical on every rank?
+    invariant: bool
+    #: producing collective event, propagated only through
+    #: optimization_barrier ties (M4T203's dataflow)
+    producer: Optional[ScheduleEvent] = None
+    producer_src: Optional[str] = None
+
+    @property
+    def known(self) -> bool:
+        return self.val is not None
+
+
+_DIVERGENT = _Val(None, False)
+_UNIFORM = _Val(None, True)
+
+
+def _known(v, invariant: bool) -> _Val:
+    arr = np.asarray(v)
+    if arr.size > MAX_VALUE_ELEMS:
+        return _Val(None, invariant)
+    return _Val(arr, invariant)
+
+
+def _degrade(ins: Sequence[_Val]) -> _Val:
+    """Unknown output of an uninterpreted primitive: rank-invariant iff
+    every input is."""
+    return _Val(None, all(v.invariant for v in ins))
+
+
+# numpy evaluators for the rank-arithmetic subset of lax. ``div`` is
+# C-style truncation for ints (lax semantics), not Python floor.
+def _np_div(a, b):
+    a = np.asarray(a)
+    if np.issubdtype(a.dtype, np.integer):
+        return (np.sign(a) * np.sign(b) * (abs(a) // abs(b))).astype(a.dtype)
+    return a / b
+
+
+def _np_select_n(which, *cases):
+    which = np.asarray(which)
+    idx = which.astype(np.int64)
+    out = np.choose(idx, [np.broadcast_to(c, which.shape) for c in cases])
+    return out.astype(np.asarray(cases[0]).dtype)
+
+
+_EVAL = {
+    "add": np.add,
+    "add_any": np.add,
+    "sub": np.subtract,
+    "mul": np.multiply,
+    "rem": lambda a, b: np.fmod(a, b),
+    "div": _np_div,
+    "neg": np.negative,
+    "sign": np.sign,
+    "abs": np.abs,
+    "floor": np.floor,
+    "ceil": np.ceil,
+    "max": np.maximum,
+    "min": np.minimum,
+    "eq": np.equal,
+    "ne": np.not_equal,
+    "lt": np.less,
+    "le": np.less_equal,
+    "gt": np.greater,
+    "ge": np.greater_equal,
+    "and": np.bitwise_and,
+    "or": np.bitwise_or,
+    "xor": np.bitwise_xor,
+    "not": np.invert,
+    "select_n": _np_select_n,
+    "squeeze": lambda a, dimensions=(): np.squeeze(
+        a, axis=tuple(dimensions) or None
+    ),
+    "stop_gradient": lambda a: a,
+    "copy": lambda a: a,
+    "integer_pow": lambda a, y=2: np.power(a, y),
+    "is_finite": np.isfinite,
+}
+
+
+def _eval_prim(name: str, params: Dict[str, Any], vals: List[np.ndarray]):
+    """Evaluate one whitelisted primitive with numpy; returns the
+    result array or raises KeyError/Exception for 'not evaluable'."""
+    if name == "convert_element_type":
+        return np.asarray(vals[0]).astype(np.dtype(str(params["new_dtype"])))
+    if name == "broadcast_in_dim":
+        shape = tuple(int(d) for d in params["shape"])
+        if math.prod(shape) > MAX_VALUE_ELEMS:
+            raise ValueError("too large")
+        a = np.asarray(vals[0])
+        bdims = tuple(int(d) for d in params.get("broadcast_dimensions", ()))
+        expanded_shape = [1] * len(shape)
+        for i, d in enumerate(bdims):
+            expanded_shape[d] = a.shape[i]
+        return np.broadcast_to(a.reshape(expanded_shape), shape)
+    if name == "reshape":
+        return np.reshape(vals[0], tuple(int(d) for d in params["new_sizes"]))
+    if name == "iota":
+        shape = tuple(int(d) for d in params["shape"])
+        if math.prod(shape) > MAX_VALUE_ELEMS:
+            raise ValueError("too large")
+        dim = int(params.get("dimension", 0))
+        out = np.arange(shape[dim], dtype=np.dtype(str(params["dtype"])))
+        expand = [1] * len(shape)
+        expand[dim] = shape[dim]
+        return np.broadcast_to(out.reshape(expand), shape)
+    fn = _EVAL[name]
+    if name in ("squeeze", "integer_pow"):
+        kw = {}
+        if name == "squeeze":
+            kw = {"dimensions": params.get("dimensions", ())}
+        if name == "integer_pow":
+            kw = {"y": params.get("y", 2)}
+        return fn(*vals, **kw)
+    return fn(*vals)
+
+
+# ---------------------------------------------------------------------
+# the per-rank interpreter
+# ---------------------------------------------------------------------
+
+#: main sub-jaxpr parameter of call-like equations, in priority order
+_CALL_JAXPR_KEYS = ("jaxpr", "call_jaxpr", "fun_jaxpr")
+
+
+def _closed(j):
+    """(open jaxpr, consts) of a possibly-Closed jaxpr."""
+    if hasattr(j, "jaxpr"):
+        return j.jaxpr, tuple(j.consts)
+    return j, ()
+
+
+def _is_var(atom) -> bool:
+    return not hasattr(atom, "val")
+
+
+class _RankWalker:
+    """Interpret the jaxpr for one concrete rank, collecting events."""
+
+    def __init__(self, space: AxisSpace, rank: int, schedule: "ProgramSchedule"):
+        self.space = space
+        self.rank = rank
+        self.schedule = schedule
+        self.events: List[ScheduleEvent] = []
+        self._note_keys = set()
+
+    # -- helpers -------------------------------------------------------
+
+    def _note(self, key: str, msg: str) -> None:
+        if key not in self._note_keys:
+            self._note_keys.add(key)
+            if msg not in self.schedule.notes:
+                self.schedule.notes.append(msg)
+
+    def _fail(self, reason: str):
+        raise ScheduleNotStatic(reason)
+
+    def _append(self, event: ScheduleEvent) -> None:
+        if len(self.events) >= MAX_EVENTS_PER_RANK:
+            self._fail(
+                f"rank {self.rank}: schedule exceeds "
+                f"{MAX_EVENTS_PER_RANK} events (unbounded or very deep "
+                "program); cost/simulation would be unreliable"
+            )
+        self.events.append(event)
+
+    # -- collective event construction ---------------------------------
+
+    def _comm_membership(self, comm) -> Tuple[Tuple[int, ...], List[int]]:
+        """(group of this event, axis-slice ranks) for this rank's
+        communicator. ``group`` is who must co-execute; the slice is
+        the comm-axes linearization used to globalize p2p edges."""
+        axes = tuple(getattr(comm, "axes", ()) or ())
+        axes = tuple(a for a in axes if a in self.space.names)
+        if not axes:
+            return (self.rank,), [self.rank]
+        slice_ranks = self.space.slice_ranks(self.rank, axes)
+        groups = getattr(comm, "groups", None)
+        if groups:
+            cr = self.space.axis_linear(self.rank, axes)
+            for grp in groups:
+                if cr in grp:
+                    return tuple(slice_ranks[i] for i in grp), slice_ranks
+            # a rank outside every group cannot bind the op; treat as
+            # local no-op membership
+            return (self.rank,), slice_ranks
+        return tuple(slice_ranks), slice_ranks
+
+    def _record_collective(self, eqn, path: Tuple[str, ...], ins: List[_Val]) -> List[_Val]:
+        prim = eqn.primitive.name
+        if eqn.params.get("transpose", False):
+            # identity-with-allreduce-grad marker: no communication
+            out = [_Val(ins[0].val, ins[0].invariant) if ins else _UNIFORM]
+            return out
+        site = site_from_eqn(eqn, index=0, path=path, token_tied=False)
+        comm = eqn.params.get("comm")
+        group, slice_ranks = self._comm_membership(comm)
+        edges: Tuple[Tuple[int, int], ...] = ()
+        sends: Tuple[int, ...] = ()
+        recvs: Tuple[int, ...] = ()
+        if prim == "tpu_collective_permute" and site.perm:
+            perm = site.perm
+            to_global = getattr(comm, "to_global_edges", None)
+            axis_edges = tuple(to_global(perm)) if to_global else tuple(perm)
+            gl = []
+            for s, d in axis_edges:
+                if 0 <= s < len(slice_ranks) and 0 <= d < len(slice_ranks):
+                    gl.append((slice_ranks[s], slice_ranks[d]))
+            edges = tuple(gl)
+            # the fused permute is executed by the whole axis slice,
+            # not just edge endpoints
+            group = tuple(slice_ranks)
+            sends = tuple(d for s, d in edges if s == self.rank)
+            recvs = tuple(s for s, d in edges if d == self.rank)
+        if len(group) <= 1 and not edges:
+            # world-size-1 / local resolution: no cross-rank event
+            return self._collective_outputs(site, eqn, ins, event=None)
+        event = ScheduleEvent(
+            op=site.op,
+            fingerprint=site.fingerprint,
+            kind="collective",
+            group=group,
+            edges=edges,
+            sends=sends,
+            recvs=recvs,
+            nbytes=site.nbytes,
+            dtype=site.dtype,
+            world=site.world if site.world else len(group),
+            reduce_op=site.reduce_op,
+            source=site.source,
+            path=path,
+        )
+        # M4T203: identical collective consuming the unmodified output
+        # of the previous one (producer tracked through the token
+        # ties). Only ops whose second application is genuinely
+        # redundant qualify: AllReduce/Bcast produce rank-uniform
+        # output, so a second identical round changes nothing
+        # (idempotent ops) or double-counts (SUM). A repeated
+        # CollectivePermute is a *ring rotation* — each hop moves data
+        # one step further — and must not be flagged.
+        if (
+            event.op in ("AllReduce", "Bcast")
+            and ins
+            and ins[0].producer is not None
+        ):
+            prev = ins[0].producer
+            if (
+                prev.fingerprint == event.fingerprint
+                and prev.reduce_op == event.reduce_op
+                and prev.edges == event.edges
+            ):
+                pair = RedundantPair(
+                    fingerprint=event.fingerprint,
+                    first_source=ins[0].producer_src or prev.source,
+                    second_source=event.source,
+                    reduce_op=event.reduce_op,
+                    rank=self.rank,
+                )
+                if not any(
+                    p.fingerprint == pair.fingerprint
+                    and p.first_source == pair.first_source
+                    and p.second_source == pair.second_source
+                    for p in self.schedule.redundant
+                ):
+                    self.schedule.redundant.append(pair)
+        self._append(event)
+        return self._collective_outputs(site, eqn, ins, event=event)
+
+    def _collective_outputs(self, site, eqn, ins, *, event) -> List[_Val]:
+        #: ops whose output is provably rank-uniform
+        uniform_ops = {"AllReduce", "AllGather", "Bcast", "Barrier"}
+        invariant = site.op in uniform_ops
+        out = _Val(None, invariant, producer=event,
+                   producer_src=site.source if event else None)
+        return [out] * len(eqn.outvars)
+
+    # -- the walk ------------------------------------------------------
+
+    def walk(
+        self,
+        jaxpr,
+        consts: Sequence[_Val],
+        args: Sequence[_Val],
+        path: Tuple[str, ...],
+    ) -> List[_Val]:
+        env: Dict[Any, _Val] = {}
+
+        def read(atom) -> _Val:
+            if not _is_var(atom):  # Literal
+                return _known(atom.val, True)
+            return env.get(atom, _DIVERGENT)
+
+        def write(var, val: _Val) -> None:
+            env[var] = val
+
+        for v, val in zip(jaxpr.constvars, consts):
+            write(v, val)
+        vals = list(args) + [_DIVERGENT] * len(jaxpr.invars)
+        for v, val in zip(jaxpr.invars, vals):
+            write(v, val)
+
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            ins = [read(v) for v in eqn.invars]
+
+            if name == "optimization_barrier":
+                # the token tie: pure positional identity — values AND
+                # producer tags pass through
+                for o, v in zip(eqn.outvars, ins):
+                    write(o, v)
+                continue
+
+            if name == "axis_index":
+                axis = eqn.params.get("axis_name")
+                axes = (axis,) if isinstance(axis, (str,)) else tuple(axis)
+                if all(a in self.space.names for a in axes):
+                    write(
+                        eqn.outvars[0],
+                        _known(
+                            np.int32(self.space.axis_linear(self.rank, axes)),
+                            self.space.world == 1,
+                        ),
+                    )
+                else:
+                    write(eqn.outvars[0], _DIVERGENT)
+                continue
+
+            if name in PRIM_TO_OP:
+                outs = self._record_collective(eqn, path, ins)
+                for o, v in zip(eqn.outvars, outs):
+                    write(o, v)
+                continue
+
+            if name in ("cond", "switch"):
+                outs = self._walk_cond(eqn, ins, path)
+            elif name == "while":
+                outs = self._walk_while(eqn, ins, path)
+            elif name == "scan":
+                outs = self._walk_scan(eqn, ins, path)
+            elif any(k in eqn.params for k in _CALL_JAXPR_KEYS) or name in (
+                "pjit",
+                "closed_call",
+                "core_call",
+                "shard_map",
+            ) or name.startswith(("remat", "custom_jvp", "custom_vjp")):
+                outs = self._walk_call(eqn, ins, path, name)
+            else:
+                outs = self._walk_plain(name, eqn, ins)
+
+            for o, v in zip(eqn.outvars, outs):
+                write(o, v)
+
+        return [read(v) for v in jaxpr.outvars]
+
+    def _walk_plain(self, name: str, eqn, ins: List[_Val]) -> List[_Val]:
+        if all(v.known for v in ins) and (
+            name in _EVAL
+            or name in ("convert_element_type", "broadcast_in_dim",
+                        "reshape", "iota")
+        ):
+            try:
+                result = _eval_prim(
+                    name, dict(eqn.params), [v.val for v in ins]
+                )
+                out = _known(result, all(v.invariant for v in ins))
+                return [out] * len(eqn.outvars)
+            except Exception:
+                pass
+        return [_degrade(ins)] * len(eqn.outvars)
+
+    # -- structured control flow ---------------------------------------
+
+    def _walk_cond(self, eqn, ins: List[_Val], path) -> List[_Val]:
+        pred, operands = ins[0], ins[1:]
+        branches = eqn.params.get("branches", ())
+        if pred.known:
+            idx = int(np.clip(int(np.asarray(pred.val).reshape(())),
+                              0, len(branches) - 1))
+            br, br_consts = _closed(branches[idx])
+            return self.walk(
+                br, [ _known(c, True) for c in br_consts ],
+                operands, path + (f"cond[{idx}]",),
+            )
+        # unknown predicate: every branch must produce the *same*
+        # event sequence, else the schedule is data-dependent
+        probes = []
+        for i, b in enumerate(branches):
+            br, br_consts = _closed(b)
+            sub = _RankWalker(self.space, self.rank, self.schedule)
+            sub._note_keys = self._note_keys
+            outs = sub.walk(
+                br, [_known(c, True) for c in br_consts],
+                operands, path + (f"cond[{i}]",),
+            )
+            probes.append((sub.events, outs))
+        seqs = [tuple(e.match_key for e in ev) for ev, _ in probes]
+        if len(set(seqs)) > 1:
+            kind = "rank-divergent" if not pred.invariant else "data-dependent"
+            self._fail(
+                f"{kind} cond at {source_of(eqn)} selects between "
+                "differing collective schedules; the per-rank schedule "
+                "is not statically enumerable (see the linter's "
+                "M4T101/M4T102 findings for this site)"
+            )
+        events, outs = probes[0] if probes else ([], [])
+        for e in events:
+            self._append(e)
+        # outputs: keep values only when every branch agrees
+        merged: List[_Val] = []
+        for col in zip(*(o for _, o in probes)) if probes else []:
+            vals = [v.val for v in col]
+            inv = pred.invariant and all(v.invariant for v in col)
+            if all(v is not None for v in vals) and all(
+                np.array_equal(vals[0], v) for v in vals[1:]
+            ):
+                merged.append(_Val(vals[0], inv))
+            else:
+                merged.append(_Val(None, inv))
+        if not probes:
+            merged = [_degrade(ins)] * len(eqn.outvars)
+        return merged
+
+    def _walk_while(self, eqn, ins: List[_Val], path) -> List[_Val]:
+        cond_n = eqn.params["cond_nconsts"]
+        body_n = eqn.params["body_nconsts"]
+        cond_jaxpr, cond_consts_v = _closed(eqn.params["cond_jaxpr"])
+        body_jaxpr, body_consts_v = _closed(eqn.params["body_jaxpr"])
+        cond_consts = ins[:cond_n]
+        body_consts = ins[cond_n:cond_n + body_n]
+        carry = list(ins[cond_n + body_n:])
+        cconsts = [_known(c, True) for c in cond_consts_v]
+        bconsts = [_known(c, True) for c in body_consts_v]
+
+        def eval_pred(carry_now):
+            sub = _RankWalker(self.space, self.rank, self.schedule)
+            sub._note_keys = self._note_keys
+            outs = sub.walk(
+                cond_jaxpr, cconsts, list(cond_consts) + carry_now,
+                path + ("while[cond]",),
+            )
+            return sub.events, outs[0]
+
+        cond_events, pred = eval_pred(carry)
+
+        if pred.known:
+            # concrete per-rank trip count: actually iterate
+            iters = 0
+            for e in cond_events:
+                self._append(e)
+            while bool(np.asarray(pred.val).reshape(())):
+                iters += 1
+                if iters > MAX_WHILE_ITERS:
+                    self._fail(
+                        f"while at {source_of(eqn)}: concrete trip "
+                        f"count exceeds {MAX_WHILE_ITERS}"
+                    )
+                carry = self.walk(
+                    body_jaxpr, bconsts, list(body_consts) + carry,
+                    path + ("while[body]",),
+                )
+                cond_events, pred = eval_pred(carry)
+                for e in cond_events:
+                    self._append(e)
+                if not pred.known:
+                    break
+            if pred.known:
+                return carry
+
+        # unknown predicate
+        probe = _RankWalker(self.space, self.rank, self.schedule)
+        probe._note_keys = self._note_keys
+        body_out = probe.walk(
+            body_jaxpr, bconsts, list(body_consts) + carry,
+            path + ("while[body]",),
+        )
+        has_events = bool(probe.events) or bool(cond_events)
+        if not has_events:
+            inv = pred.invariant and all(v.invariant for v in body_out)
+            return [_Val(None, v.invariant and inv) for v in body_out]
+        if not pred.invariant:
+            self._fail(
+                f"while at {source_of(eqn)}: rank-divergent (data-"
+                "dependent per-rank) termination test around "
+                "collectives; trip counts may differ per rank "
+                "(the linter's M4T101 subject)"
+            )
+        # rank-uniform unknown trip count: every rank executes the same
+        # number of iterations, so ONE representative iteration proves
+        # alignment; cost is counted once and flagged in the notes.
+        self._note(
+            f"while:{source_of(eqn)}",
+            f"while at {source_of(eqn)}: rank-uniform data-dependent "
+            "trip count — schedule/cost counts one iteration",
+        )
+        for e in cond_events:
+            self._append(e)
+        for e in probe.events:
+            self._append(e)
+        return [_Val(None, pred.invariant and v.invariant) for v in body_out]
+
+    def _walk_scan(self, eqn, ins: List[_Val], path) -> List[_Val]:
+        num_consts = eqn.params["num_consts"]
+        num_carry = eqn.params["num_carry"]
+        length = int(eqn.params["length"])
+        reverse = bool(eqn.params.get("reverse", False))
+        body_jaxpr, body_consts_v = _closed(eqn.params["jaxpr"])
+        bconsts = [_known(c, True) for c in body_consts_v]
+        consts = list(ins[:num_consts])
+        carry = list(ins[num_consts:num_consts + num_carry])
+        xs = list(ins[num_consts + num_carry:])
+
+        def xs_at(i: int) -> List[_Val]:
+            out = []
+            for x in xs:
+                if x.known and np.asarray(x.val).ndim >= 1:
+                    out.append(_known(np.asarray(x.val)[i], x.invariant))
+                else:
+                    out.append(_Val(None, x.invariant))
+            return out
+
+        order = range(length - 1, -1, -1) if reverse else range(length)
+
+        # probe the first iteration: a body with no collectives only
+        # needs value-level interpretation (bounded), not a full unroll
+        it0 = next(iter(order), None)
+        if it0 is None:
+            return carry + [
+                _Val(None, all(v.invariant for v in ins))
+            ] * (len(eqn.outvars) - num_carry)
+        probe = _RankWalker(self.space, self.rank, self.schedule)
+        probe._note_keys = self._note_keys
+        probe_out = probe.walk(
+            body_jaxpr, bconsts, consts + carry + xs_at(it0),
+            path + ("scan",),
+        )
+        if not probe.events:
+            if length <= MAX_SILENT_SCAN_ITERS and all(
+                v.known for v in probe_out[:num_carry]
+            ):
+                carry = probe_out[:num_carry]
+                for i in list(order)[1:]:
+                    out = self.walk(
+                        body_jaxpr, bconsts, consts + carry + xs_at(i),
+                        path + ("scan",),
+                    )
+                    carry = out[:num_carry]
+                    if not all(v.known for v in carry):
+                        break
+                ys_inv = all(v.invariant for v in probe_out[num_carry:])
+                return list(carry) + [_Val(None, ys_inv)] * (
+                    len(eqn.outvars) - num_carry
+                )
+            inv = all(v.invariant for v in ins)
+            return [
+                _Val(None, inv and v.invariant) for v in probe_out
+            ]
+
+        # collectives inside: unroll for real (events must repeat per
+        # iteration; caps guard the pathological cases)
+        carry_now = carry
+        for i in order:
+            out = self.walk(
+                body_jaxpr, bconsts, consts + carry_now + xs_at(i),
+                path + ("scan",),
+            )
+            carry_now = out[:num_carry]
+        return list(carry_now) + [
+            _Val(None, all(v.invariant for v in out[num_carry:]))
+        ] * (len(eqn.outvars) - num_carry)
+
+    def _walk_call(self, eqn, ins: List[_Val], path, name: str) -> List[_Val]:
+        sub = None
+        for key in _CALL_JAXPR_KEYS:
+            if key in eqn.params:
+                cand = eqn.params[key]
+                if hasattr(cand, "eqns") or hasattr(cand, "jaxpr"):
+                    sub = cand
+                    break
+        if sub is None:
+            return [_degrade(ins)] * len(eqn.outvars)
+        jaxpr, consts_v = _closed(sub)
+        n_sub = len(jaxpr.invars)
+        n_eqn = len(ins)
+        if n_sub <= n_eqn:
+            mapped = ins[n_eqn - n_sub:]
+        else:
+            mapped = list(ins) + [_DIVERGENT] * (n_sub - n_eqn)
+        frame = {
+            "pjit": f"pjit({eqn.params.get('name', '?')})",
+            "shard_map": "shard_map",
+        }.get(name, name.split("_")[0] if name.startswith(("remat", "custom")) else name)
+        if name.startswith("remat"):
+            frame = "remat"
+        elif name.startswith("custom_vjp"):
+            frame = "custom_vjp"
+        elif name.startswith("custom_jvp"):
+            frame = "custom_jvp"
+        outs = self.walk(
+            jaxpr, [_known(c, True) for c in consts_v], mapped,
+            path + (frame,),
+        )
+        if len(outs) < len(eqn.outvars):
+            outs = outs + [_degrade(ins)] * (len(eqn.outvars) - len(outs))
+        return outs[:len(eqn.outvars)]
+
+
+# ---------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------
+
+
+def enumerate_schedule(
+    closed, *, axis_env: Optional[Dict[str, int]] = None
+) -> ProgramSchedule:
+    """Enumerate the concrete per-rank schedule of a ``ClosedJaxpr``.
+
+    Never raises for unprovable programs — the returned schedule's
+    ``unprovable`` field carries the reason instead."""
+    env = dict(axis_env or {})
+    space = AxisSpace(env)
+    schedule = ProgramSchedule(axis_env=env, world=space.world, events={})
+    jaxpr, consts = _closed(closed)
+    const_vals = [_known(c, True) for c in consts]
+    for rank in range(space.world):
+        walker = _RankWalker(space, rank, schedule)
+        try:
+            walker.walk(
+                jaxpr, const_vals,
+                [_DIVERGENT] * len(jaxpr.invars), (),
+            )
+        except ScheduleNotStatic as e:
+            schedule.unprovable = str(e)
+            schedule.events = {}
+            return schedule
+        schedule.events[rank] = walker.events
+    return schedule
+
+
+def trace_schedule(
+    fn,
+    args: Sequence[Any] = (),
+    *,
+    axis_env: Optional[Dict[str, int]] = None,
+) -> ProgramSchedule:
+    """Trace ``fn(*args)`` abstractly (same conventions as
+    :func:`.linter.trace_sites`) and enumerate its per-rank schedule.
+    Raises whatever the trace raises."""
+    import jax
+
+    from .. import token as _token
+    from .linter import _abstractify
+
+    env = dict(axis_env or {})
+    _token.drain_pending_sends()
+    try:
+        closed = jax.make_jaxpr(fn, axis_env=list(env.items()))(
+            *_abstractify(args)
+        )
+    finally:
+        _token.drain_pending_sends()
+    return enumerate_schedule(closed, axis_env=env)
+
+
+# ---------------------------------------------------------------------
+# static cost report (the planner's seed; ``lint --cost``)
+# ---------------------------------------------------------------------
+
+
+def event_cost(event: ScheduleEvent) -> Dict[str, Any]:
+    """The PR 4 analytic cost of one schedule event (same numbers as
+    the runtime attribution: ``observability/costmodel.cost``)."""
+    return costmodel.cost(
+        event.op,
+        nbytes=event.nbytes,
+        world=event.world or len(event.group),
+        dtype=event.dtype,
+    )
+
+
+def cost_report(
+    schedule: ProgramSchedule,
+    *,
+    top_k: int = 5,
+    gbps: Optional[float] = None,
+    device_kind: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Join a program schedule against the analytic cost model.
+
+    Returns predicted per-rank wire bytes / algorithm steps / alpha-beta
+    time, plus the ``top_k`` dominant collectives by expected time
+    (grouped by fingerprint and source line) on the most expensive
+    rank. This is the static seed the ROADMAP-item-1 planner consumes:
+    what the program *will* put on the wire, before any rank spawns.
+    """
+    gbps = costmodel.peak_gbps(device_kind) if gbps is None else float(gbps)
+    alpha = costmodel.alpha_s()
+    per_rank: Dict[int, Dict[str, Any]] = {}
+    for rank, events in sorted(schedule.events.items()):
+        costs = [event_cost(e) for e in events]
+        agg = costmodel.total_cost(costs, gbps=gbps, alpha=alpha)
+        agg["n_events"] = len(events)
+        per_rank[rank] = agg
+    if per_rank:
+        worst = max(per_rank, key=lambda r: per_rank[r]["expected_s"])
+    else:
+        worst = 0
+        per_rank[0] = {"wire_bytes": 0, "steps": 0, "expected_s": 0.0,
+                       "n_events": 0}
+    groups: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    for e in schedule.events.get(worst, []):
+        c = event_cost(e)
+        key = (e.fingerprint, e.source)
+        g = groups.setdefault(
+            key,
+            {"fingerprint": e.fingerprint, "source": e.source, "op": e.op,
+             "count": 0, "wire_bytes": 0, "steps": 0, "expected_s": 0.0},
+        )
+        g["count"] += 1
+        g["wire_bytes"] += c["wire_bytes"]
+        g["steps"] += c["steps"]
+        g["expected_s"] += costmodel.expected_time_s(c, gbps=gbps, alpha=alpha)
+    top = sorted(groups.values(), key=lambda g: -g["expected_s"])[:top_k]
+    return {
+        "world": schedule.world,
+        "axis_env": dict(sorted(schedule.axis_env.items())),
+        "peak_gbps": gbps,
+        "alpha_s": alpha,
+        "per_rank": {str(r): per_rank[r] for r in sorted(per_rank)},
+        "max_rank": worst,
+        "program": dict(per_rank[worst]),
+        "top": top,
+        "notes": list(schedule.notes),
+    }
+
+
+def format_cost_report(report: Dict[str, Any]) -> str:
+    prog = report["program"]
+    out = [
+        f"static cost @ world={report['world']} "
+        f"(peak {report['peak_gbps']:g} GB/s, alpha "
+        f"{report['alpha_s'] * 1e6:g} us/step):",
+        f"  per-program (max rank {report['max_rank']}): "
+        f"{prog['n_events']} collective(s), "
+        f"{prog['wire_bytes']} wire bytes, {prog['steps']} steps, "
+        f"expected {prog['expected_s'] * 1e6:.1f} us",
+    ]
+    if report["top"]:
+        out.append("  dominant collectives:")
+    for g in report["top"]:
+        out.append(
+            f"    {g['expected_s'] * 1e6:8.1f} us  {g['count']:3d}x "
+            f"{g['fingerprint']}  [{g['wire_bytes']} B, "
+            f"{g['steps']} steps]  {g['source']}"
+        )
+    for note in report.get("notes", []):
+        out.append(f"  note: {note}")
+    return "\n".join(out)

@@ -1,0 +1,554 @@
+"""Cross-rank schedule simulator: prove deadlock-freedom, or witness.
+
+Given the concrete per-rank schedules from :mod:`.schedule`, this
+module executes them against blocking collective/point-to-point
+semantics:
+
+- a **collective** event completes only when every rank of its group
+  is parked at an event with the same match key (fingerprint + group +
+  concrete edges) — the HLO collective rendezvous;
+- a **p2p** event (unbuffered send/recv, the shm-backend and
+  synthetic-schedule model) completes only when every counterparty of
+  every edge is parked at an event carrying the mirror edge with the
+  same fingerprint — MPI rendezvous semantics with zero buffering.
+
+All completable ranks advance simultaneously each round (the system is
+monotone, so the final verdict is schedule-order independent — pinned
+by a property-based test against a brute-force matcher). When no rank
+can advance and some are unfinished, the stuck state is classified:
+
+- **M4T201 — global deadlock**: a cycle of ranks each blocked on the
+  other (crossed unbuffered send/recv, a rank entering ``allreduce``
+  while its peer waits in ``recv``, divergent branches executing
+  different permutes), or a rank blocked on a peer that already
+  finished. The finding carries a concrete rank-cycle witness: each
+  rank's position, event, and who it is waiting for.
+- **M4T202 — cross-rank collective-order mismatch**: every rank of a
+  group arrived at a collective over the same group but the
+  fingerprints differ — the runtime doctor's MISMATCH verdict, caught
+  before launch.
+- **M4T203 — redundant collective** (from the schedule enumeration):
+  a collective consumes the unmodified output of an identical earlier
+  collective — an idempotent duplicate (MAX/MIN/logical) or a
+  double-counting bug (SUM applies the reduction twice).
+
+The ``verify*`` drivers mirror the linter's entry points: trace a
+function (or a module's ``M4T_LINT_TARGETS``), enumerate, simulate,
+and report — all device-free, jaxpr-level only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .schedule import (
+    ProgramSchedule,
+    ScheduleEvent,
+    cost_report,
+    trace_schedule,
+)
+
+#: report schema version for ``--simulate --json`` (pinned by
+#: tests/data/simulate_golden.json)
+SIM_REPORT_VERSION = 1
+
+
+@dataclasses.dataclass
+class SimRule:
+    code: str
+    title: str
+    severity: str
+
+
+#: the M4T2xx simulation verdict catalog (documentation + ``--rules``)
+SIM_RULES: Dict[str, SimRule] = {
+    "M4T201": SimRule(
+        "M4T201", "global deadlock (cycle of mutually blocked ranks)",
+        "error",
+    ),
+    "M4T202": SimRule(
+        "M4T202", "cross-rank collective-order mismatch", "error"
+    ),
+    "M4T203": SimRule(
+        "M4T203", "redundant collective (identical op on unmodified "
+        "output of the same collective)", "warning",
+    ),
+}
+
+
+@dataclasses.dataclass
+class SimFinding:
+    code: str
+    severity: str
+    message: str
+    #: structured witness: ranks involved, per-rank stuck position
+    witness: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "code": self.code,
+            "severity": self.severity,
+            "message": self.message,
+            "witness": self.witness,
+        }
+
+
+@dataclasses.dataclass
+class SimReport:
+    """Verdict of simulating one program at one axis env."""
+
+    target: str
+    axis_env: Dict[str, int]
+    world: int
+    #: ``deadlock-free`` | ``findings`` | ``unprovable`` | ``error``
+    verdict: str
+    findings: List[SimFinding] = dataclasses.field(default_factory=list)
+    #: rank -> number of schedule events
+    n_events: Dict[int, int] = dataclasses.field(default_factory=dict)
+    #: synchronization rounds the simulation took
+    rounds: int = 0
+    #: unprovable/error reason
+    reason: Optional[str] = None
+    #: the enumerated schedule (available when provable)
+    schedule: Optional[ProgramSchedule] = None
+    #: static cost report (``verify(..., cost=True)`` / ``lint --cost``)
+    cost: Optional[Dict[str, Any]] = None
+
+    @property
+    def deadlock_free(self) -> bool:
+        return self.verdict == "deadlock-free"
+
+    def to_json(self) -> Dict[str, Any]:
+        out = {
+            "version": SIM_REPORT_VERSION,
+            "target": self.target,
+            "axis_env": dict(sorted(self.axis_env.items())),
+            "world": self.world,
+            "verdict": self.verdict,
+            "rounds": self.rounds,
+            "n_events": {str(r): n for r, n in sorted(self.n_events.items())},
+            "findings": [f.to_json() for f in self.findings],
+            "reason": self.reason,
+            "notes": list(self.schedule.notes) if self.schedule else [],
+        }
+        if self.cost is not None:
+            out["cost"] = self.cost
+        return out
+
+    def to_text(self) -> str:
+        head = (
+            f"simulate: {self.target} over axes "
+            f"{dict(sorted(self.axis_env.items()))} (world {self.world})"
+        )
+        lines = [head]
+        if self.verdict == "deadlock-free":
+            ev = sorted(set(self.n_events.values()))
+            lines.append(
+                f"  PROVED deadlock-free: {self.world} rank(s) ran "
+                f"{'/'.join(str(e) for e in ev)} event(s) to completion "
+                f"in {self.rounds} round(s)"
+            )
+        elif self.verdict == "unprovable":
+            lines.append(f"  UNPROVABLE: {self.reason}")
+        elif self.verdict == "error":
+            lines.append(f"  ERROR: {self.reason}")
+        for f in self.findings:
+            lines.append(f"{f.code} [{f.severity}] {f.message}")
+        if self.schedule is not None:
+            for note in self.schedule.notes:
+                lines.append(f"  note: {note}")
+        if self.cost is not None:
+            from .schedule import format_cost_report
+
+            lines.append(format_cost_report(self.cost))
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------
+# the simulator
+# ---------------------------------------------------------------------
+
+
+def _collective_ready(
+    rank: int,
+    e: ScheduleEvent,
+    pcs: Dict[int, int],
+    events: Dict[int, List[ScheduleEvent]],
+) -> bool:
+    for g in e.group:
+        if g == rank:
+            continue
+        if g not in events or pcs[g] >= len(events[g]):
+            return False
+        eg = events[g][pcs[g]]
+        if eg.kind != "collective" or eg.match_key != e.match_key:
+            return False
+    return True
+
+
+def _p2p_ready(
+    rank: int,
+    e: ScheduleEvent,
+    pcs: Dict[int, int],
+    events: Dict[int, List[ScheduleEvent]],
+) -> bool:
+    def cur(g: int) -> Optional[ScheduleEvent]:
+        if g not in events or pcs[g] >= len(events[g]):
+            return None
+        return events[g][pcs[g]]
+
+    for d in e.sends:
+        if d == rank:
+            if rank not in e.recvs:
+                return False
+            continue
+        ed = cur(d)
+        if ed is None or ed.kind != "p2p" or rank not in ed.recvs:
+            return False
+        if ed.fingerprint != e.fingerprint:
+            return False
+    for s in e.recvs:
+        if s == rank:
+            continue  # covered by the sends check
+        es = cur(s)
+        if es is None or es.kind != "p2p" or rank not in es.sends:
+            return False
+        if es.fingerprint != e.fingerprint:
+            return False
+    return True
+
+
+def _blockers(
+    rank: int,
+    e: ScheduleEvent,
+    pcs: Dict[int, int],
+    events: Dict[int, List[ScheduleEvent]],
+) -> List[int]:
+    """Peers this rank is waiting on (not parked at a matching event)."""
+    out = []
+    peers = e.group if e.kind == "collective" else tuple(
+        dict.fromkeys(tuple(e.sends) + tuple(e.recvs))
+    )
+    for g in peers:
+        if g == rank:
+            continue
+        if g not in events or pcs[g] >= len(events[g]):
+            out.append(g)
+            continue
+        eg = events[g][pcs[g]]
+        if e.kind == "collective":
+            if eg.kind != "collective" or eg.match_key != e.match_key:
+                out.append(g)
+        else:
+            # direction-aware: our send needs the peer's recv (and
+            # vice versa) — a peer merely *sending back* is the
+            # crossed-unbuffered-send shape, not a match
+            compatible = eg.kind == "p2p" and eg.fingerprint == e.fingerprint
+            if g in e.sends and not (compatible and rank in eg.recvs):
+                out.append(g)
+            elif g in e.recvs and not (compatible and rank in eg.sends):
+                out.append(g)
+    return out
+
+
+def _describe(rank, pcs, events) -> Dict[str, Any]:
+    if rank not in events:
+        return {"rank": rank, "state": "absent", "position": 0}
+    if pcs.get(rank, 0) >= len(events.get(rank, [])):
+        return {"rank": rank, "state": "finished",
+                "position": pcs.get(rank, 0)}
+    e = events[rank][pcs[rank]]
+    return {
+        "rank": rank,
+        "state": "blocked",
+        "position": pcs[rank],
+        "op": e.op,
+        "fingerprint": e.fingerprint,
+        "edges": [list(x) for x in e.edges],
+        "source": e.source,
+    }
+
+
+def _classify_stuck(
+    pcs: Dict[int, int],
+    events: Dict[int, List[ScheduleEvent]],
+) -> List[SimFinding]:
+    blocked = {
+        r: events[r][pcs[r]]
+        for r in events
+        if pcs[r] < len(events[r])
+    }
+    findings: List[SimFinding] = []
+
+    # M4T202: a whole group parked at collectives over the same group
+    # with differing fingerprints — the doctor's MISMATCH, pre-launch
+    seen_groups = set()
+    for r, e in sorted(blocked.items()):
+        if e.kind != "collective" or e.group in seen_groups:
+            continue
+        members = [
+            g for g in e.group
+            if g in blocked
+            and blocked[g].kind == "collective"
+            and blocked[g].group == e.group
+        ]
+        if len(members) != len(e.group):
+            continue
+        fps = {g: blocked[g].fingerprint for g in members}
+        if len(set(fps.values())) <= 1:
+            continue
+        seen_groups.add(e.group)
+        groups: Dict[str, List[int]] = {}
+        for g, fp in sorted(fps.items()):
+            groups.setdefault(fp, []).append(g)
+        detail = "; ".join(
+            f"rank(s) {','.join(map(str, ranks))}: {fp} at "
+            f"{blocked[ranks[0]].source}"
+            for fp, ranks in groups.items()
+        )
+        findings.append(
+            SimFinding(
+                code="M4T202",
+                severity="error",
+                message=(
+                    f"cross-rank collective-order mismatch at schedule "
+                    f"position {pcs[members[0]]}: the ranks of group "
+                    f"{list(e.group)} arrived at different collectives "
+                    f"({detail}). At runtime this is the doctor's "
+                    "MISMATCH verdict; caught before launch."
+                ),
+                witness={
+                    "position": pcs[members[0]],
+                    "group": list(e.group),
+                    "fingerprints": {str(g): fp for g, fp in fps.items()},
+                    "ranks": [_describe(g, pcs, events) for g in members],
+                },
+            )
+        )
+
+    if findings:
+        return findings
+
+    # M4T201: extract a wait-for cycle (or a chain onto a finished
+    # rank) as the deadlock witness
+    wait: Dict[int, List[int]] = {
+        r: _blockers(r, e, pcs, events) for r, e in blocked.items()
+    }
+    start = min(blocked)
+    chain = [start]
+    seen_at = {start: 0}
+    cycle: List[int] = []
+    while True:
+        cur = chain[-1]
+        nxts = wait.get(cur, [])
+        if not nxts:
+            break
+        nxt = nxts[0]
+        if nxt in seen_at:
+            cycle = chain[seen_at[nxt]:]
+            break
+        if nxt not in blocked:  # waiting on a finished rank
+            chain.append(nxt)
+            break
+        seen_at[nxt] = len(chain)
+        chain.append(nxt)
+    ranks_involved = cycle or chain
+    arrow = " -> ".join(str(r) for r in ranks_involved)
+    if cycle:
+        arrow += f" -> {cycle[0]}"
+    positions = "; ".join(
+        f"rank {r} "
+        + (
+            f"blocked at [{pcs[r]}] {blocked[r].fingerprint} "
+            f"({blocked[r].source}) waiting on "
+            f"{wait.get(r, [])}"
+            if r in blocked
+            else "already finished its schedule"
+        )
+        for r in ranks_involved
+    )
+    findings.append(
+        SimFinding(
+            code="M4T201",
+            severity="error",
+            message=(
+                f"global deadlock: rank cycle {arrow} — each rank is "
+                f"blocked in a collective its peers never join "
+                f"({positions}). No rank can make progress; at runtime "
+                "this hangs until the watchdog kills the world."
+            ),
+            witness={
+                "cycle": ranks_involved,
+                "is_cycle": bool(cycle),
+                "ranks": [
+                    dict(_describe(r, pcs, events),
+                         waiting_on=wait.get(r, []))
+                    for r in ranks_involved
+                ],
+            },
+        )
+    )
+    return findings
+
+
+def simulate_events(
+    events: Dict[int, List[ScheduleEvent]],
+) -> Tuple[bool, int, List[SimFinding]]:
+    """Run the blocking-semantics simulation over raw per-rank event
+    lists. Returns ``(deadlock_free, rounds, findings)``. Exposed
+    separately from :func:`simulate` so synthetic schedules (the
+    property-based tests) can drive it directly."""
+    pcs = {r: 0 for r in events}
+    total = sum(len(ev) for ev in events.values())
+    rounds = 0
+    while any(pcs[r] < len(events[r]) for r in events):
+        advance = []
+        for r in sorted(events):
+            if pcs[r] >= len(events[r]):
+                continue
+            e = events[r][pcs[r]]
+            ready = (
+                _collective_ready(r, e, pcs, events)
+                if e.kind == "collective"
+                else _p2p_ready(r, e, pcs, events)
+            )
+            if ready:
+                advance.append(r)
+        if not advance:
+            return False, rounds, _classify_stuck(pcs, events)
+        for r in advance:
+            pcs[r] += 1
+        rounds += 1
+        if rounds > total + 1:  # pragma: no cover — safety backstop
+            return False, rounds, _classify_stuck(pcs, events)
+    return True, rounds, []
+
+
+def simulate(schedule: ProgramSchedule) -> Tuple[str, int, List[SimFinding]]:
+    """Simulate an enumerated program schedule.
+
+    Returns ``(verdict, rounds, findings)`` where verdict is
+    ``deadlock-free`` / ``findings`` / ``unprovable``. M4T203
+    redundancy witnesses from the enumeration are appended as warning
+    findings either way."""
+    findings: List[SimFinding] = []
+    for pair in schedule.redundant:
+        findings.append(
+            SimFinding(
+                code="M4T203",
+                severity="warning",
+                message=(
+                    f"redundant collective: {pair.fingerprint} at "
+                    f"{pair.second_source} consumes the unmodified "
+                    f"output of the identical collective at "
+                    f"{pair.first_source}"
+                    + (
+                        " — a SUM reduction applied twice multiplies "
+                        "by the world size (double-counting bug); "
+                        "idempotent ops (MAX/MIN/logical) waste a full "
+                        "round of wire traffic"
+                        if pair.reduce_op == "SUM"
+                        else " — the second round of wire traffic "
+                        "changes nothing"
+                    )
+                ),
+                witness=pair.to_json(),
+            )
+        )
+    if not schedule.provable:
+        return "unprovable", 0, findings
+    ok, rounds, sim_findings = simulate_events(schedule.events)
+    findings = sim_findings + findings
+    if ok and not findings:
+        return "deadlock-free", rounds, findings
+    if ok:
+        return "findings", rounds, findings
+    return "findings", rounds, findings
+
+
+# ---------------------------------------------------------------------
+# verify drivers (linter-shaped entry points)
+# ---------------------------------------------------------------------
+
+
+def verify(
+    fn,
+    args: Sequence[Any] = (),
+    *,
+    axis_env: Optional[Dict[str, int]] = None,
+    name: Optional[str] = None,
+    with_cost: bool = False,
+) -> SimReport:
+    """Trace, enumerate, and simulate one per-rank function; never
+    raises for findings-shaped failures (mirrors ``linter.lint``)."""
+    env = dict(axis_env) if axis_env is not None else {"ranks": 8}
+    target = name or getattr(fn, "__name__", repr(fn))
+    try:
+        schedule = trace_schedule(fn, args, axis_env=env)
+    except Exception as e:
+        return SimReport(
+            target=target,
+            axis_env=env,
+            world=0,
+            verdict="error",
+            reason=f"{type(e).__name__}: {e}",
+        )
+    verdict, rounds, findings = simulate(schedule)
+    report = SimReport(
+        target=target,
+        axis_env=env,
+        world=schedule.world,
+        verdict=verdict,
+        findings=findings,
+        n_events={r: len(ev) for r, ev in schedule.events.items()},
+        rounds=rounds,
+        reason=schedule.unprovable,
+        schedule=schedule,
+    )
+    if with_cost and schedule.provable:
+        report.cost = cost_report(schedule)
+    return report
+
+
+def verify_module(
+    module,
+    *,
+    world: Optional[int] = None,
+    with_cost: bool = False,
+) -> List[SimReport]:
+    """Verify every ``M4T_LINT_TARGETS`` entry of a module, optionally
+    re-instantiated at a different world size (thunks accepting a
+    ``world`` keyword — see ``linter.iter_module_targets``)."""
+    from .linter import iter_module_targets
+
+    modname = getattr(module, "__name__", str(module))
+    reports = []
+    for tname, target in iter_module_targets(module, world=world):
+        reports.append(
+            verify(
+                target.fn,
+                target.args,
+                axis_env=target.axis_env,
+                name=f"{modname}:{tname}",
+                with_cost=with_cost,
+            )
+        )
+    return reports
+
+
+def sim_reports_to_json(reports: List[SimReport]) -> Dict[str, Any]:
+    return {
+        "version": SIM_REPORT_VERSION,
+        "reports": [r.to_json() for r in reports],
+        "n_findings": sum(len(r.findings) for r in reports),
+        "n_unproved": sum(
+            1 for r in reports if r.verdict in ("unprovable", "error")
+        ),
+    }
+
+
+def sim_rule_catalog() -> str:
+    return "\n".join(
+        f"{r.code} [{r.severity}] {r.title}" for r in SIM_RULES.values()
+    )

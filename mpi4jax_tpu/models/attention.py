@@ -179,11 +179,16 @@ def train_step(config: TransformerConfig, params, tokens, targets, n_dp: int = 1
 # ---------------------------------------------------------------------
 
 
-def _lint_train_step(attention: str = "ring", sp_size: int = 8):
+def _lint_train_step(attention: str = "ring", sp_size: int = 8,
+                     world: int = None):
     """Abstract sequence-parallel training step for the SPMD
     collective linter (ring attention by default — the
-    CollectivePermute-heavy path)."""
+    CollectivePermute-heavy path). ``world`` rescales the sequence
+    axis for the schedule-simulator self-verify gate."""
     from ..analysis import LintTarget
+
+    if world is not None:
+        sp_size = world
 
     config = TransformerConfig(
         vocab=64, d_model=64, n_heads=8, n_layers=2, d_ff=128,
@@ -202,6 +207,10 @@ def _lint_train_step(attention: str = "ring", sp_size: int = 8):
 
 
 M4T_LINT_TARGETS = {
-    "train_step_ring": lambda: _lint_train_step("ring"),
-    "train_step_ulysses": lambda: _lint_train_step("ulysses"),
+    "train_step_ring": lambda world=None: _lint_train_step(
+        "ring", world=world
+    ),
+    "train_step_ulysses": lambda world=None: _lint_train_step(
+        "ulysses", world=world
+    ),
 }

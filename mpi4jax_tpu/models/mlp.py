@@ -135,10 +135,16 @@ def train_step(config: MLPConfig, params, batch, n_dp: int = 1):
 # ---------------------------------------------------------------------
 
 
-def _lint_train_step(n_dp: int = 4, tp_size: int = 2):
+def _lint_train_step(n_dp: int = 4, tp_size: int = 2, world: int = None):
     """Abstract dp+tp training step for the SPMD collective linter:
-    shapes only, no devices (analysis.linter.LintTarget)."""
+    shapes only, no devices (analysis.linter.LintTarget). ``world``
+    re-derives the (dp, tp) split at another total rank count — the
+    schedule-simulator self-verify gate sweeps ranks in {2, 4, 8}."""
     from ..analysis import LintTarget
+
+    if world is not None:
+        tp_size = 2 if world % 2 == 0 else 1
+        n_dp = world // tp_size
 
     config = MLPConfig(tp_axis="tp", dp_axis="dp", tp_size=tp_size)
     params = jax.eval_shape(

@@ -441,12 +441,18 @@ class ShallowWaterModel:
 # ---------------------------------------------------------------------
 
 
-def _lint_step(dims: Tuple[int, int] = (2, 4)):
+def _lint_step(dims: Tuple[int, int] = (2, 4), world: int = None):
     """Abstract per-rank step over a (2, 4) process grid for the SPMD
-    collective linter: the four halo sendrecvs trace with no devices."""
+    collective linter: the four halo sendrecvs trace with no devices.
+    ``world`` re-derives the grid (1-row below 4 ranks, 2 rows from 4)
+    for the schedule-simulator self-verify gate."""
     import jax as _jax
 
     from ..analysis import LintTarget
+
+    if world is not None:
+        npy = 1 if world < 4 else 2
+        dims = (npy, world // npy)
 
     config = ShallowWaterConfig(nx=16, ny=8, dims=dims)
     model = ShallowWaterModel(config)

@@ -228,6 +228,30 @@ def expected_time_s(
     return c["steps"] * alpha + beta
 
 
+def total_cost(
+    costs,
+    *,
+    gbps: Optional[float] = None,
+    alpha: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Aggregate a sequence of per-emission cost dicts into program
+    totals: summed wire bytes, summed algorithm steps, and the
+    alpha-beta expected time of the whole sequence (collectives are
+    serialized by the ordering-token chain, so times add). Consumed by
+    the static schedule cost report (``analysis/schedule.py``) and the
+    ``lint --cost`` CLI."""
+    gbps = peak_gbps() if gbps is None else float(gbps)
+    alpha = alpha_s() if alpha is None else float(alpha)
+    wire = 0
+    steps = 0
+    t = 0.0
+    for c in costs:
+        wire += int(c["wire_bytes"])
+        steps += int(c["steps"])
+        t += expected_time_s(c, gbps=gbps, alpha=alpha)
+    return {"wire_bytes": wire, "steps": steps, "expected_s": t}
+
+
 def achieved_gbps(c: Dict[str, Any], seconds: float) -> Optional[float]:
     """Achieved wire bandwidth for a measured latency (None when the
     op moved no bytes or the measurement is unusable)."""

@@ -61,7 +61,11 @@ Finding kinds and their stable fields:
 - ``hang`` — ``rank``, ``verdict`` (``hung``/``dead``/``behind``),
   ``last_seq``, ``front_seq``, ``gap``, ``front_ranks``,
   ``stuck_before`` (fingerprint or null), ``last_heartbeat_t``,
-  ``last_emission_t``, optional ``static_sites``;
+  ``last_emission_t``, optional ``static_sites``, optional
+  ``schedule_position`` (with ``--static``: the hung rank's position
+  in its *simulated* per-rank schedule — ``expected_next`` names the
+  collective it should emit next, ``peers_next`` what each peer
+  expects, even when no peer log reached that seq);
 - ``missing_rank`` — ``rank``, ``world``, ``note``;
 - ``straggler`` — ``op``, ``rank``, ``mean_s``, ``peer_median_s``,
   ``ratio``, ``samples``, ``min_samples``, ``peer_samples``.
@@ -453,6 +457,93 @@ def collect_static_sites(
     return [s for r in reports for s in r.sites]
 
 
+def collect_static_schedules(
+    target: str,
+    *,
+    axis_specs: Iterable[str] = (),
+    world: Optional[int] = None,
+):
+    """Enumerate the per-rank collective schedules of ``target``'s
+    lint entry points (``analysis/schedule.py``), preferring a world
+    size matching the observed run. Returns a list of provable
+    ``ProgramSchedule``s (possibly empty). Imports jax — only reached
+    through ``--static``."""
+    from ..analysis import trace_schedule
+    from ..analysis.__main__ import _import_target, parse_axis_env
+    from ..analysis.linter import iter_module_targets
+
+    module, fn = _import_target(target)
+    axis_env = parse_axis_env(axis_specs)
+    schedules = []
+    if fn is not None:
+        env = axis_env
+        if env is None:
+            env = {"ranks": world} if world else {"ranks": 8}
+        try:
+            schedules.append(trace_schedule(fn, (), axis_env=env))
+        except Exception:
+            pass
+        return [s for s in schedules if s.provable]
+    for _tname, t in iter_module_targets(module, world=world):
+        try:
+            schedules.append(
+                trace_schedule(t.fn, t.args, axis_env=t.axis_env)
+            )
+        except Exception:
+            continue
+    return [s for s in schedules if s.provable]
+
+
+def attach_schedule_positions(report: Dict[str, Any], schedules) -> int:
+    """Join hang verdicts to the simulated schedule: a hung rank's
+    ``last_seq`` is its position in its own enumerated schedule, so
+    the doctor can cite the collective it *should* have emitted next —
+    and what every peer expects next — without any peer log reaching
+    that point. Mutates hang findings in place (``schedule_position``
+    field); returns how many joins landed."""
+
+    def describe(ev):
+        return {
+            "fingerprint": ev.fingerprint,
+            "op": ev.op,
+            "source": ev.source,
+            "group": list(ev.group),
+        }
+
+    joined = 0
+    seqs = {int(r): s for r, s in report.get("seqs", {}).items()}
+    world = len(report.get("ranks", [])) or None
+    # prefer a schedule enumerated at the observed world size
+    candidates = sorted(
+        schedules, key=lambda s: (s.world != world,)
+    )
+    for f in report.get("findings", []):
+        if f.get("kind") != "hang":
+            continue
+        rank = f.get("rank")
+        for sched in candidates:
+            events = sched.events.get(rank)
+            if events is None:
+                continue
+            pos = f.get("last_seq", 0)
+            if pos >= len(events):
+                continue
+            peers = {}
+            for peer, pseq in sorted(seqs.items()):
+                pev = sched.events.get(peer)
+                if peer != rank and pev is not None and pseq < len(pev):
+                    peers[str(peer)] = describe(pev[pseq])
+            f["schedule_position"] = {
+                "world": sched.world,
+                "position": pos,
+                "expected_next": describe(events[pos]),
+                "peers_next": peers,
+            }
+            joined += 1
+            break
+    return joined
+
+
 def attach_static_sites(report: Dict[str, Any], sites) -> int:
     """Join runtime verdicts to static sites by fingerprint (the
     recorder schema both layers share; the p2p family is canonicalized
@@ -531,6 +622,19 @@ def _fmt_finding(f: Dict[str, Any]) -> str:
         for site in f.get("static_sites", ()):
             where = "/".join(site["path"]) or "<root>"
             txt += f"\n    declared at {site['source']} [{where}]"
+        sp = f.get("schedule_position")
+        if sp:
+            nxt = sp["expected_next"]
+            txt += (
+                f"\n  simulated schedule (world {sp['world']}): rank "
+                f"{f['rank']} should next emit [{sp['position']}] "
+                f"{nxt['fingerprint']} declared at {nxt['source']}"
+            )
+            for peer, pev in sp.get("peers_next", {}).items():
+                txt += (
+                    f"\n    peer r{peer} expects next: "
+                    f"{pev['fingerprint']} ({pev['source']})"
+                )
         return txt
     if kind == "missing_rank":
         return (
@@ -676,6 +780,28 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"{joined} fingerprint join(s)",
             file=sys.stderr,
         )
+        # hang verdicts additionally cite the *simulated* schedule
+        # position: the collective the hung rank should emit next and
+        # what each peer expects next (analysis/schedule.py)
+        try:
+            schedules = collect_static_schedules(
+                args.static,
+                axis_specs=args.static_axis,
+                world=len(report["ranks"]) or None,
+            )
+        except Exception as e:
+            print(
+                f"# static: schedule enumeration skipped: {e}",
+                file=sys.stderr,
+            )
+            schedules = []
+        if schedules:
+            pos_joins = attach_schedule_positions(report, schedules)
+            print(
+                f"# static: {len(schedules)} simulated schedule(s), "
+                f"{pos_joins} hang position join(s)",
+                file=sys.stderr,
+            )
     if args.trace:
         from . import trace
 

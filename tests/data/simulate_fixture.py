@@ -1,0 +1,118 @@
+"""Fixed simulate-fixture module for the golden JSON schema pin.
+
+tests/test_analysis_simulate.py verifies this module's targets
+(``--simulate --json`` schema) and compares the full JSON report
+against tests/data/simulate_golden.json (same pattern as
+lint_golden.json / trace_golden.json): any schema drift must be an
+intentional, reviewed change — regenerate with
+
+    python tests/test_analysis_simulate.py --regen
+
+Do not edit casually: source line numbers of this file are part of
+the pinned output.
+"""
+
+N = 4
+
+
+def _target_clean(world: int = N):
+    import jax
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as m4t
+    from mpi4jax_tpu.analysis import LintTarget
+
+    def step(x):
+        y = m4t.allreduce(x)
+        return m4t.allgather(y)
+
+    return LintTarget(
+        fn=step,
+        args=(jax.ShapeDtypeStruct((8,), jnp.float32),),
+        axis_env={"ranks": world},
+    )
+
+
+def _target_crossed(world: int = 2):
+    """Crossed unbuffered sendrecv: even ranks send right while odd
+    ranks send left — the canonical M4T201 deadlock."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    import mpi4jax_tpu as m4t
+    from mpi4jax_tpu.analysis import LintTarget
+
+    n = world
+
+    def step(x):
+        r = lax.axis_index("ranks")
+
+        def evens(v):
+            dest = tuple((k + 1) if k % 2 == 0 else -1 for k in range(n))
+            src = tuple((k - 1) if k % 2 == 1 else -1 for k in range(n))
+            return m4t.sendrecv(v, v, src, dest, sendtag=1)
+
+        def odds(v):
+            dest = tuple((k - 1) if k % 2 == 1 else -1 for k in range(n))
+            src = tuple((k + 1) if k % 2 == 0 else -1 for k in range(n))
+            return m4t.sendrecv(v, v, src, dest, sendtag=1)
+
+        return lax.cond(r % 2 == 0, evens, odds, x)
+
+    return LintTarget(
+        fn=step,
+        args=(jax.ShapeDtypeStruct((8,), jnp.float32),),
+        axis_env={"ranks": n},
+    )
+
+
+def _target_mismatch(world: int = 2):
+    """Rank 0 enters an AllReduce while every other rank enters an
+    AllGather: the doctor's runtime MISMATCH, statically (M4T202)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    import mpi4jax_tpu as m4t
+    from mpi4jax_tpu.analysis import LintTarget
+
+    def step(x):
+        r = lax.axis_index("ranks")
+        return lax.cond(
+            r == 0,
+            lambda v: m4t.allreduce(v),
+            lambda v: m4t.allgather(v)[0] * 1.0,
+            x,
+        )
+
+    return LintTarget(
+        fn=step,
+        args=(jax.ShapeDtypeStruct((8,), jnp.float32),),
+        axis_env={"ranks": world},
+    )
+
+
+def _target_redundant(world: int = N):
+    import jax
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as m4t
+    from mpi4jax_tpu.analysis import LintTarget
+
+    def step(x):
+        return m4t.allreduce(m4t.allreduce(x))
+
+    return LintTarget(
+        fn=step,
+        args=(jax.ShapeDtypeStruct((8,), jnp.float32),),
+        axis_env={"ranks": world},
+    )
+
+
+M4T_LINT_TARGETS = {
+    "clean": _target_clean,
+    "crossed": _target_crossed,
+    "mismatch": _target_mismatch,
+    "redundant": _target_redundant,
+}

@@ -1,0 +1,392 @@
+"""Per-rank schedule enumeration (analysis/schedule.py): partial
+evaluation of rank-dependent control flow, concrete p2p edges, the
+M4T103 precision fix, M4T203 redundancy detection, fingerprint drift
+pins, and the static cost report."""
+
+import importlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+import mpi4jax_tpu as m4t
+from mpi4jax_tpu.analysis import lint, trace_schedule
+from mpi4jax_tpu.analysis.linter import iter_module_targets
+from mpi4jax_tpu.analysis.schedule import cost_report, event_cost
+from mpi4jax_tpu.observability import costmodel
+from mpi4jax_tpu.observability.recorder import fingerprint as rt_fingerprint
+
+N = 4
+X = jax.ShapeDtypeStruct((8,), jnp.float32)
+RING_DEST = [(r + 1) % N for r in range(N)]
+RING_SRC = [(r - 1) % N for r in range(N)]
+
+
+def sched_of(fn, args=(X,), env=None):
+    return trace_schedule(fn, args, axis_env=env or {"ranks": N})
+
+
+# -- basic enumeration ------------------------------------------------
+
+
+def test_flat_program_same_schedule_every_rank():
+    def step(x):
+        return m4t.allgather(m4t.allreduce(x))
+
+    s = sched_of(step)
+    assert s.provable and s.world == N
+    assert sorted(s.events) == list(range(N))
+    for rank in range(N):
+        assert [e.op for e in s.events[rank]] == ["AllReduce", "AllGather"]
+        assert all(e.group == tuple(range(N)) for e in s.events[rank])
+
+
+def test_rank_divergent_cond_resolved_per_rank():
+    def step(x):
+        r = lax.axis_index("ranks")
+        y = lax.cond(r == 0, lambda v: m4t.allreduce(v), lambda v: v, x)
+        return m4t.allgather(y)
+
+    s = sched_of(step)
+    assert s.provable
+    assert [e.op for e in s.events[0]] == ["AllReduce", "AllGather"]
+    for rank in range(1, N):
+        assert [e.op for e in s.events[rank]] == ["AllGather"]
+
+
+def test_ring_edges_are_concrete_global_ranks():
+    def step(x):
+        m4t.send(x, RING_DEST, tag=1)
+        return m4t.recv(x, RING_SRC, tag=1)
+
+    s = sched_of(step)
+    for rank in range(N):
+        (e,) = s.events[rank]
+        assert e.edges == tuple((k, (k + 1) % N) for k in range(N))
+        assert e.sends == ((rank + 1) % N,)
+        assert e.recvs == ((rank - 1) % N,)
+        assert e.group == tuple(range(N))
+
+
+def test_scan_unrolls_static_length():
+    def step(x):
+        def body(c, _):
+            return m4t.allreduce(c), None
+
+        y, _ = lax.scan(body, x, None, length=3)
+        return y
+
+    s = sched_of(step)
+    for rank in range(N):
+        assert [e.op for e in s.events[rank]] == ["AllReduce"] * 3
+
+
+def test_uniform_while_counts_one_iteration_with_note():
+    # cg_solver-shaped: the trip count depends on an allreduce output,
+    # unknown but provably rank-uniform -> one representative
+    # iteration, flagged in the notes
+    def step(x):
+        rs0 = m4t.allreduce(jnp.vdot(x, x))
+
+        def cond(state):
+            _, rs = state
+            return rs > 1e-6
+
+        def body(state):
+            v, _ = state
+            v = v * 0.5
+            return v, m4t.allreduce(jnp.vdot(v, v))
+
+        v, _ = lax.while_loop(cond, body, (x, rs0))
+        return v
+
+    s = sched_of(step)
+    assert s.provable
+    for rank in range(N):
+        assert [e.op for e in s.events[rank]] == ["AllReduce", "AllReduce"]
+    assert any("rank-uniform" in n for n in s.notes)
+
+
+def test_concrete_rank_dependent_while_iterates_per_rank():
+    # trip count = rank: schedules genuinely differ per rank — the
+    # enumeration must produce them (the simulator then proves the
+    # deadlock, which M4T101 could only suspect)
+    def step(x):
+        r = lax.axis_index("ranks")
+
+        def cond(state):
+            v, it = state
+            return it < r
+
+        def body(state):
+            v, it = state
+            return m4t.allreduce(v), it + 1
+
+        v, _ = lax.while_loop(cond, body, (x, jnp.asarray(0, jnp.int32)))
+        return v
+
+    s = sched_of(step)
+    assert s.provable
+    for rank in range(N):
+        assert len(s.events[rank]) == rank
+
+
+def test_divergent_data_cond_with_differing_branches_unprovable():
+    def step(x):
+        return lax.cond(
+            x.sum() > 0,
+            lambda v: m4t.allreduce(v),
+            lambda v: m4t.allgather(v)[0] * 1.0,
+            x,
+        )
+
+    s = sched_of(step)
+    assert not s.provable
+    assert "differing collective schedules" in s.unprovable
+
+
+def test_uniform_data_cond_with_identical_branches_provable():
+    def step(x):
+        s0 = m4t.allreduce(x.sum())
+        return lax.cond(
+            s0 > 0,
+            lambda v: m4t.allreduce(v),
+            lambda v: m4t.allreduce(v * 2),
+            x,
+        )
+
+    s = sched_of(step)
+    assert s.provable
+    for rank in range(N):
+        assert [e.op for e in s.events[rank]] == ["AllReduce", "AllReduce"]
+
+
+def test_multi_axis_groups():
+    # dp collective groups ranks sharing the tp coordinate and vice
+    # versa (env order row-major: dp is the slow axis)
+    def step(x):
+        y = m4t.allreduce(x, comm=m4t.Comm("dp"))
+        return m4t.allreduce(y, comm=m4t.Comm("tp"))
+
+    s = sched_of(step, env={"dp": 2, "tp": 2})
+    assert s.world == 4
+    dp_ev, tp_ev = s.events[0]
+    assert dp_ev.group == (0, 2)  # ranks with tp-coord 0
+    assert tp_ev.group == (0, 1)  # ranks with dp-coord 0
+    dp_ev3, tp_ev3 = s.events[3]
+    assert dp_ev3.group == (1, 3)
+    assert tp_ev3.group == (2, 3)
+
+
+# -- fingerprint drift pins (extends the PR 3 pin) --------------------
+
+
+def test_schedule_fingerprint_byte_identical_to_site_and_recorder():
+    def step(x):
+        return m4t.allreduce(x)
+
+    rep = lint(step, (X,), axis_env={"ranks": N})
+    s = sched_of(step)
+    (site,) = rep.sites
+    (event,) = s.events[0]
+    runtime_record = {
+        "op": "AllReduce",
+        "shape": [8],
+        "bytes": 32,
+        "dtype": "float32",
+        "axes": ["ranks"],
+    }
+    pinned = "AllReduce[8:float32]@ranks"
+    assert event.fingerprint == pinned
+    assert site.fingerprint == pinned
+    assert rt_fingerprint(runtime_record) == pinned
+
+
+def test_p2p_schedule_fingerprint_matches_site():
+    def step(x):
+        return m4t.sendrecv(x, x, RING_SRC, RING_DEST)
+
+    rep = lint(step, (X,), axis_env={"ranks": N})
+    s = sched_of(step)
+    assert s.events[0][0].fingerprint == rep.sites[0].fingerprint
+    assert s.events[0][0].fingerprint == (
+        "CollectivePermute[8:float32]@ranks"
+    )
+
+
+# -- M4T103 precision (ring / shift / self-edge regressions) ----------
+
+
+def test_m4t103_full_ring_clean():
+    def ok(x):
+        return m4t.sendrecv(x, x, RING_SRC, RING_DEST)
+
+    rep = lint(ok, (X,), axis_env={"ranks": N})
+    assert rep.findings == []
+
+
+def test_m4t103_open_shift_with_proc_null_clean():
+    # non-periodic chain shift: boundary ranks have no partner
+    src = tuple((r - 1) if r >= 1 else m4t.PROC_NULL for r in range(N))
+    dst = tuple((r + 1) if r + 1 < N else m4t.PROC_NULL for r in range(N))
+
+    def ok(x):
+        return m4t.sendrecv(x, x, src, dst)
+
+    rep = lint(ok, (X,), axis_env={"ranks": N})
+    assert rep.findings == []
+
+
+def test_m4t103_degenerate_all_self_edges_flagged():
+    table = [(r + N) % N for r in range(N)]
+
+    def bad(x):
+        return m4t.sendrecv(x, x, table, table)
+
+    rep = lint(bad, (X,), axis_env={"ranks": N})
+    assert [f.code for f in rep.findings] == ["M4T103"]
+    assert "entirely of self-edges" in rep.findings[0].message
+
+
+def test_m4t103_single_deliberate_self_edge_not_flagged():
+    # the precision fix: one rank keeping its own value while the
+    # others rotate is legal CollectivePermute routing and used to
+    # false-positive as "degenerate shift arithmetic"
+    dest = [1, 2, 0, 3]  # ranks 0-2 rotate, rank 3 keeps its value
+    src = [2, 0, 1, 3]
+
+    def ok(x):
+        return m4t.sendrecv(x, x, src, dest)
+
+    rep = lint(ok, (X,), axis_env={"ranks": N})
+    assert rep.findings == []
+    # and the schedule shows the per-rank pairing concretely
+    s = sched_of(ok)
+    assert s.events[3][0].sends == (3,)
+    assert s.events[3][0].recvs == (3,)
+    from mpi4jax_tpu.analysis.simulate import simulate_events
+
+    ok_sim, _, findings = simulate_events(s.events)
+    assert ok_sim and findings == []
+
+
+# -- M4T203: redundant collective -------------------------------------
+
+
+def test_m4t203_double_allreduce_detected():
+    def bad(x):
+        return m4t.allreduce(m4t.allreduce(x))
+
+    s = sched_of(bad)
+    assert len(s.redundant) == 1
+    pair = s.redundant[0]
+    assert pair.fingerprint == "AllReduce[8:float32]@ranks"
+    assert pair.reduce_op == "SUM"
+
+
+def test_m4t203_not_fired_when_value_modified_between():
+    def ok(x):
+        return m4t.allreduce(m4t.allreduce(x) * 2.0)
+
+    s = sched_of(ok)
+    assert s.redundant == []
+
+
+def test_m4t203_ring_rotation_not_redundant():
+    # repeated CollectivePermute of the same buffer is a ring — each
+    # hop moves data one step further (the ring-attention regression)
+    def ok(x):
+        def body(c, _):
+            c = m4t.sendrecv(c, c, RING_SRC, RING_DEST)
+            return c, None
+
+        y, _ = lax.scan(body, x, None, length=3)
+        return y
+
+    s = sched_of(ok)
+    assert s.redundant == []
+
+
+# -- static cost report ------------------------------------------------
+
+
+def test_event_cost_matches_costmodel():
+    def step(x):
+        return m4t.allgather(m4t.allreduce(x))
+
+    s = sched_of(step)
+    ar, ag = s.events[0]
+    # the PR 4 golden numbers: 32B payload, n=4 ring algorithms
+    assert event_cost(ar) == costmodel.cost(
+        "AllReduce", nbytes=32, world=N, dtype="float32"
+    )
+    assert event_cost(ar)["wire_bytes"] == 48  # 2*(n-1)/n * 32
+    assert event_cost(ag)["wire_bytes"] == 96  # (n-1) * 32
+
+
+@pytest.mark.perf
+def test_shallow_water_cost_matches_pr4_golden_table():
+    """Acceptance pin: ``lint --cost`` predicted wire bytes for the
+    shallow_water target equal the analytic cost model's numbers
+    (PR 4 golden table: CollectivePermute wire = payload bytes)."""
+    mod = importlib.import_module("mpi4jax_tpu.models.shallow_water")
+    ((_, target),) = list(iter_module_targets(mod, world=8))
+    s = trace_schedule(target.fn, target.args, axis_env=target.axis_env)
+    assert s.provable and s.world == 8
+    rep = cost_report(s)
+    # every rank: 20 halo permutes, f32 payloads 1x6/2x6/3x6/4x6 on a
+    # (2, 4) grid of the 16x8 domain -> 1152 wire bytes, 20 steps
+    for rank in range(8):
+        assert rep["per_rank"][str(rank)]["wire_bytes"] == 1152
+        assert rep["per_rank"][str(rank)]["steps"] == 20
+        assert rep["per_rank"][str(rank)]["n_events"] == 20
+    # byte-identical to summing the runtime cost model over the events
+    for rank, events in s.events.items():
+        assert rep["per_rank"][str(rank)]["wire_bytes"] == sum(
+            costmodel.cost(
+                e.op, nbytes=e.nbytes, world=e.world, dtype=e.dtype
+            )["wire_bytes"]
+            for e in events
+        )
+    assert rep["top"], "dominant-collectives table must not be empty"
+    assert rep["program"]["expected_s"] > 0
+
+
+def test_cost_report_alpha_beta_time():
+    def step(x):
+        return m4t.allreduce(x)
+
+    s = sched_of(step)
+    rep = cost_report(s, gbps=1.0)  # 1 GB/s, alpha default 1us/step
+    c = costmodel.cost("AllReduce", nbytes=32, world=N, dtype="float32")
+    expected = c["steps"] * 1e-6 + c["wire_bytes"] / 1e9
+    assert np.isclose(rep["program"]["expected_s"], expected)
+
+
+# -- world-parametrized module targets --------------------------------
+
+
+def test_iter_module_targets_world_reinstantiates():
+    mod = importlib.import_module("mpi4jax_tpu.models.mlp")
+    ((_, t2),) = list(iter_module_targets(mod, world=2))
+    assert int(np.prod(list(t2.axis_env.values()))) == 2
+    ((_, t8),) = list(iter_module_targets(mod, world=8))
+    assert int(np.prod(list(t8.axis_env.values()))) == 8
+
+
+def test_iter_module_targets_skips_unscalable_mismatched_world():
+    import types
+
+    from mpi4jax_tpu.analysis import LintTarget
+
+    def fixed_thunk():
+        return LintTarget(fn=lambda x: x, args=(X,), axis_env={"ranks": 4})
+
+    mod = types.SimpleNamespace(
+        __name__="fake", M4T_LINT_TARGETS={"fixed": fixed_thunk}
+    )
+    assert list(iter_module_targets(mod, world=8)) == []
+    assert len(list(iter_module_targets(mod, world=4))) == 1
+    assert len(list(iter_module_targets(mod))) == 1

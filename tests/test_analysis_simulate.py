@@ -1,0 +1,565 @@
+"""Schedule simulator (analysis/simulate.py): deadlock/mismatch
+verdicts with concrete witnesses, property-based agreement with a
+brute-force blocking-semantics matcher, the golden JSON schema pin for
+``--simulate --json``, the self-verify gate over every registered
+lint target at ranks in {2, 4, 8}, SARIF export, ``launch --verify``
+as a pre-spawn gate, and the doctor's simulated schedule positions.
+
+Regenerate the golden after an intentional schema change::
+
+    python tests/test_analysis_simulate.py --regen
+"""
+
+import importlib
+import json
+import os
+import random
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from mpi4jax_tpu.analysis.__main__ import _import_target
+from mpi4jax_tpu.analysis.__main__ import main as lint_main
+from mpi4jax_tpu.analysis.schedule import ScheduleEvent
+from mpi4jax_tpu.analysis.simulate import (
+    sim_reports_to_json,
+    simulate_events,
+    verify_module,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+FIXTURE = os.path.join(HERE, "data", "simulate_fixture.py")
+GOLDEN = os.path.join(HERE, "data", "simulate_golden.json")
+
+MODEL_MODULES = (
+    "mpi4jax_tpu.models.mlp",
+    "mpi4jax_tpu.models.attention",
+    "mpi4jax_tpu.models.shallow_water",
+)
+EXAMPLE_FILES = (
+    "examples/cg_solver.py",
+    "examples/zero_optimizer.py",
+    "examples/train_transformer.py",
+    "examples/shallow_water.py",
+)
+
+
+def C(fp, group, edges=()):
+    """Synthetic group-synchronizing collective event."""
+    edges = tuple(tuple(e) for e in edges)
+    return ScheduleEvent(
+        op="AllReduce", fingerprint=fp, kind="collective",
+        group=tuple(group), edges=edges,
+        sends=tuple(d for s, d in edges),
+        recvs=tuple(s for s, d in edges),
+    )
+
+
+def P(fp, sends=(), recvs=()):
+    """Synthetic blocking point-to-point event (unbuffered)."""
+    return ScheduleEvent(
+        op="Sendrecv", fingerprint=fp, kind="p2p", group=(),
+        sends=tuple(sends), recvs=tuple(recvs),
+    )
+
+
+# -- simulator semantics on synthetic schedules -----------------------
+
+
+def test_matching_collectives_complete():
+    ok, rounds, findings = simulate_events(
+        {0: [C("A", (0, 1))], 1: [C("A", (0, 1))]}
+    )
+    assert ok and findings == [] and rounds == 1
+
+
+def test_collective_fingerprint_mismatch_is_m4t202():
+    ok, _, findings = simulate_events(
+        {0: [C("A", (0, 1))], 1: [C("B", (0, 1))]}
+    )
+    assert not ok
+    assert [f.code for f in findings] == ["M4T202"]
+    w = findings[0].witness
+    assert w["fingerprints"] == {"0": "A", "1": "B"}
+
+
+def test_crossed_p2p_is_m4t201_cycle():
+    # rank0: send->1 then recv<-1; rank1: send->0 then recv<-0 —
+    # the canonical crossed unbuffered send/recv
+    ok, _, findings = simulate_events(
+        {
+            0: [P("A", sends=(1,)), P("A", recvs=(1,))],
+            1: [P("A", sends=(0,)), P("A", recvs=(0,))],
+        }
+    )
+    assert not ok
+    (f,) = findings
+    assert f.code == "M4T201"
+    assert f.witness["is_cycle"]
+    assert sorted(f.witness["cycle"]) == [0, 1]
+
+
+def test_sendrecv_exchange_completes():
+    # the same transfer expressed as a simultaneous exchange is fine
+    ok, _, findings = simulate_events(
+        {
+            0: [P("A", sends=(1,), recvs=(1,))],
+            1: [P("A", sends=(0,), recvs=(0,))],
+        }
+    )
+    assert ok and findings == []
+
+
+def test_three_rank_chain_completes():
+    # rank1 sendrecv(send->0, recv<-2); rank0 recv<-1; rank2 send->1:
+    # MPI posting semantics, no barrier needed
+    ok, _, findings = simulate_events(
+        {
+            0: [P("A", recvs=(1,))],
+            1: [P("A", sends=(0,), recvs=(2,))],
+            2: [P("A", sends=(1,))],
+        }
+    )
+    assert ok and findings == []
+
+
+def test_allreduce_vs_waiting_recv_is_m4t201():
+    # rank 0 enters a collective while its peer waits in a recv
+    ok, _, findings = simulate_events(
+        {0: [C("A", (0, 1))], 1: [P("B", recvs=(0,))]}
+    )
+    assert not ok
+    (f,) = findings
+    assert f.code == "M4T201"
+
+
+def test_wait_on_finished_rank_is_m4t201():
+    ok, _, findings = simulate_events(
+        {0: [C("A", (0, 1)), C("A", (0, 1))], 1: [C("A", (0, 1))]}
+    )
+    assert not ok
+    (f,) = findings
+    assert f.code == "M4T201"
+    states = {r["rank"]: r["state"] for r in f.witness["ranks"]}
+    assert states[0] == "blocked" and states[1] == "finished"
+
+
+def test_crossed_permute_same_fingerprint_is_m4t201():
+    # divergent branches executing different permutes share a
+    # fingerprint but not edges — deadlock, not mismatch
+    ok, _, findings = simulate_events(
+        {
+            0: [C("A", (0, 1), edges=((0, 1),))],
+            1: [C("A", (0, 1), edges=((1, 0),))],
+        }
+    )
+    assert not ok
+    (f,) = findings
+    assert f.code == "M4T201"
+
+
+def test_independent_subgroups_interleave():
+    # two disjoint groups progress independently, in any order
+    ok, rounds, findings = simulate_events(
+        {
+            0: [C("A", (0, 1)), C("X", (0, 1, 2, 3))],
+            1: [C("A", (0, 1)), C("X", (0, 1, 2, 3))],
+            2: [C("B", (2, 3)), C("B", (2, 3)), C("X", (0, 1, 2, 3))],
+            3: [C("B", (2, 3)), C("B", (2, 3)), C("X", (0, 1, 2, 3))],
+        }
+    )
+    assert ok and findings == []
+
+
+# -- property-based: agreement with a brute-force matcher -------------
+
+
+def _brute_force_free(events, rng):
+    """Independent implementation of the blocking semantics: snapshot
+    the parked positions, advance every individually completable rank
+    (visiting in random order), repeat. Monotone system => the verdict
+    is schedule-order independent."""
+    pcs = {r: 0 for r in events}
+
+    def parked(snap, g):
+        if g not in events or snap[g] >= len(events[g]):
+            return None
+        return events[g][snap[g]]
+
+    while any(pcs[r] < len(events[r]) for r in events):
+        snap = dict(pcs)
+        movers = []
+        order = list(events)
+        rng.shuffle(order)
+        for r in order:
+            e = parked(snap, r)
+            if e is None:
+                continue
+            if e.kind == "collective":
+                good = all(
+                    (lambda pg: pg is not None
+                     and pg.kind == "collective"
+                     and pg.fingerprint == e.fingerprint
+                     and pg.group == e.group
+                     and pg.edges == e.edges)(parked(snap, g))
+                    for g in e.group
+                )
+            else:
+                good = True
+                for d in e.sends:
+                    if d == r:
+                        good = good and (r in e.recvs)
+                        continue
+                    pd = parked(snap, d)
+                    good = good and (
+                        pd is not None and pd.kind == "p2p"
+                        and r in pd.recvs and pd.fingerprint == e.fingerprint
+                    )
+                for s in e.recvs:
+                    if s == r:
+                        continue
+                    ps = parked(snap, s)
+                    good = good and (
+                        ps is not None and ps.kind == "p2p"
+                        and r in ps.sends and ps.fingerprint == e.fingerprint
+                    )
+            if good:
+                movers.append(r)
+        if not movers:
+            return False
+        for r in movers:
+            pcs[r] += 1
+    return True
+
+
+def _random_schedule(rng):
+    n = rng.randint(2, 4)
+    events = {r: [] for r in range(n)}
+    fps = ["A", "B", "C"]
+    for _ in range(rng.randint(0, 5)):
+        if rng.random() < 0.5:
+            fp = rng.choice(fps)
+            roll = rng.random()
+            bad_rank = rng.randrange(n)
+            for r in range(n):
+                myfp = fp
+                if roll < 0.15 and r == bad_rank:
+                    myfp = rng.choice([f for f in fps if f != fp])
+                if 0.15 <= roll < 0.28 and r == bad_rank:
+                    continue  # this rank skips the collective
+                events[r].append(C(myfp, range(n)))
+        else:
+            fp = rng.choice(fps)
+            perm = list(range(n))
+            rng.shuffle(perm)
+            inv = [perm.index(r) for r in range(n)]
+            flip = rng.randrange(n) if rng.random() < 0.25 else None
+            for r in range(n):
+                sends, recvs = (perm[r],), (inv[r],)
+                if r == flip:
+                    sends, recvs = recvs, sends
+                events[r].append(P(fp, sends, recvs))
+    return events
+
+
+def test_property_simulator_agrees_with_brute_force():
+    """~1k seeded random per-rank schedules: the optimized simulator's
+    deadlock-free verdict must agree with the brute-force matcher on
+    every one (and stuck states must always classify into a finding)."""
+    rng = random.Random(20260804)
+    for case in range(1000):
+        events = _random_schedule(rng)
+        ok, _, findings = simulate_events(
+            {r: list(ev) for r, ev in events.items()}
+        )
+        expected = _brute_force_free(events, rng)
+        assert ok == expected, f"case {case}: sim={ok} brute={expected}"
+        if not ok:
+            assert findings, f"case {case}: stuck but no witness"
+            assert all(f.code in ("M4T201", "M4T202") for f in findings)
+
+
+# -- verify drivers on the fixture ------------------------------------
+
+
+def _fixture_reports(world=None):
+    module, _fn = _import_target(FIXTURE)
+    return verify_module(module, world=world)
+
+
+def test_fixture_verdicts():
+    by_name = {
+        r.target.split(":")[-1]: r for r in _fixture_reports()
+    }
+    assert by_name["clean"].deadlock_free
+    assert [f.code for f in by_name["crossed"].findings] == ["M4T201"]
+    assert [f.code for f in by_name["mismatch"].findings] == ["M4T202"]
+    assert [f.code for f in by_name["redundant"].findings] == ["M4T203"]
+
+
+def test_crossed_witness_names_the_cycle_and_sources():
+    rep = {
+        r.target.split(":")[-1]: r for r in _fixture_reports()
+    }["crossed"]
+    (f,) = rep.findings
+    assert f.witness["is_cycle"]
+    assert sorted(f.witness["cycle"]) == [0, 1]
+    for entry in f.witness["ranks"]:
+        assert "simulate_fixture.py" in entry["source"]
+
+
+# -- golden JSON schema pin -------------------------------------------
+
+
+def _normalize(obj, root):
+    if isinstance(obj, str):
+        return obj.replace(root + os.sep, "")
+    if isinstance(obj, list):
+        return [_normalize(v, root) for v in obj]
+    if isinstance(obj, dict):
+        return {k: _normalize(v, root) for k, v in obj.items()}
+    return obj
+
+
+def _fixture_sim_json():
+    obj = sim_reports_to_json(_fixture_reports())
+    return json.loads(json.dumps(_normalize(obj, REPO), sort_keys=True))
+
+
+def test_simulate_golden_file():
+    """The exact ``--simulate --json`` payload for the fixed fixture is
+    pinned — schema drift must be intentional (same pattern as
+    lint_golden.json)."""
+    produced = _fixture_sim_json()
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    assert produced == golden
+
+
+# -- the self-verify gate ---------------------------------------------
+
+
+@pytest.mark.parametrize("world", (2, 4, 8))
+@pytest.mark.parametrize("modname", MODEL_MODULES)
+def test_models_proved_deadlock_free(modname, world):
+    reports = verify_module(
+        importlib.import_module(modname), world=world
+    )
+    assert reports, f"{modname} has no target at world {world}"
+    for rep in reports:
+        assert rep.deadlock_free, f"{rep.target}:\n{rep.to_text()}"
+        assert rep.world == world
+
+
+@pytest.mark.parametrize("world", (2, 4, 8))
+@pytest.mark.parametrize("relpath", EXAMPLE_FILES)
+def test_examples_proved_deadlock_free(relpath, world):
+    module, _fn = _import_target(os.path.join(REPO, relpath))
+    reports = verify_module(module, world=world)
+    assert reports, f"{relpath} has no target at world {world}"
+    for rep in reports:
+        assert rep.deadlock_free, f"{rep.target}:\n{rep.to_text()}"
+
+
+# -- CLI ---------------------------------------------------------------
+
+
+def test_cli_simulate_clean_exits_0(capsys):
+    rc = lint_main(["mpi4jax_tpu.models.mlp", "--simulate"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "PROVED deadlock-free" in out
+
+
+def test_cli_simulate_fixture_exits_1_with_witness(capsys):
+    rc = lint_main([FIXTURE, "--simulate"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "M4T201" in out and "rank cycle" in out
+    assert "M4T202" in out and "M4T203" in out
+
+
+def test_cli_ranks_sweep(capsys):
+    rc = lint_main(["mpi4jax_tpu.models.mlp", "--simulate", "--ranks", "2,4"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "world 2" in out and "world 4" in out
+
+
+def test_cli_cost_report(capsys):
+    rc = lint_main(["mpi4jax_tpu.models.shallow_water", "--cost"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "static cost" in out and "dominant collectives" in out
+
+
+def test_cli_simulate_json_schema(capsys):
+    rc = lint_main([FIXTURE, "--simulate", "--json"])
+    assert rc == 1
+    obj = json.loads(capsys.readouterr().out)
+    assert "simulate" in obj
+    verdicts = {
+        r["target"].split(":")[-1]: r["verdict"]
+        for r in obj["simulate"]["reports"]
+    }
+    assert verdicts["clean"] == "deadlock-free"
+    assert verdicts["crossed"] == "findings"
+
+
+def test_cli_sarif_output(tmp_path, capsys):
+    out_path = str(tmp_path / "findings.sarif")
+    rc = lint_main([FIXTURE, "--simulate", "--sarif", out_path])
+    assert rc == 1
+    with open(out_path) as f:
+        sarif = json.load(f)
+    assert sarif["version"] == "2.1.0"
+    (run,) = sarif["runs"]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"M4T101", "M4T201", "M4T202", "M4T203"} <= rule_ids
+    results = run["results"]
+    assert any(r["ruleId"] == "M4T201" for r in results)
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("simulate_fixture.py")
+    assert loc["region"]["startLine"] > 1
+
+
+def test_cli_rules_lists_m4t2xx(capsys):
+    rc = lint_main(["--rules"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    for code in ("M4T201", "M4T202", "M4T203"):
+        assert code in out
+
+
+# -- launch --verify ---------------------------------------------------
+
+
+def _write_fixture_copy(tmp_path, body):
+    path = str(tmp_path / "script.py")
+    with open(path, "w") as f:
+        f.write(f"import sys; sys.path.insert(0, {REPO!r})\n")
+        f.write(textwrap.dedent(body))
+    return path
+
+
+_DEADLOCK_SCRIPT = """
+    import sys
+
+    def _lint_bad(world: int = 2):
+        import jax, jax.numpy as jnp
+        from jax import lax
+        import mpi4jax_tpu as m4t
+        from mpi4jax_tpu.analysis import LintTarget
+        n = world
+
+        def step(x):
+            r = lax.axis_index("ranks")
+
+            def evens(v):
+                dest = tuple((k + 1) if k % 2 == 0 else -1 for k in range(n))
+                src = tuple((k - 1) if k % 2 == 1 else -1 for k in range(n))
+                return m4t.sendrecv(v, v, src, dest, sendtag=1)
+
+            def odds(v):
+                dest = tuple((k - 1) if k % 2 == 1 else -1 for k in range(n))
+                src = tuple((k + 1) if k % 2 == 0 else -1 for k in range(n))
+                return m4t.sendrecv(v, v, src, dest, sendtag=1)
+
+            return lax.cond(r % 2 == 0, evens, odds, x)
+
+        return LintTarget(
+            fn=step,
+            args=(jax.ShapeDtypeStruct((8,), jnp.float32),),
+            axis_env={"ranks": n},
+        )
+
+    M4T_LINT_TARGETS = {"bad": _lint_bad}
+
+    if __name__ == "__main__":
+        print("RANK_RAN")  # must never appear under --verify
+        sys.exit(0)
+"""
+
+
+def test_launch_verify_blocks_deadlock_before_spawn(tmp_path):
+    """Acceptance: the seeded crossed-sendrecv fixture is flagged
+    M4T201 with a rank-cycle witness and blocked by ``launch --verify``
+    before any rank spawns."""
+    path = _write_fixture_copy(tmp_path, _DEADLOCK_SCRIPT)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.launch", "-n", "2",
+         "--verify", path],
+        env=env, capture_output=True, text=True, timeout=180, cwd=REPO,
+    )
+    assert res.returncode == 1
+    assert "M4T201" in res.stderr and "rank cycle" in res.stderr
+    assert "BLOCKED" in res.stderr
+    assert "RANK_RAN" not in res.stdout  # no rank ever spawned
+
+
+def test_launch_verify_reports_unimportable_target(tmp_path):
+    path = str(tmp_path / "nope.py")
+    with open(path, "w") as f:
+        f.write("raise RuntimeError('boom at import')\n")
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.launch", "-n", "2",
+         "--verify", path],
+        capture_output=True, text=True, timeout=180, cwd=REPO,
+    )
+    assert res.returncode == 1
+    assert "cannot import" in res.stderr
+
+
+# -- doctor --static: simulated schedule positions --------------------
+
+
+def test_doctor_hang_cites_simulated_schedule_position(tmp_path):
+    from mpi4jax_tpu.observability import doctor
+
+    def emission(rank, seq, op, shape):
+        return {
+            "kind": "emission", "rank": rank, "seq": seq, "op": op,
+            "shape": shape, "dtype": "float32", "axes": ["ranks"],
+            "world": 2, "bytes": 32, "t": 100.0 + seq,
+        }
+
+    # rank 0 completed AllReduce+AllGather; rank 1 stopped after the
+    # AllReduce — its simulated schedule says AllGather comes next
+    logs = {
+        0: [emission(0, 1, "AllReduce", [8]),
+            emission(0, 2, "AllGather", [8])],
+        1: [emission(1, 1, "AllReduce", [8])],
+    }
+    for rank, records in logs.items():
+        with open(tmp_path / f"events-rank{rank}.jsonl", "w") as f:
+            for rec in records:
+                f.write(json.dumps(rec) + "\n")
+    report = doctor.diagnose([str(tmp_path)])
+    hangs = [f for f in report["findings"] if f["kind"] == "hang"]
+    assert hangs and hangs[0]["rank"] == 1
+    schedules = doctor.collect_static_schedules(FIXTURE, world=2)
+    assert schedules
+    joined = doctor.attach_schedule_positions(report, schedules)
+    assert joined == 1
+    sp = hangs[0]["schedule_position"]
+    assert sp["position"] == 1
+    assert sp["expected_next"]["op"] == "AllGather"
+    assert "simulate_fixture.py" in sp["expected_next"]["source"]
+    # and the text report prints it
+    txt = doctor.format_report(report)
+    assert "simulated schedule" in txt and "should next emit" in txt
+
+
+if __name__ == "__main__":
+    if "--regen" in sys.argv:
+        with open(GOLDEN, "w") as f:
+            json.dump(_fixture_sim_json(), f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"golden rewritten: {GOLDEN}")
+    else:
+        print("usage: python tests/test_analysis_simulate.py --regen")
