@@ -353,6 +353,12 @@ def main():
         if scale == 10 and not on_cpu_platform and nproc == 1
         else None
     )
+    # Armed collective plan, if any (planner/dispatch.py): the plan id
+    # + per-op impl choices this run actually dispatched. null when
+    # unarmed — so `perf gate` cohorts can tell two rounds measured
+    # the same routing before comparing them (docs/planner.md).
+    from mpi4jax_tpu.planner import dispatch as plan_dispatch
+
     record = {
         "metric": "shallow_water_100x_solve",
         "value": round(elapsed, 3),
@@ -362,6 +368,7 @@ def main():
         # which hot loop actually ran — makes a captured row
         # self-describing (null = composable XLA step)
         "fused": fused_info,
+        "plan": plan_dispatch.bench_annotation(),
     }
     print(json.dumps(record))
     # Mirror the result into the shared telemetry event stream

@@ -910,12 +910,35 @@ def trace_schedule(
 
 def event_cost(event: ScheduleEvent) -> Dict[str, Any]:
     """The PR 4 analytic cost of one schedule event (same numbers as
-    the runtime attribution: ``observability/costmodel.cost``)."""
+    the runtime attribution: ``observability/costmodel.cost``).
+
+    When the planner dispatch seam is armed (``M4T_PLAN_CACHE`` /
+    ``M4T_IMPL``), the event is costed as the implementation the plan
+    would route it through (``planner/dispatch.static_impl``), so the
+    static cost report predicts the *planned* program — the same impl
+    tag the runtime telemetry will stamp. Unarmed, this is exactly the
+    plain op model (golden-pinned)."""
+    impl = None
+    try:
+        from ..planner import dispatch as _dispatch
+
+        axes_txt = event.fingerprint.rpartition("@")[2]
+        impl = _dispatch.static_impl(
+            event.op,
+            nbytes=event.nbytes,
+            dtype=event.dtype,
+            world=event.world or len(event.group),
+            axes=(() if axes_txt in ("", "<none>")
+                  else tuple(axes_txt.split(","))),
+        )
+    except Exception:
+        impl = None
     return costmodel.cost(
         event.op,
         nbytes=event.nbytes,
         world=event.world or len(event.group),
         dtype=event.dtype,
+        impl=impl,
     )
 
 
@@ -957,6 +980,10 @@ def cost_report(
             {"fingerprint": e.fingerprint, "source": e.source, "op": e.op,
              "count": 0, "wire_bytes": 0, "steps": 0, "expected_s": 0.0},
         )
+        if c.get("impl"):
+            # armed planner: name the impl the plan routes this site
+            # through (keeps the static report in sync with runtime)
+            g["impl"] = c["impl"]
         g["count"] += 1
         g["wire_bytes"] += c["wire_bytes"]
         g["steps"] += c["steps"]

@@ -65,6 +65,26 @@ Performance attribution (``observability/{costmodel,perf}.py``):
 - ``M4T_PERF_WARMUP``: int -> samples per fingerprint before the
   watch may flag anything (default 10).
 
+Adaptive collective planner (``planner/``):
+
+- ``M4T_PLAN_CACHE``: path to the persisted collective plan cache
+  (``planner/plan.py``, schema ``m4t-plan/1``). When the file exists
+  and validates (schema + content fingerprint + platform class), the
+  dispatch seam arms it and routes plannable collectives
+  (AllReduce/ReduceScatter/AllGather) per plan key; an invalid or
+  mismatched cache warns and is ignored. ``launch --plan PATH`` sets
+  this for every rank; ``python -m mpi4jax_tpu.planner tune`` writes
+  it.
+- ``M4T_IMPL``: manual per-op implementation pins,
+  ``<op>:<impl>[,<op>:<impl>...]`` (e.g.
+  ``M4T_IMPL=AllReduce:quantized``); takes precedence over the armed
+  plan. Unknown ops/impls warn and are ignored; a pinned impl that is
+  infeasible at an emission site falls back to the default policy.
+- ``M4T_PLATFORM_CLASS``: override the plan key's platform class
+  (``cpu`` / ``tpu:v5e`` / ...) — the device-free escape hatch for
+  the tune CLI and tests; unset, the class is derived from the jax
+  backend + device kind at first dispatch.
+
 Resilience (``resilience/``):
 
 - ``M4T_FAULT_PLAN``: path to (or inline) JSON fault-injection plan
@@ -211,6 +231,15 @@ def _static_check_mode() -> str:
 #: emission-time static screening mode ('' = off, 'warn', 'error');
 #: see analysis/emit_check.py
 STATIC_CHECK = _static_check_mode()
+
+#: persisted collective-plan cache path ('' = no cache); armed by
+#: planner/dispatch.py at import when the file exists and validates
+PLAN_CACHE = os.environ.get("M4T_PLAN_CACHE", "")
+#: manual per-op impl pins ("AllReduce:quantized,..."); parsed by
+#: planner/dispatch.py, precedence over the armed plan
+IMPL_PIN = os.environ.get("M4T_IMPL", "")
+#: plan-key platform class override (device-free tune CLI / tests)
+PLATFORM_CLASS = os.environ.get("M4T_PLATFORM_CLASS", "")
 
 #: fault-injection plan spec — path or inline JSON ('' = unarmed);
 #: gates the per-emission hook in ops/_core.py so the unarmed cost is

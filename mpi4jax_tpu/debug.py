@@ -76,6 +76,8 @@ def log_emission(
     world: Optional[int] = None,
     annotation: Optional[str] = None,
     shape: Optional[Sequence[int]] = None,
+    impl: Optional[str] = None,
+    plan: Optional[str] = None,
 ) -> str:
     """Record a trace-time emission; returns the correlation id.
 
@@ -97,6 +99,8 @@ def log_emission(
             cid=ident,
             annotation=annotation,
             shape=shape,
+            impl=impl,
+            plan=plan,
         )
         _obs.events.emit(record)
     return ident

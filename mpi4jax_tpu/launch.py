@@ -32,6 +32,14 @@ rank-cycle witness) or cross-rank order mismatch (M4T202) blocks the
 launch — the bug the doctor would name post-mortem is named pre-spawn
 instead, for free.
 
+Adaptive planning (``planner/``): ``--plan PLAN.json`` arms a tuned
+collective plan cache in every rank (``M4T_PLAN_CACHE``) so plannable
+collectives route per plan key; ``--tune`` (with ``--events-dir`` and
+``--plan``) turns a clean run into a tuning run — ranks sample per-op
+runtime latency, and afterwards the autotuner joins achieved GB/s
+against the analytic cost model over the keys the run emitted and
+pins the winners into the plan (``docs/planner.md``).
+
 Resilience (``resilience/``): ``--fault-plan`` arms a deterministic
 fault-injection plan in every rank (chaos testing); ``--retries K
 --backoff S --resume-dir CKPTROOT`` runs the world under the
@@ -99,6 +107,50 @@ def _run_perf_report(events_dir):
         )
     except Exception as exc:  # pragma: no cover — attribution best-effort
         sys.stderr.write(f"mpi4jax_tpu.launch: perf report failed: {exc!r}\n")
+
+
+def _run_tune(events_dir, plan_path):
+    """``--tune``: post-run autotuning over the artifacts this world
+    just wrote — derive per-impl achieved bandwidth via the perf
+    attribution join, sweep the keys the run actually emitted (cost-
+    model seeded), and pin the winners into ``plan_path`` (merged over
+    any existing cache). Best-effort like the doctor: a tune failure
+    must not change the run's exit code."""
+    try:
+        from . import config
+        from .planner import autotune, plan as _plan
+
+        platform = config.PLATFORM_CLASS or "cpu"
+        table = autotune.measured_table_from_events(
+            [events_dir], platform=platform
+        )
+        keys = autotune.keys_from_events([events_dir], platform=platform)
+        if not keys:
+            sys.stderr.write(
+                "mpi4jax_tpu.launch: --tune: no plannable emissions in "
+                f"{events_dir}; nothing to tune\n"
+            )
+            return
+        planobj, report = autotune.sweep(keys, measured=table)
+        if os.path.exists(plan_path):
+            try:
+                planobj = _plan.merge(
+                    _plan.load(plan_path, platform=platform), planobj
+                )
+            except _plan.PlanError as exc:
+                sys.stderr.write(
+                    f"mpi4jax_tpu.launch: --tune: replacing invalid "
+                    f"cache {plan_path}: {exc} [{exc.reason}]\n"
+                )
+        _plan.save(planobj, plan_path)
+        measured_n = sum(1 for r in report if r["source"] == "measured")
+        sys.stderr.write(
+            f"mpi4jax_tpu.launch: --tune: pinned {len(keys)} key(s) "
+            f"({measured_n} measured) into plan {planobj.plan_id} at "
+            f"{plan_path}\n"
+        )
+    except Exception as exc:  # pragma: no cover — tuning best-effort
+        sys.stderr.write(f"mpi4jax_tpu.launch: --tune failed: {exc!r}\n")
 
 
 def _verify_prelaunch(args) -> int:
@@ -217,6 +269,10 @@ def _spawn_world(
             if fault_plan_env:
                 env["M4T_FAULT_PLAN"] = fault_plan_env
                 env["M4T_FAULT_ATTEMPT"] = str(attempt)
+            if getattr(args, "plan_cache_env", None):
+                # arm the collective plan cache in every rank
+                # (planner/dispatch.py validates and arms at import)
+                env["M4T_PLAN_CACHE"] = args.plan_cache_env
             if resume_step is not None:
                 env["M4T_RESUME_STEP"] = str(resume_step)
             if events_dir:
@@ -232,10 +288,12 @@ def _spawn_world(
                     M4T_FLIGHT_RECORDER_DIR=events_dir,
                     M4T_HEARTBEAT=str(args.heartbeat),
                 )
-                if args.perf:
+                if args.perf or args.tune:
+                    # --tune needs the runtime latency samples too:
+                    # they are the measured side of the sweep
                     env.update(
                         M4T_TELEMETRY_RUNTIME="1",
-                        M4T_PERF_WATCH="1",
+                        M4T_PERF_WATCH="1" if args.perf else "0",
                     )
             cmd = [sys.executable]
             if os.environ.get("M4T_LAUNCH_COVERAGE"):
@@ -380,6 +438,22 @@ def main(argv=None):
         "achieved-bandwidth / %%-of-peak table",
     )
     parser.add_argument(
+        "--plan", default=None, metavar="PLAN.json",
+        help="arm a collective plan cache (planner/plan.py, "
+        "M4T_PLAN_CACHE) in every rank: plannable collectives "
+        "(AllReduce/ReduceScatter/AllGather) route per plan key; an "
+        "invalid cache blocks the launch. With --tune this is also "
+        "where the tuned plan is written",
+    )
+    parser.add_argument(
+        "--tune", action="store_true",
+        help="post-run autotuning (requires --events-dir and --plan): "
+        "ranks sample per-op runtime latency; after a clean run the "
+        "autotuner joins achieved GB/s against the cost model over "
+        "the keys the run emitted and pins winners into --plan "
+        "(merged over the existing cache)",
+    )
+    parser.add_argument(
         "--verify", action="store_true",
         help="fail-fast pre-spawn gate: lint + schedule-simulate the "
         "target's M4T_LINT_TARGETS at -n ranks (analysis/simulate.py) "
@@ -447,9 +521,29 @@ def main(argv=None):
     if args.perf and not events_dir:
         parser.error("--perf requires --events-dir (it reads the "
                      "per-rank latency events back)")
+    if args.tune and not (events_dir and args.plan):
+        parser.error("--tune requires --events-dir (the measurements) "
+                     "and --plan (where the tuned plan is written)")
     if events_dir:
         events_dir = os.path.abspath(events_dir)
         os.makedirs(events_dir, exist_ok=True)
+
+    args.plan_cache_env = None
+    if args.plan:
+        plan_path = os.path.abspath(args.plan)
+        args.plan = plan_path
+        if os.path.exists(plan_path):
+            from .planner import plan as _planmod
+
+            try:
+                _planmod.load(plan_path)
+            except _planmod.PlanError as e:
+                parser.error(f"--plan: {plan_path}: {e} [{e.reason}]")
+            args.plan_cache_env = plan_path
+        elif not args.tune:
+            parser.error(f"--plan: {plan_path} does not exist "
+                         "(tune one with --tune or "
+                         "`python -m mpi4jax_tpu.planner tune`)")
 
     fault_plan_env = None
     if args.fault_plan:
@@ -479,6 +573,8 @@ def main(argv=None):
             _run_doctor(events_dir)
         if events_dir and args.perf:
             _run_perf_report(events_dir)
+        if args.tune and exit_code == 0:
+            _run_tune(events_dir, args.plan)
         return exit_code
 
     # -- supervised path (--retries K) --------------------------------
@@ -563,6 +659,8 @@ def main(argv=None):
         _run_doctor(state["dir"])
     if events_dir and args.perf and state.get("dir"):
         _run_perf_report(state["dir"])
+    if args.tune and exit_code == 0 and state.get("dir"):
+        _run_tune(state["dir"], args.plan)
     return exit_code
 
 

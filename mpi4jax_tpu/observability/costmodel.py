@@ -150,16 +150,34 @@ def cost(
     nbytes: int,
     world: Optional[int],
     dtype: Optional[str] = None,
+    impl: Optional[str] = None,
+    params: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Expected per-rank wire bytes and algorithm steps for one
-    emission. Returns ``{"op", "wire_bytes", "steps", "algorithm"}``;
-    unknown ops get the conservative identity model (wire = payload,
-    1 step) with ``algorithm: "unknown"``."""
+    emission. Returns ``{"op", "wire_bytes", "steps", "algorithm"}``
+    (plus ``"impl"`` when a non-default implementation was asked
+    for); unknown ops get the conservative identity model (wire =
+    payload, 1 step) with ``algorithm: "unknown"``.
+
+    ``impl`` is the planner's implementation tag
+    (``planner/plan.AVAILABLE``): ``None``/``"hlo"`` is the plain op
+    model below; ``"pallas_ring"`` moves the same bytes (the table's
+    AllReduce/RS/AG rows *are* the ring schedule) under a distinct
+    algorithm label; ``"quantized"`` re-routes AllReduce through the
+    int8 wire format; ``"hierarchical"`` is the two-level AllReduce
+    (ring RS+AG on the fast axis of ``params["fast"]`` ranks, ring
+    allreduce of the 1/fast shard across the ``world/fast`` slow
+    groups)."""
     n = int(world) if world else 1
     b = max(0, int(nbytes))
     if n <= 1:
         return {"op": op, "wire_bytes": 0, "steps": 0,
                 "algorithm": "local (world size 1)"}
+    if impl and impl != "hlo":
+        c = _impl_cost(op, impl, b, n, dtype, params or {})
+        if c is not None:
+            c["impl"] = impl
+            return c
     log2n = int(math.ceil(math.log2(n)))
     if op == "AllReduce":
         return {"op": op, "wire_bytes": int(round(2 * (n - 1) * b / n)),
@@ -203,14 +221,66 @@ def cost(
     return {"op": op, "wire_bytes": b, "steps": 1, "algorithm": "unknown"}
 
 
+def _impl_cost(
+    op: str,
+    impl: str,
+    b: int,
+    n: int,
+    dtype: Optional[str],
+    params: Dict[str, Any],
+) -> Optional[Dict[str, Any]]:
+    """Planner-impl variants of the op models above (n > 1 here).
+    Returns None for an impl this model does not know for this op —
+    the caller then falls through to the plain op model, so a plan
+    from a newer schema degrades to a conservative estimate instead
+    of crashing an offline report."""
+    if impl == "pallas_ring" and op in (
+        "AllReduce", "ReduceScatter", "AllGather"
+    ):
+        base = cost(op, nbytes=b, world=n, dtype=dtype)
+        base["algorithm"] = {
+            "AllReduce": "pallas RDMA ring RS+AG",
+            "ReduceScatter": "pallas RDMA ring",
+            "AllGather": "pallas RDMA ring",
+        }[op]
+        return base
+    if impl == "quantized" and op == "AllReduce":
+        c = cost("QuantizedAllReduce", nbytes=b, world=n, dtype=dtype)
+        c["op"] = op
+        return c
+    if impl == "hierarchical" and op == "AllReduce":
+        fast = int(params.get("fast") or 0)
+        if not (1 < fast < n and n % fast == 0):
+            return None
+        slow = n // fast
+        # fast-axis ring RS+AG over the full payload, plus a ring
+        # allreduce of the 1/fast shard across the slow groups — one
+        # crossing of the slow axis
+        fast_wire = int(round(2 * (fast - 1) * b / fast))
+        slow_wire = int(round(2 * (slow - 1) * (b / fast) / slow))
+        return {
+            "op": op,
+            "wire_bytes": fast_wire + slow_wire,
+            "steps": 2 * (fast - 1) + 2 * (slow - 1),
+            "algorithm": (
+                f"hierarchical ring (fast {fast} x slow {slow})"
+            ),
+        }
+    return None
+
+
 def record_cost(record: Dict[str, Any]) -> Dict[str, Any]:
     """Cost of one emission/recorder record (the JSONL schema both
-    sinks share)."""
+    sinks share). Records stamped with a planner ``impl`` tag
+    (``ops/_core.py`` under an armed plan) are costed as that
+    implementation."""
     return cost(
         record.get("op", "?"),
         nbytes=record.get("bytes") or 0,
         world=record.get("world"),
         dtype=record.get("dtype"),
+        impl=record.get("impl"),
+        params=record.get("impl_params"),
     )
 
 

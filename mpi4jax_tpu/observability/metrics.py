@@ -183,6 +183,8 @@ class MetricsRegistry:
         cid: str,
         annotation: Optional[str] = None,
         shape: Optional[Sequence[int]] = None,
+        impl: Optional[str] = None,
+        plan: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Count one trace-time op emission; returns the record stored
         in the emission ring (shared schema with the JSONL event log).
@@ -191,6 +193,9 @@ class MetricsRegistry:
         (global across ops — the doctor's cross-rank alignment key)
         and ``op_seq`` (per op, also exposed as ``snapshot()['ops']
         [op]['seq']``); both restart from 1 after :meth:`reset`.
+        ``impl``/``plan`` (the planner's routing stamp) are recorded
+        only when given — unarmed emissions stay schema-identical to
+        pre-planner records.
         """
         record = {
             "kind": "emission",
@@ -204,6 +209,10 @@ class MetricsRegistry:
             "shape": None if shape is None else [int(d) for d in shape],
             "t": time.time(),
         }
+        if impl is not None:
+            record["impl"] = str(impl)
+            if plan is not None:
+                record["plan"] = str(plan)
         key = _axes_key(axes)
         with self._lock:
             m = self._ops.get(op)

@@ -114,6 +114,11 @@ def attribute(
                 rec.get("world"),
                 int(rec.get("bytes") or 0),
                 rec.get("dtype"),
+                # planner impl stamp (armed runs only): two emissions
+                # of the same fingerprint routed through different
+                # implementations must attribute separately — that is
+                # the per-impl bandwidth the autotuner refines on
+                rec.get("impl"),
             )
             g = groups.get(key)
             if g is None:
@@ -149,8 +154,10 @@ def attribute(
             groups[key]["samples"].extend(float(s) for s in samples)
 
     rows: List[Dict[str, Any]] = []
-    for (op, axes, world, nbytes, dtype), g in groups.items():
-        c = costmodel.cost(op, nbytes=nbytes, world=world, dtype=dtype)
+    for (op, axes, world, nbytes, dtype, impl), g in groups.items():
+        c = costmodel.cost(
+            op, nbytes=nbytes, world=world, dtype=dtype, impl=impl
+        )
         expected = costmodel.expected_time_s(c, gbps=peak, alpha=alpha)
         row = {
             "op": op,
@@ -158,6 +165,7 @@ def attribute(
             "world": world,
             "bytes": nbytes,
             "dtype": dtype,
+            "impl": impl,
             "emissions": g["emissions"],
             "wire_bytes": c["wire_bytes"],
             "steps": c["steps"],
@@ -222,8 +230,9 @@ def format_table(result: Dict[str, Any]) -> str:
         gbps = r.get("achieved_gbps")
         pct = r.get("pct_of_peak")
         slow = r.get("slowdown")
+        op_txt = r["op"] + (f"+{r['impl']}" if r.get("impl") else "")
         lines.append(
-            f"{r['op']:<20} {r['axes']:<8} "
+            f"{op_txt:<20} {r['axes']:<8} "
             f"{r['world'] if r['world'] else '-':>3} "
             f"{_fmt_bytes(r['bytes']):>9} {r['emissions']:>5} "
             f"{_fmt_bytes(r['wire_bytes']):>10} "
@@ -290,7 +299,8 @@ def write_markdown(
         gbps = r.get("achieved_gbps")
         pct = r.get("pct_of_peak")
         lines.append(
-            f"| {r['op']} | {r['axes']} | {r['world'] or '-'} "
+            f"| {r['op'] + ('+' + r['impl'] if r.get('impl') else '')} "
+            f"| {r['axes']} | {r['world'] or '-'} "
             f"| {_fmt_bytes(r['bytes'])} | {r['emissions']} "
             f"| {_fmt_bytes(r['wire_bytes'])} | {r['steps']} "
             f"| {r['algorithm']} | {_fmt_s(r['expected_s'])} "
@@ -515,6 +525,7 @@ def parse_bench_file(path: str) -> Optional[Dict[str, Any]]:
         return None
     if rnd is None:
         rnd = int(m.group(1))
+    plan = rec.get("plan")
     return {
         "round": int(rnd),
         "variant": m.group(2) or "",
@@ -524,6 +535,9 @@ def parse_bench_file(path: str) -> Optional[Dict[str, Any]]:
         "unit": rec.get("unit"),
         "vs_baseline": rec.get("vs_baseline"),
         "nproc": rec.get("nproc"),
+        # armed collective-plan id (bench.py "plan" field, PR 7);
+        # absent/null = unplanned default routing
+        "plan_id": plan.get("id") if isinstance(plan, dict) else None,
         "rc": rc,
     }
 
@@ -547,11 +561,14 @@ def _cohort(row: Dict[str, Any]) -> tuple:
     the same conditions may gate each other. ``vs_baseline`` is
     non-null exactly for genuine on-chip runs (bench.py), so it
     separates chip windows from CPU-fallback rounds; missing nproc
-    (pre-PR1 rows) means single device."""
+    (pre-PR1 rows) means single device. The armed plan id (PR 7) is
+    part of the key: a round measured under a collective plan must
+    not gate — or be gated by — rounds with different routing."""
     return (
         row.get("metric"),
         row.get("vs_baseline") is not None,
         row.get("nproc") or 1,
+        row.get("plan_id"),
     )
 
 
@@ -588,6 +605,7 @@ def gate_history(
             "metric": cohort[0],
             "on_chip": cohort[1],
             "nproc": cohort[2],
+            "plan_id": cohort[3],
         },
         "prior_rounds": [r["round"] for r in prior],
         "tolerance": tolerance,
@@ -700,11 +718,11 @@ def _cmd_compare(args: argparse.Namespace) -> int:
         print("verdict:", "REGRESSED" if regressed else "ok")
         return 1 if regressed else 0
     rows_a = {
-        (r["op"], r["axes"]): r for r in a["rows"]
+        (r["op"], r["axes"], r.get("impl")): r for r in a["rows"]
     }
     regressed = False
     for r in b["rows"]:
-        prev = rows_a.get((r["op"], r["axes"]))
+        prev = rows_a.get((r["op"], r["axes"], r.get("impl")))
         cur_p50, prev_p50 = r.get("lat_p50_s"), (
             prev.get("lat_p50_s") if prev else None
         )

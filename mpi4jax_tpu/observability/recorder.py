@@ -93,27 +93,37 @@ class FlightRecorder:
         shape: Optional[Sequence[int]] = None,
         axes: Optional[Sequence[str]] = None,
         world: Optional[int] = None,
+        impl: Optional[str] = None,
+        plan: Optional[str] = None,
     ) -> int:
         """Append one emission; returns its sequence number (0 when
-        the recorder is disabled)."""
+        the recorder is disabled). ``impl``/``plan`` are the planner's
+        routing stamp (only present when the dispatch seam is armed;
+        they do not participate in :func:`fingerprint` — a re-routed
+        collective is still the *same* collective to the cross-rank
+        doctor)."""
         if not self._enabled:
             return 0
+        entry = {
+            "kind": "recorder",
+            "seq": 0,
+            "op": op,
+            "cid": cid,
+            "bytes": int(nbytes),
+            "dtype": None if dtype is None else str(dtype),
+            "shape": None if shape is None else [int(d) for d in shape],
+            "axes": list(axes) if axes else [],
+            "world": None if world is None else int(world),
+            "t": time.time(),
+        }
+        if impl is not None:
+            entry["impl"] = str(impl)
+            if plan is not None:
+                entry["plan"] = str(plan)
         with self._lock:
             self._seq += 1
-            self._ring.append(
-                {
-                    "kind": "recorder",
-                    "seq": self._seq,
-                    "op": op,
-                    "cid": cid,
-                    "bytes": int(nbytes),
-                    "dtype": None if dtype is None else str(dtype),
-                    "shape": None if shape is None else [int(d) for d in shape],
-                    "axes": list(axes) if axes else [],
-                    "world": None if world is None else int(world),
-                    "t": time.time(),
-                }
-            )
+            entry["seq"] = self._seq
+            self._ring.append(entry)
             return self._seq
 
     # -- reading ------------------------------------------------------

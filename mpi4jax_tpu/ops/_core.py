@@ -141,6 +141,7 @@ def _telemetry_prologue(
     bound_comm,
     annotation: Optional[str],
     payload: Optional[int],
+    decision=None,
 ) -> Tuple[str, str]:
     """Mint the correlation id and feed log line + registry + events +
     flight recorder.
@@ -158,6 +159,12 @@ def _telemetry_prologue(
     shape = _payload_shape(inputs)
     axes = getattr(bound_comm, "axes", None)
     world = getattr(bound_comm, "size", None)
+    # Planner decision stamp (planner/dispatch.py): the op wrapper
+    # only passes one when the dispatch seam is armed, so unarmed
+    # emissions carry no impl fields and pay nothing here.
+    impl = plan_id = None
+    if decision is not None:
+        impl, plan_id = decision.impl, decision.plan_id
     # Flight recorder first (observability/recorder.py): unconditional
     # and telemetry-independent — its ring is the post-mortem record of
     # what this rank was about to emit, kept even when every other
@@ -170,6 +177,8 @@ def _telemetry_prologue(
         shape=shape,
         axes=axes,
         world=world,
+        impl=impl,
+        plan=plan_id,
     )
     debug.log_emission(
         opname,
@@ -181,6 +190,8 @@ def _telemetry_prologue(
         world=world,
         annotation=scope,
         shape=shape,
+        impl=impl,
+        plan=plan_id,
     )
     debug.log_runtime(bound_comm, ident, opname, details)
     # Fault injection LAST (resilience/faults.py): the recorder ring
@@ -246,6 +257,7 @@ def emit_shm(
     bound_comm,
     annotation: Optional[str] = None,
     payload: Optional[int] = None,
+    decision=None,
 ):
     """Run a native shm-backend op under the ambient ordering token.
 
@@ -260,6 +272,7 @@ def emit_shm(
         bound_comm=bound_comm,
         annotation=annotation,
         payload=payload,
+        decision=decision,
     )
     wrapped = _with_runtime_sampling(fn, ident, opname)
     with emission_scope(scope):
@@ -276,6 +289,7 @@ def emit(
     bound_comm,
     annotation: Optional[str] = None,
     payload: Optional[int] = None,
+    decision=None,
 ) -> Tuple:
     """Bind ``prim`` under the ambient ordering token, with logging,
     telemetry, and the ``m4t.<op>`` profiler annotation.
@@ -283,7 +297,10 @@ def emit(
     ``annotation`` overrides the default ``m4t.<opname.lower()>`` scope
     name; ``payload`` overrides the default byte accounting (bytes of
     the first operand) for ops whose first operand is not the payload
-    (barrier's dummy token).
+    (barrier's dummy token); ``decision`` is the planner dispatch
+    decision for plannable ops (passed only when the planner is armed
+    — its impl + plan id then land in every telemetry record of the
+    emission).
 
     Returns a tuple of outputs (even for single-result primitives).
     """
@@ -295,6 +312,7 @@ def emit(
         bound_comm=bound_comm,
         annotation=annotation,
         payload=payload,
+        decision=decision,
     )
 
     def bind(*args):

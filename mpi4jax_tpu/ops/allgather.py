@@ -13,6 +13,7 @@ from jax import lax
 from jax.core import ShapedArray
 
 from ..comm import BoundComm, Comm, resolve_comm
+from ..planner import dispatch as _dispatch
 from ..token import NOTSET, raise_if_token_is_set
 from ..validation import enforce_types
 from ._core import define_primitive, emit
@@ -33,9 +34,10 @@ def _allgather_spmd(x, *, comm: BoundComm):
         return _shm.allgather(x)
     if not comm.axes or comm.size == 1:
         return x[None]
-    from .pallas_ring_parts import ring_allgather, use_ring_parts
-
-    if use_ring_parts(x, comm, footprint_factor=comm.size):
+    # Planner dispatch seam: unarmed this is exactly the legacy
+    # use_ring_parts gate (now the default policy in planner/dispatch)
+    if _dispatch.select("AllGather", x, None, comm).impl == "pallas_ring":
+        from .pallas_ring_parts import ring_allgather
         from .ring_guard import routed_ring
 
         # interpret mode chosen per lowering platform (ring_guard)
@@ -59,6 +61,11 @@ def allgather(x, *, comm=None, token=NOTSET):
     raise_if_token_is_set(token)
     bound = resolve_comm(comm)
     x = jnp.asarray(x)
+    decision = None
+    if (_dispatch.active is not None or _dispatch.pins) and (
+        bound.backend == "xla" and bound.size > 1
+    ):
+        decision = _dispatch.select("AllGather", x, None, bound)
     (out,) = emit(
         mpi_allgather_p,
         (x,),
@@ -67,5 +74,6 @@ def allgather(x, *, comm=None, token=NOTSET):
         details=f"[{x.size} items, n={bound.size}]",
         bound_comm=bound,
         annotation="m4t.allgather",
+        decision=decision,
     )
     return out

@@ -17,6 +17,14 @@ call. AD parity with the reference:
 Non-native operators (PROD, logical/bitwise) use an exact
 all-gather + local-reduce fallback; SUM/MAX/MIN ride a single HLO
 AllReduce on the ICI mesh.
+
+Routing among the alternative implementations (HLO collective, the
+opt-in Pallas RDMA ring, the int8-wire quantized ring, the two-level
+hierarchical reduction) goes through the planner dispatch seam
+(``planner/dispatch.select``): unarmed it reproduces the legacy
+``MPI4JAX_TPU_PALLAS_RING`` heuristic byte-for-byte; armed
+(``M4T_PLAN_CACHE`` / ``M4T_IMPL``) it routes per plan key
+(``docs/planner.md``).
 """
 
 from __future__ import annotations
@@ -26,6 +34,7 @@ from jax import lax
 from jax.interpreters import ad
 
 from ..comm import MAX, MIN, SUM, BoundComm, Comm, Op, resolve_comm
+from ..planner import dispatch as _dispatch
 from ..token import NOTSET, raise_if_token_is_set
 from ..validation import enforce_types
 from ._core import define_primitive, emit, register_passthrough_batcher
@@ -80,6 +89,66 @@ def _shm_reduction_dtype_check(x, op=None):
         )
 
 
+def _hierarchical_reduce(x, op: Op, comm: BoundComm):
+    """Two-level SUM allreduce over a multi-axis communicator: ring
+    reduce-scatter on the fast (innermost) axis, allreduce of the
+    1/n_fast shard across the slow axes — the single crossing of the
+    slow fabric — then allgather back on the fast axis. Bandwidth on
+    the slow axis drops from ``2(n-1)/n * B`` to ``~2B/n_fast``; the
+    planner selects this impl (``hierarchical``) when the slow axis is
+    the bottleneck (DCN/host crossings, Cloud Collectives' premise).
+    Exact for SUM up to float reassociation (allclose, not
+    bit-identical, vs the flat reduction)."""
+    from ..jax_compat import axis_size as _axis_size
+
+    fast = comm.axes[-1]
+    slow = tuple(comm.axes[:-1])
+    nf = _axis_size(fast)
+    if nf <= 1:
+        return _native_reduce(x, op, comm)
+    work_dtype = jnp.int32 if x.dtype == jnp.bool_ else x.dtype
+    flat = x.astype(work_dtype).reshape(-1)
+    total = flat.shape[0]
+    pad = (-total) % nf
+    flat = jnp.pad(flat, (0, pad))
+    blocks = flat.reshape(nf, -1)
+    part = lax.psum_scatter(blocks, fast, scatter_dimension=0, tiled=False)
+    part = lax.psum(part, slow)
+    out = lax.all_gather(part, fast, tiled=False)
+    return out.reshape(-1)[:total].reshape(x.shape).astype(x.dtype)
+
+
+def _ring_reduce(x, comm: BoundComm, params):
+    from ..utils.profiling import emission_scope
+    from .pallas_ring import ring_allreduce
+    from .ring_guard import routed_ring
+
+    # interpret mode is chosen per lowering platform (ring_guard):
+    # TPU lowerings get the compiled RDMA ring, everything else
+    # (tests, CPU meshes) the interpret kernel. The extra scope
+    # distinguishes ring-routed allreduces from HLO AllReduce in
+    # profiler traces (nested under the emission's m4t.allreduce).
+    kwargs = {}
+    if params and params.get("block_rows"):
+        kwargs["block_rows"] = int(params["block_rows"])
+    with emission_scope("m4t.pallas_ring"):
+        return routed_ring(
+            ring_allreduce, x, comm.axes[0], comm.size, **kwargs
+        )
+
+
+def _quantized_reduce(x, comm: BoundComm):
+    from ..utils.profiling import emission_scope
+    from .quantized import _quantized_ring
+
+    # The planner selected the int8 wire format for this AllReduce
+    # emission: run the quantized ring directly (the emission is
+    # already recorded as AllReduce with impl="quantized" — calling
+    # the quantized_allreduce wrapper here would double-count it).
+    with emission_scope("m4t.quantized_ring"):
+        return _quantized_ring(x, comm, comm.size, comm.axis_target())
+
+
 def _allreduce_spmd(x, *, op, comm: BoundComm, transpose):
     if transpose:
         # Identity, no communication (reference allreduce.py:78-80).
@@ -96,34 +165,19 @@ def _allreduce_spmd(x, *, op, comm: BoundComm, transpose):
     if not comm.axes or comm.size == 1:
         # World size 1: reduction over a single rank is the identity.
         return x
-    if _use_pallas_ring(x, op, comm):
-        from ..utils.profiling import emission_scope
-        from .pallas_ring import ring_allreduce
-        from .ring_guard import routed_ring
-
-        # interpret mode is chosen per lowering platform (ring_guard):
-        # TPU lowerings get the compiled RDMA ring, everything else
-        # (tests, CPU meshes) the interpret kernel. The extra scope
-        # distinguishes ring-routed allreduces from HLO AllReduce in
-        # profiler traces (nested under the emission's m4t.allreduce).
-        with emission_scope("m4t.pallas_ring"):
-            return routed_ring(ring_allreduce, x, comm.axes[0], comm.size)
+    # The planner dispatch seam (planner/dispatch.py): unarmed it
+    # reduces to the legacy opt-in ring heuristic (the policy that
+    # used to live here as _use_pallas_ring) and the HLO path below.
+    d = _dispatch.select("AllReduce", x, op, comm)
+    if d.impl == "pallas_ring":
+        return _ring_reduce(x, comm, d.params)
+    if d.impl == "quantized":
+        return _quantized_reduce(x, comm)
+    if d.impl == "hierarchical":
+        return _hierarchical_reduce(x, op, comm)
     if op.native is not None:
         return _native_reduce(x, op, comm)
     return _generic_reduce(x, op, comm)
-
-
-def _use_pallas_ring(x, op, comm: BoundComm) -> bool:
-    """Opt-in (MPI4JAX_TPU_PALLAS_RING=1) hand-scheduled RDMA ring for
-    large float SUM payloads on a plain single-axis communicator.
-    Lower bound: latency-bound payloads stay on HLO AllReduce. The
-    upper bound is generous because the grid-streamed variant keeps
-    arbitrarily large payloads in HBM (validated at 64 MiB)."""
-    from .pallas_ring import ring_gate
-
-    return op is SUM and ring_gate(
-        x, comm, min_bytes=1 << 20, max_bytes=1 << 30
-    )
 
 
 mpi_allreduce_p = define_primitive(
@@ -206,6 +260,14 @@ def allreduce(x, op=SUM, *, comm=None, token=NOTSET):
     raise_if_token_is_set(token)
     bound = resolve_comm(comm)
     x = jnp.asarray(x)
+    # Planner stamp (armed only — one falsy check otherwise): the same
+    # pure decision the lowering will make, recorded into telemetry so
+    # perf attribution / the doctor can group by implementation.
+    decision = None
+    if (_dispatch.active is not None or _dispatch.pins) and (
+        bound.backend == "xla" and bound.size > 1
+    ):
+        decision = _dispatch.select("AllReduce", x, op, bound)
     (out,) = emit(
         mpi_allreduce_p,
         (x,),
@@ -214,5 +276,6 @@ def allreduce(x, op=SUM, *, comm=None, token=NOTSET):
         details=f"[{x.size} items, op={op.name}, n={bound.size}]",
         bound_comm=bound,
         annotation="m4t.allreduce",
+        decision=decision,
     )
     return out

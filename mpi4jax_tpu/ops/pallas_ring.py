@@ -41,8 +41,10 @@ Flow control (the part the guide's sketch leaves implicit):
 
 Opt-in via ``MPI4JAX_TPU_PALLAS_RING=1`` (routes SUM-allreduce of
 float32/bfloat16 payloads >= 1 MiB on a communicator spanning a 1-D
-mesh through this kernel — see ``_use_pallas_ring`` in
-``ops/allreduce.py``) or call :func:`ring_allreduce` directly.
+mesh through this kernel — the default policy of the planner dispatch
+seam, ``planner/dispatch.default_impl``), by pinning/planning the
+``pallas_ring`` impl (``M4T_IMPL`` / ``M4T_PLAN_CACHE``,
+``docs/planner.md``), or call :func:`ring_allreduce` directly.
 
 **Validation status.** Correctness is validated in Pallas interpret
 mode on the virtual CPU mesh (``tests/test_pallas_ring.py``, incl. a
@@ -119,7 +121,8 @@ def _derive_collective_id(
 
 
 def ring_gate(x, comm, *, min_bytes: int, max_bytes: int,
-              footprint_factor: int = 1) -> bool:
+              footprint_factor: int = 1,
+              opt_in: bool | None = None) -> bool:
     """Shared routing predicate for all Pallas ring kernels.
 
     ``footprint_factor`` scales the payload before *both* window
@@ -132,14 +135,21 @@ def ring_gate(x, comm, *, min_bytes: int, max_bytes: int,
     id == axis_index, which only holds when the comm axis spans the
     entire mesh (a 1-D mesh) — on a multi-axis mesh the ids would hit
     other rows' devices and deadlock, so those stay on HLO collectives.
+
+    ``opt_in`` overrides the ``MPI4JAX_TPU_PALLAS_RING`` flag: the
+    planner's dispatch seam passes ``True`` when a plan or ``M4T_IMPL``
+    pin *explicitly* selected the ring — the plan is the opt-in then —
+    while the default policy keeps the env-flag semantics (None).
     """
     from .. import config
 
     import jax
 
+    if opt_in is None:
+        opt_in = config.PALLAS_RING
     nbytes = x.size * x.dtype.itemsize
     if not (
-        config.PALLAS_RING
+        opt_in
         and comm.backend == "xla"
         and comm.groups is None
         and len(comm.axes) == 1
@@ -273,12 +283,19 @@ def ring_allreduce(
     *,
     interpret: bool = False,
     collective_id: int | None = None,
+    block_rows: int | None = None,
 ):
     """SUM all-reduce of ``x`` over ``axis_name`` via a Pallas RDMA
     ring. Must be called inside shard_map with ``axis_name`` bound and
     the axis laid out as a (logical) ring; any float dtype/shape.
     Payloads whose VMEM-resident footprint would exceed the budget are
-    grid-streamed from HBM in macro-blocks automatically."""
+    grid-streamed from HBM in macro-blocks automatically.
+
+    ``block_rows`` overrides the VMEM-budget-derived macro-block row
+    count (the planner's ring tunable, plan param ``block_rows``):
+    values are clamped to the packing-tile multiple and the VMEM
+    budget, so a stale plan can shift the compute/stream overlap but
+    never produce an unmappable kernel."""
     if n == 1:
         return x
     orig_shape, orig_dtype = x.shape, x.dtype
@@ -305,6 +322,12 @@ def ring_allreduce(
     max_rows = max(_VMEM_BUDGET // per_row, 1)
     # floor to a whole number of tiles (minimum one tile)
     max_rows = max((max_rows // sublanes) * sublanes, sublanes)
+    if block_rows is not None and block_rows > 0:
+        # planner tunable: clamp into [one tile, VMEM budget], tile-
+        # aligned — an out-of-range request degrades to the nearest
+        # legal block size instead of failing the lowering
+        requested = max((int(block_rows) // sublanes) * sublanes, sublanes)
+        max_rows = min(requested, max_rows)
     if rows > max_rows:
         block_rows = max_rows
         rows = -(-rows // block_rows) * block_rows  # pad to whole blocks

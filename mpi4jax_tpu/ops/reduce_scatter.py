@@ -23,6 +23,7 @@ from jax.core import ShapedArray
 from jax.interpreters import ad
 
 from ..comm import BoundComm, Comm, Op, SUM, resolve_comm
+from ..planner import dispatch as _dispatch
 from ..token import NOTSET, raise_if_token_is_set
 from ..validation import enforce_types
 from ._core import define_primitive, emit
@@ -49,9 +50,10 @@ def _reduce_scatter_spmd(x, *, op: Op, comm: BoundComm):
         return x[0]
     axis = comm.axis_target()
     _, kw = comm.collective_kwargs()
-    from .pallas_ring_parts import ring_reduce_scatter, use_ring_parts
-
-    if use_ring_parts(x, comm, sum_only_op=op):
+    # Planner dispatch seam: unarmed this is exactly the legacy
+    # use_ring_parts gate (now the default policy in planner/dispatch)
+    if _dispatch.select("ReduceScatter", x, op, comm).impl == "pallas_ring":
+        from .pallas_ring_parts import ring_reduce_scatter
         from .ring_guard import routed_ring
 
         # interpret mode chosen per lowering platform (ring_guard)
@@ -112,6 +114,11 @@ def reduce_scatter(x, op=SUM, *, comm=None, token=NOTSET):
             f"reduce_scatter input must have leading axis of size "
             f"{bound.size} (the communicator size), got shape {x.shape}"
         )
+    decision = None
+    if (_dispatch.active is not None or _dispatch.pins) and (
+        bound.backend == "xla" and bound.size > 1
+    ):
+        decision = _dispatch.select("ReduceScatter", x, op, bound)
     (out,) = emit(
         mpi_reduce_scatter_p,
         (x,),
@@ -120,5 +127,6 @@ def reduce_scatter(x, op=SUM, *, comm=None, token=NOTSET):
         details=f"[{x.size} items, op={op.name}, n={bound.size}]",
         bound_comm=bound,
         annotation="m4t.reduce_scatter",
+        decision=decision,
     )
     return out

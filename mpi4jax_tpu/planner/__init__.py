@@ -1,0 +1,53 @@
+"""Adaptive collective planner + autotuner.
+
+The reference lowers every collective to exactly one algorithm; this
+package closes the loop between the analytic cost model
+(``observability/costmodel.py``), the achieved-bandwidth attribution
+(``observability/perf.py``) and the op layer's multiple
+implementations (HLO collective / Pallas RDMA ring / int8-wire
+quantized ring / hierarchical two-level):
+
+- :mod:`.plan` — versioned plan schema, plan keys ``(op,
+  payload-bucket, dtype, world, mesh-axes, platform-class)``, and the
+  persisted cache (``M4T_PLAN_CACHE``, atomic writes, invalidated on
+  schema/topology/fingerprint mismatch);
+- :mod:`.dispatch` — the single routing seam the op wrappers consult
+  (``M4T_IMPL`` pins > armed plan > the legacy default policy);
+- :mod:`.autotune` — cost-model-seeded sweeps refined by measured
+  GB/s, pinning winners into the cache;
+- ``python -m mpi4jax_tpu.planner`` — ``tune`` / ``show`` /
+  ``--selftest`` CLI.
+
+See ``docs/planner.md``.
+"""
+
+from . import plan  # noqa: F401
+from .plan import (  # noqa: F401
+    AVAILABLE,
+    Plan,
+    PlanEntry,
+    PlanError,
+    plan_key,
+)
+
+__all__ = [
+    "AVAILABLE",
+    "Plan",
+    "PlanEntry",
+    "PlanError",
+    "autotune",
+    "dispatch",
+    "plan",
+    "plan_key",
+]
+
+
+def __getattr__(name):
+    # dispatch/autotune resolve lazily: dispatch arms from the
+    # environment at its own import, which plain `import
+    # mpi4jax_tpu.planner` (e.g. the device-free CLI) must not force.
+    if name in ("dispatch", "autotune"):
+        import importlib
+
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,404 @@
+"""``python -m mpi4jax_tpu.planner``: tune, inspect, self-test.
+
+Device-free by design (the measured-bandwidth table carries the
+hardware truth): ``tune`` sweeps candidate implementations per plan
+key, seeded by the analytic cost model and refined by measured
+achieved GB/s, and pins the winners into the plan cache that
+``M4T_PLAN_CACHE`` / ``launch --plan`` arm in every rank.
+
+Usage::
+
+    python -m mpi4jax_tpu.planner tune --world 8 [--cache PLAN.json]
+        [--measured TABLE.json] [--events RUNDIR ...]
+        [--dtypes float32,bfloat16] [--buckets 12:27:2]
+        [--axes ranks] [--mesh a=2,b=4] [--allow-lossy]
+        [--platform cpu] [--peak-gbps G] [--alpha-us A] [--json]
+    python -m mpi4jax_tpu.planner show [--cache PLAN.json] [--json]
+    python -m mpi4jax_tpu.planner --selftest
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from typing import List, Optional
+
+from .. import config
+from . import autotune, plan as _plan
+
+
+def _default_platform() -> str:
+    return config.PLATFORM_CLASS or "cpu"
+
+
+def _parse_buckets(spec: str) -> List[int]:
+    """``12:27:2`` (range) or ``20,21,24`` (list) -> bucket indices."""
+    if ":" in spec:
+        parts = [int(p) for p in spec.split(":")]
+        lo, hi = parts[0], parts[1]
+        step = parts[2] if len(parts) > 2 else 1
+        return list(range(lo, hi, step))
+    return [int(p) for p in spec.split(",") if p.strip()]
+
+
+def _parse_mesh(spec: Optional[str]):
+    if not spec:
+        return None
+    out = {}
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        out[name.strip()] = int(size)
+    return out
+
+
+def _cache_path(args) -> Optional[str]:
+    return args.cache or config.PLAN_CACHE or None
+
+
+def _cmd_tune(args: argparse.Namespace) -> int:
+    platform = args.platform or _default_platform()
+    measured = None
+    if args.measured:
+        measured = autotune.load_measured(args.measured)
+    if args.events:
+        table = autotune.measured_table_from_events(
+            args.events, platform=platform
+        )
+        if measured is None:
+            measured = table
+        else:
+            # explicit table entries win over event-derived ones
+            merged = {
+                "schema": autotune.TABLE_SCHEMA,
+                "gbps": {**table.get("gbps", {}), **measured.get("gbps", {})},
+                "keys": {**table.get("keys", {}), **measured.get("keys", {})},
+            }
+            measured = merged
+    if args.events and not args.keys_from_grid:
+        keys = autotune.keys_from_events(args.events, platform=platform)
+        if not keys:
+            print(
+                "tune: no plannable emissions in the given event dirs; "
+                "falling back to the default key grid",
+                file=sys.stderr,
+            )
+    else:
+        keys = []
+    if not keys:
+        keys = autotune.default_keys(
+            platform=platform,
+            world=args.world,
+            axes=tuple(args.axes.split(",")),
+            dtypes=tuple(args.dtypes.split(",")),
+            buckets=_parse_buckets(args.buckets),
+        )
+    planobj, report = autotune.sweep(
+        keys,
+        measured=measured,
+        allow_lossy=args.allow_lossy,
+        mesh=_parse_mesh(args.mesh),
+        gbps=args.peak_gbps,
+        alpha=(args.alpha_us * 1e-6 if args.alpha_us is not None else None),
+        prune=args.prune,
+    )
+    cache = _cache_path(args)
+    if cache and not args.dry_run:
+        if not args.fresh and os.path.exists(cache):
+            try:
+                planobj = _plan.merge(
+                    _plan.load(cache, platform=platform), planobj
+                )
+            except _plan.PlanError as exc:
+                print(
+                    f"tune: replacing invalid cache {cache}: {exc} "
+                    f"[{exc.reason}]",
+                    file=sys.stderr,
+                )
+        _plan.save(planobj, cache)
+    if args.json:
+        print(json.dumps(
+            {"plan": planobj.to_json(), "report": report}, indent=1
+        ))
+    else:
+        for line in _plan.summarize(planobj):
+            print(line)
+        measured_n = sum(1 for r in report if r["source"] == "measured")
+        print(
+            f"# plan {planobj.plan_id}: {len(planobj.entries)} keys "
+            f"({measured_n} measured, platform {planobj.platform})"
+            + (f" -> {cache}" if cache and not args.dry_run else
+               " (not persisted: no --cache/M4T_PLAN_CACHE)")
+        )
+    return 0
+
+
+def _cmd_show(args: argparse.Namespace) -> int:
+    cache = _cache_path(args)
+    if not cache:
+        print("show: no --cache given and M4T_PLAN_CACHE unset",
+              file=sys.stderr)
+        return 2
+    try:
+        planobj = _plan.load(cache)
+    except _plan.PlanError as exc:
+        print(f"show: {cache}: {exc} [{exc.reason}]", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(planobj.to_json(), indent=1))
+    else:
+        for line in _plan.summarize(planobj):
+            print(line)
+        print(
+            f"# plan {planobj.plan_id} ({planobj.source}, platform "
+            f"{planobj.platform}, {len(planobj.entries)} keys)"
+        )
+    return 0
+
+
+# ---------------------------------------------------------------------
+# selftest (device-free; wired into tier-1 via tests/test_planner.py)
+# ---------------------------------------------------------------------
+
+
+def selftest() -> int:
+    platform = "cpu"
+    # -- keys: construction, parsing, record equivalence ---------------
+    key = _plan.plan_key(
+        "AllReduce", nbytes=4 << 20, dtype="float32", world=8,
+        axes=("ranks",), platform=platform,
+    )
+    assert key == "AllReduce|b23|float32|w8|ranks|cpu", key
+    info = _plan.parse_key(key)
+    assert info["op"] == "AllReduce" and info["world"] == 8
+    assert _plan.bucket_bounds(info["bucket"])[0] <= (4 << 20) < (
+        _plan.bucket_bounds(info["bucket"])[1]
+    )
+    record = {"op": "AllReduce", "bytes": 4 << 20, "dtype": "float32",
+              "axes": ["ranks"], "world": 8}
+    assert _plan.key_from_record(record, platform) == key
+
+    # -- analytic seed: deterministic, lossless, ties break to hlo -----
+    keys = autotune.default_keys(platform=platform, world=8,
+                                 dtypes=("float32",), buckets=(13, 21, 25))
+    plan_a, report_a = autotune.sweep(keys, gbps=25.0, alpha=1e-6)
+    plan_b, _ = autotune.sweep(keys, gbps=25.0, alpha=1e-6)
+    assert plan_a.plan_id == plan_b.plan_id, "seed must be deterministic"
+    assert all(e.impl != "quantized" for e in plan_a.entries.values()), (
+        "lossy impls must not be chosen without --allow-lossy"
+    )
+    assert plan_a.lookup(key.replace("b23", "b25")).impl == "hlo", (
+        "analytic tie between hlo and pallas_ring must break to hlo"
+    )
+
+    # -- measured refinement overrides the model -----------------------
+    table = {"schema": autotune.TABLE_SCHEMA,
+             "gbps": {"pallas_ring": 100.0, "hlo": 10.0}}
+    plan_m, report_m = autotune.sweep(keys, measured=table,
+                                      gbps=25.0, alpha=1e-6)
+    flipped = [
+        k for k in plan_a.entries
+        if plan_m.entries[k].impl != plan_a.entries[k].impl
+    ]
+    assert flipped, "measured bandwidth must flip at least one key"
+    for k in flipped:
+        assert plan_m.entries[k].source == "measured", plan_m.entries[k]
+    assert plan_m.plan_id != plan_a.plan_id
+
+    # -- lossy opt-in --------------------------------------------------
+    lossy_table = {"schema": autotune.TABLE_SCHEMA,
+                   "gbps": {"quantized": 500.0}}
+    plan_l, _ = autotune.sweep(keys, measured=lossy_table, allow_lossy=True,
+                               gbps=25.0, alpha=1e-6)
+    assert any(e.impl == "quantized" for e in plan_l.entries.values())
+
+    # -- hierarchical candidates need a mesh and >= 2 axes -------------
+    key2 = _plan.plan_key("AllReduce", nbytes=4 << 20, dtype="float32",
+                          world=8, axes=("a", "b"), platform=platform)
+    cands = autotune.candidates(_plan.parse_key(key2),
+                                mesh={"a": 2, "b": 4})
+    assert ("hierarchical", {"fast": 4}) in cands, cands
+    assert all(
+        impl != "hierarchical"
+        for impl, _p in autotune.candidates(_plan.parse_key(key2))
+    )
+
+    # -- cache: atomic round-trip, merge, invalidation -----------------
+    with tempfile.TemporaryDirectory() as tmp:
+        cache = os.path.join(tmp, "plan.json")
+        _plan.save(plan_m, cache)
+        loaded = _plan.load(cache, platform=platform)
+        assert loaded.plan_id == plan_m.plan_id
+        assert {k: e.to_json() for k, e in loaded.entries.items()} == {
+            k: e.to_json() for k, e in plan_m.entries.items()
+        }
+        # merge keeps unrelated base entries
+        extra = _plan.Plan(platform=platform, entries={
+            "AllGather|b10|float32|w8|ranks|cpu": _plan.PlanEntry("hlo"),
+        })
+        merged = _plan.merge(loaded, extra)
+        assert len(merged.entries) == len(loaded.entries) + 1
+
+        data = json.load(open(cache))
+        # (a) schema mismatch
+        bad = dict(data, schema="m4t-plan/0")
+        try:
+            _plan.Plan.from_json(bad)
+        except _plan.PlanError as exc:
+            assert exc.reason == "schema"
+        else:
+            raise AssertionError("old schema must invalidate")
+        # (b) fingerprint drift (hand-edited entries, stale plan_id)
+        bad = json.loads(json.dumps(data))
+        first = sorted(bad["entries"])[0]
+        bad["entries"][first]["impl"] = "hierarchical"
+        try:
+            _plan.Plan.from_json(bad)
+        except _plan.PlanError as exc:
+            assert exc.reason == "fingerprint"
+        else:
+            raise AssertionError("edited entries must invalidate")
+        # (c) topology mismatch
+        try:
+            _plan.load(cache, platform="tpu:v5e")
+        except _plan.PlanError as exc:
+            assert exc.reason == "topology"
+        else:
+            raise AssertionError("platform mismatch must invalidate")
+        # (d) torn file
+        with open(cache, "w") as f:
+            f.write('{"schema": "m4t-plan/1", "entr')
+        try:
+            _plan.load(cache)
+        except _plan.PlanError as exc:
+            assert exc.reason == "parse"
+        else:
+            raise AssertionError("torn cache must invalidate")
+
+    # -- dispatch: pins parse + device-free static lookup --------------
+    from . import dispatch
+
+    saved_pins, saved_active = dict(dispatch.pins), dispatch.active
+    try:
+        parsed = dispatch._parse_pins("allreduce:quantized,junk,Reduce:hlo")
+        assert parsed == {"AllReduce": "quantized"}, parsed
+        dispatch.set_pins("AllReduce:quantized")
+        assert dispatch.is_armed()
+        assert dispatch.static_impl(
+            "AllReduce", nbytes=1 << 20, dtype="float32", world=8,
+            axes=("ranks",),
+        ) == "quantized"
+        assert dispatch.static_impl(
+            "AllReduce", nbytes=1 << 20, dtype="int32", world=8,
+            axes=("ranks",),
+        ) is None, "quantized is float-only, statically too"
+        dispatch.set_pins("")
+        dispatch.arm(plan_m)
+        ann = dispatch.bench_annotation()
+        assert ann and ann["id"] == plan_m.plan_id, ann
+    finally:
+        dispatch.pins = saved_pins
+        dispatch.active = saved_active
+
+    print("planner selftest ok")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--selftest" in argv:
+        return selftest()
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi4jax_tpu.planner",
+        description=(
+            "Adaptive collective planner: sweep candidate "
+            "implementations per plan key (cost-model seed, measured "
+            "GB/s refinement) and pin winners into the plan cache. "
+            "`--selftest` runs a device-free smoke."
+        ),
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_tune = sub.add_parser(
+        "tune", help="sweep impls per key and pin winners into the cache"
+    )
+    p_tune.add_argument(
+        "--cache", default=None, metavar="PLAN.json",
+        help="plan cache to write (default: M4T_PLAN_CACHE)",
+    )
+    p_tune.add_argument(
+        "--world", type=int, default=8,
+        help="world size of the default key grid (default %(default)s)",
+    )
+    p_tune.add_argument(
+        "--axes", default="ranks",
+        help="comma-joined mesh axes of the grid (default %(default)s)",
+    )
+    p_tune.add_argument(
+        "--mesh", default=None, metavar="a=2,b=4",
+        help="axis sizes (enables the hierarchical candidate on "
+        "multi-axis keys)",
+    )
+    p_tune.add_argument(
+        "--dtypes", default="float32,bfloat16",
+        help="dtypes of the grid (default %(default)s)",
+    )
+    p_tune.add_argument(
+        "--buckets", default="12:27:2", metavar="LO:HI[:STEP]|LIST",
+        help="payload size-class buckets (2^(k-1)..2^k bytes; "
+        "default %(default)s = 4KiB..64MiB)",
+    )
+    p_tune.add_argument(
+        "--measured", default=None, metavar="TABLE.json",
+        help="measured-bandwidth table (m4t-bwtable/1); overrides the "
+        "analytic peak wherever it has data",
+    )
+    p_tune.add_argument(
+        "--events", nargs="*", default=None, metavar="RUNDIR",
+        help="run artifact dirs (launch --events-dir --perf): derive "
+        "the measured table and the key set from real emissions",
+    )
+    p_tune.add_argument(
+        "--keys-from-grid", action="store_true",
+        help="with --events: still tune the default grid instead of "
+        "the keys the run emitted",
+    )
+    p_tune.add_argument(
+        "--allow-lossy", action="store_true",
+        help="let the sweep pick lossy impls (int8-wire quantized); "
+        "off by default — an autotuner must not change numerics "
+        "silently",
+    )
+    p_tune.add_argument(
+        "--platform", default=None,
+        help="platform class of the keys (default: M4T_PLATFORM_CLASS "
+        "or 'cpu')",
+    )
+    p_tune.add_argument("--peak-gbps", type=float, default=None)
+    p_tune.add_argument("--alpha-us", type=float, default=None)
+    p_tune.add_argument(
+        "--prune", type=float, default=autotune.DEFAULT_PRUNE,
+        help="drop candidates analytically slower than PRUNE x the "
+        "best before consulting measurements (default %(default)s)",
+    )
+    p_tune.add_argument(
+        "--fresh", action="store_true",
+        help="replace the cache instead of merging over it",
+    )
+    p_tune.add_argument("--dry-run", action="store_true")
+    p_tune.add_argument("--json", action="store_true")
+    p_tune.set_defaults(func=_cmd_tune)
+
+    p_show = sub.add_parser("show", help="print the plan cache")
+    p_show.add_argument("--cache", default=None, metavar="PLAN.json")
+    p_show.add_argument("--json", action="store_true")
+    p_show.set_defaults(func=_cmd_show)
+
+    args = parser.parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

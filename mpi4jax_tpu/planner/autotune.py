@@ -1,0 +1,338 @@
+"""Cost-model-seeded, measurement-refined plan construction.
+
+The sweep closes the loop the ROADMAP names: the analytic cost model
+(``observability/costmodel.py``) already predicts per-impl wire bytes
+and alpha-beta time from an emission fingerprint, and the PR 4
+attribution machinery (``observability/perf.py``) already measures
+achieved GB/s per fingerprint from run artifacts — this module joins
+the two into pinned routing decisions:
+
+1. **Seed** — for every plan key, cost each candidate implementation
+   analytically at the platform's peak bandwidth. Candidates slower
+   than ``prune`` x the best analytic time are dropped *before* any
+   measurement is consulted (the GC3 move: the model shrinks the
+   search space so a sweep only measures plausible candidates).
+2. **Refine** — where a measured-bandwidth table has an achieved-GB/s
+   figure for a surviving (key, impl) — from ``launch --events-dir
+   --perf`` artifacts via :func:`measured_table_from_events`, or an
+   explicit table file — the measured bandwidth replaces the nominal
+   peak in that candidate's beta term. Measured data therefore
+   *overrides* the model wherever it exists (pinned by
+   ``tests/test_planner.py``: a synthetic table provably flips keys
+   away from the analytic seed).
+3. **Pin** — the fastest surviving candidate per key becomes a
+   :class:`..plan.PlanEntry` (``source`` records whether measurement
+   participated), merged over any existing cache and persisted
+   atomically.
+
+Lossy implementations (``quantized``: int8 wire format, bounded
+relative error) are **never** candidates unless ``allow_lossy`` is
+set: an autotuner must not silently change numerics for speed.
+
+Import-light (stdlib + the import-light cost model): the tune CLI
+runs device-free; measured tables carry the hardware truth instead.
+
+Measured-bandwidth table schema (``m4t-bwtable/1``)::
+
+    {"schema": "m4t-bwtable/1",
+     "gbps": {"hlo": 18.2, "pallas_ring": 31.0},          # per impl
+     "keys": {"<plan key>": {"hlo": 12.9, ...}}}          # overrides
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..observability import costmodel
+from . import plan as _plan
+
+TABLE_SCHEMA = "m4t-bwtable/1"
+
+#: analytic prune factor: candidates predicted slower than this
+#: multiple of the best analytic time are not worth measuring
+DEFAULT_PRUNE = 4.0
+
+
+def representative_nbytes(bucket: int) -> int:
+    """The payload size a bucket is costed at: the bucket midpoint
+    (1.5 x the lower bound), the expected value of a size class under
+    a log-uniform payload distribution."""
+    lo, hi = _plan.bucket_bounds(bucket)
+    return (lo + hi) // 2
+
+
+def candidates(
+    info: Dict[str, Any],
+    *,
+    allow_lossy: bool = False,
+    mesh: Optional[Dict[str, int]] = None,
+) -> List[Tuple[str, Dict[str, Any]]]:
+    """Statically feasible (impl, params) candidates for one parsed
+    plan key (:func:`..plan.parse_key` output). Static feasibility is
+    the dtype/arity subset of the dispatch seam's checks — the seam
+    re-validates at the emission site, so an optimistic candidate can
+    lose at dispatch but never mis-route."""
+    op = info["op"]
+    world = info["world"]
+    dtype = str(info["dtype"] or "")
+    axes = tuple(info["axes"] or ())
+    nbytes = representative_nbytes(info["bucket"])
+    out: List[Tuple[str, Dict[str, Any]]] = [("hlo", {})]
+    if world <= 1:
+        return out
+    avail = _plan.impls_for(op)
+    if "pallas_ring" in avail and len(axes) == 1 and dtype in (
+        "float32", "bfloat16"
+    ):
+        resident_cap = 1 << 22
+        factor = world if op == "AllGather" else 1
+        if op == "AllReduce" or nbytes * factor <= resident_cap:
+            out.append(("pallas_ring", {}))
+    if (
+        "quantized" in avail
+        and allow_lossy
+        and dtype.startswith(("float", "bfloat"))
+    ):
+        out.append((
+            "quantized",
+            {"chunk_elems": costmodel._quant_ring_chunk_elems(
+                nbytes // costmodel.itemsize(dtype), world
+            )},
+        ))
+    if "hierarchical" in avail and len(axes) >= 2:
+        fast = (mesh or {}).get(axes[-1])
+        if fast and world % fast == 0 and 1 < fast < world:
+            out.append(("hierarchical", {"fast": int(fast)}))
+    return out
+
+
+def _lookup_gbps(
+    table: Optional[Dict[str, Any]], key: str, impl: str
+) -> Optional[float]:
+    if not table:
+        return None
+    per_key = (table.get("keys") or {}).get(key) or {}
+    value = per_key.get(impl)
+    if value is None:
+        value = (table.get("gbps") or {}).get(impl)
+    if isinstance(value, (int, float)) and value > 0:
+        return float(value)
+    return None
+
+
+def sweep(
+    keys: Sequence[str],
+    *,
+    measured: Optional[Dict[str, Any]] = None,
+    allow_lossy: bool = False,
+    mesh: Optional[Dict[str, int]] = None,
+    gbps: Optional[float] = None,
+    alpha: Optional[float] = None,
+    prune: float = DEFAULT_PRUNE,
+) -> Tuple[_plan.Plan, List[Dict[str, Any]]]:
+    """Seed + refine + pin over ``keys``; returns ``(plan, report)``
+    where ``report`` holds one row per key with every candidate's
+    analytic/measured time (the tune CLI's transcript)."""
+    gbps = costmodel.peak_gbps() if gbps is None else float(gbps)
+    alpha = costmodel.alpha_s() if alpha is None else float(alpha)
+    platform = None
+    entries: Dict[str, _plan.PlanEntry] = {}
+    report: List[Dict[str, Any]] = []
+    any_measured = False
+    for key in keys:
+        info = _plan.parse_key(key)
+        if platform is None:
+            platform = info["platform"]
+        nbytes = representative_nbytes(info["bucket"])
+        rows = []
+        for impl, params in candidates(
+            info, allow_lossy=allow_lossy, mesh=mesh
+        ):
+            c = costmodel.cost(
+                info["op"], nbytes=nbytes, world=info["world"],
+                dtype=info["dtype"], impl=impl, params=params,
+            )
+            rows.append({
+                "impl": impl,
+                "params": params,
+                "cost": c,
+                "analytic_s": costmodel.expected_time_s(
+                    c, gbps=gbps, alpha=alpha
+                ),
+            })
+        best_analytic = min(r["analytic_s"] for r in rows)
+        for r in rows:
+            # the analytic best itself is never pruned (a prune factor
+            # below 1 must not empty the candidate set)
+            r["pruned"] = (
+                r["analytic_s"] > prune * max(best_analytic, 1e-12)
+                and r["analytic_s"] > best_analytic
+            )
+            r["measured_gbps"] = None
+            r["time_s"] = r["analytic_s"]
+            if r["pruned"]:
+                continue
+            m = _lookup_gbps(measured, key, r["impl"])
+            if m is not None:
+                r["measured_gbps"] = m
+                r["time_s"] = costmodel.expected_time_s(
+                    r["cost"], gbps=m, alpha=alpha
+                )
+        live = [r for r in rows if not r["pruned"]]
+        winner = min(live, key=lambda r: r["time_s"])
+        source = "measured" if winner["measured_gbps"] is not None else "analytic"
+        any_measured |= source == "measured"
+        used_gbps = winner["measured_gbps"] if source == "measured" else gbps
+        entries[key] = _plan.PlanEntry(
+            impl=winner["impl"],
+            params=dict(winner["params"]),
+            source=source,
+            expected_gbps=used_gbps,
+            expected_s=winner["time_s"],
+        )
+        report.append({
+            "key": key,
+            "winner": winner["impl"],
+            "source": source,
+            "candidates": [
+                {k: r[k] for k in
+                 ("impl", "analytic_s", "measured_gbps", "time_s", "pruned")}
+                for r in rows
+            ],
+        })
+    return (
+        _plan.Plan(
+            platform=platform or "cpu",
+            entries=entries,
+            source="measured" if any_measured else "analytic",
+        ),
+        report,
+    )
+
+
+# ---------------------------------------------------------------------
+# measured tables
+# ---------------------------------------------------------------------
+
+
+def load_measured(path: str) -> Dict[str, Any]:
+    """Read a measured-bandwidth table file; schema-checked loosely
+    (an unknown schema raises — measurements must not be guessed)."""
+    with open(path) as f:
+        table = json.load(f)
+    if not isinstance(table, dict) or table.get("schema") != TABLE_SCHEMA:
+        raise _plan.PlanError(
+            "schema",
+            f"{path}: expected a {TABLE_SCHEMA!r} table "
+            f"(got {table.get('schema') if isinstance(table, dict) else table!r})",
+        )
+    return table
+
+
+def _row_impl(row: Dict[str, Any]) -> str:
+    impl = row.get("impl")
+    if impl:
+        return str(impl)
+    if row.get("op") == "QuantizedAllReduce":
+        return "quantized"
+    return "hlo"
+
+
+def _row_record(row: Dict[str, Any]) -> Dict[str, Any]:
+    rec = {
+        "op": row.get("op"),
+        "bytes": row.get("bytes"),
+        "dtype": row.get("dtype"),
+        "world": row.get("world"),
+        "axes": (
+            () if row.get("axes") in (None, "<none>")
+            else str(row["axes"]).split(",")
+        ),
+    }
+    if rec["op"] == "QuantizedAllReduce":
+        rec["op"] = "AllReduce"
+    return rec
+
+
+def measured_table_from_events(
+    inputs: Iterable[str], *, platform: str
+) -> Dict[str, Any]:
+    """Build a measured-bandwidth table from run artifacts (``launch
+    --events-dir --perf`` layouts) through the PR 4 attribution join:
+    per (plan key, impl) the median achieved GB/s, plus per-impl
+    medians as the cross-key fallback."""
+    from ..observability import doctor, perf
+
+    by_rank = doctor.load(list(inputs))
+    result = perf.attribute(by_rank) if by_rank else {"rows": []}
+    per_key: Dict[str, Dict[str, List[float]]] = {}
+    per_impl: Dict[str, List[float]] = {}
+    for row in result["rows"]:
+        achieved = row.get("achieved_gbps")
+        if not isinstance(achieved, (int, float)) or achieved <= 0:
+            continue
+        impl = _row_impl(row)
+        rec = _row_record(row)
+        if rec["op"] not in _plan.AVAILABLE:
+            continue
+        key = _plan.key_from_record(rec, platform)
+        per_key.setdefault(key, {}).setdefault(impl, []).append(float(achieved))
+        per_impl.setdefault(impl, []).append(float(achieved))
+    return {
+        "schema": TABLE_SCHEMA,
+        "gbps": {
+            impl: statistics.median(v) for impl, v in sorted(per_impl.items())
+        },
+        "keys": {
+            key: {
+                impl: statistics.median(v)
+                for impl, v in sorted(impls.items())
+            }
+            for key, impls in sorted(per_key.items())
+        },
+    }
+
+
+def keys_from_events(
+    inputs: Iterable[str], *, platform: str
+) -> List[str]:
+    """The plannable plan keys a run actually emitted (the key set a
+    post-run ``launch --tune`` refines)."""
+    from ..observability import doctor
+
+    by_rank = doctor.load(list(inputs))
+    records: List[Dict[str, Any]] = []
+    for rank in sorted(by_rank or {}):
+        for rec in by_rank[rank]:
+            if rec.get("kind") in ("emission", "recorder"):
+                records.append(rec)
+    return _plan.keys_from_records(records, platform)
+
+
+def default_keys(
+    *,
+    platform: str,
+    world: int,
+    axes: Sequence[str] = ("ranks",),
+    dtypes: Sequence[str] = ("float32", "bfloat16"),
+    buckets: Sequence[int] = tuple(range(12, 27, 2)),
+    ops: Sequence[str] = tuple(_plan.AVAILABLE),
+) -> List[str]:
+    """The standalone tune grid: op x size-class x dtype at one world
+    size (4 KiB..64 MiB by default — below that every impl is
+    latency-bound and the HLO collective always wins the seed)."""
+    keys = []
+    for op in ops:
+        for dtype in dtypes:
+            for bucket in buckets:
+                keys.append(_plan.plan_key(
+                    op,
+                    nbytes=representative_nbytes(bucket),
+                    dtype=dtype,
+                    world=world,
+                    axes=axes,
+                    platform=platform,
+                ))
+    return keys

@@ -1,0 +1,431 @@
+"""The dispatch seam: one decision point for collective routing.
+
+Every plannable op wrapper/lowering (``ops/allreduce.py``,
+``ops/reduce_scatter.py``, ``ops/allgather.py``) asks this module
+which implementation to emit, instead of consulting its own ad-hoc
+gate. Three sources, in precedence order:
+
+1. **Manual pins** — ``M4T_IMPL=<op>:<impl>[,<op>:<impl>...]``
+   (e.g. ``M4T_IMPL=AllReduce:quantized``) force an impl per op.
+2. **Armed plan** — a validated plan cache (``M4T_PLAN_CACHE`` or
+   :func:`arm`) looked up by the emission's plan key
+   (:func:`..plan.plan_key`).
+3. **Default policy** (:func:`default_impl`) — the pre-planner
+   behavior, verbatim: the Pallas ring for opted-in
+   (``MPI4JAX_TPU_PALLAS_RING=1``) large float SUM payloads on a
+   1-D mesh (the heuristic that used to live in
+   ``ops/allreduce.py:_use_pallas_ring`` and
+   ``ops/pallas_ring_parts.py:use_ring_parts``), the HLO collective
+   otherwise.
+
+A pinned/planned impl that is *infeasible* at the actual emission site
+(wrong dtype, multi-axis mesh for the ring, shm backend, ...) falls
+back to the default policy — a plan can never produce a program the op
+layer could not already express, only re-route among its existing
+implementations. The shm backend is never re-routed: its single
+native implementation is the communicator's identity, not a choice.
+
+Unarmed (no pins, no plan — the default) the fast path is one falsy
+check (module attribute reads, the ``resilience/faults.py`` standard)
+and the decision collapses to the legacy heuristic, byte-identical
+lowering included (pinned by ``tests/test_planner_dispatch.py``).
+
+Armed decisions are logged per plan key (:func:`decision_log`) so
+``bench.py`` can stamp the BENCH record with the plan id + per-op impl
+choices, and every emission's telemetry record carries
+``impl``/``plan`` fields (``ops/_core.py``).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Any, Dict, NamedTuple, Optional
+
+from .. import config
+from . import plan as _plan
+
+
+class Decision(NamedTuple):
+    """One routing decision for one emission."""
+
+    impl: str
+    params: Dict[str, Any]
+    #: plan key the decision was made under (armed only; None unarmed)
+    key: Optional[str]
+    #: plan id backing the decision ("env" for an M4T_IMPL pin, None
+    #: when the default policy decided)
+    plan_id: Optional[str]
+
+
+#: the armed plan (None = unarmed); module attribute so the op layer's
+#: armed check is a plain attribute read
+active: Optional[_plan.Plan] = None
+
+#: parsed M4T_IMPL pins: op name -> impl (empty dict = no pins)
+pins: Dict[str, str] = {}
+
+_lock = threading.Lock()
+#: armed-only decision log: plan key -> impl (feeds bench annotation)
+_decisions: Dict[str, str] = {}
+#: has the active plan's platform been validated against this process?
+_platform_checked = False
+_platform_cache: Optional[str] = None
+
+#: ring-byte windows when a plan/pin *explicitly* selects the ring:
+#: feasibility keeps only the hardware constraints (the VMEM-resident
+#: cap for the standalone kernels); the policy window of the legacy
+#: opt-in gate is the plan's job now
+_RING_ARMED_WINDOWS = {
+    "AllReduce": (1, 1 << 30),
+    "ReduceScatter": (1, 1 << 22),
+    "AllGather": (1, 1 << 22),
+}
+
+
+def _parse_pins(spec: str) -> Dict[str, str]:
+    """``M4T_IMPL=AllReduce:quantized,ReduceScatter:hlo`` -> dict.
+    Unknown ops/impls warn once and are dropped — a typo must not
+    silently disable the whole override, nor crash import."""
+    out: Dict[str, str] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        op, sep, impl = part.partition(":")
+        op, impl = op.strip(), impl.strip()
+        # accept case-insensitive op spellings (allreduce / AllReduce)
+        canon = {name.lower(): name for name in _plan.AVAILABLE}
+        op_name = canon.get(op.lower())
+        if not sep or op_name is None or impl not in _plan.impls_for(op_name):
+            print(
+                f"# M4T_IMPL: ignoring {part!r} (want <op>:<impl> with "
+                f"op in {sorted(_plan.AVAILABLE)} and a known impl)",
+                file=sys.stderr,
+            )
+            continue
+        out[op_name] = impl
+    return out
+
+
+def platform_class() -> str:
+    """The plan key's platform class: ``M4T_PLATFORM_CLASS`` override,
+    else jax's default backend, refined to the TPU generation
+    (``tpu:v5e`` style, matching ``costmodel.ICI_PEAK_GBPS``'s
+    vocabulary). Cached per process — this may initialize the backend,
+    so it is only called once a decision is actually needed."""
+    global _platform_cache
+    if config.PLATFORM_CLASS:
+        return config.PLATFORM_CLASS
+    if _platform_cache is not None:
+        return _platform_cache
+    try:
+        import jax
+
+        backend = jax.default_backend()
+        if backend == "tpu":
+            kind = jax.devices()[0].device_kind.lower()
+            for key, gen in (
+                ("v5 lite", "v5e"), ("v5litepod", "v5e"), ("v5e", "v5e"),
+                ("v5p", "v5p"), ("v6 lite", "v6e"), ("v6e", "v6e"),
+                ("v4", "v4"),
+            ):
+                if key in kind:
+                    backend = f"tpu:{gen}"
+                    break
+            else:
+                backend = "tpu"
+    except Exception:
+        backend = "cpu"
+    _platform_cache = backend
+    return backend
+
+
+# ---------------------------------------------------------------------
+# arming
+# ---------------------------------------------------------------------
+
+
+def arm(planobj: _plan.Plan) -> None:
+    """Arm a plan programmatically (the in-process analog of
+    ``M4T_PLAN_CACHE``)."""
+    global active, _platform_checked
+    with _lock:
+        active = planobj
+        _platform_checked = False
+        _decisions.clear()
+
+
+def disarm() -> None:
+    global active, _platform_checked
+    with _lock:
+        active = None
+        _platform_checked = False
+        _decisions.clear()
+
+
+def set_pins(spec: str) -> Dict[str, str]:
+    """Replace the manual pins (the in-process analog of ``M4T_IMPL``);
+    returns the parsed pin map."""
+    global pins
+    with _lock:
+        pins = _parse_pins(spec)
+        _decisions.clear()
+    return pins
+
+
+def is_armed() -> bool:
+    """Is any non-default routing source active? The op layer's gate:
+    unarmed, nothing below :func:`default_impl` runs."""
+    return active is not None or bool(pins)
+
+
+def _load_cache_from_env() -> None:
+    """Arm from ``M4T_PLAN_CACHE`` at import when the cache exists and
+    parses; an invalid cache warns and stays unarmed (the collective
+    layer must keep working with a stale cache on disk). Platform
+    validation is deferred to the first decision — checking it here
+    would initialize the jax backend at import time."""
+    global active
+    if not config.PLAN_CACHE:
+        return
+    import os
+
+    if not os.path.exists(config.PLAN_CACHE):
+        return
+    try:
+        active = _plan.load(config.PLAN_CACHE)
+    except _plan.PlanError as exc:
+        print(
+            f"# m4t planner: ignoring plan cache {config.PLAN_CACHE}: "
+            f"{exc} [{exc.reason}]",
+            file=sys.stderr,
+        )
+
+
+def _check_platform() -> Optional[_plan.Plan]:
+    """The armed plan, platform-validated once per arming: a cache
+    tuned for a different fabric disarms with a warning (topology
+    invalidation)."""
+    global active, _platform_checked
+    planobj = active
+    if planobj is None or _platform_checked:
+        return planobj
+    with _lock:
+        planobj = active
+        if planobj is None or _platform_checked:
+            return planobj
+        here = platform_class()
+        if planobj.platform != here:
+            print(
+                f"# m4t planner: disarming plan {planobj.plan_id} "
+                f"(tuned for {planobj.platform!r}, this process is "
+                f"{here!r}); re-tune with "
+                "`python -m mpi4jax_tpu.planner tune`",
+                file=sys.stderr,
+            )
+            active = None
+            return None
+        _platform_checked = True
+        return planobj
+
+
+# ---------------------------------------------------------------------
+# default policy (the legacy heuristics, moved here verbatim)
+# ---------------------------------------------------------------------
+
+
+def default_impl(op: str, x, reduce_op, comm) -> str:
+    """The pre-planner routing policy, byte-identical to the old
+    ``_use_pallas_ring`` / ``use_ring_parts`` gates: the opt-in
+    (``MPI4JAX_TPU_PALLAS_RING=1``) Pallas ring for large float SUM
+    payloads on a plain single-axis communicator — latency-bound
+    payloads stay on the HLO collective, and the standalone RS/AG
+    kernels additionally cap at their VMEM-resident footprint — else
+    ``hlo``."""
+    from ..comm import SUM
+
+    if op == "AllReduce":
+        from ..ops.pallas_ring import ring_gate
+
+        if reduce_op is SUM and ring_gate(
+            x, comm, min_bytes=1 << 20, max_bytes=1 << 30
+        ):
+            return "pallas_ring"
+        return "hlo"
+    if op == "ReduceScatter":
+        from ..ops.pallas_ring_parts import use_ring_parts
+
+        if use_ring_parts(x, comm, sum_only_op=reduce_op):
+            return "pallas_ring"
+        return "hlo"
+    if op == "AllGather":
+        from ..ops.pallas_ring_parts import use_ring_parts
+
+        if use_ring_parts(x, comm, footprint_factor=comm.size):
+            return "pallas_ring"
+        return "hlo"
+    return "hlo"
+
+
+def _feasible(impl: str, op: str, x, reduce_op, comm) -> bool:
+    """Can ``impl`` implement this emission *correctly* here? Hardware
+    and semantics constraints only — policy (payload windows, opt-in
+    flags) belongs to the plan/default policy, not feasibility."""
+    if impl == "hlo":
+        return True
+    if comm.backend != "xla" or comm.size <= 1:
+        return False
+    from ..comm import SUM
+
+    if impl == "pallas_ring":
+        if op not in _RING_ARMED_WINDOWS:
+            return False
+        if op in ("AllReduce", "ReduceScatter") and reduce_op is not SUM:
+            return False
+        from ..ops.pallas_ring import ring_gate
+
+        lo, hi = _RING_ARMED_WINDOWS[op]
+        factor = comm.size if op == "AllGather" else 1
+        return ring_gate(
+            x, comm, min_bytes=lo, max_bytes=hi,
+            footprint_factor=factor, opt_in=True,
+        )
+    if impl == "quantized":
+        import jax.numpy as jnp
+
+        return (
+            op == "AllReduce"
+            and reduce_op is SUM
+            and jnp.issubdtype(x.dtype, jnp.floating)
+        )
+    if impl == "hierarchical":
+        import jax.numpy as jnp
+
+        return (
+            op == "AllReduce"
+            and reduce_op is SUM
+            and len(comm.axes) >= 2
+            and comm.groups is None
+            and jnp.issubdtype(x.dtype, jnp.number)
+        )
+    return False
+
+
+# ---------------------------------------------------------------------
+# the decision point
+# ---------------------------------------------------------------------
+
+
+def select(op: str, x, reduce_op, comm) -> Decision:
+    """Route one emission. Called from the op lowering (and, when
+    armed, from the op wrapper to stamp telemetry); must therefore be
+    a pure function of its arguments and the armed state."""
+    if active is None and not pins:
+        return Decision(default_impl(op, x, reduce_op, comm), {}, None, None)
+    planobj = _check_platform()
+    key = _plan.plan_key(
+        op,
+        nbytes=int(getattr(x, "size", 0) or 0)
+        * getattr(getattr(x, "dtype", None), "itemsize", 1),
+        dtype=str(getattr(x, "dtype", "?")),
+        world=comm.size,
+        axes=comm.axes,
+        platform=platform_class(),
+    )
+    impl: Optional[str] = None
+    params: Dict[str, Any] = {}
+    plan_id: Optional[str] = None
+    pinned = pins.get(op)
+    if pinned is not None:
+        impl, plan_id = pinned, "env"
+    elif planobj is not None:
+        entry = planobj.lookup(key)
+        if entry is not None:
+            impl = entry.impl
+            params = dict(entry.params)
+            plan_id = planobj.plan_id
+    if impl is None or not _feasible(impl, op, x, reduce_op, comm):
+        # no decision for this key, or the decision cannot run here:
+        # today's behavior
+        impl, params, plan_id = default_impl(op, x, reduce_op, comm), {}, None
+    with _lock:
+        if len(_decisions) < 4096:
+            _decisions[key] = impl
+    return Decision(impl, params, key, plan_id)
+
+
+def static_impl(
+    op: str,
+    *,
+    nbytes: int,
+    dtype: Optional[str],
+    world: Optional[int],
+    axes,
+) -> Optional[str]:
+    """Device-free impl lookup for the static layer
+    (``analysis/schedule.py``'s cost report): what would the armed
+    plan/pins route this site through? Feasibility is approximated
+    from the static fields only (dtype + axis arity — no mesh, no
+    probe), so the static answer can be optimistic about ring
+    availability; unarmed returns None (the static default is the
+    plain op model)."""
+    if active is None and not pins:
+        return None
+    impl = pins.get(op)
+    if impl is None:
+        planobj = active
+        if planobj is None:
+            return None
+        entry = planobj.lookup(
+            _plan.plan_key(
+                op, nbytes=nbytes, dtype=dtype, world=world, axes=axes,
+                platform=platform_class(),
+            )
+        )
+        if entry is None:
+            return None
+        impl = entry.impl
+    if impl not in _plan.impls_for(op):
+        return None
+    n_axes = len(tuple(axes or ()))
+    if impl == "pallas_ring" and (
+        n_axes != 1 or str(dtype) not in ("float32", "bfloat16")
+    ):
+        return None
+    if impl == "quantized" and not str(dtype).startswith(
+        ("float", "bfloat")
+    ):
+        return None
+    if impl == "hierarchical" and n_axes < 2:
+        return None
+    return impl
+
+
+def decision_log() -> Dict[str, str]:
+    """Armed-only log of (plan key -> chosen impl) decisions made so
+    far in this process."""
+    with _lock:
+        return dict(_decisions)
+
+
+def bench_annotation() -> Optional[Dict[str, Any]]:
+    """The BENCH-record ``plan`` field: None when unarmed, else the
+    armed plan id (``"env"`` when only ``M4T_IMPL`` pins are active)
+    plus the per-op impl choices actually made (``op -> sorted impl
+    list``, usually a single impl per op)."""
+    if not is_armed():
+        return None
+    per_op: Dict[str, set] = {}
+    for key, impl in decision_log().items():
+        per_op.setdefault(key.split("|", 1)[0], set()).add(impl)
+    return {
+        "id": active.plan_id if active is not None else "env",
+        "pins": dict(pins) or None,
+        "impls": {op: sorted(impls) for op, impls in sorted(per_op.items())},
+    }
+
+
+# arm from the environment at import (one-time; cheap when unset)
+pins = _parse_pins(config.IMPL_PIN)
+_load_cache_from_env()

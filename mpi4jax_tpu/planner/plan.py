@@ -1,0 +1,374 @@
+"""Versioned collective-plan schema and the persisted plan cache.
+
+A *plan* maps **plan keys** — ``(op, payload-bucket, dtype, world,
+mesh-axes, platform-class)`` — to the collective *implementation* (and
+tunable parameters) the dispatch seam (:mod:`.dispatch`) should route
+that emission through. The key is derived from exactly the fields
+every telemetry layer already records per emission (``op``/``bytes``/
+``dtype``/``world``/``axes`` — ``observability/recorder.py``,
+``observability/metrics.py``, ``analysis/sites.CollectiveSite``), so
+a key computed from a runtime JSONL record, a static
+``CollectiveSite``, or a cost-model query is byte-identical
+(pinned by ``tests/test_planner.py``). Payload bytes are bucketed by
+power of two: tuning is per size *class*, not per exact byte count, so
+one measured win generalizes to neighboring payloads.
+
+The implementation vocabulary (:data:`AVAILABLE`) names the routes the
+op layer already owns:
+
+- ``hlo`` — the default XLA HLO collective (AllReduce / ReduceScatter
+  / AllGather), compiler-scheduled;
+- ``pallas_ring`` — the hand-scheduled Pallas RDMA ring kernels
+  (``ops/pallas_ring.py`` / ``ops/pallas_ring_parts.py``);
+- ``quantized`` — the int8-wire ring (``ops/quantized.py``), **lossy**
+  (bounded relative error) and therefore never chosen by the autotuner
+  unless explicitly allowed (``tune --allow-lossy``);
+- ``hierarchical`` — two-level SUM allreduce over a multi-axis
+  communicator: reduce-scatter on the fast (innermost) axis, allreduce
+  on the slow axes, allgather back on the fast axis — one crossing of
+  the slow axis with ``1/n_fast`` of the payload.
+
+Persistence (``M4T_PLAN_CACHE``): plans are JSON documents with a
+``schema`` tag (:data:`SCHEMA`), a ``platform`` class, and a content
+fingerprint ``plan_id`` (sha256 over the canonical body). Loading
+validates all three and raises :class:`PlanError` on schema mismatch,
+platform/topology mismatch, or fingerprint drift (a hand-edited or
+torn cache must be re-tuned, not half-trusted). Writes are atomic
+(tmp + fsync + ``os.replace``), the ``resilience/ckpt.py`` commit
+protocol.
+
+Import-light on purpose (stdlib only): the tune CLI and the plan-aware
+offline consumers (perf report, doctor) run on hosts without jax.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: plan-cache schema tag; bump on any incompatible layout change (an
+#: old cache then invalidates instead of misrouting collectives)
+SCHEMA = "m4t-plan/1"
+
+#: implementation vocabulary per plannable op. ``hlo`` is always first:
+#: it is the fallback when a planned impl is infeasible at the actual
+#: emission site, and the analytic tie-breaker (stable ordering).
+AVAILABLE: Dict[str, Tuple[str, ...]] = {
+    "AllReduce": ("hlo", "pallas_ring", "quantized", "hierarchical"),
+    "ReduceScatter": ("hlo", "pallas_ring"),
+    "AllGather": ("hlo", "pallas_ring"),
+}
+
+#: impls that change numerics beyond reordering (int8 wire format):
+#: excluded from autotuning unless explicitly allowed, and flagged in
+#: ``show`` output
+LOSSY_IMPLS = frozenset({"quantized"})
+
+
+class PlanError(ValueError):
+    """A plan document that must not be trusted (schema / topology /
+    fingerprint mismatch, or malformed JSON). Carries ``reason`` in
+    {"schema", "topology", "fingerprint", "parse"}."""
+
+    def __init__(self, reason: str, message: str):
+        super().__init__(message)
+        self.reason = reason
+
+
+# ---------------------------------------------------------------------
+# plan keys
+# ---------------------------------------------------------------------
+
+
+def payload_bucket(nbytes: int) -> int:
+    """Power-of-two size class of a payload: 0 for empty payloads,
+    else ``bit_length`` (bucket k covers [2^(k-1), 2^k) bytes)."""
+    n = int(nbytes or 0)
+    return n.bit_length() if n > 0 else 0
+
+
+def bucket_bounds(bucket: int) -> Tuple[int, int]:
+    """[lo, hi) byte range of a bucket (inverse of
+    :func:`payload_bucket`)."""
+    if bucket <= 0:
+        return (0, 1)
+    return (1 << (bucket - 1), 1 << bucket)
+
+
+def _axes_txt(axes: Optional[Sequence[str]]) -> str:
+    # the recorder fingerprint's axes convention (recorder.fingerprint)
+    if not axes:
+        return "<none>"
+    return ",".join(str(a) for a in axes)
+
+
+def plan_key(
+    op: str,
+    *,
+    nbytes: int,
+    dtype: Optional[str],
+    world: Optional[int],
+    axes: Optional[Sequence[str]],
+    platform: str,
+) -> str:
+    """The canonical plan key string:
+    ``<op>|b<bucket>|<dtype>|w<world>|<axes>|<platform>``."""
+    return (
+        f"{op}|b{payload_bucket(nbytes)}|{dtype or '?'}|"
+        f"w{int(world) if world else 1}|{_axes_txt(axes)}|{platform}"
+    )
+
+
+def key_from_record(record: Dict[str, Any], platform: str) -> str:
+    """Plan key of one emission/recorder/site record (the shared JSONL
+    schema: ``op``/``bytes``/``dtype``/``axes``/``world``)."""
+    return plan_key(
+        record.get("op", "?"),
+        nbytes=record.get("bytes") or 0,
+        dtype=record.get("dtype"),
+        world=record.get("world"),
+        axes=record.get("axes"),
+        platform=platform,
+    )
+
+
+def parse_key(key: str) -> Dict[str, Any]:
+    """Split a plan key back into its fields (for reports and the
+    tune CLI); inverse of :func:`plan_key` up to the payload bucket."""
+    parts = key.split("|")
+    if len(parts) != 6 or not parts[1].startswith("b") or not parts[3].startswith("w"):
+        raise PlanError("parse", f"malformed plan key: {key!r}")
+    axes = () if parts[4] == "<none>" else tuple(parts[4].split(","))
+    return {
+        "op": parts[0],
+        "bucket": int(parts[1][1:]),
+        "dtype": None if parts[2] == "?" else parts[2],
+        "world": int(parts[3][1:]),
+        "axes": axes,
+        "platform": parts[5],
+    }
+
+
+# ---------------------------------------------------------------------
+# plan entries and documents
+# ---------------------------------------------------------------------
+
+
+@dataclass
+class PlanEntry:
+    """The pinned decision for one plan key."""
+
+    impl: str
+    #: tunable parameters for the impl (e.g. ``block_rows`` for the
+    #: Pallas ring, ``fast`` axis size for hierarchical); advisory —
+    #: the dispatch seam validates them at the emission site
+    params: Dict[str, Any] = field(default_factory=dict)
+    #: "analytic" (cost-model seed) or "measured" (achieved-bandwidth
+    #: refinement overrode the model)
+    source: str = "analytic"
+    #: predicted bandwidth/time backing the decision (diagnostics)
+    expected_gbps: Optional[float] = None
+    expected_s: Optional[float] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"impl": self.impl, "source": self.source}
+        if self.params:
+            out["params"] = dict(self.params)
+        if self.expected_gbps is not None:
+            out["expected_gbps"] = self.expected_gbps
+        if self.expected_s is not None:
+            out["expected_s"] = self.expected_s
+        return out
+
+    @classmethod
+    def from_json(cls, data: Dict[str, Any]) -> "PlanEntry":
+        if not isinstance(data, dict) or "impl" not in data:
+            raise PlanError("parse", f"malformed plan entry: {data!r}")
+        return cls(
+            impl=str(data["impl"]),
+            params=dict(data.get("params") or {}),
+            source=str(data.get("source", "analytic")),
+            expected_gbps=data.get("expected_gbps"),
+            expected_s=data.get("expected_s"),
+        )
+
+
+def _canonical_body(platform: str, entries: Dict[str, PlanEntry]) -> str:
+    """The byte sequence the plan fingerprint covers: schema, platform
+    and sorted entries — everything that changes routing. ``created``
+    deliberately does not participate, so re-saving an identical plan
+    keeps its id."""
+    return json.dumps(
+        {
+            "schema": SCHEMA,
+            "platform": platform,
+            "entries": {k: entries[k].to_json() for k in sorted(entries)},
+        },
+        sort_keys=True,
+        separators=(",", ":"),
+    )
+
+
+@dataclass
+class Plan:
+    """A keyed set of pinned decisions for one platform class."""
+
+    platform: str
+    entries: Dict[str, PlanEntry] = field(default_factory=dict)
+    source: str = "analytic"
+    created: float = 0.0
+
+    @property
+    def plan_id(self) -> str:
+        """Content fingerprint: 16 hex chars of sha256 over the
+        canonical body."""
+        blob = _canonical_body(self.platform, self.entries).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+    def lookup(self, key: str) -> Optional[PlanEntry]:
+        return self.entries.get(key)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "schema": SCHEMA,
+            "plan_id": self.plan_id,
+            "platform": self.platform,
+            "source": self.source,
+            "created": self.created,
+            "entries": {
+                k: self.entries[k].to_json() for k in sorted(self.entries)
+            },
+        }
+
+    @classmethod
+    def from_json(cls, data: Any) -> "Plan":
+        if not isinstance(data, dict):
+            raise PlanError("parse", "plan document is not a JSON object")
+        if data.get("schema") != SCHEMA:
+            raise PlanError(
+                "schema",
+                f"plan schema {data.get('schema')!r} != {SCHEMA!r}; re-tune",
+            )
+        entries = {
+            str(k): PlanEntry.from_json(v)
+            for k, v in (data.get("entries") or {}).items()
+        }
+        plan = cls(
+            platform=str(data.get("platform", "?")),
+            entries=entries,
+            source=str(data.get("source", "analytic")),
+            created=float(data.get("created") or 0.0),
+        )
+        recorded = data.get("plan_id")
+        if recorded is not None and recorded != plan.plan_id:
+            raise PlanError(
+                "fingerprint",
+                f"plan_id {recorded!r} does not match the entries "
+                f"(recomputed {plan.plan_id!r}): stale or hand-edited "
+                "cache; re-tune",
+            )
+        return plan
+
+
+# ---------------------------------------------------------------------
+# persisted cache (M4T_PLAN_CACHE)
+# ---------------------------------------------------------------------
+
+
+def save(planobj: Plan, path: str) -> str:
+    """Atomic plan-cache write (tmp + fsync + rename, the
+    ``resilience/ckpt.py`` commit protocol): a rank killed mid-save
+    can never leave a half-parsed cache."""
+    if not planobj.created:
+        planobj.created = time.time()
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(planobj.to_json(), f, indent=1, sort_keys=True)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def load(path: str, *, platform: Optional[str] = None) -> Plan:
+    """Load and validate a plan cache. Raises :class:`PlanError` on
+    malformed JSON, schema mismatch, fingerprint drift, or — when
+    ``platform`` is given — a platform-class (topology) mismatch: a
+    plan tuned for one fabric must never route another."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError) as exc:
+        raise PlanError("parse", f"cannot read plan cache {path}: {exc}")
+    planobj = Plan.from_json(data)
+    if platform is not None and planobj.platform != platform:
+        raise PlanError(
+            "topology",
+            f"plan cache {path} was tuned for platform "
+            f"{planobj.platform!r}, this process is {platform!r}; re-tune",
+        )
+    return planobj
+
+
+def impls_for(op: str) -> Tuple[str, ...]:
+    """The implementation vocabulary of one op (``("hlo",)`` for ops
+    with no alternative route)."""
+    return AVAILABLE.get(op, ("hlo",))
+
+
+def merge(base: Optional[Plan], update: Plan) -> Plan:
+    """New plan = ``base`` entries overridden by ``update`` entries
+    (incremental tuning: a sweep over a few keys must not drop the
+    rest of the cache)."""
+    if base is None or base.platform != update.platform:
+        return update
+    entries = dict(base.entries)
+    entries.update(update.entries)
+    return Plan(
+        platform=update.platform,
+        entries=entries,
+        source="mixed" if base.entries else update.source,
+        created=update.created,
+    )
+
+
+def summarize(planobj: Plan) -> List[str]:
+    """One line per entry for ``show``/``tune`` output."""
+    lines = []
+    for key in sorted(planobj.entries):
+        e = planobj.entries[key]
+        extra = ""
+        if e.params:
+            extra += " " + ",".join(f"{k}={v}" for k, v in sorted(e.params.items()))
+        if e.expected_gbps is not None:
+            extra += f" ~{e.expected_gbps:.3g}GB/s"
+        lossy = " (lossy)" if e.impl in LOSSY_IMPLS else ""
+        lines.append(f"{key} -> {e.impl}{lossy} [{e.source}]{extra}")
+    return lines
+
+
+def keys_from_records(
+    records: Iterable[Dict[str, Any]], platform: str
+) -> List[str]:
+    """Distinct plan keys of the *plannable* emissions in a record
+    stream (events JSONL / recorder dumps / schedule events), in first-
+    seen order — the key set a post-run ``tune`` refines."""
+    seen: Dict[str, None] = {}
+    for rec in records:
+        op = rec.get("op")
+        if op == "QuantizedAllReduce":
+            # the quantized collective is the AllReduce impl "quantized";
+            # its measurements refine the AllReduce key
+            rec = dict(rec)
+            rec["op"] = op = "AllReduce"
+        if op not in AVAILABLE:
+            continue
+        seen.setdefault(key_from_record(rec, platform))
+    return list(seen)

@@ -1,0 +1,523 @@
+"""Planner subsystem, device-free half: plan schema + cache protocol,
+cost-model impl variants, the autotune sweep (analytic seed, measured
+refinement, lossy gating), the CLI, and the cross-layer plan-key /
+fingerprint drift pins.
+
+Regen the golden plan-cache pin after an intentional schema change::
+
+    python tests/test_planner.py --regen
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mpi4jax_tpu.observability import costmodel
+from mpi4jax_tpu.planner import autotune, plan as planmod
+
+pytestmark = pytest.mark.tuning
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "data", "plan_golden.json")
+
+#: the fixed tune invocation the golden file pins: analytic seed over
+#: a 3-bucket float32 grid at world 8, refined by a synthetic measured
+#: table that makes the Pallas ring 10x faster than HLO
+GOLDEN_KEYS = dict(platform="cpu", world=8, dtypes=("float32",),
+                   buckets=(13, 21, 25))
+GOLDEN_TABLE = {"schema": autotune.TABLE_SCHEMA,
+                "gbps": {"pallas_ring": 100.0, "hlo": 10.0}}
+
+
+def golden_plan():
+    keys = autotune.default_keys(**GOLDEN_KEYS)
+    planobj, _ = autotune.sweep(keys, measured=GOLDEN_TABLE,
+                                gbps=25.0, alpha=1e-6)
+    return planobj
+
+
+# ---------------------------------------------------------------------
+# plan keys and the cross-layer drift pin
+# ---------------------------------------------------------------------
+
+
+def test_plan_key_literal_pin():
+    # the exact key string is a contract (cache files, bench records,
+    # decision logs all carry it); changing it invalidates every
+    # persisted plan, so it must not drift by accident
+    key = planmod.plan_key(
+        "AllReduce", nbytes=4096 * 4, dtype="float32", world=8,
+        axes=("ranks",), platform="cpu",
+    )
+    assert key == "AllReduce|b15|float32|w8|ranks|cpu"
+    assert planmod.plan_key(
+        "AllGather", nbytes=0, dtype=None, world=None, axes=(),
+        platform="tpu:v5e",
+    ) == "AllGather|b0|?|w1|<none>|tpu:v5e"
+
+
+def test_plan_key_bucket_roundtrip():
+    for nbytes in (1, 2, 3, 1023, 1024, 1025, 1 << 20, (1 << 20) + 1):
+        bucket = planmod.payload_bucket(nbytes)
+        lo, hi = planmod.bucket_bounds(bucket)
+        assert lo <= nbytes < hi, (nbytes, bucket, lo, hi)
+    info = planmod.parse_key("AllReduce|b15|float32|w8|ranks|cpu")
+    assert info == {"op": "AllReduce", "bucket": 15, "dtype": "float32",
+                    "world": 8, "axes": ("ranks",), "platform": "cpu"}
+
+
+def test_key_from_record_matches_all_telemetry_layers():
+    """Satellite pin: the plan key computed from a runtime emission
+    record, a recorder entry, a static CollectiveSite JSON, and the
+    cost-model record shape are byte-identical — the planner joins all
+    four layers by this key."""
+    fields = dict(op="AllReduce", bytes=16384, dtype="float32",
+                  axes=["ranks"], world=8)
+    emission = dict(fields, kind="emission", cid="aaaaaaaa", seq=1)
+    recorder_entry = dict(fields, kind="recorder", cid="aaaaaaaa", seq=1)
+    site_json = dict(fields, index=0, prim="tpu_allreduce",
+                     shape=[4096], source="x.py:1")
+    keys = {
+        planmod.key_from_record(rec, "cpu")
+        for rec in (fields, emission, recorder_entry, site_json)
+    }
+    assert keys == {"AllReduce|b15|float32|w8|ranks|cpu"}, keys
+
+
+def test_keys_from_records_folds_quantized_into_allreduce():
+    records = [
+        {"op": "QuantizedAllReduce", "bytes": 16384, "dtype": "float32",
+         "axes": ["ranks"], "world": 8},
+        {"op": "AllReduce", "bytes": 16384, "dtype": "float32",
+         "axes": ["ranks"], "world": 8},
+        {"op": "Barrier", "bytes": 0, "axes": ["ranks"], "world": 8},
+    ]
+    keys = planmod.keys_from_records(records, "cpu")
+    # quantized measurements refine the AllReduce key; Barrier is not
+    # plannable
+    assert keys == ["AllReduce|b15|float32|w8|ranks|cpu"], keys
+
+
+# ---------------------------------------------------------------------
+# cost model impl variants (literal numbers)
+# ---------------------------------------------------------------------
+
+
+def test_cost_impl_pallas_ring_same_bytes_distinct_algorithm():
+    base = costmodel.cost("AllReduce", nbytes=4096, world=8,
+                          dtype="float32")
+    ring = costmodel.cost("AllReduce", nbytes=4096, world=8,
+                          dtype="float32", impl="pallas_ring")
+    assert ring["wire_bytes"] == base["wire_bytes"] == 7168
+    assert ring["steps"] == base["steps"] == 14
+    assert ring["algorithm"] == "pallas RDMA ring RS+AG"
+    assert ring["impl"] == "pallas_ring"
+    assert "impl" not in base
+
+
+def test_cost_impl_quantized_matches_quantized_op_model():
+    as_impl = costmodel.cost("AllReduce", nbytes=4096, world=8,
+                             dtype="float32", impl="quantized")
+    as_op = costmodel.cost("QuantizedAllReduce", nbytes=4096, world=8,
+                           dtype="float32")
+    assert as_impl["wire_bytes"] == as_op["wire_bytes"] == 3640
+    assert as_impl["steps"] == as_op["steps"]
+    assert as_impl["op"] == "AllReduce"
+
+
+def test_cost_impl_hierarchical_literal():
+    c = costmodel.cost("AllReduce", nbytes=4096, world=8,
+                       dtype="float32", impl="hierarchical",
+                       params={"fast": 4})
+    # fast ring RS+AG: 2*(3/4)*4096 = 6144; slow ring allreduce of the
+    # 1/4 shard over 2 groups: 2*(1/2)*1024 = 1024
+    assert c["wire_bytes"] == 6144 + 1024
+    assert c["steps"] == 2 * 3 + 2 * 1
+    # degenerate/invalid splits fall back to the plain op model
+    flat = costmodel.cost("AllReduce", nbytes=4096, world=8,
+                          dtype="float32", impl="hierarchical",
+                          params={"fast": 3})
+    assert flat["wire_bytes"] == 7168 and "impl" not in flat
+
+
+def test_record_cost_reads_impl_stamp():
+    rec = {"op": "AllReduce", "bytes": 4096, "world": 8,
+           "dtype": "float32", "impl": "quantized"}
+    assert costmodel.record_cost(rec)["wire_bytes"] == 3640
+    del rec["impl"]
+    assert costmodel.record_cost(rec)["wire_bytes"] == 7168
+
+
+# ---------------------------------------------------------------------
+# autotune: seed, refinement, gating
+# ---------------------------------------------------------------------
+
+
+def test_analytic_seed_is_deterministic_and_lossless():
+    keys = autotune.default_keys(**GOLDEN_KEYS)
+    a, _ = autotune.sweep(keys, gbps=25.0, alpha=1e-6)
+    b, _ = autotune.sweep(keys, gbps=25.0, alpha=1e-6)
+    assert a.plan_id == b.plan_id
+    assert a.source == "analytic"
+    assert all(e.impl not in planmod.LOSSY_IMPLS for e in a.entries.values())
+
+
+def test_measured_data_overrides_the_analytic_seed():
+    """Acceptance criterion: tune on a synthetic bandwidth table
+    provably flips at least one plan key away from the analytic
+    seed."""
+    keys = autotune.default_keys(**GOLDEN_KEYS)
+    seed, _ = autotune.sweep(keys, gbps=25.0, alpha=1e-6)
+    tuned, report = autotune.sweep(keys, measured=GOLDEN_TABLE,
+                                   gbps=25.0, alpha=1e-6)
+    flipped = [k for k in seed.entries
+               if tuned.entries[k].impl != seed.entries[k].impl]
+    assert flipped, "the measured table must flip at least one key"
+    for k in flipped:
+        assert seed.entries[k].impl == "hlo"
+        assert tuned.entries[k].impl == "pallas_ring"
+        assert tuned.entries[k].source == "measured"
+        assert tuned.entries[k].expected_gbps == 100.0
+    assert tuned.source == "measured"
+    # the report names both candidates with their analytic times
+    row = next(r for r in report if r["key"] == flipped[0])
+    impls = {c["impl"] for c in row["candidates"]}
+    assert {"hlo", "pallas_ring"} <= impls
+
+
+def test_pruning_drops_implausible_candidates_before_measurement():
+    # a measured table praising an impl the model prunes must not
+    # resurrect it: pruned candidates are never measured (the GC3
+    # "only measure plausible candidates" move)
+    key = planmod.plan_key("AllReduce", nbytes=16 << 20, dtype="float32",
+                           world=8, axes=("ranks",), platform="cpu")
+    table = {"schema": autotune.TABLE_SCHEMA, "gbps": {"quantized": 1e9}}
+    planobj, report = autotune.sweep(
+        [key], measured=table, allow_lossy=True, gbps=25.0, alpha=1e-6,
+        prune=0.5,  # quantized moves ~4x fewer bytes: hlo gets pruned
+    )
+    (row,) = report
+    pruned = {c["impl"] for c in row["candidates"] if c["pruned"]}
+    assert "hlo" in pruned or "pallas_ring" in pruned
+    for c in row["candidates"]:
+        if c["pruned"]:
+            assert c["measured_gbps"] is None
+
+
+def test_lossy_needs_explicit_opt_in():
+    keys = autotune.default_keys(**GOLDEN_KEYS)
+    table = {"schema": autotune.TABLE_SCHEMA, "gbps": {"quantized": 1e6}}
+    off, _ = autotune.sweep(keys, measured=table, gbps=25.0, alpha=1e-6)
+    assert all(e.impl != "quantized" for e in off.entries.values())
+    on, _ = autotune.sweep(keys, measured=table, allow_lossy=True,
+                           gbps=25.0, alpha=1e-6)
+    assert any(e.impl == "quantized" for e in on.entries.values())
+
+
+def test_measured_table_from_events(tmp_path):
+    # synthetic 1-rank run: impl-stamped emissions + latency samples
+    path = tmp_path / "events-rank0.jsonl"
+    with open(path, "w") as f:
+        for seq, (impl, seconds) in enumerate(
+            [("hlo", 0.001), ("hlo", 0.001), ("pallas_ring", 0.0001)], 1
+        ):
+            cid = f"c{seq}"
+            f.write(json.dumps({
+                "kind": "emission", "rank": 0, "seq": seq, "cid": cid,
+                "op": "AllReduce", "bytes": 1 << 20, "dtype": "float32",
+                "axes": ["ranks"], "world": 8, "impl": impl, "t": seq,
+            }) + "\n")
+            f.write(json.dumps({
+                "kind": "latency", "rank": 0, "cid": cid, "op": "AllReduce",
+                "seconds": seconds, "t": seq + 0.5,
+            }) + "\n")
+    table = autotune.measured_table_from_events(
+        [str(tmp_path)], platform="cpu"
+    )
+    assert table["schema"] == autotune.TABLE_SCHEMA
+    assert set(table["gbps"]) == {"hlo", "pallas_ring"}
+    # the ring measured 10x faster on the same fingerprint
+    assert table["gbps"]["pallas_ring"] > 5 * table["gbps"]["hlo"]
+    key = planmod.plan_key("AllReduce", nbytes=1 << 20, dtype="float32",
+                           world=8, axes=("ranks",), platform="cpu")
+    assert key in table["keys"]
+    keys = autotune.keys_from_events([str(tmp_path)], platform="cpu")
+    assert keys == [key]
+
+
+# ---------------------------------------------------------------------
+# cache protocol: round-trip, atomicity, invalidation, restart
+# ---------------------------------------------------------------------
+
+
+def test_cache_roundtrip_and_merge(tmp_path):
+    planobj = golden_plan()
+    cache = tmp_path / "plan.json"
+    planmod.save(planobj, str(cache))
+    loaded = planmod.load(str(cache), platform="cpu")
+    assert loaded.plan_id == planobj.plan_id
+    assert {k: e.to_json() for k, e in loaded.entries.items()} == {
+        k: e.to_json() for k, e in planobj.entries.items()
+    }
+    extra_key = "AllGather|b10|float32|w8|ranks|cpu"
+    merged = planmod.merge(
+        loaded,
+        planmod.Plan(platform="cpu",
+                     entries={extra_key: planmod.PlanEntry("hlo")}),
+    )
+    assert set(merged.entries) == set(loaded.entries) | {extra_key}
+    # no tmp litter after the atomic rename
+    assert [p for p in os.listdir(tmp_path) if ".tmp." in p] == []
+
+
+@pytest.mark.parametrize("tamper,reason", [
+    ("schema", "schema"),
+    ("entries", "fingerprint"),
+    ("platform_load", "topology"),
+    ("torn", "parse"),
+])
+def test_cache_invalidation(tmp_path, tamper, reason):
+    cache = tmp_path / "plan.json"
+    planmod.save(golden_plan(), str(cache))
+    data = json.load(open(cache))
+    if tamper == "schema":
+        data["schema"] = "m4t-plan/999"
+    elif tamper == "entries":
+        key = sorted(data["entries"])[0]
+        data["entries"][key]["impl"] = "hierarchical"
+    if tamper == "torn":
+        open(cache, "w").write(json.dumps(data)[: len(json.dumps(data)) // 2])
+    else:
+        json.dump(data, open(cache, "w"))
+    with pytest.raises(planmod.PlanError) as e:
+        planmod.load(
+            str(cache),
+            platform="tpu:v5e" if tamper == "platform_load" else "cpu",
+        )
+    assert e.value.reason == reason
+
+
+def test_pinned_plan_survives_process_restart(tmp_path):
+    """Acceptance criterion: a tuned plan persisted via
+    ``M4T_PLAN_CACHE`` re-arms in a *fresh process* and routes the
+    pinned impl end to end (the pinned quantized ring shows up in the
+    lowered HLO as collective-permutes instead of an all-reduce)."""
+    key = planmod.plan_key("AllReduce", nbytes=4096 * 4, dtype="float32",
+                           world=8, axes=("ranks",), platform="cpu")
+    planobj = planmod.Plan(platform="cpu", entries={
+        key: planmod.PlanEntry("quantized", source="measured"),
+    })
+    cache = tmp_path / "plan.json"
+    planmod.save(planobj, str(cache))
+    script = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import mpi4jax_tpu as m4t
+from mpi4jax_tpu.parallel import spmd, world_mesh
+from mpi4jax_tpu.planner import dispatch
+
+assert dispatch.active is not None, "plan cache did not arm"
+assert dispatch.active.plan_id == %(plan_id)r, dispatch.active.plan_id
+mesh = world_mesh(8)
+arr = np.arange(8 * 4096, dtype=np.float32).reshape(8, 4096)
+fn = spmd(lambda x: m4t.allreduce(x), mesh=mesh)
+text = jax.jit(lambda x: fn(x)).lower(jnp.asarray(arr)).as_text()
+assert "collective_permute" in text, "quantized ring not routed"
+assert "all_reduce" not in text, "HLO AllReduce still present"
+out = np.asarray(fn(jnp.asarray(arr)))
+exact = arr.sum(axis=0)
+err = np.abs(out[0] - exact).max() / np.abs(exact).max()
+assert err < 0.05, err
+log = dispatch.decision_log()
+assert log.get(%(key)r) == "quantized", log
+print("restart-ok")
+""" % {"plan_id": planobj.plan_id, "key": key}
+    env = dict(
+        os.environ,
+        M4T_PLAN_CACHE=str(cache),
+        M4T_PLATFORM_CLASS="cpu",
+        MPI4JAX_TPU_SKIP_VERSION_CHECK="1",
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", script], env=env,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "restart-ok" in proc.stdout
+
+
+def test_invalid_cache_in_env_warns_and_stays_unarmed(tmp_path):
+    cache = tmp_path / "plan.json"
+    cache.write_text('{"schema": "m4t-plan/999", "entries": {}}')
+    script = (
+        "from mpi4jax_tpu.planner import dispatch\n"
+        "assert dispatch.active is None\n"
+        "print('unarmed-ok')\n"
+    )
+    env = dict(os.environ, M4T_PLAN_CACHE=str(cache),
+               MPI4JAX_TPU_SKIP_VERSION_CHECK="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "unarmed-ok" in proc.stdout
+    assert "ignoring plan cache" in proc.stderr
+
+
+# ---------------------------------------------------------------------
+# golden plan-cache schema pin
+# ---------------------------------------------------------------------
+
+
+def test_plan_cache_golden_pin():
+    """Literal pin of the persisted plan-cache JSON (the ``m4t-plan/1``
+    schema): any change to the key format, entry fields, or fingerprint
+    computation shows up as a diff here. Regen intentionally with
+    ``python tests/test_planner.py --regen``."""
+    got = golden_plan().to_json()
+    with open(GOLDEN) as f:
+        want = json.load(f)
+    assert got == want, (
+        "plan-cache schema drifted from tests/data/plan_golden.json; "
+        "if intentional, regen with `python tests/test_planner.py "
+        "--regen` and bump planner/plan.SCHEMA if the layout changed"
+    )
+
+
+# ---------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------
+
+
+def _run_cli(args, **env_extra):
+    env = dict(os.environ, MPI4JAX_TPU_SKIP_VERSION_CHECK="1",
+               JAX_PLATFORMS="cpu", **env_extra)
+    return subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.planner", *args],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+
+
+def test_cli_selftest():
+    proc = _run_cli(["--selftest"])
+    assert proc.returncode == 0, proc.stderr
+    assert "planner selftest ok" in proc.stdout
+
+
+def test_cli_tune_show_roundtrip(tmp_path):
+    table = tmp_path / "table.json"
+    json.dump(GOLDEN_TABLE, open(table, "w"))
+    cache = tmp_path / "plan.json"
+    proc = _run_cli([
+        "tune", "--cache", str(cache), "--world", "8",
+        "--dtypes", "float32", "--buckets", "13,21,25",
+        "--measured", str(table), "--platform", "cpu", "--json",
+        "--peak-gbps", "25", "--alpha-us", "1",
+    ])
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["plan"]["plan_id"] == golden_plan().plan_id
+    assert cache.exists()
+
+    show = _run_cli(["show", "--cache", str(cache)])
+    assert show.returncode == 0, show.stderr
+    assert golden_plan().plan_id in show.stdout
+    assert "pallas_ring" in show.stdout
+
+    # show on a torn cache: exit 1 with the reason
+    cache.write_text("{broken")
+    bad = _run_cli(["show", "--cache", str(cache)])
+    assert bad.returncode == 1
+    assert "[parse]" in bad.stderr
+
+
+def test_cli_show_without_cache_is_usage_error():
+    proc = _run_cli(["show"], M4T_PLAN_CACHE="")
+    assert proc.returncode == 2
+
+
+# ---------------------------------------------------------------------
+# launch integration: --tune writes a plan, --plan re-arms it
+# ---------------------------------------------------------------------
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+needs_native = pytest.mark.skipif(
+    subprocess.run(["which", "g++"], capture_output=True).returncode != 0,
+    reason="no C++ toolchain",
+)
+
+_TUNE_SCRIPT = """
+import jax.numpy as jnp
+import mpi4jax_tpu as m4t
+from mpi4jax_tpu.runtime import shm
+x = jnp.arange(2048.0) + shm.rank()
+for _ in range(4):
+    x = m4t.allreduce(x)
+print(f"OK{shm.rank()}")
+"""
+
+
+@needs_native
+def test_launch_tune_writes_plan_and_plan_rearms(tmp_path):
+    """e2e: a 2-rank ``launch --events-dir --plan --tune`` run measures
+    its own collectives, writes a validating plan cache whose keys are
+    the run's emissions, and a second launch arms it via ``--plan``."""
+    import textwrap
+
+    case = str(tmp_path / "case.py")
+    with open(case, "w") as f:
+        f.write(f"import sys; sys.path.insert(0, {REPO!r})\n")
+        f.write(textwrap.dedent(_TUNE_SCRIPT))
+    rundir = str(tmp_path / "run")
+    cache = str(tmp_path / "plan.json")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.launch", "-n", "2",
+         "--events-dir", rundir, "--plan", cache, "--tune", case],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert res.returncode == 0, res.stderr
+    assert "OK0" in res.stdout and "OK1" in res.stdout
+    assert "--tune: pinned" in res.stderr, res.stderr
+    planobj = planmod.load(cache, platform="cpu")
+    keys = list(planobj.entries)
+    assert keys, "tune pinned nothing"
+    assert all(k.startswith("AllReduce|") for k in keys), keys
+    assert all(k.endswith("|cpu") for k in keys), keys
+
+    # second run arms the tuned plan in every rank
+    res2 = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.launch", "-n", "2",
+         "--plan", cache, case],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert res2.returncode == 0, res2.stderr
+
+    # a torn cache blocks the launch before any rank spawns
+    open(cache, "w").write("{broken")
+    res3 = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.launch", "-n", "2",
+         "--plan", cache, case],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert res3.returncode == 2, (res3.returncode, res3.stderr)
+    assert "OK0" not in res3.stdout
+
+
+if __name__ == "__main__":
+    if "--regen" in sys.argv:
+        os.makedirs(os.path.dirname(GOLDEN), exist_ok=True)
+        with open(GOLDEN, "w") as f:
+            json.dump(golden_plan().to_json(), f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"regenerated {GOLDEN}")
+    else:
+        sys.exit(pytest.main([__file__, "-v"]))

@@ -1,0 +1,379 @@
+"""Planner dispatch seam, jax half: backward compatibility (unarmed ==
+the legacy heuristics, byte-identical lowering), the numerical parity
+matrix across implementations x dtype x world size, zero overhead when
+unarmed, telemetry impl stamps, and the armed static cost report.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import mpi4jax_tpu as m4t
+from mpi4jax_tpu import config, observability as obs
+from mpi4jax_tpu.parallel import spmd, world_mesh
+from mpi4jax_tpu.planner import dispatch, plan as planmod
+
+from tests.conftest import needs_supported_jax
+
+pytestmark = pytest.mark.tuning
+
+N = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_dispatch(monkeypatch):
+    """Every test starts unarmed with no pins and a deterministic
+    platform class; whatever it arms is torn down again."""
+    monkeypatch.setattr(config, "PLATFORM_CLASS", "cpu")
+    saved = (dispatch.active, dict(dispatch.pins))
+    dispatch.disarm()
+    dispatch.pins = {}
+    yield
+    dispatch.active, dispatch.pins = saved
+    obs.disable()
+    obs.reset()
+
+
+def _mesh(world):
+    return world_mesh(world)
+
+
+def _lowered(world, payload_elems, dtype, op_fn=None):
+    """Lowered StableHLO text of one collective over a world-sized
+    mesh (fresh function object per call: jit caches per identity)."""
+    mesh = _mesh(world)
+    op_fn = op_fn or (lambda x: m4t.allreduce(x))
+    fn = spmd(lambda x: op_fn(x), mesh=mesh)
+    arr = jnp.zeros((world, payload_elems), dtype)
+    return jax.jit(lambda x: fn(x)).lower(arr).as_text()
+
+
+# ---------------------------------------------------------------------
+# backward compatibility: unarmed == the legacy policy, byte for byte
+# ---------------------------------------------------------------------
+
+
+def test_unarmed_lowering_identical_to_explicit_hlo_pin():
+    """Satellite pin: with no plan armed, the dispatch seam reproduces
+    today's behavior byte-identically — the lowered program equals an
+    explicit pin to the impl the legacy heuristic would have chosen."""
+    baseline = _lowered(N, 4096, jnp.float32)
+    assert "all_reduce" in baseline
+    dispatch.set_pins("AllReduce:hlo")
+    pinned = _lowered(N, 4096, jnp.float32)
+    assert pinned == baseline
+
+
+def test_unarmed_small_payload_stays_hlo_even_with_ring_flag(monkeypatch):
+    # the legacy window's lower bound (1 MiB) is preserved verbatim:
+    # latency-bound payloads stay on HLO AllReduce with the flag on
+    monkeypatch.setattr(config, "PALLAS_RING", True)
+    text = _lowered(N, 4096, jnp.float32)
+    assert "all_reduce" in text
+
+
+@needs_supported_jax
+def test_unarmed_ring_flag_matches_ring_pin(monkeypatch):
+    # with the opt-in flag and a >= 1 MiB payload the unarmed seam
+    # routes the Pallas ring exactly as an explicit pin does
+    monkeypatch.setattr(config, "PALLAS_RING", True)
+    flagged = _lowered(N, 1 << 19, jnp.float32)  # 2 MiB f32
+    monkeypatch.setattr(config, "PALLAS_RING", False)
+    dispatch.set_pins("AllReduce:pallas_ring")
+    pinned = _lowered(N, 1 << 19, jnp.float32)
+    assert flagged == pinned
+    assert "all_reduce" not in flagged
+
+
+def test_unarmed_decisions_match_inline_legacy_predicate(monkeypatch):
+    """The refactored default policy (planner/dispatch.default_impl)
+    equals an independent reimplementation of the old
+    ``_use_pallas_ring`` gate over a sweep of payloads/dtypes/flag
+    states, evaluated at real emission sites."""
+    from mpi4jax_tpu.comm import SUM, resolve_comm
+    from mpi4jax_tpu.ops.pallas_ring import ring_gate
+
+    seen = []
+
+    def probe(x):
+        comm = resolve_comm(None)
+        legacy = SUM is SUM and ring_gate(
+            x, comm, min_bytes=1 << 20, max_bytes=1 << 30
+        )
+        got = dispatch.select("AllReduce", x, SUM, comm).impl
+        seen.append((x.size, str(x.dtype), config.PALLAS_RING,
+                     "pallas_ring" if legacy else "hlo", got))
+        return m4t.allreduce(x)
+
+    mesh = _mesh(N)
+    for flag in (False, True):
+        monkeypatch.setattr(config, "PALLAS_RING", flag)
+        for elems, dtype in [(64, jnp.float32), (1 << 19, jnp.float32),
+                             (1 << 19, jnp.bfloat16), (1 << 19, jnp.int32)]:
+            fn = spmd(lambda x: probe(x), mesh=mesh)
+            jax.eval_shape(fn, jnp.zeros((N, elems), dtype))
+    assert seen, "probe never ran"
+    for elems, dtype, flag, want, got in seen:
+        assert want == got, (elems, dtype, flag, want, got)
+
+
+# ---------------------------------------------------------------------
+# zero overhead unarmed (the fault-injection standard)
+# ---------------------------------------------------------------------
+
+
+def test_unarmed_records_carry_no_impl_fields():
+    obs.enable()
+    obs.reset()
+    obs.flight_recorder.reset()
+
+    def program(x):
+        return m4t.allreduce(x * 2)
+
+    spmd(program, mesh=_mesh(N))(jnp.ones((N, 16)))
+    snap = obs.snapshot()
+    assert snap["ops"]["AllReduce"]["emissions"] >= 1
+    for rec in snap["emissions"]:
+        assert "impl" not in rec and "plan" not in rec, rec
+    for rec in obs.flight_recorder.snapshot():
+        assert "impl" not in rec, rec
+
+
+def test_armed_pin_stamps_impl_and_plan_into_telemetry():
+    obs.enable()
+    dispatch.set_pins("AllReduce:quantized")
+
+    spmd(lambda x: m4t.allreduce(x), mesh=_mesh(N))(
+        jnp.ones((N, 512), jnp.float32)
+    )
+    recs = [r for r in obs.snapshot()["emissions"]
+            if r["op"] == "AllReduce"]
+    assert recs and recs[-1]["impl"] == "quantized"
+    assert recs[-1]["plan"] == "env"
+    ring = [r for r in obs.flight_recorder.snapshot()
+            if r["op"] == "AllReduce"]
+    assert ring and ring[-1]["impl"] == "quantized"
+    # perf attribution groups the armed emissions per impl
+    result = obs.perf.attribute({0: obs.snapshot()["emissions"]})
+    row = next(r for r in result["rows"] if r["op"] == "AllReduce")
+    assert row["impl"] == "quantized"
+    assert row["algorithm"].startswith("int8 ring")
+
+
+# ---------------------------------------------------------------------
+# numerical parity matrix: impl x dtype x world (satellite 2)
+# ---------------------------------------------------------------------
+
+_WORLDS = (2, 4, 8)
+_DTYPES = ("float32", "bfloat16")
+
+
+def _payload(world, dtype, seed=0):
+    rng = np.random.RandomState(seed)
+    # 777 elements: deliberately unaligned to every chunk/block size
+    return rng.randn(world, 777).astype(np.float32) * 4.0, dtype
+
+
+def _run_allreduce(world, arr, dtype):
+    mesh = _mesh(world)
+    fn = spmd(lambda x: m4t.allreduce(x), mesh=mesh)
+    return np.asarray(
+        fn(jnp.asarray(arr).astype(dtype)).astype(jnp.float32)
+    )
+
+
+@pytest.mark.parametrize("world", _WORLDS)
+@pytest.mark.parametrize("dtype", _DTYPES)
+@pytest.mark.parametrize("impl", ["hlo", "quantized", "pallas_ring"])
+def test_allreduce_impl_parity(world, dtype, impl, request):
+    """Every plannable AllReduce impl agrees with the exact reduction
+    (allclose; bit-identical to unarmed for the hlo impl) at every
+    world size — the dispatch seam must never change answers."""
+    if impl == "pallas_ring":
+        if world != jax.device_count():
+            pytest.skip("ring kernels need the axis to span the mesh")
+        request.applymarker(needs_supported_jax)
+        from tests.conftest import JAX_BELOW_MINIMUM
+
+        if JAX_BELOW_MINIMUM:
+            pytest.skip("pallas ring needs jax >= minimum")
+    arr, _ = _payload(world, dtype)
+    baseline = _run_allreduce(world, arr, dtype)  # unarmed
+    dispatch.set_pins(f"AllReduce:{impl}")
+    out = _run_allreduce(world, arr, dtype)
+    exact = arr.sum(axis=0)
+    scale = max(np.abs(exact).max(), 1e-6)
+    if impl == "hlo":
+        # pinning the default must be *bit-identical* to unarmed
+        np.testing.assert_array_equal(out, baseline)
+    tol = 0.05 if impl == "quantized" else (0.02 if dtype == "bfloat16"
+                                            else 1e-5)
+    for r in range(world):
+        err = np.abs(out[r] - exact).max() / scale
+        assert err < tol, (impl, world, dtype, err)
+
+
+@pytest.mark.parametrize("world,grid", [(4, (2, 2)), (8, (2, 4))])
+@pytest.mark.parametrize("dtype", _DTYPES)
+def test_allreduce_hierarchical_parity(world, grid, dtype):
+    devs = np.asarray(jax.devices()[:world]).reshape(grid)
+    mesh = Mesh(devs, ("a", "b"))
+    comm = m4t.Comm(("a", "b"))
+    arr, _ = _payload(world, dtype, seed=1)
+    dispatch.set_pins("AllReduce:hierarchical")
+    fn = shard_map(
+        lambda x: m4t.allreduce(x, comm=comm), mesh=mesh,
+        in_specs=P(("a", "b")), out_specs=P(("a", "b")), check_rep=False,
+    )
+    out = np.asarray(fn(jnp.asarray(arr).astype(dtype)).astype(jnp.float32))
+    # the lowering really is two-level: a reduce-scatter appears
+    text = jax.jit(fn).lower(
+        jnp.asarray(arr).astype(dtype)
+    ).as_text()
+    assert "reduce_scatter" in text or "psum_scatter" in text, (
+        "hierarchical impl did not lower to reduce-scatter"
+    )
+    exact = arr.sum(axis=0)
+    scale = max(np.abs(exact).max(), 1e-6)
+    tol = 0.02 if dtype == "bfloat16" else 1e-5
+    for r in range(world):
+        err = np.abs(out[r] - exact).max() / scale
+        assert err < tol, (world, dtype, err)
+
+
+@pytest.mark.parametrize("world", _WORLDS)
+@pytest.mark.parametrize("op", ["ReduceScatter", "AllGather"])
+def test_rs_ag_hlo_pin_bit_identical(world, op):
+    mesh = _mesh(world)
+    rng = np.random.RandomState(2)
+    if op == "ReduceScatter":
+        arr = rng.randn(world, world, 64).astype(np.float32)
+        op_fn = spmd(lambda x: m4t.reduce_scatter(x), mesh=mesh)
+    else:
+        arr = rng.randn(world, 64).astype(np.float32)
+        op_fn = spmd(lambda x: m4t.allgather(x), mesh=mesh)
+    baseline = np.asarray(op_fn(jnp.asarray(arr)))
+    dispatch.set_pins(f"{op}:hlo")
+    np.testing.assert_array_equal(
+        np.asarray(op_fn(jnp.asarray(arr))), baseline
+    )
+
+
+def test_infeasible_pin_falls_back_to_default():
+    """A pinned impl that cannot run at the emission site (here: the
+    ring on a 2-rank comm that does not span the 8-device mesh, and
+    quantized on an int payload) silently degrades to today's
+    behavior instead of mis-lowering."""
+    arr = np.arange(2 * 64, dtype=np.float32).reshape(2, 64)
+    baseline = _run_allreduce(2, arr, "float32")
+    dispatch.set_pins("AllReduce:pallas_ring")
+    np.testing.assert_array_equal(
+        _run_allreduce(2, arr, "float32"), baseline
+    )
+    dispatch.set_pins("AllReduce:quantized")
+    iarr = np.arange(N * 16, dtype=np.int32).reshape(N, 16)
+    mesh = _mesh(N)
+    fn = spmd(lambda x: m4t.allreduce(x), mesh=mesh)
+    out = np.asarray(fn(jnp.asarray(iarr)))
+    np.testing.assert_array_equal(out[0], iarr.sum(axis=0))
+
+
+# ---------------------------------------------------------------------
+# armed plan routing (in-process)
+# ---------------------------------------------------------------------
+
+
+def test_armed_plan_routes_by_key_and_logs_decisions():
+    key = planmod.plan_key("AllReduce", nbytes=512 * 4, dtype="float32",
+                           world=N, axes=("ranks",), platform="cpu")
+    other = planmod.plan_key("AllReduce", nbytes=1 << 20, dtype="float32",
+                             world=N, axes=("ranks",), platform="cpu")
+    planobj = planmod.Plan(platform="cpu", entries={
+        key: planmod.PlanEntry("quantized", source="measured"),
+        other: planmod.PlanEntry("hlo"),
+    })
+    dispatch.arm(planobj)
+    text = _lowered(N, 512, jnp.float32)
+    assert "all_reduce" not in text and "collective_permute" in text
+    # a payload in a *different* bucket has no entry: default (hlo)
+    text2 = _lowered(N, 4096, jnp.float32)
+    assert "all_reduce" in text2
+    log = dispatch.decision_log()
+    assert log[key] == "quantized"
+    ann = dispatch.bench_annotation()
+    assert ann["id"] == planobj.plan_id
+    assert "quantized" in ann["impls"]["AllReduce"]
+
+
+def test_plan_for_wrong_platform_disarms(capsys):
+    planobj = planmod.Plan(platform="tpu:v5e", entries={})
+    dispatch.arm(planobj)
+    _lowered(N, 64, jnp.float32)
+    assert dispatch.active is None, "wrong-platform plan must disarm"
+    assert "disarming plan" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------
+# plan key <-> fingerprint drift pins (satellite 3)
+# ---------------------------------------------------------------------
+
+
+def test_plan_key_joins_runtime_static_and_recorder_layers():
+    """The same collective seen by (a) the metrics registry, (b) the
+    flight recorder, and (c) the static linter produces one plan key,
+    pinned literally."""
+    from mpi4jax_tpu.analysis import lint
+
+    obs.enable()
+    obs.flight_recorder.reset()
+
+    def program(x):
+        return m4t.allreduce(x + 1)
+
+    spmd(program, mesh=_mesh(N))(jnp.ones((N, 4096), jnp.float32))
+    emission = [r for r in obs.snapshot()["emissions"]
+                if r["op"] == "AllReduce"][-1]
+    recorded = [r for r in obs.flight_recorder.snapshot()
+                if r["op"] == "AllReduce"][-1]
+    report = lint(program, (jax.ShapeDtypeStruct((4096,), jnp.float32),),
+                  axis_env={"ranks": N})
+    (site,) = [s for s in report.sites if s.op == "AllReduce"]
+    keys = {
+        planmod.key_from_record(emission, "cpu"),
+        planmod.key_from_record(recorded, "cpu"),
+        planmod.key_from_record(site.to_json(), "cpu"),
+    }
+    assert keys == {"AllReduce|b15|float32|w8|ranks|cpu"}, keys
+    # and the recorder fingerprint itself is unchanged by the planner
+    from mpi4jax_tpu.observability.recorder import fingerprint
+
+    assert fingerprint(recorded) == site.fingerprint
+
+
+# ---------------------------------------------------------------------
+# static layer: armed cost report carries the impl tag
+# ---------------------------------------------------------------------
+
+
+def test_static_cost_report_reflects_armed_plan():
+    from mpi4jax_tpu.analysis.schedule import cost_report, trace_schedule
+
+    def program(x):
+        return m4t.allreduce(x)
+
+    args = (jax.ShapeDtypeStruct((4096,), jnp.float32),)
+    sched = trace_schedule(program, args, axis_env={"ranks": N})
+    plain = cost_report(sched)
+    assert all("impl" not in g for g in plain["top"])
+
+    dispatch.set_pins("AllReduce:quantized")
+    armed = cost_report(sched)
+    (top,) = [g for g in armed["top"] if g["op"] == "AllReduce"]
+    assert top["impl"] == "quantized"
+    # quantized moves fewer wire bytes than the exact ring
+    plain_top = [g for g in plain["top"] if g["op"] == "AllReduce"][0]
+    assert top["wire_bytes"] < plain_top["wire_bytes"]
