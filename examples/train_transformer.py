@@ -108,6 +108,7 @@ def main():
     import jax.numpy as jnp
     import numpy as np
 
+    from mpi4jax_tpu import observability as obs
     from mpi4jax_tpu.models import attention as tfm
     from mpi4jax_tpu.parallel import spmd, world_mesh
 
@@ -184,6 +185,10 @@ def main():
     first = last = None
     loss = None
     for i in range(start_step, args.steps):
+        # liveness for the hang analysis: a jitted step emits its
+        # collectives once at trace, so without this a long training
+        # run looks dead to the doctor (no-op when no sink is armed)
+        obs.heartbeat("train_step", step=i)
         params, loss = step(params)
         lval = get_loss((params, loss))
         if i == start_step:

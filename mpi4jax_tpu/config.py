@@ -34,9 +34,23 @@ prefix matching the bench/watch driver family, long prefix accepted):
 - ``M4T_TELEMETRY_FSYNC``: truthy -> fsync the event sink after every
   record (crash-safe flush: the final pre-hang events survive a
   SIGKILL; costs one fsync per record).
+- ``M4T_TELEMETRY_MAX_MB``: float MiB -> size-cap the JSONL event
+  sink: when the live file exceeds the cap it rotates to ``.1`` (and
+  ``.1`` to ``.2``; older segments are dropped), so a long-lived run
+  cannot fill the disk. Readers (doctor / perf / live tailer) merge
+  rotated segments transparently. 0 (default) = unbounded.
 - ``M4T_HEARTBEAT``: float seconds -> emit periodic ``heartbeat``
   events through the sink from a daemon thread (the doctor's
   liveness signal distinguishing a hung rank from a slow one).
+
+Live telemetry plane (``observability/{live,stream_doctor,export}.py``):
+
+- ``M4T_LIVE_GRACE``: float seconds the streaming doctor waits with
+  the world stalled (no new emission/exec/latency record from any
+  rank) before *confirming* a hang/wedge verdict — in-flight seq skew
+  is normal, a persistent global stall is not (default 5.0).
+- ``M4T_LIVE_INTERVAL``: poll period of the launcher-side live
+  monitor in seconds (default 0.5).
 
 Static analysis (``analysis/``):
 
@@ -201,8 +215,17 @@ TELEMETRY_EVENTS = os.environ.get(
 TELEMETRY_RESERVOIR = max(1, env_int("M4T_TELEMETRY_RESERVOIR", 256))
 #: fsync the event sink after each record (crash-safe flush mode)
 TELEMETRY_FSYNC = env_flag2("M4T_TELEMETRY_FSYNC", "MPI4JAX_TPU_TELEMETRY_FSYNC")
+#: event-sink rotation cap in MiB (0 = unbounded; rotated segments
+#: keep ``.1``/``.2`` suffixes and are merged back by every reader)
+TELEMETRY_MAX_MB = max(0.0, env_float("M4T_TELEMETRY_MAX_MB", 0.0))
 #: heartbeat period in seconds (0 = no heartbeat thread)
 HEARTBEAT_S = max(0.0, env_float("M4T_HEARTBEAT", 0.0))
+
+#: streaming-doctor stall grace: a hang/wedge verdict is confirmed
+#: only after the whole world made no progress for this long
+LIVE_GRACE_S = max(0.1, env_float("M4T_LIVE_GRACE", 5.0))
+#: live monitor poll period
+LIVE_INTERVAL_S = max(0.05, env_float("M4T_LIVE_INTERVAL", 0.5))
 
 #: cost-model peak link bandwidth override in GB/s (0 = auto: match
 #: the device generation, else costmodel.DEFAULT_PEAK_GBPS)

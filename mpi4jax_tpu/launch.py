@@ -24,6 +24,19 @@ the artifact layout the cross-rank doctor consumes. On any failure
 tears the world down and prints the doctor's diagnosis: which rank
 diverged/hung at which collective sequence number.
 
+Live telemetry plane (``--live`` / ``--dashboard`` /
+``--metrics-port``): a launcher-side monitor tails the per-rank sinks
+*while the world runs* (``observability/live.py``), streams the
+doctor's verdicts (``stream_doctor.py``) and exports OpenMetrics
+(``export.py``: ``DIR/metrics.prom`` snapshot + optional localhost
+``/metrics`` endpoint). A hang **confirmed** by the streaming doctor
+(the world stalled past ``--live-grace`` with a named wedged/behind
+rank) tears the world down immediately with the diagnosis attached —
+seconds after the wedge instead of at ``--hang-timeout`` — and
+confirmed straggler/anomaly verdicts land as ``retune`` events in
+``DIR/live.jsonl`` that ``--tune`` and ``planner tune
+--from-verdicts`` feed back through the autotuner.
+
 Pre-flight verification (``--verify``): before any rank spawns, the
 target's ``M4T_LINT_TARGETS`` are linted and every rank's concrete
 collective schedule is enumerated and simulated at ``-n`` ranks
@@ -113,9 +126,10 @@ def _run_tune(events_dir, plan_path):
     """``--tune``: post-run autotuning over the artifacts this world
     just wrote — derive per-impl achieved bandwidth via the perf
     attribution join, sweep the keys the run actually emitted (cost-
-    model seeded), and pin the winners into ``plan_path`` (merged over
-    any existing cache). Best-effort like the doctor: a tune failure
-    must not change the run's exit code."""
+    model seeded, plus any keys the streaming doctor's ``retune``
+    recommendations name), and pin the winners into ``plan_path``
+    (merged over any existing cache). Best-effort like the doctor: a
+    tune failure must not change the run's exit code."""
     try:
         from . import config
         from .planner import autotune, plan as _plan
@@ -125,12 +139,24 @@ def _run_tune(events_dir, plan_path):
             [events_dir], platform=platform
         )
         keys = autotune.keys_from_events([events_dir], platform=platform)
+        # the closed loop: live straggler/anomaly verdicts recommend
+        # keys too (normally a subset of the emitted set, but a
+        # rotated-away emission can survive only in its verdict)
+        vkeys = autotune.keys_from_verdicts(
+            [events_dir], platform=platform
+        )
+        keys += [k for k in vkeys if k not in keys]
         if not keys:
             sys.stderr.write(
                 "mpi4jax_tpu.launch: --tune: no plannable emissions in "
                 f"{events_dir}; nothing to tune\n"
             )
             return
+        if vkeys:
+            sys.stderr.write(
+                f"mpi4jax_tpu.launch: --tune: {len(vkeys)} key(s) "
+                "flagged by live retune recommendations\n"
+            )
         planobj, report = autotune.sweep(keys, measured=table)
         if os.path.exists(plan_path):
             try:
@@ -248,6 +274,7 @@ def _spawn_world(
     # nonzero u32: 0 means "no generation check" to the extension
     shm_gen = random.getrandbits(32) | 1
     procs = []
+    monitor = None
     try:
         for rank in range(args.nproc):
             env = dict(os.environ)
@@ -288,12 +315,16 @@ def _spawn_world(
                     M4T_FLIGHT_RECORDER_DIR=events_dir,
                     M4T_HEARTBEAT=str(args.heartbeat),
                 )
-                if args.perf or args.tune:
-                    # --tune needs the runtime latency samples too:
-                    # they are the measured side of the sweep
+                if args.perf or args.tune or args.live:
+                    # --tune needs the runtime latency samples (the
+                    # measured side of the sweep); --live needs them
+                    # for the exec-start wedge evidence, straggler
+                    # samples, and the anomaly feed
                     env.update(
                         M4T_TELEMETRY_RUNTIME="1",
-                        M4T_PERF_WATCH="1" if args.perf else "0",
+                        M4T_PERF_WATCH=(
+                            "1" if (args.perf or args.live) else "0"
+                        ),
                     )
             cmd = [sys.executable]
             if os.environ.get("M4T_LAUNCH_COVERAGE"):
@@ -307,6 +338,21 @@ def _spawn_world(
                 cmd += ["-m", args.module]
             cmd += args.cmd
             procs.append(subprocess.Popen(cmd, env=env))
+
+        if args.live and events_dir:
+            # launcher-side live telemetry plane: tail the per-rank
+            # sinks, stream the doctor, export OpenMetrics — and let
+            # a *confirmed* hang tear the world down with a named
+            # culprit instead of waiting out --hang-timeout
+            from .observability.live import LiveMonitor
+
+            monitor = LiveMonitor(
+                events_dir,
+                grace_s=args.live_grace,
+                prom_path=os.path.join(events_dir, "metrics.prom"),
+                http_port=args.metrics_port,
+                dashboard=args.dashboard,
+            ).start()
 
         exit_code = 0
         done = [False] * len(procs)
@@ -345,6 +391,38 @@ def _spawn_world(
                         p.kill()
                 for p in procs:
                     p.wait()
+                break
+            if (
+                monitor is not None
+                and not all(done)
+                and term_deadline is None
+                and monitor.escalation() is not None
+            ):
+                # the streaming doctor *confirmed* a hang/mismatch:
+                # act now, with the diagnosis attached, instead of
+                # burning the rest of --hang-timeout
+                alive = [i for i, p in enumerate(procs) if p.poll() is None]
+                args._live_report = monitor.escalation()
+                sys.stderr.write(
+                    "mpi4jax_tpu.launch: streaming doctor confirmed a "
+                    f"verdict; rank(s) {','.join(map(str, alive))} "
+                    "still running — terminating world early\n"
+                    + monitor.doctor.format_escalation() + "\n"
+                )
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                grace = time.monotonic() + 5.0
+                while time.monotonic() < grace and any(
+                    p.poll() is None for p in procs
+                ):
+                    time.sleep(0.05)
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                for p in procs:
+                    p.wait()
+                exit_code = 124
                 break
             if deadline is not None and not all(done) and (
                 time.monotonic() > deadline
@@ -386,6 +464,8 @@ def _spawn_world(
             p.wait()
         return 130
     finally:
+        if monitor is not None:
+            monitor.stop()
         # shm_unlink parity: rank 0's atexit unlinks; sweep in case it
         # died before doing so.
         path = "/dev/shm" + shm_name
@@ -427,6 +507,34 @@ def main(argv=None):
         help="always print the cross-rank diagnosis at the end, not "
         "just on failure (requires --events-dir); a mismatch the "
         "backend happened to survive still gets named",
+    )
+    parser.add_argument(
+        "--live", action="store_true",
+        help="live telemetry plane (requires --events-dir): tail the "
+        "per-rank sinks while the world runs, stream the doctor's "
+        "verdicts (a confirmed hang tears the world down with the "
+        "diagnosis *before* --hang-timeout), write an OpenMetrics "
+        "snapshot to EVENTS_DIR/metrics.prom, and record verdict + "
+        "retune events in EVENTS_DIR/live.jsonl; implies runtime "
+        "latency sampling and the perf anomaly watch in every rank",
+    )
+    parser.add_argument(
+        "--live-grace", type=float, default=None, metavar="S",
+        help="streaming-doctor stall grace: a hang verdict is "
+        "confirmed only after the whole world made no progress for S "
+        "seconds (default M4T_LIVE_GRACE, 5s)",
+    )
+    parser.add_argument(
+        "--dashboard", action="store_true",
+        help="print a one-line live status to stderr every ~2s "
+        "(implies --live; the full-screen view is `python -m "
+        "mpi4jax_tpu.observability.live DIR --follow`)",
+    )
+    parser.add_argument(
+        "--metrics-port", type=int, default=None, metavar="N",
+        help="serve the live OpenMetrics text on "
+        "http://127.0.0.1:N/metrics while the world runs (implies "
+        "--live; 0 picks a free port)",
     )
     parser.add_argument(
         "--perf", action="store_true",
@@ -518,6 +626,11 @@ def main(argv=None):
             return rc
 
     events_dir = args.events_dir
+    if args.dashboard or args.metrics_port is not None:
+        args.live = True
+    if args.live and not events_dir:
+        parser.error("--live requires --events-dir (the per-rank "
+                     "sinks are what it tails)")
     if args.perf and not events_dir:
         parser.error("--perf requires --events-dir (it reads the "
                      "per-rank latency events back)")
@@ -527,6 +640,11 @@ def main(argv=None):
     if events_dir:
         events_dir = os.path.abspath(events_dir)
         os.makedirs(events_dir, exist_ok=True)
+
+    # the streaming doctor's confirmed report of the last attempt, if
+    # any (stashed by _spawn_world on live escalation): the supervisor
+    # classifies it when the offline doctor can't read anything
+    args._live_report = None
 
     args.plan_cache_env = None
     if args.plan:
@@ -608,8 +726,10 @@ def main(argv=None):
 
     def diagnose_fn(attempt):
         d = state.get("dir")
+        live_report = args._live_report
+        args._live_report = None  # one attempt's evidence only
         if not d:
-            return None
+            return live_report
         try:
             from .observability import doctor
 
@@ -618,12 +738,16 @@ def main(argv=None):
             sys.stderr.write(
                 f"mpi4jax_tpu.launch: doctor failed: {exc!r}\n"
             )
-            return None
-        if report is not None:
-            sys.stderr.write(
-                "mpi4jax_tpu.launch: post-mortem diagnosis "
-                f"({d}):\n{doctor.format_report(report)}\n"
-            )
+            return live_report
+        if report is None:
+            # nothing readable post-mortem: the streaming doctor's
+            # confirmed report (same m4t-doctor/1 schema) still lets
+            # the supervisor classify transient vs deterministic
+            return live_report
+        sys.stderr.write(
+            "mpi4jax_tpu.launch: post-mortem diagnosis "
+            f"({d}):\n{doctor.format_report(report)}\n"
+        )
         return report
 
     def resume_fn():

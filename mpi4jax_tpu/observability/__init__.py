@@ -42,6 +42,30 @@ Performance attribution (:mod:`.costmodel` + :mod:`.perf`):
    samples (``M4T_PERF_WATCH=1``), and the ``perf
    {history,gate,compare}`` bench-trajectory regression CLI.
 
+Live telemetry plane (:mod:`.live` + :mod:`.stream_doctor` +
+:mod:`.export`):
+
+9. **live** — a launcher-side aggregator tailing the per-rank sinks
+   *while they are written* (torn-line-safe, rotation-aware; no
+   network): rolling per-rank liveness, cross-rank seq skew, per-
+   (op, impl, plan-key) throughput. ``python -m
+   mpi4jax_tpu.observability.live RUNDIR`` is the terminal view.
+10. **stream_doctor** — the doctor's verdicts raised incrementally
+    (mismatch immediately, hang/wedge after a stall grace), appended
+    to ``live.jsonl`` with the supervisor's recovery class, plus
+    ``retune`` recommendation events carrying the affected plan keys
+    — the evidence ``planner tune --from-verdicts`` re-pins from.
+11. **export** — OpenMetrics/Prometheus text: a periodic atomic
+    ``metrics.prom`` snapshot and an optional localhost HTTP
+    ``/metrics`` endpoint (``launch --metrics-port``).
+
+Long-lived runs: every JSONL sink honors ``M4T_TELEMETRY_MAX_MB``
+(size-capped rotation, ``.1``/``.2`` segments) and every reader —
+doctor, perf, live — merges the rotated segments transparently.
+:func:`heartbeat` / :func:`start_heartbeat` are the library-level
+liveness hooks long step loops should call so a compute-heavy phase
+does not look dead to the hang analysis.
+
 Layers 1–3 are no-ops unless enabled (``M4T_TELEMETRY=1`` or
 :func:`enable`); the flight recorder stays on (one dict append per
 trace-time emission) unless ``M4T_FLIGHT_RECORDER=0``. See
@@ -51,6 +75,7 @@ trace-time emission) unless ``M4T_FLIGHT_RECORDER=0``. See
 from . import events  # noqa: F401
 from . import metrics  # noqa: F401
 from . import recorder  # noqa: F401
+from .events import heartbeat, start_heartbeat  # noqa: F401
 from .metrics import (  # noqa: F401
     MetricsRegistry,
     Reservoir,
@@ -68,11 +93,11 @@ from .recorder import recorder as flight_recorder  # noqa: F401
 
 
 def __getattr__(name):
-    # costmodel/perf resolve lazily (like doctor/trace they are
-    # offline-analysis modules; eager import here would also make
-    # `python -m mpi4jax_tpu.observability.perf` warn about the
-    # module pre-existing in sys.modules)
-    if name in ("costmodel", "perf"):
+    # costmodel/perf/live/stream_doctor/export resolve lazily (like
+    # doctor/trace they are monitor-side modules; eager import here
+    # would also make `python -m mpi4jax_tpu.observability.perf` warn
+    # about the module pre-existing in sys.modules)
+    if name in ("costmodel", "perf", "live", "stream_doctor", "export"):
         import importlib
 
         return importlib.import_module(f".{name}", __name__)
@@ -104,7 +129,10 @@ __all__ = [
     "enable",
     "enabled",
     "events",
+    "export",
     "flight_recorder",
+    "heartbeat",
+    "live",
     "metrics",
     "perf",
     "perf_report",
@@ -114,4 +142,6 @@ __all__ = [
     "reset",
     "runtime_enabled",
     "snapshot",
+    "start_heartbeat",
+    "stream_doctor",
 ]

@@ -61,7 +61,12 @@ Finding kinds and their stable fields:
 - ``hang`` — ``rank``, ``verdict`` (``hung``/``dead``/``behind``),
   ``last_seq``, ``front_seq``, ``gap``, ``front_ranks``,
   ``stuck_before`` (fingerprint or null), ``last_heartbeat_t``,
-  ``last_emission_t``, optional ``static_sites``, optional
+  ``last_emission_t``, optional ``wedged`` (true when the rank
+  *recorded* its last collective but — per the ``exec`` records
+  runtime sampling mirrors to the sink — never began executing it
+  while a peer did: the equal-seq hang a stream-length gap cannot
+  show; ``gap`` is 0 and ``stuck_before`` is the rank's own
+  never-executed collective), optional ``static_sites``, optional
   ``schedule_position`` (with ``--static``: the hung rank's position
   in its *simulated* per-rank schedule — ``expected_next`` names the
   collective it should emit next, ``peers_next`` what each peer
@@ -313,6 +318,86 @@ def _find_hang(
     return findings
 
 
+def _executed_seqs(records: List[Dict[str, Any]]) -> set:
+    """Alignment keys (seqs) this rank is known to have begun
+    executing: ``exec`` records (runtime-start mirror, see
+    ``metrics.mark_runtime_start``) and ``latency`` records (an end
+    implies a start)."""
+    out = set()
+    for rec in records:
+        if rec.get("kind") in ("exec", "latency") and isinstance(
+            rec.get("seq"), int
+        ):
+            out.add(rec["seq"])
+    return out
+
+
+def _find_wedged(
+    streams: Dict[int, List[Dict[str, Any]]],
+    by_rank: Dict[int, List[Dict[str, Any]]],
+) -> List[Dict[str, Any]]:
+    """Equal-seq hangs the gap analysis cannot see.
+
+    A rank that wedges *between recording a collective and executing
+    it* (a stall in trace, a fault-injected hang, a deadlock before
+    the native call) leaves the same stream length as its peers: the
+    emission is written before the stall, and the peers — having
+    entered the collective — block waiting for it, so nobody gets a
+    seq ahead. The tiebreaker is the execution-side evidence runtime
+    sampling leaves behind: ``exec``/``latency`` records. A rank at
+    the front seq with *no* execution record for it, while some peer
+    at the same seq has one, is stuck **before** its own last
+    collective. Guard: the stuck rank must have execution records for
+    earlier seqs (proof its callback path works), so a backend that
+    never delivers callbacks can't be misread as wedged."""
+    if len(streams) < 2:
+        return []
+    last_seq = {rank: (s[-1]["seq"] if s else 0) for rank, s in streams.items()}
+    front = max(last_seq.values(), default=0)
+    if front <= 0:
+        return []
+    at_front = [r for r, s in last_seq.items() if s == front]
+    if len(at_front) < 2:
+        return []
+    executed = {r: _executed_seqs(by_rank.get(r, [])) for r in at_front}
+    started = sorted(r for r in at_front if front in executed[r])
+    stuck = sorted(
+        r for r in at_front if executed[r] and front not in executed[r]
+    )
+    if not started or not stuck:
+        return []
+    findings = []
+    for rank in stuck:
+        stream = streams[rank]
+        rec = stream[-1]
+        last_emit_t = (
+            rec.get("t") if isinstance(rec.get("t"), (int, float)) else None
+        )
+        hb_t = _last_heartbeat_t(by_rank.get(rank, []))
+        if hb_t is not None and last_emit_t is not None and hb_t > last_emit_t + 1.0:
+            verdict = "hung"
+        elif hb_t is not None:
+            verdict = "dead"
+        else:
+            verdict = "behind"
+        findings.append(
+            {
+                "kind": "hang",
+                "rank": rank,
+                "verdict": verdict,
+                "last_seq": front,
+                "front_seq": front,
+                "gap": 0,
+                "front_ranks": started,
+                "stuck_before": fingerprint(rec),
+                "last_heartbeat_t": hb_t,
+                "last_emission_t": last_emit_t,
+                "wedged": True,
+            }
+        )
+    return findings
+
+
 def _find_stragglers(
     by_rank: Dict[int, List[Dict[str, Any]]],
     ratio: float,
@@ -382,9 +467,15 @@ def analyze(
     order in which a human should read them: a mismatch usually
     *causes* the hang that follows it)."""
     streams = {rank: collective_stream(recs) for rank, recs in by_rank.items()}
+    mismatches = _find_mismatch(streams)
     findings = (
-        _find_mismatch(streams)
+        mismatches
         + _find_hang(streams, by_rank, hang_gap)
+        # the wedge tiebreaker only when the program didn't fork: a
+        # mismatch at the front seq already explains why nobody there
+        # executed (different collectives can't rendezvous), and the
+        # culprit is the divergence, not a wedged rank
+        + ([] if mismatches else _find_wedged(streams, by_rank))
         + _find_stragglers(by_rank, straggler_ratio, straggler_min_samples)
     )
     return {
@@ -612,13 +703,23 @@ def _fmt_finding(f: Dict[str, Any]) -> str:
             "dead": "RANK DIED",
             "behind": "RANK BEHIND (hung or slow; no heartbeat to tell)",
         }[f["verdict"]]
-        txt = (
-            f"{head}: rank {f['rank']} stopped at seq {f['last_seq']}, "
-            f"{f['gap']} seq(s) behind rank(s) "
-            f"{','.join(str(r) for r in f['front_ranks'])} (at seq {f['front_seq']})"
-        )
-        if f.get("stuck_before"):
-            txt += f"\n  peers' next collective was: {f['stuck_before']}"
+        if f.get("wedged"):
+            txt = (
+                f"{head}: rank {f['rank']} recorded seq {f['last_seq']} "
+                f"but never began executing it; rank(s) "
+                f"{','.join(str(r) for r in f['front_ranks'])} entered "
+                "the collective and are waiting on it"
+            )
+            if f.get("stuck_before"):
+                txt += f"\n  stuck before: {f['stuck_before']}"
+        else:
+            txt = (
+                f"{head}: rank {f['rank']} stopped at seq {f['last_seq']}, "
+                f"{f['gap']} seq(s) behind rank(s) "
+                f"{','.join(str(r) for r in f['front_ranks'])} (at seq {f['front_seq']})"
+            )
+            if f.get("stuck_before"):
+                txt += f"\n  peers' next collective was: {f['stuck_before']}"
         for site in f.get("static_sites", ()):
             where = "/".join(site["path"]) or "<root>"
             txt += f"\n    declared at {site['source']} [{where}]"

@@ -34,6 +34,15 @@ placeholder in the sink path (``M4T_TELEMETRY_EVENTS`` or
 cross-rank doctor (:mod:`.doctor`) consumes. ``fsync=True`` (or
 ``M4T_TELEMETRY_FSYNC=1``) additionally fsyncs after every record so
 the final pre-hang events of a killed rank actually reach disk.
+
+Long-lived runs can cap the sink (``M4T_TELEMETRY_MAX_MB``, or
+``EventLog(max_bytes=...)``): when the live file grows past the cap
+it is rotated to ``<path>.1`` (and a previous ``.1`` to ``.2``;
+anything older is dropped), so telemetry can never fill the disk.
+Readers go through :func:`iter_records`/:func:`read`, which merge the
+rotated segments back oldest-first — the doctor, the perf
+attribution, and the live tailer (:mod:`.live`) all see one
+continuous stream.
 """
 
 from __future__ import annotations
@@ -111,16 +120,54 @@ class EventLog:
     whole lines on disk at every return, but an OS crash may still
     lose the tail.
 
+    ``max_bytes`` (default: ``M4T_TELEMETRY_MAX_MB``; 0 = unbounded)
+    rotates the file once an append pushes it past the cap: the live
+    file becomes ``<path>.1``, a previous ``.1`` becomes ``.2``, and
+    an old ``.2`` is dropped — at most ~3x the cap on disk per sink.
+
     A ``{rank}`` placeholder in ``path`` is expanded via
     :func:`expand_rank_template` at construction.
     """
 
-    def __init__(self, path: str, *, echo: bool = False, fsync: bool = False):
+    def __init__(
+        self,
+        path: str,
+        *,
+        echo: bool = False,
+        fsync: bool = False,
+        max_bytes: Optional[int] = None,
+    ):
         self.path = expand_rank_template(os.fspath(path))
         self.echo = bool(echo)
         self.fsync = bool(fsync)
+        if max_bytes is None:
+            max_bytes = int(config.TELEMETRY_MAX_MB * (1 << 20))
+        self.max_bytes = max(0, int(max_bytes))
         self._lock = threading.Lock()
         self._file = None
+
+    def _rotate_locked(self) -> None:
+        """Shift ``path`` -> ``path.1`` -> ``path.2`` (oldest segment
+        dropped) and recreate an empty live file. Caller holds the
+        lock; the open handle (fsync mode) is closed first so the
+        rename moves a complete file. The live path always exists
+        after an append — the layout contract directory scanners
+        (doctor ``*.jsonl`` glob, the live tailer) rely on."""
+        if self._file is not None and not self._file.closed:
+            self._file.close()
+            self._file = None
+        for src, dst in (
+            (self.path + ".1", self.path + ".2"),
+            (self.path, self.path + ".1"),
+        ):
+            try:
+                os.replace(src, dst)
+            except OSError:
+                pass  # first rotation has no ".1" yet; never fatal
+        try:
+            open(self.path, "a").close()
+        except OSError:
+            pass
 
     def append(self, record: Dict[str, Any]) -> Dict[str, Any]:
         """Stamp ``ts`` (if absent), append one line, return the
@@ -136,9 +183,13 @@ class EventLog:
                     self._file = open(self.path, "a", buffering=1)
                 self._file.write(line + "\n")
                 os.fsync(self._file.fileno())
+                size = self._file.tell()
             else:
                 with open(self.path, "a") as f:
                     f.write(line + "\n")
+                    size = f.tell()
+            if self.max_bytes and size >= self.max_bytes:
+                self._rotate_locked()
         if self.echo:
             print(line, flush=True)
         return rec
@@ -154,26 +205,38 @@ class EventLog:
 
 def read(path: str) -> List[Dict[str, Any]]:
     """Load every record of a JSONL file (skipping malformed lines —
-    a crashed writer may leave a torn final line)."""
+    a crashed writer may leave a torn final line), including any
+    rotated ``.1``/``.2`` segments, oldest first."""
     return list(iter_records(path))
 
 
+def segment_paths(path: str) -> List[str]:
+    """The on-disk segments of one (possibly rotated) sink, in read
+    order: ``path.2`` (oldest), ``path.1``, ``path``. The live file is
+    always included even if absent (the caller's open handles the
+    OSError); rotated segments only when they exist."""
+    out = [p for p in (path + ".2", path + ".1") if os.path.exists(p)]
+    out.append(path)
+    return out
+
+
 def iter_records(path: str) -> Iterator[Dict[str, Any]]:
-    try:
-        f = open(path)
-    except OSError:
-        return
-    with f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if isinstance(rec, dict):
-                yield rec
+    for segment in segment_paths(path):
+        try:
+            f = open(segment)
+        except OSError:
+            continue
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict):
+                    yield rec
 
 
 # -- module default sink (op-emission telemetry) ----------------------

@@ -1,0 +1,888 @@
+"""Live telemetry plane: tail per-rank sinks while the run is alive.
+
+Everything the offline stack (doctor / perf / trace) knows, it learns
+from the per-rank fsync'd JSONL artifacts — *after* the world is dead.
+This module reads the same artifacts **while they are being written**:
+
+- :class:`TailReader` — torn-line-safe incremental reader for one
+  JSONL sink. Bytes after the last newline are never parsed (a rank
+  may be mid-``write``); they are picked up — exactly once — on the
+  poll after the line completes. Rotated segments
+  (``events.EventLog(max_bytes=...)``: ``.1``/``.2`` suffixes) are
+  drained across the rename, so a capped sink still reads as one
+  continuous stream.
+- :class:`LiveAggregator` — discovers the per-rank sinks in a run
+  directory (the ``launch --events-dir`` layout), polls every reader,
+  and maintains rolling state: per-rank last seq / heartbeat age /
+  emission age, cross-rank seq skew, per-(op, impl, plan-key)
+  emission + byte counters and windowed throughput, and the full
+  per-rank record lists in the exact shape ``doctor.load`` produces —
+  so the streaming doctor (:mod:`.stream_doctor`) reuses the offline
+  analyses verbatim and its verdicts agree with the post-mortem ones
+  by construction.
+- :class:`LiveMonitor` — the launcher-side daemon thread: poll, run
+  the streaming doctor, refresh the OpenMetrics snapshot
+  (:mod:`.export`), optionally serve it over localhost HTTP and
+  print a one-line dashboard; expose a confirmed hang/mismatch as an
+  *escalation* the launcher acts on before its blunt
+  ``--hang-timeout`` would.
+
+File-tail only, no network between ranks and monitor — the whole
+plane is device-free-testable (``python -m
+mpi4jax_tpu.observability.live --selftest``) and works post-mortem
+too: pointed at a finished run directory it renders the final state.
+
+CLI::
+
+    python -m mpi4jax_tpu.observability.live RUNDIR          # snapshot
+    python -m mpi4jax_tpu.observability.live RUNDIR --follow # dashboard
+    python -m mpi4jax_tpu.observability.live RUNDIR --json
+    python -m mpi4jax_tpu.observability.live --selftest
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import config
+
+#: sink basenames in a run directory that are *about* the run rather
+#: than from a rank: never tailed into the per-rank record state
+NON_RANK_SINKS = frozenset({"live.jsonl", "supervisor.jsonl"})
+
+#: throughput window for the rolling rates (seconds)
+DEFAULT_WINDOW_S = 30.0
+
+
+# ---------------------------------------------------------------------
+# torn-line-safe file tailing
+# ---------------------------------------------------------------------
+
+
+class TailReader:
+    """Incremental JSONL reader for one (possibly rotating) sink.
+
+    ``poll()`` returns the records of every line *completed* since the
+    last poll. The invariants the streaming doctor depends on:
+
+    - a torn final line (no trailing newline yet) is never parsed; the
+      read offset stays at the last newline, so the line is consumed
+      exactly once, on the poll after the writer finishes it;
+    - rotation (``EventLog`` renames ``path`` to ``path.1``, ``.1`` to
+      ``.2``) never loses or duplicates a record that is still on
+      disk: per-generation read offsets are keyed by *inode* (renames
+      preserve it), and every poll walks the segment chain oldest
+      first — a generation read halfway as the live file is resumed
+      from the same offset at its rotated name. Only data rotated
+      past ``.2`` *and deleted* between two polls is gone, which is
+      the writer's retention decision, not a reader bug;
+    - a missing file is not an error (the rank may not have started
+      yet) — ``poll()`` just returns nothing.
+    """
+
+    #: generation-identity prefix length: rotation recycles inodes
+    #: (the unlinked ``.2``'s inode often becomes the next live file),
+    #: so a generation is (inode, first bytes), not inode alone
+    HEAD_LEN = 64
+
+    def __init__(self, path: str):
+        self.path = os.fspath(path)
+        #: inode -> (first bytes seen, bytes consumed) per generation
+        self._gens: Dict[int, Tuple[bytes, int]] = {}
+
+    def _parse(self, data: bytes) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for line in data.split(b"\n"):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+        return out
+
+    def poll(self) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        new_gens: Dict[int, Tuple[bytes, int]] = {}
+        for p in (self.path + ".2", self.path + ".1", self.path):
+            is_live = p == self.path
+            try:
+                f = open(p, "rb")
+            except OSError:
+                continue
+            with f:
+                # fstat the open fd, not the path: the identity and
+                # the bytes we read are then of the *same* file even
+                # if the writer rotates mid-poll
+                ino = os.fstat(f.fileno()).st_ino
+                head = f.read(self.HEAD_LEN)
+                stored_head, offset = self._gens.get(ino, (b"", 0))
+                if stored_head and not head.startswith(stored_head):
+                    offset = 0  # recycled inode: a brand-new generation
+                if f.seek(0, os.SEEK_END) < offset:
+                    offset = 0  # truncated in place: start over
+                f.seek(offset)
+                data = f.read()
+            if is_live:
+                # only the live file can end in a torn line; rotated
+                # segments are complete by construction
+                cut = data.rfind(b"\n")
+                data = data[: cut + 1] if cut >= 0 else b""
+            out.extend(self._parse(data))
+            # `head` is always the current file's first bytes: right
+            # for a new generation, and a superset of the stored
+            # prefix for a growing one
+            new_gens[ino] = (head, offset + len(data))
+        # generations no longer on disk drop out of the state map
+        self._gens = new_gens
+        return out
+
+
+# ---------------------------------------------------------------------
+# run-directory aggregation
+# ---------------------------------------------------------------------
+
+
+def _rank_of(record: Dict[str, Any], path: str) -> Optional[int]:
+    from . import doctor
+
+    return doctor._rank_of(record, path)
+
+
+class LiveAggregator:
+    """Rolling cross-rank state over a run directory's sinks.
+
+    ``by_rank`` accumulates the raw records per rank — byte-compatible
+    with ``doctor.load`` output, the contract that lets the streaming
+    doctor call the offline analyses unchanged. On top of it, cheap
+    incremental state the dashboard/exporter read without re-scanning:
+    per-rank seq / liveness, per-(op, impl, plan-key) totals, and a
+    windowed byte-rate.
+
+    ``clock`` is injectable (monotonic seconds) so stall timing is
+    testable without sleeping.
+    """
+
+    def __init__(
+        self,
+        rundir: str,
+        *,
+        platform: Optional[str] = None,
+        window_s: float = DEFAULT_WINDOW_S,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.rundir = os.fspath(rundir)
+        self.platform = platform or config.PLATFORM_CLASS or "cpu"
+        self.window_s = float(window_s)
+        self.clock = clock
+        self._readers: Dict[str, TailReader] = {}
+        #: rank -> raw records, the doctor.load shape
+        self.by_rank: Dict[int, List[Dict[str, Any]]] = {}
+        #: rank -> last collective seq seen
+        self.last_seq: Dict[int, int] = {}
+        #: rank -> wall-clock t of the last heartbeat / emission record
+        self.last_heartbeat_t: Dict[int, float] = {}
+        self.last_emission_t: Dict[int, float] = {}
+        #: (op, impl) -> [emissions, payload bytes]
+        self.totals: Dict[Tuple[str, str], List[int]] = {}
+        #: plan key -> [emissions, payload bytes] (plannable ops only)
+        self.key_totals: Dict[str, List[int]] = {}
+        #: rolling (mono_t, op, impl, nbytes) for windowed rates
+        self._window: deque = deque()
+        #: monotonic time of the last *progress* record (emission /
+        #: exec / latency — heartbeats are liveness, not progress)
+        self.progress_t: Optional[float] = None
+        #: monotonic time of the first/last poll that saw anything
+        self.started_t: Optional[float] = None
+        self.records_total = 0
+        self.anomalies_total = 0
+        #: anomaly records new since the last drain (stream doctor's
+        #: retune feed)
+        self._fresh_anomalies: List[Dict[str, Any]] = []
+
+    # -- discovery ----------------------------------------------------
+
+    def discover(self) -> List[str]:
+        """Current sink files: per-rank event sinks and flight-recorder
+        dumps (which appear mid-death). The monitor's own outputs and
+        the supervisor audit are excluded; rotated segments are
+        handled inside each reader, not listed separately."""
+        paths = []
+        for p in sorted(glob.glob(os.path.join(self.rundir, "*.jsonl"))):
+            if os.path.basename(p) in NON_RANK_SINKS:
+                continue
+            paths.append(p)
+        for p in paths:
+            if p not in self._readers:
+                self._readers[p] = TailReader(p)
+        return paths
+
+    # -- ingestion ----------------------------------------------------
+
+    def _ingest(self, rec: Dict[str, Any], path: str, now: float) -> None:
+        rank = _rank_of(rec, path)
+        if rank is None:
+            return
+        self.by_rank.setdefault(rank, []).append(rec)
+        self.records_total += 1
+        kind = rec.get("kind")
+        t = rec.get("t") if isinstance(rec.get("t"), (int, float)) else None
+        if kind == "heartbeat":
+            if t is not None:
+                self.last_heartbeat_t[rank] = max(
+                    self.last_heartbeat_t.get(rank, 0.0), t
+                )
+            return
+        if kind == "anomaly":
+            self.anomalies_total += 1
+            self._fresh_anomalies.append(dict(rec, rank=rank))
+            return
+        if kind in ("emission", "recorder", "exec", "latency"):
+            self.progress_t = now
+        if kind in ("emission", "recorder"):
+            seq = rec.get("seq")
+            if isinstance(seq, int):
+                self.last_seq[rank] = max(self.last_seq.get(rank, 0), seq)
+            if t is not None:
+                self.last_emission_t[rank] = max(
+                    self.last_emission_t.get(rank, 0.0), t
+                )
+            if kind != "emission":
+                # flight-recorder dumps replay emissions the sink
+                # already carries (the doctor dedupes by seq; these
+                # meters must not double-count the traffic) — they
+                # still feed seq/liveness above, which is what a rank
+                # whose sink never flushed needs
+                return
+            op = str(rec.get("op", "?"))
+            impl = str(rec.get("impl") or "-")
+            nbytes = int(rec.get("bytes") or 0)
+            tot = self.totals.setdefault((op, impl), [0, 0])
+            tot[0] += 1
+            tot[1] += nbytes
+            key = self.plan_key_of(rec)
+            if key is not None:
+                ktot = self.key_totals.setdefault(key, [0, 0])
+                ktot[0] += 1
+                ktot[1] += nbytes
+            self._window.append((now, op, impl, nbytes))
+
+    def plan_key_of(self, rec: Dict[str, Any]) -> Optional[str]:
+        """The plan key of one emission record, for plannable ops."""
+        from ..planner import plan as _plan
+
+        op = rec.get("op")
+        if op == "QuantizedAllReduce":
+            rec = dict(rec, op="AllReduce")
+            op = "AllReduce"
+        if op not in _plan.AVAILABLE:
+            return None
+        return _plan.key_from_record(rec, self.platform)
+
+    def poll(self) -> int:
+        """Drain every reader once; returns how many new records were
+        ingested (0 = no movement — the stall signal)."""
+        now = self.clock()
+        if self.started_t is None:
+            self.started_t = now
+        n = 0
+        for path in self.discover():
+            for rec in self._readers[path].poll():
+                self._ingest(rec, path, now)
+                n += 1
+        # age out the rate window
+        horizon = now - self.window_s
+        while self._window and self._window[0][0] < horizon:
+            self._window.popleft()
+        return n
+
+    def drain_anomalies(self) -> List[Dict[str, Any]]:
+        """Anomaly records that arrived since the previous drain (the
+        streaming doctor turns them into retune recommendations)."""
+        fresh, self._fresh_anomalies = self._fresh_anomalies, []
+        return fresh
+
+    # -- reading ------------------------------------------------------
+
+    def stalled_s(self, now: Optional[float] = None) -> Optional[float]:
+        """Seconds since the last progress record (None before any)."""
+        if self.progress_t is None:
+            return None
+        return max(0.0, (self.clock() if now is None else now) - self.progress_t)
+
+    def rates(self) -> Dict[Tuple[str, str], Dict[str, float]]:
+        """Windowed per-(op, impl) emission and byte rates."""
+        now = self.clock()
+        horizon = now - self.window_s
+        span = min(
+            self.window_s,
+            max(1e-9, now - (self.started_t if self.started_t else now)),
+        )
+        acc: Dict[Tuple[str, str], List[float]] = {}
+        for t, op, impl, nbytes in self._window:
+            if t < horizon:
+                continue
+            a = acc.setdefault((op, impl), [0.0, 0.0])
+            a[0] += 1
+            a[1] += nbytes
+        return {
+            k: {"emissions_per_s": v[0] / span, "bytes_per_s": v[1] / span}
+            for k, v in acc.items()
+        }
+
+    def snapshot(self, *, attribute: bool = False) -> Dict[str, Any]:
+        """Plain-JSON live state (the dashboard / exporter input).
+        ``attribute=True`` additionally joins the accumulated records
+        against the cost model (``perf.attribute``) for achieved-GB/s
+        rows — heavier, so only done at refresh cadence."""
+        now_wall = time.time()
+        ranks = sorted(self.by_rank)
+        seqs = {r: self.last_seq.get(r, 0) for r in ranks}
+        front = max(seqs.values(), default=0)
+        snap: Dict[str, Any] = {
+            "rundir": self.rundir,
+            "platform": self.platform,
+            "ranks": ranks,
+            "records": self.records_total,
+            "seqs": {str(r): seqs[r] for r in ranks},
+            "seq_skew": (front - min(seqs.values())) if seqs else 0,
+            "stalled_s": self.stalled_s(),
+            "heartbeat_age_s": {
+                str(r): max(0.0, now_wall - t)
+                for r, t in sorted(self.last_heartbeat_t.items())
+            },
+            "emission_age_s": {
+                str(r): max(0.0, now_wall - t)
+                for r, t in sorted(self.last_emission_t.items())
+            },
+            "totals": {
+                f"{op}|{impl}": {"emissions": v[0], "payload_bytes": v[1]}
+                for (op, impl), v in sorted(self.totals.items())
+            },
+            "plan_keys": {
+                k: {"emissions": v[0], "payload_bytes": v[1]}
+                for k, v in sorted(self.key_totals.items())
+            },
+            "rates": {
+                f"{op}|{impl}": v
+                for (op, impl), v in sorted(self.rates().items())
+            },
+            "anomalies": self.anomalies_total,
+        }
+        if attribute and self.by_rank:
+            from . import perf
+
+            try:
+                snap["attribution"] = perf.attribute(self.by_rank)
+            except Exception:  # pragma: no cover — best-effort join
+                snap["attribution"] = None
+        return snap
+
+
+# ---------------------------------------------------------------------
+# dashboard rendering
+# ---------------------------------------------------------------------
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}B"
+
+
+def _fmt_age(s: Optional[float]) -> str:
+    if s is None:
+        return "-"
+    return f"{s:.1f}s"
+
+
+def render_dashboard(
+    snap: Dict[str, Any], verdicts: Optional[List[Dict[str, Any]]] = None
+) -> str:
+    """Multi-line terminal view of one snapshot."""
+    lines = [
+        f"m4t live: {snap['rundir']}  "
+        f"[{len(snap['ranks'])} rank(s), {snap['records']} records, "
+        f"skew {snap['seq_skew']}, stalled {_fmt_age(snap['stalled_s'])}]"
+    ]
+    if snap["ranks"]:
+        lines.append(f"{'rank':>5} {'seq':>7} {'emit age':>9} {'hb age':>8}")
+        for r in snap["ranks"]:
+            k = str(r)
+            lines.append(
+                f"{r:>5} {snap['seqs'].get(k, 0):>7} "
+                f"{_fmt_age(snap['emission_age_s'].get(k)):>9} "
+                f"{_fmt_age(snap['heartbeat_age_s'].get(k)):>8}"
+            )
+    else:
+        lines.append("(no per-rank sinks yet)")
+    if snap["totals"]:
+        lines.append(
+            f"{'op|impl':<28} {'emits':>7} {'payload':>10} {'rate':>12}"
+        )
+        for key, tot in sorted(snap["totals"].items()):
+            rate = snap["rates"].get(key, {})
+            rate_txt = (
+                f"{_fmt_bytes(rate['bytes_per_s'])}/s"
+                if rate.get("bytes_per_s")
+                else "-"
+            )
+            lines.append(
+                f"{key:<28} {tot['emissions']:>7} "
+                f"{_fmt_bytes(tot['payload_bytes']):>10} {rate_txt:>12}"
+            )
+    attribution = snap.get("attribution")
+    if attribution and attribution.get("rows"):
+        lines.append(
+            f"{'op':<20} {'payload':>9} {'GB/s':>8} {'%peak':>6} {'slow':>6}"
+        )
+        for row in attribution["rows"]:
+            gbps = row.get("achieved_gbps")
+            pct = row.get("pct_of_peak")
+            slow = row.get("slowdown")
+            op_txt = row["op"] + (f"+{row['impl']}" if row.get("impl") else "")
+            lines.append(
+                f"{op_txt:<20} {_fmt_bytes(row['bytes']):>9} "
+                + (f"{gbps:>8.3g}" if gbps is not None else f"{'-':>8}")
+                + (f" {pct:>5.1f}%" if pct is not None else f" {'-':>6}")
+                + (f" {slow:>5.1f}x" if slow is not None else f" {'-':>6}")
+            )
+    if snap.get("anomalies"):
+        lines.append(f"anomalies: {snap['anomalies']}")
+    for v in (verdicts or [])[-5:]:
+        f = v.get("finding", {})
+        lines.append(
+            f"VERDICT [{v.get('klass', '?')}] {f.get('kind', '?')}: "
+            + json.dumps(
+                {k: f[k] for k in ("rank", "seq", "op", "verdict",
+                                   "stuck_before") if k in f},
+                default=str,
+            )
+        )
+    return "\n".join(lines)
+
+
+def status_line(
+    snap: Dict[str, Any], verdicts: Optional[List[Dict[str, Any]]] = None
+) -> str:
+    """One-line launcher-side dashboard (children share the tty)."""
+    seqs = " ".join(f"r{r}:{snap['seqs'][str(r)]}" for r in snap["ranks"])
+    rate = sum(v.get("bytes_per_s", 0.0) for v in snap["rates"].values())
+    txt = (
+        f"live: {seqs or 'no sinks yet'} skew {snap['seq_skew']} "
+        f"stalled {_fmt_age(snap['stalled_s'])} "
+        f"{_fmt_bytes(rate)}/s"
+    )
+    if snap.get("anomalies"):
+        txt += f" anomalies {snap['anomalies']}"
+    if verdicts:
+        txt += f" VERDICTS {len(verdicts)}"
+    return txt
+
+
+# ---------------------------------------------------------------------
+# launcher-side monitor thread
+# ---------------------------------------------------------------------
+
+
+class LiveMonitor:
+    """Poll + stream-doctor + export loop beside a spawned world.
+
+    The launcher starts one per attempt (``launch --live``); the spawn
+    loop checks :meth:`escalation` and tears the world down with the
+    streaming diagnosis the moment a hang/mismatch is *confirmed* —
+    seconds after the wedge, instead of at ``--hang-timeout``.
+    """
+
+    def __init__(
+        self,
+        rundir: str,
+        *,
+        interval_s: Optional[float] = None,
+        grace_s: Optional[float] = None,
+        platform: Optional[str] = None,
+        prom_path: Optional[str] = None,
+        http_port: Optional[int] = None,
+        dashboard: bool = False,
+        dashboard_every_s: float = 2.0,
+        out=None,
+    ):
+        from .stream_doctor import StreamDoctor
+
+        self.interval_s = float(
+            config.LIVE_INTERVAL_S if interval_s is None else interval_s
+        )
+        self.aggregator = LiveAggregator(rundir, platform=platform)
+        self.doctor = StreamDoctor(
+            self.aggregator,
+            grace_s=grace_s,
+            verdict_log=os.path.join(rundir, "live.jsonl"),
+        )
+        self.prom_path = prom_path
+        self.http_port = http_port
+        self.dashboard = bool(dashboard)
+        self.dashboard_every_s = float(dashboard_every_s)
+        self.out = out if out is not None else sys.stderr
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._server = None
+
+    def escalation(self) -> Optional[Dict[str, Any]]:
+        """The confirmed hang/mismatch report (``m4t-doctor/1``), or
+        None while the world looks healthy."""
+        return self.doctor.escalation_report
+
+    def _refresh(self, *, attribute: bool = False) -> Dict[str, Any]:
+        snap = self.aggregator.snapshot(attribute=attribute)
+        if self.prom_path:
+            from . import export
+
+            try:
+                export.write_prom(
+                    self.prom_path,
+                    export.render_openmetrics(
+                        snap, verdicts=self.doctor.confirmed
+                    ),
+                )
+            except OSError:
+                pass
+        return snap
+
+    def _loop(self) -> None:
+        last_dash = 0.0
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.doctor.check()
+                now = time.monotonic()
+                if now - last_dash >= self.dashboard_every_s:
+                    last_dash = now
+                    snap = self._refresh()
+                    if self.dashboard:
+                        self.out.write(
+                            status_line(snap, self.doctor.confirmed) + "\n"
+                        )
+                        self.out.flush()
+            except Exception:  # pragma: no cover — monitoring is
+                pass  # best-effort; it must never kill the launcher
+
+    def start(self) -> "LiveMonitor":
+        if self.http_port is not None:
+            from . import export
+
+            try:
+                self._server = export.serve(
+                    lambda: export.render_openmetrics(
+                        self.aggregator.snapshot(),
+                        verdicts=self.doctor.confirmed,
+                    ),
+                    port=self.http_port,
+                )
+                self.out.write(
+                    "live: serving OpenMetrics on "
+                    f"http://127.0.0.1:{self._server.server_port}/metrics\n"
+                )
+            except OSError as exc:
+                self.out.write(f"live: metrics endpoint failed: {exc}\n")
+        self._thread = threading.Thread(
+            target=self._loop, name="m4t-live-monitor", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        if self._server is not None:
+            try:
+                self._server.shutdown()
+            except Exception:
+                pass
+        # final pass so post-teardown records (flight-recorder dumps,
+        # last fsync'd lines) land in the snapshot and verdict log
+        try:
+            self.doctor.check(final=True)
+            self._refresh(attribute=True)
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------
+
+
+def _clear_screen(out) -> None:
+    out.write("\x1b[2J\x1b[H")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--selftest" in argv:
+        return selftest()
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi4jax_tpu.observability.live",
+        description=(
+            "Tail a run directory's per-rank telemetry sinks and show "
+            "the live cross-rank state: seqs, liveness, throughput, "
+            "streaming-doctor verdicts. `--selftest` runs the "
+            "device-free synthetic-stream smoke."
+        ),
+    )
+    parser.add_argument(
+        "rundir", help="run directory (the launcher's --events-dir)"
+    )
+    parser.add_argument(
+        "--follow", action="store_true",
+        help="keep polling and re-render until interrupted",
+    )
+    parser.add_argument(
+        "--interval", type=float, default=1.0, metavar="S",
+        help="refresh period under --follow (default %(default)s)",
+    )
+    parser.add_argument(
+        "--grace", type=float, default=None, metavar="S",
+        help="streaming-doctor stall grace before confirming a hang "
+        "(default M4T_LIVE_GRACE)",
+    )
+    parser.add_argument(
+        "--prom", default=None, metavar="PATH",
+        help="also write an OpenMetrics snapshot to PATH each refresh",
+    )
+    parser.add_argument(
+        "--port", type=int, default=None, metavar="N",
+        help="serve the OpenMetrics text on http://127.0.0.1:N/metrics "
+        "(0 picks a free port)",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="print the snapshot (and confirmed verdicts) as JSON",
+    )
+    args = parser.parse_args(argv)
+
+    from .stream_doctor import StreamDoctor
+
+    agg = LiveAggregator(args.rundir)
+    sdoc = StreamDoctor(agg, grace_s=args.grace, verdict_log=None)
+    server = None
+    if args.port is not None:
+        from . import export
+
+        server = export.serve(
+            lambda: export.render_openmetrics(
+                agg.snapshot(), verdicts=sdoc.confirmed
+            ),
+            port=args.port,
+        )
+        print(
+            f"# serving http://127.0.0.1:{server.server_port}/metrics",
+            file=sys.stderr,
+        )
+
+    def refresh() -> Dict[str, Any]:
+        sdoc.check()
+        snap = agg.snapshot(attribute=True)
+        if args.prom:
+            from . import export
+
+            export.write_prom(
+                args.prom,
+                export.render_openmetrics(snap, verdicts=sdoc.confirmed),
+            )
+        return snap
+
+    try:
+        if not args.follow:
+            snap = refresh()
+            if args.json:
+                print(json.dumps(
+                    {"snapshot": snap, "verdicts": sdoc.confirmed},
+                    indent=1, default=str,
+                ))
+            else:
+                print(render_dashboard(snap, sdoc.confirmed))
+            return 0
+        while True:
+            snap = refresh()
+            if args.json:
+                print(json.dumps(
+                    {"snapshot": snap, "verdicts": sdoc.confirmed},
+                    default=str,
+                ), flush=True)
+            else:
+                _clear_screen(sys.stdout)
+                print(render_dashboard(snap, sdoc.confirmed), flush=True)
+            time.sleep(max(0.05, args.interval))
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        if server is not None:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------
+# selftest (device-free; wired into CI's `live` job and tier-1)
+# ---------------------------------------------------------------------
+
+
+def selftest() -> int:  # noqa: C901 — one linear smoke script
+    import tempfile
+
+    from . import export
+    from .stream_doctor import StreamDoctor
+    from ..planner import autotune, plan as _plan
+
+    def emission(rank, seq, op="AllReduce", nbytes=4096, t=100.0, **kw):
+        rec = {
+            "kind": "emission", "rank": rank, "seq": seq, "op": op,
+            "bytes": nbytes, "dtype": "float32", "axes": ["ranks"],
+            "world": 2, "shape": [nbytes // 4], "cid": f"c{rank}x{seq}",
+            "t": t,
+        }
+        rec.update(kw)
+        return rec
+
+    with tempfile.TemporaryDirectory() as tmp:
+        sink0 = os.path.join(tmp, "events-rank0.jsonl")
+        sink1 = os.path.join(tmp, "events-rank1.jsonl")
+
+        # -- torn-line safety ------------------------------------------
+        reader = TailReader(sink0)
+        with open(sink0, "w") as f:
+            f.write(json.dumps(emission(0, 1)) + "\n")
+            f.write('{"kind": "emission", "rank": 0, "seq": 2')  # torn
+        got = reader.poll()
+        assert [r["seq"] for r in got] == [1], got
+        assert reader.poll() == []  # the torn tail stays buffered
+        with open(sink0, "a") as f:
+            f.write(', "op": "AllReduce", "bytes": 16}\n')
+        got = reader.poll()
+        assert [r["seq"] for r in got] == [2], "completed line parses once"
+        assert reader.poll() == []
+
+        # -- rotation: capped sink, reader sees every record once ------
+        from . import events as _events
+
+        rot_dir = os.path.join(tmp, "rot")  # out of the aggregated dir
+        os.makedirs(rot_dir)
+        rot_path = os.path.join(rot_dir, "rot.jsonl")
+        log = _events.EventLog(rot_path, max_bytes=512)
+        rreader = TailReader(rot_path)
+        seen: List[int] = []
+        for i in range(40):
+            log.append({"kind": "emission", "rank": 0, "seq": i + 1,
+                        "op": "AllReduce", "bytes": 64})
+            if i % 7 == 0:
+                seen.extend(r["seq"] for r in rreader.poll())
+        log.close()
+        seen.extend(r["seq"] for r in rreader.poll())
+        assert seen == list(range(1, 41)), f"lost/duped across rotation: {seen}"
+        assert os.path.exists(rot_path + ".1"), "cap must have rotated"
+        merged = [r["seq"] for r in _events.read(rot_path)]
+        assert merged == sorted(merged) and merged[-1] == 40
+
+        # -- aggregation + wedge verdict (equal seqs, exec tiebreak) ---
+        clock = {"now": 0.0}
+        agg = LiveAggregator(tmp, platform="cpu", clock=lambda: clock["now"])
+        sdoc = StreamDoctor(
+            agg, grace_s=2.0,
+            verdict_log=os.path.join(tmp, "live.jsonl"),
+            clock=lambda: clock["now"],
+        )
+        with open(sink0, "w") as f:
+            for s in (1, 2, 3):
+                f.write(json.dumps(emission(0, s)) + "\n")
+            for s in (1, 2, 3):  # rank 0 entered all three
+                f.write(json.dumps({"kind": "exec", "rank": 0, "seq": s,
+                                    "op": "AllReduce", "t": 100.0 + s}) + "\n")
+        with open(sink1, "w") as f:
+            for s in (1, 2, 3):
+                f.write(json.dumps(emission(1, s)) + "\n")
+            for s in (1, 2):  # rank 1 never began executing seq 3
+                f.write(json.dumps({"kind": "exec", "rank": 1, "seq": s,
+                                    "op": "AllReduce", "t": 100.0 + s}) + "\n")
+            f.write(json.dumps({"kind": "heartbeat", "rank": 1,
+                                "source": "hb", "t": 180.0}) + "\n")
+        sdoc.check()
+        assert sdoc.escalation_report is None, "no confirmation before grace"
+        clock["now"] += 5.0  # world stalls past the grace
+        sdoc.check()
+        rep = sdoc.escalation_report
+        assert rep is not None and rep["schema"] == "m4t-doctor/1"
+        (hang,) = [f for f in rep["findings"] if f["kind"] == "hang"]
+        assert hang["rank"] == 1 and hang["wedged"] and hang["verdict"] == "hung"
+        assert hang["stuck_before"].startswith("AllReduce"), hang
+
+        # parity: the offline doctor sees the identical finding
+        from . import doctor as _doctor
+
+        offline = _doctor.diagnose([tmp])
+        assert [
+            f for f in offline["findings"] if f.get("kind") == "hang"
+        ] == [hang], "streaming and offline doctor must agree"
+
+        # -- straggler -> retune -> autotune accepts the keys ----------
+        with open(sink0, "a") as f:
+            for i in range(6):
+                f.write(json.dumps({"kind": "latency", "rank": 0,
+                                    "op": "AllReduce", "seconds": 0.001,
+                                    "t": 104.0 + i}) + "\n")
+        with open(sink1, "a") as f:
+            for i in range(6):
+                f.write(json.dumps({"kind": "latency", "rank": 1,
+                                    "op": "AllReduce", "seconds": 0.05,
+                                    "t": 104.0 + i}) + "\n")
+        sdoc.check()
+        retunes = [v for v in _events.read(os.path.join(tmp, "live.jsonl"))
+                   if v["kind"] == "retune"]
+        assert retunes and retunes[0]["reason"] == "straggler", retunes
+        keys = autotune.keys_from_verdicts([tmp], platform="cpu")
+        assert keys, "retune events must yield plan keys"
+        for k in keys:
+            _plan.parse_key(k)  # every recommended key is well-formed
+        planobj, _report = autotune.sweep(keys)
+        assert set(planobj.entries) == set(keys)
+
+        # -- dashboard + OpenMetrics render ----------------------------
+        snap = agg.snapshot(attribute=True)
+        dash = render_dashboard(snap, sdoc.confirmed)
+        assert "rank" in dash and "VERDICT" in dash
+        text = export.render_openmetrics(snap, verdicts=sdoc.confirmed)
+        assert text.endswith("# EOF\n"), "OpenMetrics must end with # EOF"
+        assert 'm4t_rank_last_seq{rank="1"} 3' in text, text
+        assert "m4t_verdicts_total" in text
+        export.write_prom(os.path.join(tmp, "metrics.prom"), text)
+        assert open(os.path.join(tmp, "metrics.prom")).read() == text
+
+        # -- HTTP endpoint ---------------------------------------------
+        import urllib.request
+
+        server = export.serve(lambda: text, port=0)
+        try:
+            url = f"http://127.0.0.1:{server.server_port}/metrics"
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                assert resp.status == 200
+                assert resp.read().decode() == text
+                assert "openmetrics" in resp.headers.get("Content-Type", "")
+        finally:
+            server.shutdown()
+
+    print("live selftest ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

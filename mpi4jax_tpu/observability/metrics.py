@@ -238,9 +238,30 @@ class MetricsRegistry:
 
     def mark_runtime_start(self, cid: str) -> None:
         """Host-callback hook: an op with correlation id ``cid`` began
-        executing (first callback of the pair)."""
+        executing (first callback of the pair).
+
+        When a sink is configured, the start is also mirrored as an
+        ``exec`` event carrying the emission's alignment key (``seq``).
+        This is the doctor's *wedge* evidence: a rank whose last
+        emission has no matching ``exec`` record, while a peer's does,
+        stalled between tracing a collective and executing it — the
+        hang signature no seq gap can show (both ranks record the
+        emission; only one enters the collective)."""
         with self._lock:
             self._inflight[cid] = time.perf_counter()
+            rec = self._cid_rec.get(cid)
+        from . import events
+
+        if events.get_sink() is not None:
+            events.emit(
+                {
+                    "kind": "exec",
+                    "cid": cid,
+                    "op": rec["op"] if rec else None,
+                    "seq": rec["seq"] if rec else None,
+                    "t": time.time(),
+                }
+            )
 
     def mark_runtime_end(self, cid: str, op: str) -> Optional[float]:
         """Host-callback hook: the op finished; records the latency

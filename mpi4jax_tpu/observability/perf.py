@@ -480,12 +480,12 @@ def observe_runtime(
     if cid:
         context["cid"] = cid
     if record:
-        if record.get("bytes") is not None:
-            context["bytes"] = record["bytes"]
-        if record.get("world") is not None:
-            context["world"] = record["world"]
-        if record.get("seq") is not None:
-            context["seq"] = record["seq"]
+        # carry every plan-key field (op/bytes/dtype/axes/world) so an
+        # anomaly event is self-sufficient evidence for the streaming
+        # doctor's retune recommendations (planner.plan.key_from_record)
+        for field in ("bytes", "dtype", "axes", "world", "seq", "impl"):
+            if record.get(field) is not None:
+                context[field] = record[field]
     return _watch.observe(key, seconds, **context)
 
 

@@ -1,0 +1,286 @@
+"""Streaming doctor: the offline verdicts, raised while the run lives.
+
+The post-mortem doctor (:mod:`.doctor`) names MISMATCH / HANG /
+STRAGGLER from artifacts alone — but only once the world is dead.
+This module runs the *same analyses* over a :class:`..live.
+LiveAggregator`'s rolling state (the aggregator's ``by_rank`` is
+byte-compatible with ``doctor.load`` output, so verdict parity with
+the offline doctor holds by construction) and adds the one thing an
+offline pass cannot have: **time**.
+
+Confirmation policy (what turns an analysis finding into a verdict):
+
+- ``mismatch`` — confirmed immediately. A divergence on disk is
+  deterministic evidence; waiting adds nothing.
+- ``hang`` (gap-based or the equal-seq *wedged* tiebreaker) —
+  confirmed only after the whole world has made no progress (no new
+  emission / exec / latency record from any rank) for ``grace_s``
+  seconds. In-flight seq skew is normal; a persistent global stall is
+  not. A new record from anyone resets the clock.
+- ``straggler`` — confirmed immediately (the offline analysis already
+  has a min-samples floor), once per (op, rank).
+
+Every confirmed verdict is appended to the run's ``live.jsonl`` as a
+``verdict`` event stamped with the recovery class the resilience
+supervisor would assign (``resilience.supervisor.classify_findings``
+— transient vs deterministic), and a confirmed hang/mismatch exposes
+an ``m4t-doctor/1`` report as :attr:`StreamDoctor.escalation_report`
+for the launcher to act on.
+
+The closed loop: confirmed STRAGGLER verdicts and live ``anomaly``
+events (the perf watch, ``M4T_PERF_WATCH``) additionally emit
+``retune`` recommendation events carrying the affected plan keys::
+
+    {"kind": "retune", "reason": "straggler" | "anomaly",
+     "op": "AllReduce", "rank": 1,
+     "plan_keys": ["AllReduce|b23|float32|w2|ranks|cpu", ...],
+     "detail": {...}, "t": ...}
+
+``planner tune --from-verdicts RUNDIR`` (and ``launch --tune``) feed
+those keys through ``autotune.sweep`` so the plan cache is re-pinned
+from the evidence — the ROADMAP's "doctor verdicts trigger re-tuning
+automatically" loop.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import config
+from . import doctor as _doctor
+from . import events as _events
+from .live import LiveAggregator
+
+
+def _finding_key(f: Dict[str, Any]) -> Tuple:
+    """Stable identity of a finding across re-analyses (the dedupe /
+    debounce key)."""
+    kind = f.get("kind")
+    if kind == "mismatch":
+        return (kind, f.get("seq"))
+    if kind == "hang":
+        return (kind, f.get("rank"), f.get("last_seq"), f.get("verdict"))
+    if kind == "missing_rank":
+        return (kind, f.get("rank"))
+    if kind == "straggler":
+        return (kind, f.get("op"), f.get("rank"))
+    return (kind, repr(sorted(f.items())))
+
+
+class StreamDoctor:
+    """Incremental verdicts over a live aggregator.
+
+    ``check()`` is the only entry point: poll the aggregator, re-run
+    the offline analyses when anything moved, apply the confirmation
+    policy, write verdict / retune events. Cheap when idle: no new
+    records means no re-analysis — only the stall clock is consulted.
+    """
+
+    def __init__(
+        self,
+        aggregator: LiveAggregator,
+        *,
+        grace_s: Optional[float] = None,
+        hang_gap: int = _doctor.DEFAULT_HANG_GAP,
+        straggler_ratio: float = _doctor.DEFAULT_STRAGGLER_RATIO,
+        straggler_min_samples: int = _doctor.DEFAULT_STRAGGLER_MIN_SAMPLES,
+        verdict_log: Optional[str] = None,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        self.aggregator = aggregator
+        self.grace_s = float(
+            config.LIVE_GRACE_S if grace_s is None else grace_s
+        )
+        self.hang_gap = int(hang_gap)
+        self.straggler_ratio = float(straggler_ratio)
+        self.straggler_min_samples = int(straggler_min_samples)
+        self.clock = clock or aggregator.clock
+        self._log = (
+            _events.EventLog(verdict_log) if verdict_log else None
+        )
+        #: confirmed verdict events, in confirmation order
+        self.confirmed: List[Dict[str, Any]] = []
+        #: the launcher's escalation trigger: an ``m4t-doctor/1``
+        #: report containing the confirmed hang/mismatch finding(s)
+        self.escalation_report: Optional[Dict[str, Any]] = None
+        self._confirmed_keys: set = set()
+        self._retuned: set = set()
+        self._last_report: Optional[Dict[str, Any]] = None
+
+    # -- verdict/retune event emission --------------------------------
+
+    def _append(self, record: Dict[str, Any]) -> None:
+        if self._log is None:
+            return
+        try:
+            self._log.append(record)
+        except OSError:
+            pass  # the verdict log must never take the monitor down
+
+    def _confirm(self, finding: Dict[str, Any]) -> Dict[str, Any]:
+        from ..resilience.supervisor import classify_findings
+
+        verdict = {
+            "kind": "verdict",
+            "finding": finding,
+            "klass": classify_findings([finding])["klass"],
+            "t": time.time(),
+        }
+        self.confirmed.append(verdict)
+        self._append(verdict)
+        return verdict
+
+    def _plan_keys_for(
+        self, op: str, rank: Optional[int]
+    ) -> List[str]:
+        """Plan keys of the emissions behind a (op, rank) verdict —
+        the key set a re-tune should sweep."""
+        keys: Dict[str, None] = {}
+        ranks = (
+            [rank] if rank is not None else sorted(self.aggregator.by_rank)
+        )
+        for r in ranks:
+            for rec in self.aggregator.by_rank.get(r, []):
+                if rec.get("kind") not in ("emission", "recorder"):
+                    continue
+                rec_op = rec.get("op")
+                if rec_op == "QuantizedAllReduce":
+                    rec_op = "AllReduce"
+                if rec_op != op:
+                    continue
+                key = self.aggregator.plan_key_of(rec)
+                if key is not None:
+                    keys.setdefault(key)
+        return list(keys)
+
+    def _retune(
+        self,
+        reason: str,
+        *,
+        op: str,
+        rank: Optional[int],
+        plan_keys: List[str],
+        detail: Dict[str, Any],
+    ) -> Optional[Dict[str, Any]]:
+        if not plan_keys:
+            return None
+        dedupe = (reason, op, rank, tuple(sorted(plan_keys)))
+        if dedupe in self._retuned:
+            return None
+        self._retuned.add(dedupe)
+        record = {
+            "kind": "retune",
+            "reason": reason,
+            "op": op,
+            "rank": rank,
+            "plan_keys": plan_keys,
+            "detail": detail,
+            "t": time.time(),
+        }
+        self._append(record)
+        return record
+
+    # -- the check loop -----------------------------------------------
+
+    def _analyze(self) -> Dict[str, Any]:
+        return _doctor.analyze(
+            self.aggregator.by_rank,
+            hang_gap=self.hang_gap,
+            straggler_ratio=self.straggler_ratio,
+            straggler_min_samples=self.straggler_min_samples,
+        )
+
+    def check(self, *, final: bool = False) -> Optional[Dict[str, Any]]:
+        """One monitor tick: poll, analyze, confirm. Returns the
+        latest analysis report (None before any records). ``final``
+        marks the post-teardown pass: the world is dead, so hang
+        findings no longer wait out the grace (there is no more
+        progress to wait for)."""
+        moved = self.aggregator.poll()
+        if not self.aggregator.by_rank:
+            return None
+        if moved or self._last_report is None:
+            self._last_report = self._analyze()
+        report = self._last_report
+
+        stalled = self.aggregator.stalled_s()
+        stall_confirmed = final or (
+            stalled is not None and stalled >= self.grace_s
+        )
+        escalate: List[Dict[str, Any]] = []
+        for f in report.get("findings", []):
+            key = _finding_key(f)
+            kind = f.get("kind")
+            if kind in ("hang", "missing_rank") and not stall_confirmed:
+                continue  # transient skew until the world truly stalls
+            if key not in self._confirmed_keys:
+                self._confirmed_keys.add(key)
+                self._confirm(f)
+                if kind == "straggler":
+                    self._retune(
+                        "straggler",
+                        op=f.get("op", "?"),
+                        rank=f.get("rank"),
+                        plan_keys=self._plan_keys_for(
+                            f.get("op", "?"), f.get("rank")
+                        ),
+                        detail={
+                            k: f.get(k)
+                            for k in ("ratio", "mean_s", "peer_median_s",
+                                      "samples")
+                        },
+                    )
+            if kind in ("mismatch", "hang"):
+                escalate.append(f)
+
+        # live anomaly events (perf watch) -> retune recommendations
+        for rec in self.aggregator.drain_anomalies():
+            op = rec.get("op")
+            if not op:
+                continue
+            key = (
+                self.aggregator.plan_key_of(dict(rec, kind="emission"))
+                if rec.get("bytes") is not None
+                else None
+            )
+            self._retune(
+                "anomaly",
+                op=str(op),
+                rank=rec.get("rank"),
+                plan_keys=(
+                    [key] if key is not None
+                    else self._plan_keys_for(str(op), rec.get("rank"))
+                ),
+                detail={
+                    k: rec.get(k)
+                    for k in ("key", "seconds", "baseline_s", "z")
+                },
+            )
+
+        if escalate and self.escalation_report is None:
+            self.escalation_report = dict(report, findings=escalate)
+        return report
+
+    def format_escalation(self) -> str:
+        """Human-readable streaming diagnosis (the launcher prints
+        this when it tears a confirmed-hung world down)."""
+        if self.escalation_report is None:
+            return "stream doctor: no confirmed verdict"
+        return _doctor.format_report(self.escalation_report)
+
+
+def watch_directory(
+    rundir: str,
+    *,
+    grace_s: Optional[float] = None,
+    platform: Optional[str] = None,
+    verdict_log: Optional[str] = None,
+) -> StreamDoctor:
+    """Convenience constructor: a stream doctor over a fresh
+    aggregator for ``rundir`` (offline harnesses, tests)."""
+    agg = LiveAggregator(rundir, platform=platform)
+    if verdict_log is None:
+        verdict_log = os.path.join(rundir, "live.jsonl")
+    return StreamDoctor(agg, grace_s=grace_s, verdict_log=verdict_log)

@@ -10,11 +10,18 @@ Usage::
 
     python -m mpi4jax_tpu.planner tune --world 8 [--cache PLAN.json]
         [--measured TABLE.json] [--events RUNDIR ...]
+        [--from-verdicts RUNDIR ...]
         [--dtypes float32,bfloat16] [--buckets 12:27:2]
         [--axes ranks] [--mesh a=2,b=4] [--allow-lossy]
         [--platform cpu] [--peak-gbps G] [--alpha-us A] [--json]
     python -m mpi4jax_tpu.planner show [--cache PLAN.json] [--json]
     python -m mpi4jax_tpu.planner --selftest
+
+``tune --from-verdicts RUNDIR`` closes the observability loop: the
+streaming doctor (``observability/stream_doctor.py``) emits ``retune``
+events naming the plan keys behind confirmed STRAGGLER/anomaly
+verdicts, and this mode sweeps exactly those keys — measured against
+the same run's artifacts — and re-pins them over the cache.
 """
 
 from __future__ import annotations
@@ -77,7 +84,32 @@ def _cmd_tune(args: argparse.Namespace) -> int:
                 "keys": {**table.get("keys", {}), **measured.get("keys", {})},
             }
             measured = merged
-    if args.events and not args.keys_from_grid:
+    if args.from_verdicts:
+        # the closed loop: restrict the sweep to the plan keys the
+        # streaming doctor's retune events name, measured against the
+        # same run's artifacts (unless an explicit --events/--measured
+        # source was given)
+        keys = autotune.keys_from_verdicts(
+            args.from_verdicts, platform=platform
+        )
+        if not keys:
+            print(
+                "tune: no retune events (streaming-doctor "
+                "recommendations) found under "
+                f"{' '.join(args.from_verdicts)}; nothing to re-tune",
+                file=sys.stderr,
+            )
+            return 2
+        if measured is None:
+            measured = autotune.measured_table_from_events(
+                args.from_verdicts, platform=platform
+            )
+        print(
+            f"tune: re-tuning {len(keys)} key(s) recommended by live "
+            "verdicts",
+            file=sys.stderr,
+        )
+    elif args.events and not args.keys_from_grid:
         keys = autotune.keys_from_events(args.events, platform=platform)
         if not keys:
             print(
@@ -359,6 +391,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--events", nargs="*", default=None, metavar="RUNDIR",
         help="run artifact dirs (launch --events-dir --perf): derive "
         "the measured table and the key set from real emissions",
+    )
+    p_tune.add_argument(
+        "--from-verdicts", nargs="*", default=None, metavar="RUNDIR",
+        help="re-tune exactly the plan keys the streaming doctor's "
+        "retune events recommend (confirmed straggler/anomaly "
+        "verdicts in RUNDIR's live.jsonl / per-rank sinks), measured "
+        "against the same artifacts; exit 2 when no recommendations "
+        "exist",
     )
     p_tune.add_argument(
         "--keys-from-grid", action="store_true",

@@ -311,6 +311,38 @@ def keys_from_events(
     return _plan.keys_from_records(records, platform)
 
 
+def keys_from_verdicts(
+    inputs: Iterable[str], *, platform: Optional[str] = None
+) -> List[str]:
+    """The plan keys the streaming doctor recommended re-tuning.
+
+    Reads ``retune`` events (``observability/stream_doctor.py`` —
+    confirmed STRAGGLER verdicts and live perf-watch anomalies, each
+    carrying the affected plan keys) out of run artifacts: the
+    ``live.jsonl`` verdict log and/or per-rank sinks under the given
+    files/directories. Malformed keys are dropped, keys for a
+    different platform class are skipped when ``platform`` is given,
+    duplicates collapse in first-seen order — the result feeds
+    :func:`sweep` directly (``planner tune --from-verdicts``,
+    ``launch --tune``)."""
+    from ..observability import doctor, events
+
+    seen: Dict[str, None] = {}
+    for path in doctor._expand_inputs(list(inputs)):
+        for rec in events.iter_records(path):
+            if rec.get("kind") != "retune":
+                continue
+            for key in rec.get("plan_keys") or []:
+                try:
+                    info = _plan.parse_key(str(key))
+                except _plan.PlanError:
+                    continue
+                if platform is not None and info["platform"] != platform:
+                    continue
+                seen.setdefault(str(key))
+    return list(seen)
+
+
 def default_keys(
     *,
     platform: str,

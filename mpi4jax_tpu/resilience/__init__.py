@@ -37,6 +37,7 @@ from .supervisor import (  # noqa: F401
     RetryPolicy,
     Supervisor,
     classify,
+    classify_findings,
     resume_step,
 )
 
@@ -50,6 +51,7 @@ __all__ = [
     "Supervisor",
     "ckpt",
     "classify",
+    "classify_findings",
     "faults",
     "resume_step",
     "supervisor",

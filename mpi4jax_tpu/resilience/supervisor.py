@@ -47,7 +47,7 @@ import os
 import random
 import time
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Iterable, Optional
 
 #: finding kinds that mean "the program itself diverged" — re-running
 #: deterministically reproduces them, so retrying is burning compute
@@ -73,23 +73,25 @@ def resume_step() -> Optional[int]:
         return None
 
 
-def classify(
-    report: Optional[Dict[str, Any]], exit_code: int
+def classify_findings(
+    findings: Iterable[Dict[str, Any]],
 ) -> Dict[str, Any]:
-    """Map a doctor report (``doctor.analyze`` output, or None when no
-    telemetry was readable) plus the world's exit code to a recovery
-    class::
+    """The finding-level half of :func:`classify`: map a list of
+    doctor findings (offline report *or* the streaming doctor's live
+    verdicts — same schema) to::
 
         {"klass": "clean" | "transient" | "deterministic",
          "reason": <short machine-readable tag>,
          "kinds": [finding kinds seen]}
 
     Deterministic wins over transient when both appear: a mismatch
-    usually *causes* the hang recorded beside it.
+    usually *causes* the hang recorded beside it. The streaming
+    doctor (``observability/stream_doctor.py``) stamps this verdict
+    on every live ``verdict`` event, so a mid-run escalation already
+    carries the recovery class the supervisor would assign
+    post-mortem.
     """
-    if exit_code == 0:
-        return {"klass": "clean", "reason": "exit_zero", "kinds": []}
-    findings = list(report.get("findings", [])) if report else []
+    findings = list(findings or [])
     kinds = sorted({f.get("kind", "?") for f in findings})
     det = [f for f in findings if f.get("kind") in DETERMINISTIC_KINDS]
     if det:
@@ -102,17 +104,37 @@ def classify(
         ):
             reason = "mismatch_static_attributed"
         return {"klass": "deterministic", "reason": reason, "kinds": kinds}
+    if any(f.get("kind") in TRANSIENT_KINDS for f in findings):
+        return {
+            "klass": "transient", "reason": "transient_findings",
+            "kinds": kinds,
+        }
+    return {"klass": "clean", "reason": "no_findings", "kinds": kinds}
+
+
+def classify(
+    report: Optional[Dict[str, Any]], exit_code: int
+) -> Dict[str, Any]:
+    """Map a doctor report (``doctor.analyze`` output, or None when no
+    telemetry was readable) plus the world's exit code to a recovery
+    class (:func:`classify_findings` payload shape)."""
+    if exit_code == 0:
+        return {"klass": "clean", "reason": "exit_zero", "kinds": []}
     if report is None:
         return {
             "klass": "transient", "reason": "crash_no_telemetry",
-            "kinds": kinds,
+            "kinds": [],
         }
-    if any(f.get("kind") in TRANSIENT_KINDS for f in findings):
-        reason = "hang" if exit_code == WATCHDOG_EXIT else "transient_findings"
-        return {"klass": "transient", "reason": reason, "kinds": kinds}
+    verdict = classify_findings(report.get("findings", []))
+    if verdict["klass"] == "deterministic":
+        return verdict
+    if verdict["klass"] == "transient":
+        if exit_code == WATCHDOG_EXIT:
+            verdict = dict(verdict, reason="hang")
+        return verdict
     return {
         "klass": "transient", "reason": "crash_without_mismatch",
-        "kinds": kinds,
+        "kinds": verdict["kinds"],
     }
 
 

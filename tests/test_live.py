@@ -1,0 +1,689 @@
+"""Live telemetry plane (``mpi4jax_tpu/observability/{live,
+stream_doctor,export}.py`` + event-log rotation).
+
+Covers the ISSUE-8 acceptance surface:
+
+- torn-line-safe tailing: a partially-written final line is buffered
+  (never parsed) until the writer completes it, then parsed exactly
+  once; fsync-off sinks are eventually drained;
+- ``EventLog`` size-capped rotation (``.1``/``.2`` suffixes) with the
+  tailer and the offline readers (``events.read`` -> doctor/perf)
+  merging rotated segments transparently;
+- streaming-vs-offline doctor verdict parity on the synthetic
+  mismatch / hang / straggler fixtures from ``tests/test_doctor.py``;
+- the equal-seq *wedged* verdict from ``exec`` records, live and
+  post-mortem;
+- the closed loop: straggler/anomaly verdicts -> ``retune`` events ->
+  ``autotune.keys_from_verdicts`` -> ``planner tune --from-verdicts``;
+- OpenMetrics rendering, the atomic ``metrics.prom`` snapshot, and
+  the localhost HTTP endpoint;
+- end-to-end: ``launch --live`` names a fault-injected hang (rank +
+  ``stuck_before``) and exits long before ``--hang-timeout``, with
+  the streaming diagnosis matching the offline doctor's; an injected
+  slowdown produces a re-pinnable retune recommendation.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from mpi4jax_tpu.observability import doctor, events
+from mpi4jax_tpu.observability import export as prom_export
+from mpi4jax_tpu.observability.live import (
+    LiveAggregator,
+    TailReader,
+    render_dashboard,
+    status_line,
+)
+from mpi4jax_tpu.observability.stream_doctor import StreamDoctor
+from mpi4jax_tpu.planner import autotune
+from mpi4jax_tpu.planner import plan as _plan
+
+from tests.test_doctor import (  # noqa: F401 — shared synthetic builders
+    clean_world,
+    emission,
+    heartbeat,
+    latency,
+    write_logs,
+)
+
+pytestmark = [pytest.mark.telemetry, pytest.mark.live]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def exec_rec(rank, seq, op="AllReduce", t=None):
+    return {"kind": "exec", "rank": rank, "seq": seq, "op": op,
+            "cid": f"c{rank:02d}{seq:04d}", "t": 100.0 + seq if t is None else t}
+
+
+class FakeClock:
+    def __init__(self, now=1000.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+def make_stream(tmp_path, *, grace=2.0, platform="cpu"):
+    clock = FakeClock()
+    agg = LiveAggregator(str(tmp_path), platform=platform, clock=clock)
+    sdoc = StreamDoctor(
+        agg, grace_s=grace,
+        verdict_log=str(tmp_path / "live.jsonl"), clock=clock,
+    )
+    return clock, agg, sdoc
+
+
+# ---------------------------------------------------------------------
+# torn-line-safe tailing
+# ---------------------------------------------------------------------
+
+
+def test_tail_torn_final_line_buffered_and_parsed_once(tmp_path):
+    path = str(tmp_path / "events-rank0.jsonl")
+    reader = TailReader(path)
+    assert reader.poll() == []  # missing file is not an error
+    with open(path, "w") as f:
+        f.write(json.dumps(emission(0, 1, "AllReduce", [8], 100.0)) + "\n")
+        f.write('{"kind": "emission", "rank": 0, "seq": 2, "op": "AllRe')
+    got = reader.poll()
+    assert [r["seq"] for r in got] == [1]
+    # the torn tail is buffered, not parsed — and not parsed again
+    assert reader.poll() == []
+    with open(path, "a") as f:
+        f.write('duce", "shape": [8], "dtype": "float32"}\n')
+    got = reader.poll()
+    assert [r["seq"] for r in got] == [2], "completed line parses exactly once"
+    assert got[0]["op"] == "AllReduce"
+    assert reader.poll() == []
+
+
+def test_tail_drains_fsync_off_sink(tmp_path):
+    """A sink without fsync still closes whole lines per append —
+    every record is eventually visible to the tailer."""
+    path = str(tmp_path / "events-rank0.jsonl")
+    log = events.EventLog(path, fsync=False)
+    reader = TailReader(path)
+    seen = []
+    for i in range(10):
+        log.append({"kind": "emission", "rank": 0, "seq": i + 1,
+                    "op": "AllReduce"})
+        seen.extend(r["seq"] for r in reader.poll())
+    assert seen == list(range(1, 11))
+
+
+def test_tail_skips_malformed_lines(tmp_path):
+    path = str(tmp_path / "x.jsonl")
+    with open(path, "w") as f:
+        f.write("not json at all\n")
+        f.write(json.dumps({"kind": "emission", "seq": 1}) + "\n")
+        f.write("[1, 2, 3]\n")  # JSON but not a record
+    assert [r["seq"] for r in TailReader(path).poll()] == [1]
+
+
+# ---------------------------------------------------------------------
+# rotation
+# ---------------------------------------------------------------------
+
+
+def test_eventlog_rotation_caps_and_suffixes(tmp_path):
+    path = str(tmp_path / "rot.jsonl")
+    log = events.EventLog(path, max_bytes=400)
+    for i in range(40):
+        log.append({"kind": "emission", "rank": 0, "seq": i + 1,
+                    "op": "AllReduce", "bytes": 64})
+    log.close()
+    # the live path always exists after an append (rotation recreates
+    # it) — the layout contract the doctor's *.jsonl glob relies on
+    for p in (path, path + ".1", path + ".2"):
+        assert os.path.exists(p), p
+        assert os.path.getsize(p) <= 400 + 200  # cap + one record slack
+    # merged read: contiguous suffix of the stream, oldest first
+    seqs = [r["seq"] for r in events.read(path)]
+    assert seqs == sorted(seqs)
+    assert seqs[-1] == 40
+    assert len(seqs) >= 3  # at least the three on-disk segments' worth
+
+
+def test_tail_reader_never_loses_or_dupes_across_rotation(tmp_path):
+    path = str(tmp_path / "rot.jsonl")
+    log = events.EventLog(path, max_bytes=512)
+    reader = TailReader(path)
+    seen = []
+    for i in range(60):
+        log.append({"kind": "emission", "rank": 0, "seq": i + 1,
+                    "op": "AllReduce", "bytes": 64})
+        if i % 5 == 0:
+            seen.extend(r["seq"] for r in reader.poll())
+    log.close()
+    seen.extend(r["seq"] for r in reader.poll())
+    assert seen == list(range(1, 61)), seen
+
+
+def test_doctor_merges_rotated_sinks(tmp_path):
+    """The offline doctor (and everything on doctor.load: perf,
+    measured tables) sees rotated segments as one stream."""
+    for rank in (0, 1):
+        log = events.EventLog(
+            str(tmp_path / f"events-rank{rank}.jsonl"), max_bytes=300
+        )
+        for s in range(1, 21):
+            log.append(emission(rank, s, "AllReduce", [8], 100.0 + s))
+        log.close()
+    assert os.path.exists(str(tmp_path / "events-rank0.jsonl.1"))
+    report = doctor.diagnose([str(tmp_path)])
+    assert report["seqs"] == {"0": 20, "1": 20}
+    assert report["findings"] == []
+
+
+# ---------------------------------------------------------------------
+# streaming-vs-offline verdict parity (the test_doctor fixtures)
+# ---------------------------------------------------------------------
+
+
+def _confirmed_findings(sdoc):
+    return [v["finding"] for v in sdoc.confirmed]
+
+
+def test_streaming_matches_offline_on_mismatch(tmp_path):
+    logs = clean_world(n_ranks=3)
+    logs[2][2] = emission(2, 3, "AllGather", [8], 103.0)
+    write_logs(tmp_path, logs)
+    clock, agg, sdoc = make_stream(tmp_path)
+    sdoc.check()
+    offline = doctor.diagnose([str(tmp_path)])
+    mismatches = [f for f in offline["findings"] if f["kind"] == "mismatch"]
+    # confirmed immediately — no stall grace for deterministic evidence
+    assert [
+        f for f in _confirmed_findings(sdoc) if f["kind"] == "mismatch"
+    ] == mismatches
+    assert sdoc.escalation_report is not None
+    (v,) = [v for v in sdoc.confirmed if v["finding"]["kind"] == "mismatch"]
+    assert v["klass"] == "deterministic"
+
+
+def test_streaming_matches_offline_on_hang_after_grace(tmp_path):
+    logs = clean_world(n_ranks=4, n_seq=5)
+    logs[1] = logs[1][:2] + [heartbeat(1, 130.0)]
+    logs[2] = logs[2][:2] + [heartbeat(2, 102.0)]
+    logs[3] = logs[3][:2]
+    write_logs(tmp_path, logs)
+    clock, agg, sdoc = make_stream(tmp_path, grace=2.0)
+    sdoc.check()
+    assert sdoc.escalation_report is None, "no hang before the stall grace"
+    assert _confirmed_findings(sdoc) == []
+    clock.advance(5.0)  # world stalls past the grace
+    sdoc.check()
+    offline = doctor.diagnose([str(tmp_path)])
+    hangs = {f["rank"]: f for f in offline["findings"] if f["kind"] == "hang"}
+    confirmed = {
+        f["rank"]: f for f in _confirmed_findings(sdoc) if f["kind"] == "hang"
+    }
+    assert confirmed == hangs
+    assert confirmed[1]["verdict"] == "hung"
+    assert confirmed[2]["verdict"] == "dead"
+    assert confirmed[3]["verdict"] == "behind"
+    for v in sdoc.confirmed:
+        assert v["klass"] == "transient"
+    assert sdoc.escalation_report["schema"] == "m4t-doctor/1"
+
+
+def test_streaming_progress_resets_the_stall_clock(tmp_path):
+    logs = clean_world(n_seq=4)
+    logs[1] = logs[1][:2]
+    write_logs(tmp_path, logs)
+    clock, agg, sdoc = make_stream(tmp_path, grace=3.0)
+    sdoc.check()
+    clock.advance(2.0)
+    # rank 1 catches up just before the grace expires
+    with open(tmp_path / "events-rank1.jsonl", "a") as f:
+        f.write(json.dumps(emission(1, 3, "AllReduce", [8], 103.0)) + "\n")
+        f.write(json.dumps(emission(1, 4, "AllReduce", [8], 104.0)) + "\n")
+    sdoc.check()
+    clock.advance(2.0)  # stall clock restarted by the new records
+    sdoc.check()
+    assert sdoc.escalation_report is None
+    assert _confirmed_findings(sdoc) == []
+
+
+def test_streaming_matches_offline_on_straggler(tmp_path):
+    logs = clean_world(n_ranks=4)
+    for r in range(4):
+        per = 0.05 if r == 3 else 0.001
+        for i in range(5):
+            logs[r].append(latency(r, "AllReduce", per, 105.0 + i))
+    write_logs(tmp_path, logs)
+    clock, agg, sdoc = make_stream(tmp_path)
+    sdoc.check()
+    offline = [f for f in doctor.diagnose([str(tmp_path)])["findings"]
+               if f["kind"] == "straggler"]
+    confirmed = [f for f in _confirmed_findings(sdoc)
+                 if f["kind"] == "straggler"]
+    assert confirmed == offline and confirmed[0]["rank"] == 3
+    # stragglers never escalate (transient, the run may still finish)
+    assert sdoc.escalation_report is None
+    # ...and are confirmed only once across re-checks
+    sdoc.check()
+    clock.advance(10.0)
+    sdoc.check()
+    assert len([f for f in _confirmed_findings(sdoc)
+                if f["kind"] == "straggler"]) == len(offline)
+
+
+# ---------------------------------------------------------------------
+# the equal-seq wedge verdict (exec records)
+# ---------------------------------------------------------------------
+
+
+def wedged_world(tmp_path):
+    """Both ranks record seqs 1..3; rank 0 began executing all three,
+    rank 1 never entered seq 3 (its heartbeats continue)."""
+    logs = clean_world(n_ranks=2, n_seq=3)
+    logs[0] += [exec_rec(0, s) for s in (1, 2, 3)]
+    logs[1] += [exec_rec(1, s) for s in (1, 2)]
+    logs[1].append(heartbeat(1, 150.0))
+    return write_logs(tmp_path, logs)
+
+
+def test_offline_doctor_names_wedged_rank(tmp_path):
+    d = wedged_world(tmp_path)
+    report = doctor.diagnose([d])
+    (f,) = [x for x in report["findings"] if x["kind"] == "hang"]
+    assert f["wedged"] is True
+    assert f["rank"] == 1 and f["verdict"] == "hung"
+    assert f["last_seq"] == f["front_seq"] == 3 and f["gap"] == 0
+    assert f["front_ranks"] == [0]
+    assert f["stuck_before"] == "AllReduce[8:float32]@ranks"
+    text = doctor.format_report(report)
+    assert "never began executing" in text
+    assert "stuck before: AllReduce[8:float32]@ranks" in text
+
+
+def test_wedge_needs_peer_exec_evidence(tmp_path):
+    """No rank entered the front seq -> no culprit to name (could be
+    a mismatch's rendezvous failure or plain slowness)."""
+    logs = clean_world(n_ranks=2, n_seq=3)
+    logs[0] += [exec_rec(0, s) for s in (1, 2)]
+    logs[1] += [exec_rec(1, s) for s in (1, 2)]
+    write_logs(tmp_path, logs)
+    assert doctor.diagnose([str(tmp_path)])["findings"] == []
+
+
+def test_wedge_needs_own_earlier_exec_evidence(tmp_path):
+    """A rank with no exec records at all (callbacks unsupported /
+    sampling off) is never branded wedged."""
+    logs = clean_world(n_ranks=2, n_seq=3)
+    logs[0] += [exec_rec(0, s) for s in (1, 2, 3)]
+    write_logs(tmp_path, logs)
+    assert doctor.diagnose([str(tmp_path)])["findings"] == []
+
+
+def test_completed_world_is_not_wedged(tmp_path):
+    logs = clean_world(n_ranks=2, n_seq=3)
+    for r in (0, 1):
+        logs[r] += [exec_rec(r, s) for s in (1, 2, 3)]
+    write_logs(tmp_path, logs)
+    assert doctor.diagnose([str(tmp_path)])["findings"] == []
+
+
+def test_streaming_wedge_confirms_after_stall_and_matches_offline(tmp_path):
+    d = wedged_world(tmp_path)
+    clock, agg, sdoc = make_stream(tmp_path, grace=2.0)
+    sdoc.check()
+    assert sdoc.escalation_report is None
+    clock.advance(3.0)
+    sdoc.check()
+    rep = sdoc.escalation_report
+    assert rep is not None
+    offline = doctor.diagnose([d])
+    assert rep["findings"] == [
+        f for f in offline["findings"] if f["kind"] == "hang"
+    ]
+
+
+# ---------------------------------------------------------------------
+# retune recommendations (the closed loop)
+# ---------------------------------------------------------------------
+
+
+def straggler_world_with_payloads(tmp_path):
+    logs = {}
+    for r in range(2):
+        logs[r] = [
+            emission(r, s, "AllReduce", [1024], 100.0 + s, nbytes=4096)
+            for s in range(1, 4)
+        ]
+        per = 0.05 if r == 1 else 0.001
+        logs[r] += [latency(r, "AllReduce", per, 104.0 + i)
+                    for i in range(6)]
+    return write_logs(tmp_path, logs)
+
+
+def test_straggler_confirmation_emits_retune_with_plan_keys(tmp_path):
+    straggler_world_with_payloads(tmp_path)
+    clock, agg, sdoc = make_stream(tmp_path)
+    sdoc.check()
+    retunes = [r for r in events.read(str(tmp_path / "live.jsonl"))
+               if r["kind"] == "retune"]
+    assert len(retunes) == 1
+    rt = retunes[0]
+    assert rt["reason"] == "straggler" and rt["op"] == "AllReduce"
+    assert rt["plan_keys"], rt
+    for key in rt["plan_keys"]:
+        info = _plan.parse_key(key)  # well-formed by contract
+        assert info["op"] == "AllReduce" and info["world"] == 2
+    # retune events are deduped across re-checks
+    sdoc.check()
+    assert len([r for r in events.read(str(tmp_path / "live.jsonl"))
+                if r["kind"] == "retune"]) == 1
+
+
+def test_anomaly_records_become_retune_events(tmp_path):
+    logs = clean_world()
+    logs[0].append({
+        "kind": "anomaly", "rank": 0, "op": "AllReduce",
+        "key": "AllReduce[8:float32]@ranks", "seconds": 0.5,
+        "baseline_s": 0.001, "z": 40.0, "bytes": 4096,
+        "dtype": "float32", "axes": ["ranks"], "world": 2, "t": 109.0,
+    })
+    write_logs(tmp_path, logs)
+    clock, agg, sdoc = make_stream(tmp_path)
+    sdoc.check()
+    (rt,) = [r for r in events.read(str(tmp_path / "live.jsonl"))
+             if r["kind"] == "retune"]
+    assert rt["reason"] == "anomaly"
+    assert rt["plan_keys"] == [
+        _plan.plan_key("AllReduce", nbytes=4096, dtype="float32",
+                       world=2, axes=("ranks",), platform="cpu")
+    ]
+
+
+def test_keys_from_verdicts_reads_validates_and_dedupes(tmp_path):
+    log = events.EventLog(str(tmp_path / "live.jsonl"))
+    good = "AllReduce|b13|float32|w2|ranks|cpu"
+    other_platform = "AllReduce|b13|float32|w2|ranks|tpu:v5e"
+    log.append({"kind": "retune", "reason": "straggler",
+                "plan_keys": [good, "garbage-key", other_platform]})
+    log.append({"kind": "retune", "reason": "anomaly",
+                "plan_keys": [good]})
+    log.close()
+    assert autotune.keys_from_verdicts(
+        [str(tmp_path)], platform="cpu"
+    ) == [good]
+    # platform=None keeps every well-formed key
+    assert autotune.keys_from_verdicts([str(tmp_path)]) == [
+        good, other_platform
+    ]
+    assert autotune.keys_from_verdicts([str(tmp_path / "nope")]) == []
+    # the keys feed the sweep directly
+    planobj, _ = autotune.sweep([good])
+    assert good in planobj.entries
+
+
+def _run_cli(module, *argv, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", module, *argv],
+        capture_output=True, text=True, cwd=REPO, timeout=timeout,
+    )
+
+
+def test_planner_tune_from_verdicts_cli(tmp_path):
+    straggler_world_with_payloads(tmp_path)
+    clock, agg, sdoc = make_stream(tmp_path)
+    sdoc.check()  # writes the retune event into live.jsonl
+    cache = str(tmp_path / "plan.json")
+    res = _run_cli("mpi4jax_tpu.planner", "tune",
+                   "--from-verdicts", str(tmp_path),
+                   "--cache", cache, "--platform", "cpu")
+    assert res.returncode == 0, res.stderr
+    assert "recommended by live verdicts" in res.stderr
+    planobj = _plan.load(cache, platform="cpu")
+    keys = autotune.keys_from_verdicts([str(tmp_path)], platform="cpu")
+    assert keys and set(keys) <= set(planobj.entries)
+
+    # no recommendations -> exit 2, cache untouched
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    res = _run_cli("mpi4jax_tpu.planner", "tune",
+                   "--from-verdicts", str(empty), "--cache", cache,
+                   "--platform", "cpu")
+    assert res.returncode == 2
+    assert "no retune events" in res.stderr
+
+
+# ---------------------------------------------------------------------
+# aggregator snapshot / dashboard / OpenMetrics
+# ---------------------------------------------------------------------
+
+
+def test_aggregator_snapshot_state(tmp_path):
+    logs = clean_world(n_ranks=2, n_seq=4)
+    logs[0].append(heartbeat(0, time.time()))
+    write_logs(tmp_path, logs)
+    agg = LiveAggregator(str(tmp_path), platform="cpu")
+    assert agg.poll() > 0
+    assert agg.poll() == 0  # drained
+    snap = agg.snapshot()
+    assert snap["ranks"] == [0, 1]
+    assert snap["seqs"] == {"0": 4, "1": 4}
+    assert snap["seq_skew"] == 0
+    assert "AllReduce|-" in snap["totals"]
+    assert snap["totals"]["AllReduce|-"]["emissions"] == 8
+    assert snap["heartbeat_age_s"]["0"] >= 0
+    key = _plan.plan_key("AllReduce", nbytes=16, dtype="float32",
+                         world=2, axes=("ranks",), platform="cpu")
+    assert snap["plan_keys"][key]["emissions"] == 8
+    dash = render_dashboard(snap)
+    assert "rank" in dash and "AllReduce" in dash
+    line = status_line(snap)
+    assert "r0:4" in line and "skew 0" in line
+
+
+def test_openmetrics_render_contract(tmp_path):
+    write_logs(tmp_path, clean_world())
+    agg = LiveAggregator(str(tmp_path), platform="cpu")
+    agg.poll()
+    verdicts = [{"kind": "verdict", "klass": "transient",
+                 "finding": {"kind": "hang", "rank": 1}}]
+    text = prom_export.render_openmetrics(agg.snapshot(), verdicts=verdicts)
+    assert text.endswith("# EOF\n")
+    lines = text.splitlines()
+    assert 'm4t_rank_last_seq{rank="0"} 4' in lines
+    assert 'm4t_emissions_total{impl="-",op="AllReduce"} 8' in lines
+    assert 'm4t_verdicts_total{kind="hang",klass="transient"} 1' in lines
+    # TYPE precedes every family's samples
+    seen_types = set()
+    for ln in lines:
+        if ln.startswith("# TYPE"):
+            seen_types.add(ln.split()[2])
+        elif ln and not ln.startswith("#"):
+            name = ln.split("{")[0].split(" ")[0]
+            assert name in seen_types, f"sample before its TYPE: {ln}"
+
+
+def test_openmetrics_label_escaping():
+    text = prom_export.render_openmetrics({
+        "ranks": [0], "records": 1, "seqs": {"0": 1}, "seq_skew": 0,
+        "stalled_s": None, "heartbeat_age_s": {}, "emission_age_s": {},
+        "totals": {'Op"quoted\\|x': {"emissions": 1, "payload_bytes": 2}},
+        "plan_keys": {}, "rates": {}, "anomalies": 0,
+    })
+    assert 'op="Op\\"quoted\\\\"' in text
+
+
+def test_write_prom_is_atomic_and_replaces(tmp_path):
+    path = str(tmp_path / "metrics.prom")
+    prom_export.write_prom(path, "# EOF\n")
+    assert open(path).read() == "# EOF\n"
+    prom_export.write_prom(path, "m4t_live_ranks 2\n# EOF\n")
+    assert open(path).read().startswith("m4t_live_ranks")
+    leftovers = [p for p in os.listdir(str(tmp_path))
+                 if p.startswith(".prom-")]
+    assert leftovers == []
+
+
+def test_http_metrics_endpoint(tmp_path):
+    payload = {"text": "m4t_live_ranks 2\n# EOF\n"}
+    server = prom_export.serve(lambda: payload["text"], port=0)
+    try:
+        base = f"http://127.0.0.1:{server.server_port}"
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as resp:
+            assert resp.status == 200
+            assert "openmetrics-text" in resp.headers["Content-Type"]
+            assert resp.read().decode() == payload["text"]
+        payload["text"] = "m4t_live_ranks 4\n# EOF\n"  # live re-render
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as resp:
+            assert b"4" in resp.read()
+        try:
+            urllib.request.urlopen(f"{base}/other", timeout=10)
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        else:
+            raise AssertionError("non-/metrics paths must 404")
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------
+
+
+def test_live_cli_selftest():
+    res = _run_cli("mpi4jax_tpu.observability.live", "--selftest")
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "live selftest ok" in res.stdout
+
+
+def test_live_cli_snapshot_and_json(tmp_path):
+    write_logs(tmp_path, clean_world())
+    res = _run_cli("mpi4jax_tpu.observability.live", str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    assert "m4t live" in res.stdout and "AllReduce" in res.stdout
+    res = _run_cli("mpi4jax_tpu.observability.live", str(tmp_path), "--json")
+    assert res.returncode == 0, res.stderr
+    obj = json.loads(res.stdout)
+    assert obj["snapshot"]["seqs"] == {"0": 4, "1": 4}
+    assert obj["verdicts"] == []
+
+
+def test_live_cli_writes_prom(tmp_path):
+    write_logs(tmp_path, clean_world())
+    out = str(tmp_path / "m.prom")
+    res = _run_cli("mpi4jax_tpu.observability.live", str(tmp_path),
+                   "--prom", out)
+    assert res.returncode == 0, res.stderr
+    assert open(out).read().endswith("# EOF\n")
+
+
+# ---------------------------------------------------------------------
+# end-to-end: real 2-rank launcher worlds on CPU (slow-marked)
+# ---------------------------------------------------------------------
+
+needs_native = pytest.mark.skipif(
+    subprocess.run(["which", "g++"], capture_output=True).returncode != 0,
+    reason="no C++ toolchain",
+)
+
+
+def _launch(tmp_path, n, script, *launch_args, timeout=180):
+    import textwrap
+
+    path = str(tmp_path / "case.py")
+    with open(path, "w") as f:
+        f.write(f"import sys; sys.path.insert(0, {REPO!r})\n")
+        f.write(textwrap.dedent(script))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    return subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.launch", "-n", str(n),
+         *launch_args, path],
+        env=env, capture_output=True, text=True, timeout=timeout, cwd=REPO,
+    )
+
+
+LOOP_SCRIPT = """
+import jax.numpy as jnp
+import mpi4jax_tpu as m4t
+from mpi4jax_tpu.runtime import shm
+x = jnp.arange(1024.0) + shm.rank()
+for i in range({n}):
+    x = m4t.allreduce(x) * 0.5
+    float(x[0])
+print("DONE", shm.rank(), flush=True)
+"""
+
+
+@needs_native
+@pytest.mark.slow
+def test_launch_live_escalates_fault_hang_before_watchdog(tmp_path):
+    """Acceptance: under a --fault-plan injected hang the streaming
+    doctor names the hung rank and its stuck_before collective and
+    the launcher exits *long before* --hang-timeout, with a diagnosis
+    the offline doctor agrees with."""
+    rundir = str(tmp_path / "run")
+    start = time.monotonic()
+    res = _launch(
+        tmp_path, 2, LOOP_SCRIPT.format(n=6),
+        "--events-dir", rundir, "--live", "--live-grace", "3",
+        "--heartbeat", "1", "--hang-timeout", "120",
+        "--fault-plan",
+        '[{"rank": 1, "op": "AllReduce", "nth": 3, "action": "hang"}]',
+    )
+    elapsed = time.monotonic() - start
+    assert res.returncode == 124, (res.returncode, res.stderr)
+    assert elapsed < 60, f"escalation took {elapsed:.0f}s (watchdog is 120s)"
+    assert "streaming doctor confirmed a verdict" in res.stderr
+    assert "rank 1 recorded seq 3 but never began executing it" in res.stderr
+    assert "stuck before: AllReduce" in res.stderr
+    # the offline doctor reaches the same verdict from the artifacts
+    (f,) = [x for x in doctor.diagnose([rundir])["findings"]
+            if x["kind"] == "hang"]
+    assert f["rank"] == 1 and f["wedged"] and f["last_seq"] == 3
+    assert f["stuck_before"].startswith("AllReduce")
+    # the same diagnosis was printed as the exit post-mortem
+    assert "post-mortem diagnosis" in res.stderr
+    # verdict event recorded with the supervisor's classification
+    (v,) = [r for r in events.read(os.path.join(rundir, "live.jsonl"))
+            if r["kind"] == "verdict"]
+    assert v["klass"] == "transient" and v["finding"]["rank"] == 1
+    # and the exporter left a final scrape behind
+    prom = open(os.path.join(rundir, "metrics.prom")).read()
+    assert prom.endswith("# EOF\n") and 'm4t_rank_last_seq{rank="1"} 3' in prom
+
+
+@needs_native
+@pytest.mark.slow
+def test_launch_live_slowdown_yields_retune_that_repins(tmp_path):
+    """Acceptance: an injected slowdown produces a retune event whose
+    plan keys `tune --from-verdicts` accepts and re-pins."""
+    rundir = str(tmp_path / "run")
+    res = _launch(
+        tmp_path, 2, LOOP_SCRIPT.format(n=12),
+        "--events-dir", rundir, "--live", "--heartbeat", "1",
+        "--fault-plan",
+        '[{"rank": 1, "op": "AllReduce", "nth": 1, '
+        '"action": "slowdown", "ms": 40}]',
+    )
+    assert res.returncode == 0, res.stderr
+    retunes = [r for r in events.read(os.path.join(rundir, "live.jsonl"))
+               if r["kind"] == "retune"]
+    assert retunes, "slowdown must produce a retune recommendation"
+    assert retunes[0]["reason"] == "straggler"
+    assert retunes[0]["op"] == "AllReduce" and retunes[0]["plan_keys"]
+    cache = str(tmp_path / "plan.json")
+    cli = _run_cli("mpi4jax_tpu.planner", "tune", "--from-verdicts",
+                   rundir, "--cache", cache, "--platform", "cpu")
+    assert cli.returncode == 0, cli.stderr
+    planobj = _plan.load(cache, platform="cpu")
+    for key in retunes[0]["plan_keys"]:
+        assert key in planobj.entries, f"{key} not re-pinned"
