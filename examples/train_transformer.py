@@ -17,6 +17,17 @@ restart under the self-healing supervisor (``launch --retries K
 ``--resume`` — continues from the newest valid checkpoint instead of
 step 0. Training is deterministic given (params, step), so a resumed
 run reproduces the uninterrupted one exactly.
+
+Elastic (``m4t-ckpt/2``): checkpoints are written in the *sharded*
+schema — the manifest records each leaf's global shape and layout
+(params are replicated across the data-parallel ranks, so one copy is
+stored), which makes them world-size independent: a run preempted at
+``--nproc 4`` resumes at ``--nproc 2`` from the same checkpoint (pass
+``--seq-total`` so the global batch stays fixed while the per-rank
+slice scales). A SIGTERM preemption notice is caught by
+``resilience.PreemptGuard``: the loop finishes its step, checkpoints,
+and exits 143 — the grace-window behavior a real preempted host needs,
+and what ``launch --elastic`` keys its world-shrinking restart on.
 """
 
 import argparse
@@ -71,6 +82,13 @@ def main():
     p.add_argument("--nproc", type=int, default=None)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--seq-per-rank", type=int, default=16)
+    p.add_argument(
+        "--seq-total", type=int, default=None, metavar="T",
+        help="fix the GLOBAL sequence length regardless of world size "
+        "(must divide by the world; overrides --seq-per-rank) — what "
+        "makes a 4-rank run and its 2-rank elastic resume the same "
+        "training problem",
+    )
     p.add_argument("--attention", choices=["ring", "ulysses"], default="ring")
     p.add_argument("--platform", default=None)
     p.add_argument(
@@ -116,6 +134,14 @@ def main():
     n = min(n, len(jax.devices()))
     mesh = world_mesh(n)
     t_local = args.seq_per_rank
+    if args.seq_total:
+        if args.seq_total % n:
+            print(
+                f"--seq-total {args.seq_total} is not divisible by the "
+                f"world size {n}", file=sys.stderr,
+            )
+            sys.exit(2)
+        t_local = args.seq_total // n
     t = n * t_local
 
     cfg = tfm.TransformerConfig(
@@ -153,28 +179,103 @@ def main():
 
     mgr = None
     start_step = 0
+    guard = None
+    save_ckpt = None
     if args.ckpt_dir:
-        from mpi4jax_tpu.resilience import CheckpointManager, resume_step
-        from mpi4jax_tpu.resilience.ckpt import pytree_fingerprint
+        from mpi4jax_tpu.resilience import (
+            CheckpointManager, PreemptGuard, resume_step,
+        )
+        from mpi4jax_tpu.resilience import ckpt as ckpt_mod
+        from mpi4jax_tpu.resilience.reshard import (
+            spec_for_array, specs_fingerprint,
+        )
 
-        mgr = CheckpointManager(args.ckpt_dir, keep=args.ckpt_keep)
-        fp = pytree_fingerprint({"params": params})
+        # the preemption grace hook: SIGTERM -> finish the step,
+        # checkpoint, exit 143 (see the loop below)
+        guard = PreemptGuard()
+        mgr = CheckpointManager(
+            args.ckpt_dir, keep=args.ckpt_keep, world=n
+        )
+
+        def one_copy(ps):
+            # params are replicated across the data-parallel ranks
+            # (identical gradients applied identically); the stacked
+            # leading axis is execution layout, not state
+            return ps if n == 1 else jax.tree.map(lambda a: a[0], ps)
+
+        def restack(single):
+            host = jax.tree.map(lambda a: jnp.asarray(np.asarray(a)),
+                                single)
+            if n == 1:
+                return host
+            return jax.tree.map(
+                lambda a: jnp.broadcast_to(a, (n,) + a.shape), host
+            )
+
+        flat0 = ckpt_mod.tree_leaves_dict({"params": one_copy(params)})
+        specs = {
+            k: spec_for_array(v, kind="replicated")
+            for k, v in flat0.items()
+        }
+        fp = specs_fingerprint(specs)
+
+        def save_ckpt(step, ps):
+            mgr.save_sharded(
+                step,
+                ckpt_mod.tree_leaves_dict({"params": one_copy(ps)}),
+                specs,
+            )
+
         rstep = resume_step()
         if rstep is not None:
             # the supervisor validated this exact step before the
             # restart; every rank must restore it, not whatever is
             # newest by the time it looks
-            info = mgr.at_step(rstep, fingerprint=fp)
+            info = mgr.at_step(rstep, allow_reshard=True)
         else:
-            info = mgr.latest_valid(fingerprint=fp) if args.resume else None
-        if info is not None:
-            restored = mgr.restore(info, {"params": params})["params"]
-            # decommit: orbax pins restored leaves to one device, but
-            # the spmd step wants the same uncommitted host arrays the
-            # fresh-init path produces (jit reshards those freely)
-            params = jax.tree.map(
-                lambda a: jnp.asarray(np.asarray(a)), restored
+            info = (
+                mgr.latest_valid(allow_reshard=True)
+                if args.resume else None
             )
+        if info is not None and info.sharded and (
+            info.manifest.get("fingerprint") not in (None, fp)
+        ):
+            print(
+                f"ignoring checkpoint step {info.step}: layout "
+                f"fingerprint {info.manifest.get('fingerprint')} != "
+                f"this model's {fp}", file=sys.stderr,
+            )
+            info = None
+        if info is not None and not info.sharded and info.world_mismatch:
+            # a v1 checkpoint records no layout; only same-world resume
+            print(
+                f"ignoring pre-elastic (m4t-ckpt/1) checkpoint step "
+                f"{info.step} from world {info.world}", file=sys.stderr,
+            )
+            info = None
+        if info is not None:
+            if info.sharded:
+                # world-independent read: replicated leaves are stored
+                # once, so a 4-rank checkpoint loads at 2 ranks as-is
+                flat = ckpt_mod.load_sharded_global(info)
+                single = ckpt_mod.tree_from_dict(
+                    {"params": one_copy(params)}, flat
+                )["params"]
+                params = restack(single)
+                if info.world_mismatch:
+                    print(
+                        f"elastic resume: checkpoint step {info.step} "
+                        f"was written at world {info.world}, resuming "
+                        f"at world {n}", file=sys.stderr,
+                    )
+            else:
+                restored = mgr.restore(info, {"params": params})["params"]
+                # decommit: orbax pins restored leaves to one device,
+                # but the spmd step wants the same uncommitted host
+                # arrays the fresh-init path produces
+                params = jax.tree.map(
+                    lambda a: jnp.asarray(np.asarray(a)), restored
+                )
             start_step = info.step + 1
             print(
                 f"resumed from checkpoint step {info.step} "
@@ -185,6 +286,17 @@ def main():
     first = last = None
     loss = None
     for i in range(start_step, args.steps):
+        if guard is not None and guard.preempted:
+            # the SIGTERM grace window: commit what we have (the
+            # params reflect step i-1) and leave with the preemption
+            # signature the elastic supervisor keys on
+            if i > start_step:
+                save_ckpt(i - 1, params)
+                print(
+                    f"preempted: checkpointed step {i - 1}, exiting "
+                    f"{guard.exit_code}", file=sys.stderr,
+                )
+            sys.exit(guard.exit_code)
         # liveness for the hang analysis: a jitted step emits its
         # collectives once at trace, so without this a long training
         # run looks dead to the doctor (no-op when no sink is armed)
@@ -199,7 +311,7 @@ def main():
         if mgr is not None and (
             (i + 1) % args.ckpt_every == 0 or i == args.steps - 1
         ):
-            mgr.save(i, {"params": params})
+            save_ckpt(i, params)
     if loss is None:
         print("nothing to do: checkpoint is already past --steps",
               file=sys.stderr)

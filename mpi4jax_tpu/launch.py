@@ -57,13 +57,28 @@ Resilience (``resilience/``): ``--fault-plan`` arms a deterministic
 fault-injection plan in every rank (chaos testing); ``--retries K
 --backoff S --resume-dir CKPTROOT`` runs the world under the
 self-healing supervisor — failed attempts are diagnosed by the doctor
-and classified: transient failures (hang, dead rank, plain crash)
-restart from the latest valid checkpoint with exponential backoff
-(``M4T_RESUME_STEP`` exported to the children), deterministic ones
-(MISMATCH) fail fast with the diagnosis. With retries, each attempt
-gets its own ``DIR/attempt<k>`` artifact directory and every verdict
-lands in ``DIR/supervisor.jsonl``. ``--retries 0`` (the default) is
-byte-for-byte the old single-attempt behavior.
+and classified: transient failures (hang, dead rank, plain crash,
+preemption) restart from the latest valid checkpoint with exponential
+backoff (``M4T_RESUME_STEP`` exported to the children), deterministic
+ones (MISMATCH) fail fast with the diagnosis. With retries, each
+attempt gets its own ``DIR/attempt<k>`` artifact directory and every
+verdict lands in ``DIR/supervisor.jsonl``. ``--retries 0`` (the
+default) is byte-for-byte the old single-attempt behavior.
+
+Elastic resume (``--elastic --min-ranks K``, with retries and
+``--resume-dir``): ranks that exit with the preemption signature
+(``PREEMPT_EXIT`` 143 from a :class:`resilience.PreemptGuard` grace
+exit, or an unhandled SIGTERM) are counted as *capacity lost* rather
+than a bug — the next attempt restarts at the shrunk world, the newest
+``m4t-ckpt/2`` checkpoint is resharded N→M offline through a planned
+schedule whose peak scratch is bounded by two shard sizes
+(``resilience/reshard.py``), ``--verify`` re-proves the target
+deadlock-free at M ranks before any rank spawns, and the plan cache's
+world-keyed entries simply stop matching at M (plan keys include
+world), so collective routing falls back to the default policy by
+construction. The ``supervisor.jsonl`` audit records every world-size
+transition (old world, new world, reshard source step) and the doctor
+narrates them post-mortem.
 """
 
 from __future__ import annotations
@@ -179,7 +194,7 @@ def _run_tune(events_dir, plan_path):
         sys.stderr.write(f"mpi4jax_tpu.launch: --tune failed: {exc!r}\n")
 
 
-def _verify_prelaunch(args) -> int:
+def _verify_prelaunch(args, world=None) -> int:
     """``--verify``: prove the target's collective schedules
     deadlock-free at ``-n`` ranks *before any rank spawns*.
 
@@ -191,11 +206,16 @@ def _verify_prelaunch(args) -> int:
     an unprovable schedule — blocks the launch with exit 1. A target
     that declares no entry points is a warning, not a block (there is
     nothing to verify). Returns 0 to proceed.
+
+    ``world`` overrides ``-n`` — the elastic supervisor re-proves the
+    target at the *shrunk* world before respawning (a program
+    deadlock-free at 4 ranks is not automatically deadlock-free at 2).
     """
+    world = args.nproc if world is None else int(world)
     target = args.module if args.module else args.cmd[0]
     sys.stderr.write(
         f"mpi4jax_tpu.launch: --verify: proving {target!r} "
-        f"deadlock-free at n={args.nproc} before spawning\n"
+        f"deadlock-free at n={world} before spawning\n"
     )
     try:
         from .analysis import lint_module, verify_module
@@ -209,8 +229,8 @@ def _verify_prelaunch(args) -> int:
         )
         return 1
     try:
-        lint_reports = lint_module(module, world=args.nproc)
-        sim_reports = verify_module(module, world=args.nproc)
+        lint_reports = lint_module(module, world=world)
+        sim_reports = verify_module(module, world=world)
     except Exception as exc:
         sys.stderr.write(
             f"mpi4jax_tpu.launch: --verify failed: {exc!r}\n"
@@ -219,7 +239,7 @@ def _verify_prelaunch(args) -> int:
     if not sim_reports and not lint_reports:
         sys.stderr.write(
             f"mpi4jax_tpu.launch: --verify: {target!r} declares no "
-            f"M4T_LINT_TARGETS (at world {args.nproc}); nothing to "
+            f"M4T_LINT_TARGETS (at world {world}); nothing to "
             "verify — proceeding\n"
         )
         return 0
@@ -246,9 +266,14 @@ def _verify_prelaunch(args) -> int:
         return 1
     sys.stderr.write(
         f"mpi4jax_tpu.launch: --verify: {len(sim_reports)} target(s) "
-        f"proved deadlock-free at n={args.nproc}; spawning\n"
+        f"proved deadlock-free at n={world}; spawning\n"
     )
     return 0
+
+
+#: rank exit signatures that read "preemption notice honored": the
+#: PreemptGuard's graceful 143, or death by unhandled SIGTERM
+_PREEMPT_RCS = (143, -signal.SIGTERM)
 
 
 def _spawn_world(
@@ -258,8 +283,10 @@ def _spawn_world(
     attempt=0,
     resume_step=None,
     fault_plan_env=None,
+    world=None,
 ):
-    """Spawn and babysit one N-rank world; returns its exit code.
+    """Spawn and babysit one world of ``world`` ranks (default
+    ``-n``); returns ``(exit_code, preempted_ranks)``.
 
     One *attempt* in supervisor terms: a fresh shm segment name and
     generation nonce every time, so a restarted world can never attach
@@ -269,19 +296,30 @@ def _spawn_world(
     grace period to dump flight recorders, then killed — a surviving
     rank wedged inside a native collective must not hold the launcher
     (or the retry loop) hostage.
+
+    ``preempted_ranks`` are ranks that exited with the preemption
+    signature (``PREEMPT_EXIT`` 143, or an unhandled SIGTERM) *on
+    their own*, before the launcher began tearing the world down —
+    launcher-terminated survivors never count. Under ``--elastic`` a
+    preempt-first failure gets a short settle window before teardown
+    so co-preempted ranks (a whole host's worth, in real fleets) are
+    counted together; the elastic supervisor then restarts at
+    ``world - len(preempted)``.
     """
+    world = args.nproc if world is None else int(world)
     shm_name = f"/m4t_{os.getpid()}_{attempt}_{uuid.uuid4().hex[:8]}"
     # nonzero u32: 0 means "no generation check" to the extension
     shm_gen = random.getrandbits(32) | 1
     procs = []
     monitor = None
+    preempted = set()
     try:
-        for rank in range(args.nproc):
+        for rank in range(world):
             env = dict(os.environ)
             env.update(
                 M4T_SHM_NAME=shm_name,
                 M4T_RANK=str(rank),
-                M4T_SIZE=str(args.nproc),
+                M4T_SIZE=str(world),
                 M4T_SHM_GEN=str(shm_gen),
                 # world membership is for *direct* children only:
                 # runtime/shm.py refuses to join when the parent pid
@@ -365,6 +403,11 @@ def _spawn_world(
         # recorder dumps), then SIGKILL — a rank wedged in a native
         # collective spin can't run Python handlers at all
         term_deadline = None
+        # armed under --elastic when the first failure is a preemption
+        # exit: wait briefly before teardown so co-preempted ranks
+        # finish their own grace exits and are counted as capacity
+        # loss, not as launcher-terminated survivors
+        settle_deadline = None
         while not all(done):
             for i, p in enumerate(procs):
                 if done[i]:
@@ -373,11 +416,39 @@ def _spawn_world(
                 if rc is None:
                     continue
                 done[i] = True
+                if rc in _PREEMPT_RCS and term_deadline is None:
+                    preempted.add(i)
                 if rc != 0 and exit_code == 0:
                     exit_code = rc
+                    if getattr(args, "elastic", False) and (
+                        rc in _PREEMPT_RCS
+                    ):
+                        sys.stderr.write(
+                            f"mpi4jax_tpu.launch: rank {i} exited with "
+                            f"the preemption signature ({rc}); settling "
+                            "before teardown to count co-preempted "
+                            "ranks\n"
+                        )
+                        settle_deadline = time.monotonic() + 1.0
+                    else:
+                        sys.stderr.write(
+                            f"mpi4jax_tpu.launch: rank {i} exited with "
+                            f"code {rc}; terminating world\n"
+                        )
+                        term_deadline = time.monotonic() + 10.0
+                        for q in procs:
+                            if q.poll() is None:
+                                q.terminate()
+            if settle_deadline is not None and term_deadline is None and (
+                all(done) or time.monotonic() > settle_deadline
+            ):
+                settle_deadline = None
+                if not all(done):
                     sys.stderr.write(
-                        f"mpi4jax_tpu.launch: rank {i} exited with code "
-                        f"{rc}; terminating world\n"
+                        "mpi4jax_tpu.launch: "
+                        f"{len(preempted)} rank(s) preempted "
+                        f"({','.join(map(str, sorted(preempted)))}); "
+                        "terminating the survivors\n"
                     )
                     term_deadline = time.monotonic() + 10.0
                     for q in procs:
@@ -455,14 +526,23 @@ def _spawn_world(
                 exit_code = 124
                 break
             time.sleep(0.02)
-        return exit_code
+        if getattr(args, "elastic", False) and preempted and (
+            exit_code in _PREEMPT_RCS
+        ):
+            # normalize the world's exit to the canonical preemption
+            # signature (a guardless rank dies -SIGTERM) so the
+            # supervisor classifies it as "preempted", not "crash"
+            from .resilience.supervisor import PREEMPT_EXIT
+
+            exit_code = PREEMPT_EXIT
+        return exit_code, sorted(preempted)
     except KeyboardInterrupt:
         for p in procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGINT)
         for p in procs:
             p.wait()
-        return 130
+        return 130, sorted(preempted)
     finally:
         if monitor is not None:
             monitor.stop()
@@ -601,6 +681,25 @@ def main(argv=None):
         "restart the newest *valid* checkpoint step is found here and "
         "exported to every rank as M4T_RESUME_STEP",
     )
+    parser.add_argument(
+        "--elastic", action="store_true",
+        help="elastic world-size resume (requires --retries and "
+        "--resume-dir): ranks exiting with the preemption signature "
+        "(PREEMPT_EXIT 143 / SIGTERM) count as capacity lost, and the "
+        "next attempt restarts at the shrunk world — the newest "
+        "m4t-ckpt/2 checkpoint is resharded N->M offline "
+        "(resilience/reshard.py, peak scratch bounded by 2 shard "
+        "sizes), --verify re-proves the target at M ranks, and the "
+        "plan cache's world-keyed entries simply stop matching (plan "
+        "keys include world, so routing at M falls back to the "
+        "default policy by construction)",
+    )
+    parser.add_argument(
+        "--min-ranks", type=int, default=1, metavar="K",
+        help="elastic floor: never shrink below K ranks — fewer "
+        "survivors than K is a give-up, not a smaller world "
+        "(default %(default)s)",
+    )
     parser.add_argument("cmd", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
 
@@ -619,6 +718,14 @@ def main(argv=None):
         parser.error("--retries must be >= 0")
     if args.backoff < 0:
         parser.error("--backoff must be >= 0")
+    if args.min_ranks < 1:
+        parser.error("--min-ranks must be >= 1")
+    if args.min_ranks > args.nproc:
+        parser.error("--min-ranks cannot exceed -n")
+    if args.elastic and (args.retries < 1 or not args.resume_dir):
+        parser.error("--elastic requires --retries >= 1 (the restart "
+                     "loop) and --resume-dir (the checkpoint to "
+                     "reshard)")
 
     if args.verify:
         rc = _verify_prelaunch(args)
@@ -684,7 +791,7 @@ def main(argv=None):
     if args.retries == 0:
         # the pre-supervisor contract, preserved exactly: one attempt,
         # flat artifact layout, same exit codes
-        exit_code = _spawn_world(
+        exit_code, _preempted = _spawn_world(
             args, events_dir, fault_plan_env=fault_plan_env
         )
         if events_dir and (exit_code != 0 or args.doctor):
@@ -698,7 +805,15 @@ def main(argv=None):
     # -- supervised path (--retries K) --------------------------------
     from .resilience.supervisor import RetryPolicy, Supervisor
 
-    state = {"dir": events_dir}
+    state = {
+        "dir": events_dir,
+        "world": args.nproc,      # world the NEXT attempt spawns at
+        "world_ran": args.nproc,  # world the LAST attempt ran at
+        "preempted": [],
+        "transition": None,       # elastic shrink decided for next
+        "blocked": None,          # elastic give-up reason, if any
+        "last_exit": 0,
+    }
 
     def attempt_dir(attempt):
         if not events_dir:
@@ -708,21 +823,36 @@ def main(argv=None):
         return d
 
     def run_fn(attempt, resume_step):
+        if state["blocked"]:
+            # elastic give-up: not enough survivors (or the shrunk
+            # world failed verification) — burning a spawn here would
+            # just pretend capacity came back
+            sys.stderr.write(
+                f"mpi4jax_tpu.launch: attempt {attempt} not spawned: "
+                f"{state['blocked']}\n"
+            )
+            return state["last_exit"] or 1
         d = attempt_dir(attempt)
         state["dir"] = d
+        world = state["world"]
+        state["world_ran"] = world
         sys.stderr.write(
-            f"mpi4jax_tpu.launch: attempt {attempt}"
+            f"mpi4jax_tpu.launch: attempt {attempt} (world {world})"
             + (f" (resuming from step {resume_step})"
                if resume_step is not None else "")
             + (f" [{d}]" if d else "")
             + "\n"
         )
-        return _spawn_world(
+        exit_code, preempted = _spawn_world(
             args, d,
             attempt=attempt,
             resume_step=resume_step,
             fault_plan_env=fault_plan_env,
+            world=world,
         )
+        state["preempted"] = preempted
+        state["last_exit"] = exit_code
+        return exit_code
 
     def diagnose_fn(attempt):
         d = state.get("dir")
@@ -750,15 +880,92 @@ def main(argv=None):
         )
         return report
 
+    def _log(msg):
+        sys.stderr.write(f"mpi4jax_tpu.launch: {msg}\n")
+
+    def _elastic_shrink():
+        """Decide the next attempt's world after a preemption: shrink
+        to the survivors, reshard the newest checkpoint to the new
+        world, and re-prove the target there. Returns the resume step
+        (or None), having updated ``state``."""
+        from .resilience import reshard as _reshard
+        from .resilience.ckpt import CheckpointManager
+
+        old_world = state["world"]
+        lost = len(state["preempted"])
+        new_world = old_world - lost
+        if new_world < args.min_ranks:
+            state["blocked"] = (
+                f"elastic: only {new_world} survivor(s) of {old_world} "
+                f"after {lost} preemption(s) — below --min-ranks "
+                f"{args.min_ranks}; giving up"
+            )
+            _log(state["blocked"])
+            return None
+        _log(
+            f"elastic: {lost} rank(s) preempted "
+            f"({','.join(map(str, state['preempted']))}); shrinking "
+            f"world {old_world} -> {new_world}"
+        )
+        mgr = CheckpointManager(resume_dir, world=new_world)
+        info = mgr.latest_valid(world=new_world, allow_reshard=True)
+        resume = None
+        reshard_src = None
+        if info is None:
+            _log(
+                "elastic: no valid checkpoint to carry over; the "
+                f"shrunk world restarts from step 0"
+            )
+        elif not info.world_mismatch:
+            resume = info.step  # already at the new world
+        elif not info.sharded:
+            _log(
+                f"elastic: checkpoint step {info.step} (world "
+                f"{info.world}) predates {info.schema or 'm4t-ckpt/1'} "
+                "sharded manifests and cannot be resharded; the "
+                "shrunk world restarts from step 0"
+            )
+        else:
+            try:
+                new_info = _reshard.reshard_checkpoint(
+                    mgr, info, new_world,
+                    log=lambda m: _log(f"elastic: {m}"),
+                )
+                resume = new_info.step
+                reshard_src = {
+                    "step": info.step, "world": info.world,
+                }
+            except Exception as exc:
+                _log(
+                    f"elastic: reshard of step {info.step} failed "
+                    f"({exc!r}); the shrunk world restarts from step 0"
+                )
+        if args.verify and _verify_prelaunch(args, world=new_world) != 0:
+            state["blocked"] = (
+                f"elastic: --verify failed at the shrunk world "
+                f"{new_world}; giving up"
+            )
+            _log(state["blocked"])
+            return None
+        state["transition"] = {
+            "world": old_world,
+            "next_world": new_world,
+            "resharded_from": reshard_src,
+        }
+        state["world"] = new_world
+        return resume
+
     def resume_fn():
         if not resume_dir:
             return None
         try:
+            if args.elastic and state["preempted"]:
+                return _elastic_shrink()
             from .resilience.ckpt import CheckpointManager
 
             info = CheckpointManager(
-                resume_dir, world=args.nproc
-            ).latest_valid(world=args.nproc)
+                resume_dir, world=state["world"]
+            ).latest_valid(world=state["world"])
             return None if info is None else info.step
         except Exception as exc:
             sys.stderr.write(
@@ -766,12 +973,29 @@ def main(argv=None):
             )
             return None
 
+    def extra_fn(attempt):
+        rec = {"world": state["world_ran"]}
+        if state["preempted"]:
+            rec["preempted_ranks"] = list(state["preempted"])
+        transition = state["transition"]
+        if transition is not None:
+            rec["next_world"] = transition["next_world"]
+            src = transition.get("resharded_from")
+            if src:
+                rec["resharded_from_step"] = src["step"]
+                rec["resharded_from_world"] = src["world"]
+            state["transition"] = None
+        if state["blocked"]:
+            rec["elastic_blocked"] = state["blocked"]
+        return rec
+
     audit_root = events_dir or resume_dir
     sup = Supervisor(
         run_fn,
         policy=RetryPolicy(retries=args.retries, backoff_s=args.backoff),
         diagnose_fn=diagnose_fn,
         resume_fn=resume_fn,
+        extra_fn=extra_fn,
         audit_path=(
             os.path.join(audit_root, "supervisor.jsonl")
             if audit_root else None

@@ -767,6 +767,80 @@ def format_report(report: Dict[str, Any]) -> str:
 
 
 # ---------------------------------------------------------------------
+# supervisor timeline (elastic recovery narration)
+# ---------------------------------------------------------------------
+
+
+def load_supervisor_audit(
+    inputs: Iterable[str],
+) -> List[Dict[str, Any]]:
+    """``supervisor.jsonl`` records found beside the given inputs, or
+    one level up — a doctor pointed at ``RUN/attempt01`` finds the
+    audit log the supervisor writes at ``RUN/``. A per-attempt rank
+    log can't explain a restart; the audit trail can."""
+    seen: set = set()
+    records: List[Dict[str, Any]] = []
+    for item in inputs:
+        d = item if os.path.isdir(item) else os.path.dirname(item)
+        d = os.path.abspath(d)
+        for cand in (d, os.path.dirname(d)):
+            path = os.path.join(cand, "supervisor.jsonl")
+            if path in seen:
+                continue
+            seen.add(path)
+            if not os.path.exists(path):
+                continue
+            try:
+                records.extend(
+                    r for r in events.iter_records(path)
+                    if r.get("kind") == "supervisor"
+                )
+            except OSError:
+                continue
+    return records
+
+
+def format_supervisor_timeline(records: List[Dict[str, Any]]) -> str:
+    """Narrate the supervisor's attempts — including elastic
+    world-size transitions (old world → new world, the resharded
+    checkpoint step) — so a run that was preempted, shrunk, resharded
+    and resumed explains itself post-mortem."""
+    out = [f"supervisor timeline ({len(records)} attempt(s)):"]
+    for r in records:
+        attempt = r.get("attempt", "?")
+        world = r.get("world")
+        line = f"  attempt {attempt}:"
+        if world is not None:
+            line += f" world {world},"
+        line += (
+            f" exit {r.get('exit_code')} -> {r.get('klass')}"
+            f" ({r.get('reason')}), action {r.get('action')}"
+        )
+        pre = r.get("preempted_ranks")
+        if pre:
+            line += (
+                f"; rank(s) {','.join(str(p) for p in pre)} preempted"
+            )
+        nxt = r.get("next_world")
+        if nxt is not None:
+            line += f"\n    ELASTIC: world {world} -> {nxt}"
+            if r.get("resharded_from_step") is not None:
+                line += (
+                    f"; checkpoint step {r['resharded_from_step']} "
+                    f"(world {r.get('resharded_from_world')}) "
+                    f"resharded for {nxt} rank(s)"
+                )
+            else:
+                line += "; no checkpoint carried over"
+        if r.get("elastic_blocked"):
+            line += f"\n    blocked: {r['elastic_blocked']}"
+        if r.get("action") == "retry" and r.get("resume_step") is not None:
+            line += f"; resume step {r['resume_step']}"
+        out.append(line)
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------
 
@@ -912,6 +986,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(json.dumps(report, indent=1, default=str))
     else:
         print(format_report(report))
+        audit = load_supervisor_audit(args.inputs)
+        if audit:
+            # the restart/elastic story around these artifacts: which
+            # attempts failed, how they were classified, and any
+            # world-size transitions (preemption -> shrink -> reshard)
+            print(format_supervisor_timeline(audit))
     if args.perf:
         from . import perf
 

@@ -16,24 +16,44 @@ incidents — see PAPERS.md, Cloud Collectives):
   last), retention of the last K, and ``latest_valid()`` that skips
   torn or mismatched checkpoints on resume.
 - :mod:`.supervisor` — restart policy over the doctor's verdicts:
-  transient failures (hang, dead/missing rank, plain crash) restart
-  from the latest valid checkpoint with exponential backoff + jitter
-  and ``M4T_RESUME_STEP`` exported to the children; deterministic
-  failures (MISMATCH, statically attributable) fail fast with the
-  diagnosis. Every attempt is recorded in a ``supervisor.jsonl``
-  audit log. Driven by ``python -m mpi4jax_tpu.launch --retries K
-  --backoff S --resume-dir DIR``.
+  transient failures (hang, dead/missing rank, plain crash,
+  preemption) restart from the latest valid checkpoint with
+  exponential backoff + jitter and ``M4T_RESUME_STEP`` exported to
+  the children; deterministic failures (MISMATCH, statically
+  attributable) fail fast with the diagnosis. Every attempt is
+  recorded in a ``supervisor.jsonl`` audit log. Driven by ``python -m
+  mpi4jax_tpu.launch --retries K --backoff S --resume-dir DIR``.
+- :mod:`.reshard` — the elastic half: a planned, peak-memory-bounded
+  (≤ 2 shard sizes per rank) resharding primitive that rewrites an
+  N-rank ``m4t-ckpt/2`` checkpoint for M ranks, device-free (numpy;
+  the offline ``reshard`` CLI) or on-mesh (the existing p2p ops).
+  :class:`~.supervisor.PreemptGuard` turns a SIGTERM preemption
+  notice into checkpoint-and-exit-143, and ``launch --elastic
+  --min-ranks K`` turns "we lost two hosts" into "restart at the
+  shrunk world from a resharded checkpoint" instead of a dead job.
 
 ``python -m mpi4jax_tpu.resilience --selftest`` is the device-free CI
-smoke (no jax, no orbax, no subprocesses). See ``docs/resilience.md``.
+smoke (no devices, no orbax, no subprocesses); ``python -m
+mpi4jax_tpu.resilience reshard --selftest`` covers the resharding
+primitive the same way. See ``docs/resilience.md``.
 """
 
 from . import ckpt  # noqa: F401
 from . import faults  # noqa: F401
+from . import reshard  # noqa: F401
 from . import supervisor  # noqa: F401
 from .ckpt import CheckpointInfo, CheckpointManager  # noqa: F401
 from .faults import FaultPlan, FaultPlanError, InjectedFault  # noqa: F401
+from .reshard import (  # noqa: F401
+    LeafSpec,
+    ReshardError,
+    ReshardPlan,
+    plan_reshard,
+    reshard_checkpoint,
+)
 from .supervisor import (  # noqa: F401
+    PREEMPT_EXIT,
+    PreemptGuard,
     RetryPolicy,
     Supervisor,
     classify,
@@ -47,12 +67,20 @@ __all__ = [
     "FaultPlan",
     "FaultPlanError",
     "InjectedFault",
+    "LeafSpec",
+    "PREEMPT_EXIT",
+    "PreemptGuard",
+    "ReshardError",
+    "ReshardPlan",
     "RetryPolicy",
     "Supervisor",
     "ckpt",
     "classify",
     "classify_findings",
     "faults",
+    "plan_reshard",
+    "reshard",
+    "reshard_checkpoint",
     "resume_step",
     "supervisor",
 ]

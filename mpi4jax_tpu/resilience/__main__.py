@@ -1,15 +1,26 @@
-"""``python -m mpi4jax_tpu.resilience --selftest``: device-free smoke.
+"""``python -m mpi4jax_tpu.resilience``: device-free CLIs.
 
-Mirrors ``observability.perf --selftest``: a CI-runnable exercise of
-the subsystem's pure-Python core — fault-plan parsing and matching,
-the checkpoint commit/validity protocol (via a JSON storage layer, so
-no jax/orbax), verdict classification, and the supervisor retry loop —
-with no devices, no subprocess worlds, no network. Wired into tier-1
-by ``tests/test_resilience.py`` so the CLI cannot silently rot.
+- ``--selftest`` mirrors ``observability.perf --selftest``: a
+  CI-runnable exercise of the subsystem's pure-Python core —
+  fault-plan parsing and matching, the checkpoint commit/validity
+  protocol (via a JSON storage layer, so no jax/orbax), verdict
+  classification, and the supervisor retry loop — with no devices, no
+  subprocess worlds, no network. Wired into tier-1 by
+  ``tests/test_resilience.py`` so the CLI cannot silently rot.
+- ``reshard ROOT --world M`` rewrites the newest (or ``--step S``)
+  ``m4t-ckpt/2`` checkpoint under ``ROOT`` for an M-rank world
+  through the planned bounded-memory schedule (``reshard.py``) —
+  what ``launch --elastic`` runs between attempts, and what an
+  operator runs by hand to move a run across differently-sized
+  reservations. ``--dry-run`` prints the plan (transfers, bytes
+  moved, peak scratch vs bound) without writing; ``--out DIR``
+  writes the resharded checkpoint to a different root;
+  ``reshard --selftest`` is the primitive's own device-free smoke.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -159,8 +170,129 @@ def selftest() -> int:
     return 0
 
 
+def reshard_main(argv) -> int:
+    """The ``reshard`` subcommand (offline, numpy-only)."""
+    from . import reshard as _reshard
+    from . import ckpt as _ckpt
+
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi4jax_tpu.resilience reshard",
+        description=(
+            "Rewrite an m4t-ckpt/2 checkpoint written at world N as an "
+            "equivalent checkpoint for world M, through a planned "
+            "slice-transfer schedule whose peak scratch per rank is "
+            "bounded by 2 shard sizes."
+        ),
+    )
+    parser.add_argument(
+        "root", nargs="?", default=None,
+        help="CheckpointManager root holding the source checkpoint",
+    )
+    parser.add_argument(
+        "--world", type=int, default=None, metavar="M",
+        help="target world size",
+    )
+    parser.add_argument(
+        "--step", type=int, default=None, metavar="S",
+        help="reshard this exact step (default: newest valid)",
+    )
+    parser.add_argument(
+        "--out", default=None, metavar="DIR",
+        help="write the resharded checkpoint under this root instead "
+        "of committing in place",
+    )
+    parser.add_argument(
+        "--keep", type=int, default=3, metavar="N",
+        help="retention at the target root (default %(default)s)",
+    )
+    parser.add_argument(
+        "--dry-run", action="store_true",
+        help="plan only: print transfers / bytes moved / peak scratch "
+        "vs the 2-shard bound, write nothing",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="print the plan summary as JSON",
+    )
+    parser.add_argument(
+        "--selftest", action="store_true",
+        help="device-free smoke of the primitive (partition math, "
+        "plan coverage, metered execution vs planned peak, round-trip "
+        "bit-identity)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.selftest:
+        return _reshard.selftest()
+    if not args.root or args.world is None:
+        parser.error("reshard needs ROOT and --world M (or --selftest)")
+    if args.world < 1:
+        parser.error("--world must be >= 1")
+
+    mgr = CheckpointManager(args.root, keep=args.keep, world=args.world)
+    if args.step is not None:
+        info = mgr.at_step(args.step, allow_reshard=True)
+    else:
+        info = mgr.latest_valid(allow_reshard=True)
+    if info is None:
+        print(
+            f"reshard: no valid checkpoint under {args.root}",
+            file=sys.stderr,
+        )
+        return 2
+    if not info.sharded:
+        print(
+            f"reshard: checkpoint step {info.step} has schema "
+            f"{info.schema!r}; only m4t-ckpt/2 records the sharding "
+            "layout needed to reshard",
+            file=sys.stderr,
+        )
+        return 1
+    src_world = info.world or 0
+    specs = _ckpt.specs_from_manifest(info.manifest)
+    plan = _reshard.plan_reshard(specs, src_world, args.world)
+    summary = plan.summary()
+    if args.json:
+        print(json.dumps({"step": info.step, **summary}, indent=1))
+    else:
+        print(
+            f"reshard: step {info.step}: world {src_world} -> "
+            f"{args.world}; {summary['leaves']} leaves, "
+            f"{summary['transfers']} transfer(s), "
+            f"{summary['moved_bytes']} B moved; peak scratch "
+            f"{summary['peak_scratch_bytes']} B <= bound "
+            f"{summary['memory_bound_bytes']} B",
+            file=sys.stderr,
+        )
+    if args.dry_run:
+        return 0
+    if src_world == args.world and not args.out:
+        print(
+            f"reshard: checkpoint step {info.step} is already at "
+            f"world {args.world}; nothing to do",
+            file=sys.stderr,
+        )
+        return 0
+    out_mgr = None
+    if args.out:
+        out_mgr = CheckpointManager(
+            args.out, keep=args.keep, world=args.world
+        )
+    new = _reshard.reshard_checkpoint(
+        mgr, info, args.world, out_mgr=out_mgr,
+        log=lambda m: print(f"reshard: {m}", file=sys.stderr),
+    )
+    print(
+        f"reshard: committed step {new.step} at world {args.world} "
+        f"under {new.path}",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "reshard":
+        return reshard_main(argv[1:])
     if "--selftest" in argv:
         return selftest()
     print(__doc__)

@@ -21,12 +21,25 @@ newest checkpoint that is actually whole. That is this module:
   step tag matches its directory, whose data exists, and (when asked)
   whose world size / pytree fingerprint match the resuming program —
   a checkpoint from a differently-shaped model or a different world
-  must not be silently loaded into this one.
+  must not be silently loaded into this one. A checkpoint that is
+  valid *except* for its world size is never silently skipped: by
+  default the skip is logged, and under ``allow_reshard=True`` it is
+  returned as an explicit **reshard candidate**
+  (``CheckpointInfo.world_mismatch``) for the elastic resume path.
+- **Sharded schema** (``m4t-ckpt/2``) — manifests record the *global*
+  pytree shapes plus a per-leaf :class:`~.reshard.LeafSpec` sharding
+  layout, and data is stored as per-rank ``.npy`` shards
+  (``data/rank00000/leaf00000.npy``; replicated leaves once under
+  ``data/replicated/``). That is what makes an N-rank checkpoint
+  reshardable onto M ranks (``reshard.reshard_checkpoint``) with
+  bounded peak memory, and readable without jax or orbax. v1
+  checkpoints remain readable exactly as before.
 
-The storage layer is pluggable (``save_fn``/``restore_fn``): the
+The v1 storage layer is pluggable (``save_fn``/``restore_fn``): the
 default is ``utils/checkpoint.py`` (orbax), and the device-free
 ``--selftest`` (``__main__.py``) swaps in a JSON saver so the commit
-protocol is testable with no jax, no orbax, no devices.
+protocol is testable with no jax, no orbax, no devices. The v2 layer
+is numpy-only by construction.
 """
 
 from __future__ import annotations
@@ -36,15 +49,37 @@ import json
 import os
 import re
 import shutil
+import sys
 import time
 from dataclasses import dataclass
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from . import reshard as _reshard
+from .reshard import LeafSpec, specs_fingerprint
 
 MANIFEST_NAME = "manifest.json"
 DATA_NAME = "data"
 MANIFEST_SCHEMA = "m4t-ckpt/1"
+MANIFEST_SCHEMA_V2 = "m4t-ckpt/2"
+
+#: v2 data layout: per-rank shard dirs + one dir for replicated leaves
+RANK_DIR_FMT = "rank{:05d}"
+REPLICATED_DIR = "replicated"
+STAGE_PREFIX = ".stage-"
 
 _STEP_RE = re.compile(r"^step_(\d{8,})$")
+
+
+def _log(msg: str) -> None:
+    sys.stderr.write(f"m4t.ckpt: {msg}\n")
+
+
+def _leaf_files(specs: Dict[str, LeafSpec]) -> Dict[str, str]:
+    """Deterministic per-leaf file names (sorted key order), recorded
+    in the manifest so readers never re-derive them."""
+    return {k: f"leaf{i:05d}.npy" for i, k in enumerate(sorted(specs))}
 
 
 def step_dirname(step: int) -> str:
@@ -75,15 +110,42 @@ def pytree_fingerprint(tree: Any) -> str:
 
 @dataclass
 class CheckpointInfo:
-    """One valid on-disk checkpoint."""
+    """One valid on-disk checkpoint. ``world_mismatch`` marks a
+    checkpoint returned under ``allow_reshard=True`` whose recorded
+    world differs from the requested one — a *reshard candidate*, not
+    something to restore directly."""
 
     step: int
     path: str          # the step directory
     manifest: dict
+    world_mismatch: bool = False
 
     @property
     def data_path(self) -> str:
         return os.path.join(self.path, DATA_NAME)
+
+    @property
+    def world(self) -> Optional[int]:
+        w = self.manifest.get("world")
+        return None if w is None else int(w)
+
+    @property
+    def schema(self) -> Optional[str]:
+        return self.manifest.get("schema")
+
+    @property
+    def sharded(self) -> bool:
+        """True when this checkpoint records a per-leaf sharding
+        layout (schema v2) and can therefore be resharded."""
+        return self.schema == MANIFEST_SCHEMA_V2
+
+
+def _checkpoint_io():
+    """The device-free array IO layer (lazy: importing the resilience
+    package must stay cheap)."""
+    from ..utils import checkpoint
+
+    return checkpoint
 
 
 def _default_save(path: str, state: Any) -> None:
@@ -143,6 +205,7 @@ class CheckpointManager:
         *,
         fingerprint: Optional[str] = None,
         world: Optional[int] = None,
+        allow_reshard: bool = False,
     ) -> Optional[CheckpointInfo]:
         path = os.path.join(self.root, step_dirname(step))
         manifest_path = os.path.join(path, MANIFEST_NAME)
@@ -154,20 +217,62 @@ class CheckpointManager:
         if not isinstance(manifest, dict) or manifest.get("step") != step:
             return None  # renamed/copied dir whose tag lies
         data = os.path.join(path, DATA_NAME)
-        if not os.path.exists(data) or (
+        if manifest.get("schema") == MANIFEST_SCHEMA_V2:
+            if not self._v2_data_complete(data, manifest):
+                return None  # truncated shard layout
+        elif not os.path.exists(data) or (
             os.path.isdir(data) and not os.listdir(data)
         ):
             return None  # manifest without data: truncated by hand
-        want_world = self.world if world is None else int(world)
-        if want_world is not None and manifest.get("world") not in (
-            None, want_world
-        ):
-            return None  # checkpoint from a differently-sized world
         if fingerprint is not None and manifest.get("fingerprint") not in (
             None, fingerprint
         ):
             return None  # different model shape: do not resume into it
+        want_world = self.world if world is None else int(world)
+        have_world = manifest.get("world")
+        if want_world is not None and have_world not in (None, want_world):
+            # otherwise-valid checkpoint from a differently-sized
+            # world: NEVER indistinguishable from "no checkpoint" —
+            # either hand it back as an explicit reshard candidate or
+            # say out loud that it was skipped
+            if allow_reshard:
+                return CheckpointInfo(
+                    step=step, path=path, manifest=manifest,
+                    world_mismatch=True,
+                )
+            _log(
+                f"skipping otherwise-valid checkpoint step {step} at "
+                f"{path}: world {have_world} != wanted {want_world} "
+                "(pass allow_reshard=True to get it as a reshard "
+                "candidate)"
+            )
+            return None
         return CheckpointInfo(step=step, path=path, manifest=manifest)
+
+    @staticmethod
+    def _v2_data_complete(data: str, manifest: dict) -> bool:
+        """Every shard file the v2 manifest names must exist — a rank
+        dir deleted by hand must read as torn, not crash the resume."""
+        leaves = manifest.get("leaves")
+        world = manifest.get("world")
+        if not isinstance(leaves, dict) or not leaves:
+            return False
+        if not isinstance(world, int) or world < 1:
+            return False
+        for meta in leaves.values():
+            fname = meta.get("file")
+            if not fname:
+                return False
+            if meta.get("kind") == "replicated":
+                paths = [os.path.join(data, REPLICATED_DIR, fname)]
+            else:
+                paths = [
+                    os.path.join(data, RANK_DIR_FMT.format(r), fname)
+                    for r in range(world)
+                ]
+            if not all(os.path.exists(p) for p in paths):
+                return False
+        return True
 
     def at_step(
         self,
@@ -175,13 +280,15 @@ class CheckpointManager:
         *,
         fingerprint: Optional[str] = None,
         world: Optional[int] = None,
+        allow_reshard: bool = False,
     ) -> Optional[CheckpointInfo]:
         """The committed checkpoint at exactly ``step``, if valid —
         how a restarted rank resolves the ``M4T_RESUME_STEP`` the
         supervisor validated (every rank must restore the *same* step,
         not whatever is newest by the time it looks)."""
         return self._validate(
-            int(step), fingerprint=fingerprint, world=world
+            int(step), fingerprint=fingerprint, world=world,
+            allow_reshard=allow_reshard,
         )
 
     def latest_valid(
@@ -190,16 +297,22 @@ class CheckpointManager:
         fingerprint: Optional[str] = None,
         world: Optional[int] = None,
         template: Any = None,
+        allow_reshard: bool = False,
     ) -> Optional[CheckpointInfo]:
         """Newest checkpoint that passes validation; torn or
         mismatched ones are skipped, not fatal — resume prefers an
         older good checkpoint over dying on a bad new one.
-        ``template`` computes the wanted fingerprint for you."""
+        ``template`` computes the wanted fingerprint for you.
+        ``allow_reshard=True`` additionally accepts a checkpoint whose
+        recorded world disagrees with the wanted one, returned with
+        ``world_mismatch=True`` — the elastic resume path reshards it
+        (``reshard.reshard_checkpoint``) instead of losing the run."""
         if template is not None and fingerprint is None:
             fingerprint = pytree_fingerprint(template)
         for step in reversed(self.steps()):
             info = self._validate(
-                step, fingerprint=fingerprint, world=world
+                step, fingerprint=fingerprint, world=world,
+                allow_reshard=allow_reshard,
             )
             if info is not None:
                 return info
@@ -258,6 +371,261 @@ class CheckpointManager:
         self.prune()
         return CheckpointInfo(step=step, path=final, manifest=manifest)
 
+    # -- saving, sharded (schema m4t-ckpt/2) --------------------------
+
+    def _commit_manifest(
+        self, tmp: str, final: str, manifest: dict
+    ) -> None:
+        """The shared commit tail: manifest written + fsync'd last in
+        the staging dir, then the whole dir renamed into place."""
+        mpath = os.path.join(tmp, MANIFEST_NAME)
+        with open(mpath, "w") as f:
+            json.dump(manifest, f, indent=1, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.replace(tmp, final)
+
+    def _v2_manifest(
+        self,
+        step: int,
+        specs: Dict[str, LeafSpec],
+        world: int,
+        extra: Optional[dict],
+    ) -> dict:
+        files = _leaf_files(specs)
+        manifest = {
+            "schema": MANIFEST_SCHEMA_V2,
+            "step": int(step),
+            "world": int(world),
+            "fingerprint": specs_fingerprint(specs),
+            "leaves": {
+                k: dict(specs[k].to_json(), file=files[k])
+                for k in sorted(specs)
+            },
+            "t": time.time(),
+        }
+        if extra:
+            manifest.update(extra)
+        return manifest
+
+    def save_sharded(
+        self,
+        step: int,
+        flat: Dict[str, Any],
+        specs: Dict[str, LeafSpec],
+        *,
+        world: Optional[int] = None,
+        extra: Optional[dict] = None,
+    ) -> CheckpointInfo:
+        """Single-writer sharded commit: ``flat`` maps leaf keys to
+        *global* arrays; each rank's shard is sliced out and written
+        as its own ``.npy`` (replicated leaves once). Same atomic
+        protocol as :meth:`save`. This is the path a single-process
+        training loop (or the offline reshard CLI writing its output)
+        uses; a launcher world where no rank sees the whole state
+        stages per-rank instead (:meth:`stage_shard` +
+        :meth:`commit_sharded`)."""
+        step = int(step)
+        world = int(self.world if world is None else world)
+        if world < 1:
+            raise ValueError(
+                "save_sharded needs a world size (manager world=None "
+                "and no world= given)"
+            )
+        if set(flat) != set(specs):
+            raise ValueError(
+                f"flat/specs key mismatch: {sorted(set(flat) ^ set(specs))}"
+            )
+        self._sweep_tmp()
+        final = os.path.join(self.root, step_dirname(step))
+        tmp = os.path.join(
+            self.root, f".tmp-{step_dirname(step)}.{os.getpid()}"
+        )
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        try:
+            data = os.path.join(tmp, DATA_NAME)
+            files = _leaf_files(specs)
+            for key in sorted(specs):
+                spec = specs[key]
+                arr = np.asarray(flat[key])
+                if tuple(arr.shape) != spec.shape:
+                    raise ValueError(
+                        f"leaf {key!r}: array shape {arr.shape} != "
+                        f"global spec shape {spec.shape}"
+                    )
+                wire = spec.wire_dtype()
+                if arr.dtype != wire:
+                    arr = np.ascontiguousarray(arr).view(wire)
+                if spec.kind == "replicated":
+                    d = os.path.join(data, REPLICATED_DIR)
+                    os.makedirs(d, exist_ok=True)
+                    _checkpoint_io().save_array(
+                        os.path.join(d, files[key]), arr
+                    )
+                else:
+                    for r in range(world):
+                        d = os.path.join(data, RANK_DIR_FMT.format(r))
+                        os.makedirs(d, exist_ok=True)
+                        _checkpoint_io().save_array(
+                            os.path.join(d, files[key]),
+                            arr[_reshard.shard_slices(spec, world, r)],
+                        )
+            manifest = self._v2_manifest(step, specs, world, extra)
+            self._commit_manifest(tmp, final, manifest)
+        finally:
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp, ignore_errors=True)
+        self.prune()
+        return CheckpointInfo(step=step, path=final, manifest=manifest)
+
+    def save_resharded(
+        self,
+        step: int,
+        plan: "_reshard.ReshardPlan",
+        read_slice: Callable[[str, int, int, int], np.ndarray],
+        specs: Dict[str, LeafSpec],
+        *,
+        extra: Optional[dict] = None,
+    ) -> CheckpointInfo:
+        """Commit the output of a reshard plan without ever holding
+        the global state: each destination shard is built slice by
+        slice (``reshard.execute_plan`` memory bound) and written to
+        the staging dir before the next one is touched."""
+        step = int(step)
+        world = plan.dst_world
+        self._sweep_tmp()
+        final = os.path.join(self.root, step_dirname(step))
+        tmp = os.path.join(
+            self.root, f".tmp-{step_dirname(step)}.{os.getpid()}"
+        )
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        try:
+            data = os.path.join(tmp, DATA_NAME)
+            files = _leaf_files(specs)
+
+            def write_shard(key: str, dst_rank: int, arr: np.ndarray):
+                spec = specs[key]
+                if spec.kind == "replicated":
+                    if dst_rank != 0:
+                        return  # stored once
+                    d = os.path.join(data, REPLICATED_DIR)
+                else:
+                    d = os.path.join(data, RANK_DIR_FMT.format(dst_rank))
+                os.makedirs(d, exist_ok=True)
+                _checkpoint_io().save_array(
+                    os.path.join(d, files[key]), arr
+                )
+
+            _reshard.execute_plan(plan, read_slice, write_shard)
+            manifest = self._v2_manifest(step, specs, world, extra)
+            self._commit_manifest(tmp, final, manifest)
+        finally:
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp, ignore_errors=True)
+        self.prune()
+        return CheckpointInfo(step=step, path=final, manifest=manifest)
+
+    # -- saving, sharded, two-phase (every rank writes its own shard) --
+
+    def _stage_dir(self, step: int) -> str:
+        return os.path.join(self.root, STAGE_PREFIX + step_dirname(step))
+
+    def stage_shard(
+        self,
+        step: int,
+        rank: int,
+        flat_local: Dict[str, Any],
+        specs: Dict[str, LeafSpec],
+        *,
+        world: Optional[int] = None,
+    ) -> str:
+        """Phase one of a cooperative sharded save: rank ``rank``
+        writes its *local* shards (and, on rank 0, the replicated
+        leaves) into a shared staging dir. No manifest is written —
+        the stage is invisible to the validity scan until every rank
+        has staged and one rank runs :meth:`commit_sharded` (callers
+        barrier in between). Ranks write disjoint files, so there is
+        no cross-rank ordering to get wrong; a stage left behind by a
+        crashed attempt is simply overwritten file by file when the
+        step is recomputed, and swept at the next commit."""
+        step = int(step)
+        rank = int(rank)
+        world = int(self.world if world is None else world)
+        stage = self._stage_dir(step)
+        data = os.path.join(stage, DATA_NAME)
+        files = _leaf_files(specs)
+        for key in sorted(specs):
+            spec = specs[key]
+            arr = np.asarray(flat_local[key])
+            wire = spec.wire_dtype()
+            if arr.dtype != wire:
+                arr = np.ascontiguousarray(arr).view(wire)
+            if spec.kind == "replicated":
+                if rank != 0:
+                    continue
+                if tuple(arr.shape) != spec.shape:
+                    raise ValueError(
+                        f"leaf {key!r}: replicated array shape "
+                        f"{arr.shape} != spec shape {spec.shape}"
+                    )
+                d = os.path.join(data, REPLICATED_DIR)
+            else:
+                want = _reshard.shard_shape(spec, world, rank)
+                if tuple(arr.shape) != want:
+                    raise ValueError(
+                        f"leaf {key!r}: rank {rank} shard shape "
+                        f"{arr.shape} != expected {want} "
+                        f"(world {world})"
+                    )
+                d = os.path.join(data, RANK_DIR_FMT.format(rank))
+            os.makedirs(d, exist_ok=True)
+            _checkpoint_io().save_array(os.path.join(d, files[key]), arr)
+        return stage
+
+    def commit_sharded(
+        self,
+        step: int,
+        specs: Dict[str, LeafSpec],
+        *,
+        world: Optional[int] = None,
+        extra: Optional[dict] = None,
+    ) -> CheckpointInfo:
+        """Phase two: verify every staged shard the manifest will name
+        actually exists (a rank that died before staging must abort
+        the commit, not produce a checkpoint that lies), then write
+        the manifest last and rename the stage into place. Run by one
+        rank, after a barrier."""
+        step = int(step)
+        world = int(self.world if world is None else world)
+        stage = self._stage_dir(step)
+        final = os.path.join(self.root, step_dirname(step))
+        manifest = self._v2_manifest(step, specs, world, extra)
+        if not self._v2_data_complete(
+            os.path.join(stage, DATA_NAME), manifest
+        ):
+            raise RuntimeError(
+                f"commit_sharded(step={step}): staged data incomplete "
+                f"at {stage} — did every rank stage_shard() first?"
+            )
+        self._commit_manifest(stage, final, manifest)
+        # sweep stages left behind by crashed attempts
+        try:
+            for name in os.listdir(self.root):
+                if name.startswith(STAGE_PREFIX):
+                    shutil.rmtree(
+                        os.path.join(self.root, name), ignore_errors=True
+                    )
+        except OSError:
+            pass
+        self.prune()
+        return CheckpointInfo(step=step, path=final, manifest=manifest)
+
     def _sweep_tmp(self) -> None:
         try:
             names = os.listdir(self.root)
@@ -284,6 +652,12 @@ class CheckpointManager:
     # -- restoring ----------------------------------------------------
 
     def restore(self, info: CheckpointInfo, template: Any) -> Any:
+        if info.sharded:
+            raise ValueError(
+                f"checkpoint step {info.step} is sharded "
+                f"({MANIFEST_SCHEMA_V2}); read it with load_shard() / "
+                "load_sharded_global(), not restore()"
+            )
         return self._restore_fn(info.data_path, template)
 
     def restore_latest(
@@ -303,3 +677,158 @@ class CheckpointManager:
         if info is None:
             return None
         return info.step, self.restore(info, template)
+
+
+# ---------------------------------------------------------------------
+# sharded (v2) readers — module-level, numpy-only
+# ---------------------------------------------------------------------
+
+
+def specs_from_manifest(manifest: dict) -> Dict[str, LeafSpec]:
+    """The per-leaf layout an ``m4t-ckpt/2`` manifest records."""
+    leaves = manifest.get("leaves")
+    if not isinstance(leaves, dict):
+        raise ValueError(
+            f"manifest schema {manifest.get('schema')!r} records no "
+            "per-leaf layout"
+        )
+    return {k: LeafSpec.from_json(v) for k, v in leaves.items()}
+
+
+def _leaf_file(
+    info: CheckpointInfo, key: str, spec: LeafSpec, rank: int
+) -> str:
+    fname = info.manifest["leaves"][key]["file"]
+    sub = (
+        REPLICATED_DIR if spec.kind == "replicated"
+        else RANK_DIR_FMT.format(rank)
+    )
+    return os.path.join(info.data_path, sub, fname)
+
+
+def shard_slice_reader(
+    info: CheckpointInfo,
+    specs: Dict[str, LeafSpec],
+    src_world: int,
+) -> Callable[[str, int, int, int], np.ndarray]:
+    """A ``reshard.execute_plan`` reader over the checkpoint's shard
+    files, memory-mapped: a slice read touches only the bytes the
+    slice covers, which is what keeps the offline reshard at the
+    plan's peak-memory bound."""
+    io = _checkpoint_io()
+
+    def read_slice(key: str, src_rank: int, lo: int, hi: int):
+        spec = specs[key]
+        arr = io.open_array(_leaf_file(info, key, spec, src_rank))
+        if spec.kind == "replicated":
+            return arr
+        base, _ = _reshard.shard_extent(
+            spec.shape[spec.axis], src_world, src_rank
+        )
+        idx = tuple(
+            slice(lo - base, hi - base) if i == spec.axis else slice(None)
+            for i in range(len(spec.shape))
+        )
+        return arr[idx]
+
+    return read_slice
+
+
+def _logical_view(arr: np.ndarray, spec: LeafSpec) -> np.ndarray:
+    """View stored wire bytes back as the logical dtype when this
+    interpreter can construct it (ml_dtypes present); opaque bytes
+    otherwise — resharding never needed the logical dtype anyway."""
+    try:
+        dt = np.dtype(spec.dtype)
+    except TypeError:
+        return arr
+    return arr if arr.dtype == dt else arr.view(dt)
+
+
+def load_shard(
+    info: CheckpointInfo,
+    rank: int,
+    *,
+    specs: Optional[Dict[str, LeafSpec]] = None,
+) -> Dict[str, np.ndarray]:
+    """Rank ``rank``'s local state from a sharded checkpoint:
+    ``{leaf key: local shard}`` (replicated leaves whole). What a
+    launched rank reads at resume — it never touches peer shards."""
+    specs = specs or specs_from_manifest(info.manifest)
+    io = _checkpoint_io()
+    out: Dict[str, np.ndarray] = {}
+    for key in sorted(specs):
+        spec = specs[key]
+        arr = np.array(io.open_array(
+            _leaf_file(info, key, spec, rank), mmap=False
+        ))
+        out[key] = _logical_view(arr, spec)
+    return out
+
+
+def load_sharded_global(
+    info: CheckpointInfo,
+    *,
+    specs: Optional[Dict[str, LeafSpec]] = None,
+) -> Dict[str, np.ndarray]:
+    """The whole global state assembled from a sharded checkpoint —
+    the single-process resume path (small states); the bounded-memory
+    path is :func:`load_shard` per rank."""
+    specs = specs or specs_from_manifest(info.manifest)
+    world = info.world or 1
+    io = _checkpoint_io()
+    out: Dict[str, np.ndarray] = {}
+    for key in sorted(specs):
+        spec = specs[key]
+        if spec.kind == "replicated":
+            arr = np.array(io.open_array(
+                _leaf_file(info, key, spec, 0), mmap=False
+            ))
+        else:
+            parts = [
+                np.asarray(io.open_array(
+                    _leaf_file(info, key, spec, r), mmap=False
+                ))
+                for r in range(world)
+            ]
+            arr = np.concatenate(parts, axis=spec.axis)
+        out[key] = _logical_view(arr, spec)
+    return out
+
+
+# ---------------------------------------------------------------------
+# pytree <-> flat-dict bridge (imports jax lazily)
+# ---------------------------------------------------------------------
+
+
+def tree_leaves_dict(tree: Any) -> Dict[str, np.ndarray]:
+    """Flatten a pytree to ``{keystr path: numpy array}`` — the
+    representation every sharded-checkpoint API speaks (string keys
+    survive a JSON manifest; pytree defs do not)."""
+    import jax
+
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        flat[jax.tree_util.keystr(path)] = np.asarray(leaf)
+    return flat
+
+
+def tree_from_dict(template: Any, flat: Dict[str, np.ndarray]) -> Any:
+    """Rebuild a pytree shaped like ``template`` from
+    :func:`tree_leaves_dict` output (values come from ``flat``;
+    structure from ``template``)."""
+    import jax
+
+    paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(
+        template
+    )
+    leaves = []
+    for path, _leaf in paths_and_leaves:
+        key = jax.tree_util.keystr(path)
+        if key not in flat:
+            raise KeyError(
+                f"flat state is missing leaf {key!r} "
+                f"(has {sorted(flat)})"
+            )
+        leaves.append(flat[key])
+    return jax.tree_util.tree_unflatten(treedef, leaves)

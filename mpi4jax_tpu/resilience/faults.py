@@ -36,7 +36,15 @@ Actions:
 - ``crash`` — ``mode: "exception"`` (default) raises
   :class:`InjectedFault` at the emission site, ``mode: "sigkill"``
   sends this process SIGKILL (no atexit, no recorder dump — the
-  doctor's *dead/missing* evidence path).
+  doctor's *dead/missing* evidence path);
+- ``preempt`` — sends this process SIGTERM, the cloud preemption
+  notice shape. Unlike ``crash`` the signal is *survivable*: a train
+  loop that installed :class:`~.supervisor.PreemptGuard` finishes its
+  step, checkpoints, and exits ``PREEMPT_EXIT`` (143) — which is what
+  lets ``launch --elastic`` tell "this rank was preempted" apart from
+  "this rank crashed" and restart the world *smaller* instead of
+  dead. Without a guard the default handler terminates the process
+  (the same 143-family signature, via the signal exit).
 
 Determinism: matching is by exact per-rank emission counting (token
 ordering serializes emissions, so "the Nth AllReduce on rank 1" names
@@ -75,7 +83,7 @@ KNOWN_OPS = frozenset({
     "Scatter", "Send", "Sendrecv",
 })
 
-ACTIONS = ("delay", "hang", "crash", "slowdown")
+ACTIONS = ("delay", "hang", "crash", "slowdown", "preempt")
 CRASH_MODES = ("exception", "sigkill")
 
 
@@ -403,9 +411,9 @@ def on_emission(
             continue
         rule.matches += 1
         due = (
-            rule.matches == rule.nth
-            if rule.action in ("delay", "hang", "crash")
-            else rule.matches >= rule.nth  # slowdown: every one from Nth
+            rule.matches >= rule.nth  # slowdown: every one from Nth
+            if rule.action == "slowdown"
+            else rule.matches == rule.nth  # one-shot actions
         )
         if not due:
             continue
@@ -440,6 +448,15 @@ def faults_selftest_hook(plan: FaultPlan) -> List[str]:
 def _perform(rule: FaultRule, op: str, fp: str) -> None:
     if rule.action in ("delay", "slowdown"):
         time.sleep(rule.ms / 1000.0)
+        return
+    if rule.action == "preempt":
+        # the preemption notice: SIGTERM to self, then *keep going* —
+        # a PreemptGuard-equipped loop finishes the step, checkpoints,
+        # and exits PREEMPT_EXIT at the next step boundary; an
+        # unguarded process dies on the default handler. Either way
+        # the artifacts written so far survive (fsync'd events, and
+        # the recorder dumps from its own SIGTERM/atexit hooks).
+        os.kill(os.getpid(), signal.SIGTERM)
         return
     if rule.action == "hang":
         # stop emitting forever; the heartbeat daemon thread keeps

@@ -22,6 +22,11 @@ crash, no findings            transient       restart (the crash left
                                               no cross-rank disagree-
                                               ment — env/infra shape)
 no telemetry at all           transient       restart blind
+exit 143 (PREEMPT_EXIT)       transient       restart; under ``launch
+                                              --elastic`` the world
+                                              *shrinks* to the
+                                              survivors and the
+                                              checkpoint is resharded
 ============================  ==============  =======================
 
 Restarts are bounded (``retries``) with exponential backoff plus
@@ -45,6 +50,8 @@ from __future__ import annotations
 
 import os
 import random
+import signal
+import sys
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterable, Optional
@@ -59,6 +66,13 @@ TRANSIENT_KINDS = frozenset({"hang", "missing_rank", "straggler"})
 #: launcher exit code when the hang watchdog tore the world down
 WATCHDOG_EXIT = 124
 
+#: exit code of a rank that received a preemption notice (SIGTERM) and
+#: left gracefully — 128 + SIGTERM, the shell's own convention for a
+#: TERM death, so guarded and unguarded preemptions read the same.
+#: ``launch --elastic`` counts ranks with this signature as *capacity
+#: lost*, not a bug, and restarts the world smaller.
+PREEMPT_EXIT = 143
+
 
 def resume_step() -> Optional[int]:
     """The step the supervisor resumed this process from
@@ -71,6 +85,67 @@ def resume_step() -> Optional[int]:
         return int(raw)
     except ValueError:
         return None
+
+
+class PreemptGuard:
+    """The SIGTERM grace hook for resume-aware loops.
+
+    A cloud preemption notice is a SIGTERM with a short grace window.
+    The default Python behavior — die mid-step, possibly mid-collective
+    — wastes the window; this guard converts the signal into a *flag*
+    so the loop finishes the step it is in, checkpoints, and leaves
+    with :data:`PREEMPT_EXIT`::
+
+        guard = PreemptGuard()          # installs the handler
+        for step in range(start, steps):
+            if guard.preempted:
+                mgr.save(step - 1, state)        # or skip: last
+                sys.exit(guard.exit_code)        # committed step wins
+            state = train_step(state)
+
+    The handler only sets the flag (async-signal-safe by construction)
+    — the flight recorder still dumps from its own atexit hook on the
+    way out, so a preempted rank leaves the same artifact trail a
+    crashed one does, plus the checkpoint. ``install=False`` builds an
+    unarmed guard (tests)."""
+
+    exit_code = PREEMPT_EXIT
+
+    def __init__(self, *, install: bool = True,
+                 signum: int = signal.SIGTERM):
+        self.preempted = False
+        self.signum = signum
+        self._count = 0
+        if install:
+            signal.signal(signum, self._on_signal)
+
+    def _on_signal(self, signum, frame):
+        self.preempted = True
+        self._count += 1
+        if self._count == 1:
+            # write() is async-signal-safe; formatting a message is
+            # fine here because we are in the main thread's handler
+            try:
+                sys.stderr.write(
+                    "m4t.resilience: preemption notice (SIGTERM) — "
+                    "finishing the current step, then checkpoint + "
+                    f"exit {PREEMPT_EXIT}\n"
+                )
+                sys.stderr.flush()
+            except Exception:
+                pass
+
+    def exit_if_preempted(
+        self, save_fn: Optional[Callable[[], Any]] = None
+    ) -> None:
+        """Call at a step boundary: if a notice arrived, run
+        ``save_fn`` (the checkpoint) and leave with
+        :data:`PREEMPT_EXIT`."""
+        if not self.preempted:
+            return
+        if save_fn is not None:
+            save_fn()
+        sys.exit(self.exit_code)
 
 
 def classify_findings(
@@ -121,6 +196,10 @@ def classify(
     if exit_code == 0:
         return {"klass": "clean", "reason": "exit_zero", "kinds": []}
     if report is None:
+        if exit_code == PREEMPT_EXIT:
+            return {
+                "klass": "transient", "reason": "preempted", "kinds": [],
+            }
         return {
             "klass": "transient", "reason": "crash_no_telemetry",
             "kinds": [],
@@ -128,6 +207,14 @@ def classify(
     verdict = classify_findings(report.get("findings", []))
     if verdict["klass"] == "deterministic":
         return verdict
+    if exit_code == PREEMPT_EXIT:
+        # a rank said "I was preempted" on its way out: capacity loss,
+        # not a bug — transient regardless of the hang/missing shapes
+        # the surviving ranks' logs show (they were waiting on it)
+        return {
+            "klass": "transient", "reason": "preempted",
+            "kinds": verdict["kinds"],
+        }
     if verdict["klass"] == "transient":
         if exit_code == WATCHDOG_EXIT:
             verdict = dict(verdict, reason="hang")
@@ -170,7 +257,11 @@ class Supervisor:
     for that attempt's artifacts. ``resume_fn() -> step|None`` names
     the newest valid checkpoint step (queried fresh before every
     restart — the failed attempt may have committed new checkpoints
-    before dying).
+    before dying). ``extra_fn(attempt) -> dict`` contributes
+    additional fields to that attempt's audit record — the elastic
+    launcher uses it to put world-size transitions (old world, new
+    world, reshard source step) on the ``supervisor.jsonl`` record so
+    the doctor can narrate an elastic recovery post-mortem.
     """
 
     def __init__(
@@ -180,6 +271,7 @@ class Supervisor:
         policy: RetryPolicy,
         diagnose_fn: Optional[Callable[[int], Optional[Dict[str, Any]]]] = None,
         resume_fn: Optional[Callable[[], Optional[int]]] = None,
+        extra_fn: Optional[Callable[[int], Dict[str, Any]]] = None,
         audit_path: Optional[str] = None,
         sleep_fn: Callable[[float], None] = time.sleep,
         log: Optional[Callable[[str], None]] = None,
@@ -188,6 +280,7 @@ class Supervisor:
         self.policy = policy
         self.diagnose_fn = diagnose_fn or (lambda attempt: None)
         self.resume_fn = resume_fn or (lambda: None)
+        self.extra_fn = extra_fn or (lambda attempt: {})
         self.audit_path = audit_path
         self.sleep_fn = sleep_fn
         self.log = log or (lambda msg: None)
@@ -207,13 +300,23 @@ class Supervisor:
         except OSError:
             pass  # auditing must not mask the run's own outcome
 
+    def _audit_attempt(
+        self, attempt: int, record: Dict[str, Any]
+    ) -> None:
+        try:
+            extra = dict(self.extra_fn(attempt) or {})
+        except Exception:
+            extra = {}
+        extra.update(record)
+        self._audit(extra)
+
     def run(self) -> int:
         resume: Optional[int] = resume_step()  # inherit if nested
         exit_code = 0
         for attempt in range(self.policy.retries + 1):
             exit_code = self.run_fn(attempt, resume)
             if exit_code == 0:
-                self._audit({
+                self._audit_attempt(attempt, {
                     "attempt": attempt, "exit_code": 0,
                     "klass": "clean", "reason": "exit_zero",
                     "action": "done", "resume_step": resume,
@@ -222,7 +325,7 @@ class Supervisor:
             if exit_code == 130:
                 # SIGINT is the operator, not the infrastructure:
                 # never retried, never reclassified
-                self._audit({
+                self._audit_attempt(attempt, {
                     "attempt": attempt, "exit_code": 130,
                     "klass": "interrupted", "reason": "sigint",
                     "action": "give_up", "resume_step": resume,
@@ -234,7 +337,7 @@ class Supervisor:
             retrying = verdict["klass"] == "transient" and not last
             delay = self.policy.delay(attempt + 1, self._rng) if retrying else 0.0
             next_resume = self.resume_fn() if retrying else None
-            self._audit({
+            self._audit_attempt(attempt, {
                 "attempt": attempt,
                 "exit_code": exit_code,
                 "klass": verdict["klass"],

@@ -13,6 +13,13 @@ half-written directory at ``path`` — it leaves ``path`` untouched (old
 checkpoint intact, or absent) plus ``.tmp`` litter that the next save
 sweeps. The step-tagged history/retention/validity layer above this is
 ``resilience/ckpt.py``'s CheckpointManager.
+
+This module also provides the device-free array IO the ``m4t-ckpt/2``
+per-rank shard layout is built on (:func:`save_array` /
+:func:`open_array`): plain ``.npy`` files written atomically and read
+back memory-mapped, so the offline reshard CLI can move slices of an
+N-rank checkpoint without jax, orbax, or ever materializing a global
+array. jax itself is imported lazily for the same reason.
 """
 
 from __future__ import annotations
@@ -21,7 +28,7 @@ import os
 import shutil
 from typing import Any
 
-import jax
+import numpy as np
 
 
 def _checkpointer():
@@ -50,6 +57,8 @@ def restore(path: str, template: Any) -> Any:
     """Restore a pytree saved by :func:`save`. ``template`` provides
     structure/shape/dtype (and sharding, if its leaves are sharded
     arrays — restored leaves then land on the same mesh layout)."""
+    import jax
+
     path = os.path.abspath(path)
     ckpt = _checkpointer()
     abstract = jax.tree.map(
@@ -59,3 +68,34 @@ def restore(path: str, template: Any) -> Any:
         template,
     )
     return ckpt.restore(path, abstract)
+
+
+# ---------------------------------------------------------------------
+# device-free array IO (the m4t-ckpt/2 shard layer)
+# ---------------------------------------------------------------------
+
+
+def save_array(path: str, arr: np.ndarray) -> None:
+    """Write one ``.npy`` atomically: staged at ``path + ".tmp"`` and
+    renamed into place, so a writer killed mid-save leaves the old
+    file (or nothing), never a torn one. The array is written exactly
+    as passed — callers pick a portable dtype (``reshard.LeafSpec
+    .wire_dtype``) so any vanilla-numpy reader can load it back."""
+    path = os.path.abspath(path)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.save(f, np.ascontiguousarray(arr))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def open_array(path: str, *, mmap: bool = True) -> np.ndarray:
+    """Read a :func:`save_array` file back, memory-mapped by default —
+    slicing then touches only the bytes the slice covers, which is
+    what keeps the reshard executor's peak memory at the planned
+    bound instead of one-global-array."""
+    return np.load(
+        os.path.abspath(path), mmap_mode="r" if mmap else None,
+        allow_pickle=False,
+    )

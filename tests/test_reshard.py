@@ -1,0 +1,629 @@
+"""Elastic world-size resharding (``mpi4jax_tpu/resilience/reshard.py``
++ the ``m4t-ckpt/2`` sharded checkpoint schema).
+
+Covers the ISSUE-9 acceptance surface:
+
+- partition math properties (cover, contiguous, balanced — M ∤ N
+  included) and :class:`LeafSpec` validation / JSON round trip;
+- plan properties over seeded random layouts × random N→M pairs:
+  every destination index covered exactly once, transfers ordered,
+  replicated leaves one copy per destination;
+- metered execution: the executor's **measured** peak scratch equals
+  the plan's :meth:`ReshardPlan.peak_scratch_bytes` exactly and never
+  exceeds the 2-shard :meth:`ReshardPlan.memory_bound_bytes` — the
+  bound is asserted, not claimed;
+- round trip N→M→N is bit-identical; resharded shards equal direct
+  global slicing; opaque (non-portable) dtypes reshard as raw bytes;
+- ``m4t-ckpt/2``: manifest fields, per-rank ``.npy`` layout, torn
+  shard detection, ``latest_valid(allow_reshard=)`` returning a
+  world-mismatched checkpoint as an explicit *reshard candidate*
+  (and logging the skip otherwise — never silent);
+- the two-phase (per-rank) stage/commit protocol;
+- :func:`reshard_checkpoint` end to end with provenance, and the
+  ``python -m mpi4jax_tpu.resilience reshard`` CLI (selftest,
+  dry-run, commit, error paths);
+- the on-mesh executor over the existing p2p ops (2-rank launcher
+  world resharding a 4-world checkpoint; native-gated).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from mpi4jax_tpu.resilience import ckpt, reshard
+from mpi4jax_tpu.resilience.reshard import (
+    LeafSpec,
+    MemoryMeter,
+    ReshardError,
+    execute_plan,
+    plan_reshard,
+    reader_from_global,
+    reader_from_shards,
+    reshard_flat,
+    shard_extent,
+    shard_slices,
+    spec_for_array,
+    specs_fingerprint,
+)
+
+pytestmark = [pytest.mark.resilience, pytest.mark.elastic]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------
+# partition math
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("length", [0, 1, 2, 5, 8, 17, 64, 101])
+@pytest.mark.parametrize("world", [1, 2, 3, 4, 7, 13])
+def test_shard_extent_properties(length, world):
+    spans = [shard_extent(length, world, r) for r in range(world)]
+    # cover [0, length) contiguously, in rank order
+    assert spans[0][0] == 0 and spans[-1][1] == length
+    for (_, b), (c, _) in zip(spans, spans[1:]):
+        assert b == c
+    # balanced: sizes differ by at most one, bigger shards first
+    sizes = [b - a for a, b in spans]
+    assert max(sizes) - min(sizes) <= 1
+    assert sizes == sorted(sizes, reverse=True)
+
+
+def test_shard_extent_errors():
+    with pytest.raises(ReshardError, match="world"):
+        shard_extent(8, 0, 0)
+    with pytest.raises(ReshardError, match="out of range"):
+        shard_extent(8, 2, 2)
+
+
+def test_leafspec_validation_and_json():
+    s = LeafSpec(shape=(4, 6), dtype="float32", axis=1)
+    assert s.itemsize == 4 and s.nbytes == 4 * 6 * 4
+    s2 = LeafSpec.from_json(s.to_json())
+    assert s2 == s
+    with pytest.raises(ReshardError, match="scalar"):
+        LeafSpec(shape=(), dtype="float32", kind="sharded")
+    with pytest.raises(ReshardError, match="axis"):
+        LeafSpec(shape=(4,), dtype="float32", axis=1)
+    with pytest.raises(ReshardError, match="kind"):
+        LeafSpec(shape=(4,), dtype="float32", kind="diagonal")
+    with pytest.raises(ReshardError, match="itemsize"):
+        LeafSpec(shape=(4,), dtype="no_such_dtype")
+    # unconstructible dtype is fine with an explicit itemsize
+    s3 = LeafSpec(shape=(4,), dtype="mystery16", itemsize=2)
+    assert s3.wire_dtype() == np.dtype("V2")
+    # replicated scalars are fine
+    LeafSpec(shape=(), dtype="int32", kind="replicated")
+
+
+def test_specs_fingerprint_world_independent_and_order_free():
+    a = {"x": LeafSpec(shape=(8, 2), dtype="float32"),
+         "y": LeafSpec(shape=(3,), dtype="int32", kind="replicated")}
+    b = dict(reversed(list(a.items())))
+    assert specs_fingerprint(a) == specs_fingerprint(b)
+    # no world anywhere in the identity: that is the point
+    c = {"x": LeafSpec(shape=(8, 2), dtype="float64"),
+         "y": a["y"]}
+    assert specs_fingerprint(a) != specs_fingerprint(c)
+
+
+def test_spec_for_array():
+    s = spec_for_array(np.zeros((3, 5), np.int16), axis=1)
+    assert s.shape == (3, 5) and s.dtype == "int16" and s.itemsize == 2
+
+
+# ---------------------------------------------------------------------
+# plans
+# ---------------------------------------------------------------------
+
+
+def _random_case(rng):
+    specs, flat = {}, {}
+    for i in range(rng.randint(1, 5)):
+        nd = rng.randint(1, 4)
+        shape = tuple(int(rng.randint(1, 10)) for _ in range(nd))
+        kind = "replicated" if rng.rand() < 0.25 else "sharded"
+        axis = int(rng.randint(0, nd)) if kind == "sharded" else 0
+        dtype = rng.choice(["float32", "int32", "float64", "int8"])
+        key = f"leaf{i}"
+        specs[key] = LeafSpec(shape=shape, dtype=dtype, kind=kind,
+                              axis=axis)
+        flat[key] = (rng.randn(*shape) * 50).astype(dtype)
+    return specs, flat
+
+
+def test_plan_covers_every_destination_exactly_once():
+    rng = np.random.RandomState(1)
+    for _ in range(25):
+        specs, _ = _random_case(rng)
+        n, m = int(rng.randint(1, 8)), int(rng.randint(1, 8))
+        plan = plan_reshard(specs, n, m)
+        for key, spec in specs.items():
+            ts = plan.transfers[key]
+            if spec.kind == "replicated":
+                assert [t.dst_rank for t in ts] == list(range(m))
+                assert all(t.nbytes == spec.nbytes for t in ts)
+                assert all(0 <= t.src_rank < n for t in ts)
+                continue
+            for d in range(m):
+                dlo, dhi = shard_extent(spec.shape[spec.axis], m, d)
+                mine = [t for t in ts if t.dst_rank == d]
+                covered = dlo
+                for t in mine:  # plan order is (dst, src): already sorted
+                    assert t.lo == covered
+                    assert 0 <= t.src_rank < n
+                    covered = t.hi
+                assert covered == dhi
+
+
+def test_plan_world_errors():
+    with pytest.raises(ReshardError, match=">= 1"):
+        plan_reshard({"x": LeafSpec(shape=(4,), dtype="f4")}, 0, 2)
+
+
+# ---------------------------------------------------------------------
+# metered execution: correctness + the asserted memory bound
+# ---------------------------------------------------------------------
+
+
+def test_execute_matches_direct_slicing_and_round_trips():
+    rng = np.random.RandomState(2)
+    for _ in range(20):
+        specs, flat = _random_case(rng)
+        n, m = int(rng.randint(1, 7)), int(rng.randint(1, 7))
+        out = reshard_flat(flat, specs, n, m)
+        for key, spec in specs.items():
+            for d in range(m):
+                np.testing.assert_array_equal(
+                    out[key, d].view(flat[key].dtype),
+                    flat[key][shard_slices(spec, m, d)],
+                )
+        # round trip back to n, starting from the m-shards
+        plan_back = plan_reshard(specs, m, n)
+        back = {}
+        execute_plan(
+            plan_back, reader_from_shards(out, specs, m),
+            lambda k, d, a: back.__setitem__((k, d), a),
+        )
+        for key, spec in specs.items():
+            for r in range(n):
+                np.testing.assert_array_equal(
+                    back[key, r].view(flat[key].dtype),
+                    flat[key][shard_slices(spec, n, r)],
+                )
+
+
+def test_peak_memory_is_metered_and_bounded():
+    """The acceptance bullet: peak per-rank scratch is *asserted*
+    against the planned schedule, not claimed."""
+    rng = np.random.RandomState(3)
+    for _ in range(20):
+        specs, flat = _random_case(rng)
+        n, m = int(rng.randint(1, 7)), int(rng.randint(1, 7))
+        plan = plan_reshard(specs, n, m)
+        shards = {
+            (k, r): np.ascontiguousarray(
+                flat[k][shard_slices(s, n, r)])
+            for k, s in specs.items() for r in range(n)
+        }
+        meter = MemoryMeter()
+        execute_plan(
+            plan, reader_from_shards(shards, specs, n),
+            lambda k, d, a: None, meter=meter,
+        )
+        assert meter.live == 0  # everything freed
+        assert meter.peak == plan.max_peak_bytes()
+        assert meter.peak <= plan.memory_bound_bytes()
+
+
+def test_peak_memory_exact_numbers():
+    """One hand-checkable case: 12×f32 over 3 ranks → 2 ranks.
+    dst shards are 6 elements (24 B); the largest staged slice is one
+    whole source shard (4 elements, 16 B) → peak 40 B, bound
+    2 × 24 B = 48 B."""
+    specs = {"w": LeafSpec(shape=(12,), dtype="float32")}
+    plan = plan_reshard(specs, 3, 2)
+    assert plan.peak_scratch_bytes() == {0: 24 + 16, 1: 24 + 16}
+    assert plan.memory_bound_bytes() == 48
+    flat = {"w": np.arange(12, dtype=np.float32)}
+    meter = MemoryMeter()
+    out = {}
+    execute_plan(
+        plan, reader_from_global(flat, specs, 3),
+        lambda k, d, a: out.__setitem__((k, d), a), meter=meter,
+    )
+    assert meter.peak == 40
+    np.testing.assert_array_equal(out["w", 0], flat["w"][:6])
+    np.testing.assert_array_equal(out["w", 1], flat["w"][6:])
+
+
+def test_execute_dst_ranks_subset_and_errors():
+    specs = {"w": LeafSpec(shape=(8,), dtype="float32")}
+    flat = {"w": np.arange(8, dtype=np.float32)}
+    plan = plan_reshard(specs, 2, 4)
+    out = {}
+    execute_plan(
+        plan, reader_from_global(flat, specs, 2),
+        lambda k, d, a: out.__setitem__((k, d), a), dst_ranks=[2],
+    )
+    assert list(out) == [("w", 2)]
+    np.testing.assert_array_equal(out["w", 2], flat["w"][4:6])
+    with pytest.raises(ReshardError, match="out of range"):
+        execute_plan(
+            plan, reader_from_global(flat, specs, 2),
+            lambda k, d, a: None, dst_ranks=[4],
+        )
+
+
+def test_opaque_dtype_moves_raw_bytes():
+    spec = LeafSpec(shape=(6, 2), dtype="mystery16", itemsize=2)
+    raw = np.arange(12, dtype=np.uint16).reshape(6, 2).view("V2")
+    out = reshard_flat({"x": raw}, {"x": spec}, 2, 3)
+    merged = np.concatenate(
+        [out["x", r].view(np.uint16) for r in range(3)], axis=0
+    )
+    np.testing.assert_array_equal(merged, raw.view(np.uint16))
+
+
+def test_bfloat16_reshard_via_portable_wire():
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    arr = np.arange(8, dtype=np.float32).astype(bf16)
+    spec = spec_for_array(arr)
+    # storage dtype is portable even though the logical one is not
+    assert spec.wire_dtype() == np.dtype("V2")
+    out = reshard_flat({"x": arr}, {"x": spec}, 1, 2)
+    merged = np.concatenate(
+        [out["x", r].view(bf16) for r in range(2)]
+    )
+    np.testing.assert_array_equal(merged, arr)
+
+
+# ---------------------------------------------------------------------
+# m4t-ckpt/2: layout, validity, reshard candidates
+# ---------------------------------------------------------------------
+
+
+def _demo_state():
+    specs = {
+        "w": LeafSpec(shape=(10, 3), dtype="float32"),
+        "b": LeafSpec(shape=(3,), dtype="float32", kind="replicated"),
+    }
+    flat = {
+        "w": np.arange(30, dtype=np.float32).reshape(10, 3),
+        "b": np.ones(3, np.float32),
+    }
+    return specs, flat
+
+
+def test_save_sharded_layout_and_manifest(tmp_path):
+    specs, flat = _demo_state()
+    mgr = ckpt.CheckpointManager(str(tmp_path / "c"), keep=3, world=4)
+    info = mgr.save_sharded(7, flat, specs)
+    assert info.schema == "m4t-ckpt/2" and info.world == 4
+    assert info.sharded and not info.world_mismatch
+    manifest = json.load(open(os.path.join(info.path, "manifest.json")))
+    assert manifest["schema"] == "m4t-ckpt/2"
+    assert manifest["world"] == 4
+    assert manifest["fingerprint"] == specs_fingerprint(specs)
+    assert set(manifest["leaves"]) == {"w", "b"}
+    assert manifest["leaves"]["w"]["shape"] == [10, 3]
+    assert manifest["leaves"]["b"]["kind"] == "replicated"
+    # on-disk layout: per-rank dirs for sharded, one dir for replicated
+    data = sorted(os.listdir(info.data_path))
+    assert data == ["rank00000", "rank00001", "rank00002", "rank00003",
+                    "replicated"]
+    # per-rank shard contents match direct slicing
+    for r in range(4):
+        sh = ckpt.load_shard(info, r)
+        np.testing.assert_array_equal(
+            sh["w"], flat["w"][shard_slices(specs["w"], 4, r)])
+        np.testing.assert_array_equal(sh["b"], flat["b"])
+    g = ckpt.load_sharded_global(info)
+    np.testing.assert_array_equal(g["w"], flat["w"])
+
+
+def test_v2_torn_shard_reads_as_invalid(tmp_path):
+    specs, flat = _demo_state()
+    mgr = ckpt.CheckpointManager(str(tmp_path / "c"), keep=5, world=2)
+    mgr.save_sharded(1, flat, specs)
+    mgr.save_sharded(2, flat, specs)
+    # delete one shard file of the newest: it must be skipped, older wins
+    doomed = os.path.join(
+        mgr.root, "step_00000002", "data", "rank00001", "leaf00001.npy"
+    )
+    os.unlink(doomed)
+    info = mgr.latest_valid(world=2)
+    assert info is not None and info.step == 1
+
+
+def test_world_mismatch_logged_never_silent(tmp_path, capfd):
+    """The satellite: a world-mismatched but otherwise-valid
+    checkpoint must be reported, never indistinguishable from 'no
+    checkpoint'."""
+    specs, flat = _demo_state()
+    mgr = ckpt.CheckpointManager(str(tmp_path / "c"), keep=3, world=4)
+    mgr.save_sharded(5, flat, specs)
+    two = ckpt.CheckpointManager(str(tmp_path / "c"), keep=3, world=2)
+    assert two.latest_valid(world=2) is None
+    err = capfd.readouterr().err
+    assert "skipping otherwise-valid checkpoint step 5" in err
+    assert "world 4 != wanted 2" in err and "allow_reshard" in err
+    # under the flag it comes back as an explicit candidate
+    cand = two.latest_valid(world=2, allow_reshard=True)
+    assert cand is not None and cand.world_mismatch and cand.world == 4
+    at = two.at_step(5, world=2, allow_reshard=True)
+    assert at is not None and at.world_mismatch
+    # restore() refuses sharded checkpoints with a pointer to the API
+    with pytest.raises(ValueError, match="load_shard"):
+        two.restore(cand, None)
+
+
+def test_v1_checkpoints_still_readable_beside_v2(tmp_path):
+    def _json_save(path, state):
+        with open(path, "w") as f:
+            json.dump(state, f)
+
+    def _json_restore(path, template):
+        with open(path) as f:
+            return json.load(f)
+
+    mgr = ckpt.CheckpointManager(
+        str(tmp_path / "c"), keep=5, world=2,
+        save_fn=_json_save, restore_fn=_json_restore,
+    )
+    mgr.save(1, {"w": [1, 2]}, fingerprint="fp")
+    specs, flat = _demo_state()
+    mgr.save_sharded(2, flat, specs)
+    newest = mgr.latest_valid(world=2)
+    assert newest.step == 2 and newest.sharded
+    old = mgr.at_step(1, world=2)
+    assert old is not None and not old.sharded
+    assert mgr.restore(old, None) == {"w": [1, 2]}
+    # a v1 checkpoint is never a reshard candidate material: the
+    # caller sees world_mismatch + sharded=False and knows
+    mgr4 = ckpt.CheckpointManager(str(tmp_path / "c"), keep=5, world=4)
+    cand = mgr4.at_step(1, world=4, allow_reshard=True)
+    assert cand is not None and cand.world_mismatch and not cand.sharded
+
+
+def test_two_phase_stage_commit(tmp_path):
+    specs = {"w": LeafSpec(shape=(7,), dtype="float32"),
+             "s": LeafSpec(shape=(), dtype="int32", kind="replicated")}
+    g = np.arange(7, dtype=np.float32)
+    mgr = ckpt.CheckpointManager(str(tmp_path / "c"), keep=2, world=3)
+    # commit before staging completes must refuse
+    mgr.stage_shard(4, 0, {"w": g[:3], "s": np.int32(4)}, specs)
+    with pytest.raises(RuntimeError, match="incomplete"):
+        mgr.commit_sharded(4, specs)
+    for r in (1, 2):
+        lo, hi = shard_extent(7, 3, r)
+        mgr.stage_shard(4, r, {"w": g[lo:hi], "s": np.int32(4)}, specs)
+    info = mgr.commit_sharded(4, specs)
+    assert info.step == 4 and info.world == 3
+    # stage swept after commit
+    assert not any(
+        n.startswith(".stage-") for n in os.listdir(mgr.root))
+    np.testing.assert_array_equal(
+        ckpt.load_sharded_global(info)["w"], g)
+    # wrong local shard shape is a loud error
+    with pytest.raises(ValueError, match="shard shape"):
+        mgr.stage_shard(5, 0, {"w": g, "s": np.int32(5)}, specs)
+
+
+def test_reshard_checkpoint_round_trip_and_provenance(tmp_path):
+    specs, flat = _demo_state()
+    mgr4 = ckpt.CheckpointManager(str(tmp_path / "c"), keep=3, world=4)
+    mgr4.save_sharded(9, flat, specs)
+    mgr3 = ckpt.CheckpointManager(str(tmp_path / "c"), keep=3, world=3)
+    cand = mgr3.latest_valid(world=3, allow_reshard=True)
+    new = reshard.reshard_checkpoint(mgr3, cand, 3)
+    assert new.world == 3 and new.step == 9
+    prov = new.manifest["resharded_from"]
+    assert prov["world"] == 4 and prov["step"] == 9
+    assert prov["plan"]["peak_scratch_bytes"] <= (
+        prov["plan"]["memory_bound_bytes"])
+    np.testing.assert_array_equal(
+        ckpt.load_sharded_global(new)["w"], flat["w"])
+    # back to 4: bit-identical global state
+    back = reshard.reshard_checkpoint(
+        mgr4, mgr4.latest_valid(world=4, allow_reshard=True), 4)
+    np.testing.assert_array_equal(
+        ckpt.load_sharded_global(back)["w"], flat["w"])
+    for r in range(4):
+        np.testing.assert_array_equal(
+            ckpt.load_shard(back, r)["w"],
+            flat["w"][shard_slices(specs["w"], 4, r)])
+
+
+def test_reshard_checkpoint_rejects_v1(tmp_path):
+    def _json_save(path, state):
+        with open(path, "w") as f:
+            json.dump(state, f)
+
+    mgr = ckpt.CheckpointManager(
+        str(tmp_path / "c"), keep=3, world=4, save_fn=_json_save,
+    )
+    mgr.save(3, {"w": [1]}, fingerprint="fp")
+    cand = ckpt.CheckpointManager(
+        str(tmp_path / "c"), keep=3, world=2
+    ).latest_valid(world=2, allow_reshard=True)
+    with pytest.raises(ReshardError, match="m4t-ckpt/2"):
+        reshard.reshard_checkpoint(mgr, cand, 2)
+
+
+# ---------------------------------------------------------------------
+# the reshard CLI
+# ---------------------------------------------------------------------
+
+
+def _run_cli(*argv, timeout=300):
+    return subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.resilience", *argv],
+        capture_output=True, text=True, cwd=REPO, timeout=timeout,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+
+
+def test_cli_reshard_selftest():
+    res = _run_cli("reshard", "--selftest")
+    assert res.returncode == 0, res.stderr
+    assert "reshard selftest ok" in res.stdout
+
+
+def test_cli_reshard_dry_run_and_commit(tmp_path):
+    specs, flat = _demo_state()
+    root = str(tmp_path / "c")
+    ckpt.CheckpointManager(root, keep=3, world=4).save_sharded(
+        6, flat, specs)
+    res = _run_cli(
+        "reshard", root, "--world", "2", "--dry-run", "--json")
+    assert res.returncode == 0, res.stderr
+    summary = json.loads(res.stdout)
+    assert summary["step"] == 6
+    assert summary["src_world"] == 4 and summary["dst_world"] == 2
+    assert summary["peak_scratch_bytes"] <= summary["memory_bound_bytes"]
+    # dry run wrote nothing
+    info = ckpt.CheckpointManager(root, world=4).latest_valid(world=4)
+    assert info is not None
+    # the real thing
+    res2 = _run_cli("reshard", root, "--world", "2")
+    assert res2.returncode == 0, res2.stderr
+    assert "committed step 6 at world 2" in res2.stderr
+    info2 = ckpt.CheckpointManager(root, world=2).latest_valid(world=2)
+    assert info2 is not None and info2.world == 2
+    np.testing.assert_array_equal(
+        ckpt.load_sharded_global(info2)["w"], flat["w"])
+
+
+def test_cli_reshard_out_root_leaves_source_untouched(tmp_path):
+    specs, flat = _demo_state()
+    src = str(tmp_path / "src")
+    dst = str(tmp_path / "dst")
+    ckpt.CheckpointManager(src, keep=3, world=4).save_sharded(
+        2, flat, specs)
+    res = _run_cli("reshard", src, "--world", "3", "--out", dst)
+    assert res.returncode == 0, res.stderr
+    assert ckpt.CheckpointManager(src, world=4).latest_valid(
+        world=4).world == 4
+    assert ckpt.CheckpointManager(dst, world=3).latest_valid(
+        world=3).world == 3
+
+
+def test_cli_reshard_error_paths(tmp_path):
+    # no checkpoint at all
+    res = _run_cli("reshard", str(tmp_path / "empty"), "--world", "2")
+    assert res.returncode == 2
+    assert "no valid checkpoint" in res.stderr
+    # v1 checkpoint: clear schema message
+    root = str(tmp_path / "v1")
+
+    def _json_save(path, state):
+        with open(path, "w") as f:
+            json.dump(state, f)
+
+    ckpt.CheckpointManager(root, world=4, save_fn=_json_save).save(
+        1, {"w": [1]}, fingerprint="fp")
+    res2 = _run_cli("reshard", root, "--world", "2")
+    assert res2.returncode == 1
+    assert "m4t-ckpt/2" in res2.stderr
+
+
+# ---------------------------------------------------------------------
+# on-mesh execution (existing collective ops; local + native paths)
+# ---------------------------------------------------------------------
+
+
+def test_on_mesh_local_copies_without_comm():
+    """dst_world=1 makes every transfer a local copy: the on-mesh
+    walker is validated device-free (send/recv never called)."""
+    specs = {"w": LeafSpec(shape=(9,), dtype="float32"),
+             "b": LeafSpec(shape=(2,), dtype="float32",
+                           kind="replicated")}
+    flat = {"w": np.arange(9, dtype=np.float32),
+            "b": np.ones(2, np.float32)}
+    plan = plan_reshard(specs, 3, 1)
+
+    def boom(*a, **k):  # no wire traffic may happen
+        raise AssertionError("p2p op called in an all-local reshard")
+
+    out = reshard.execute_plan_on_mesh(
+        plan, 0, reader_from_global(flat, specs, 3),
+        src_owner=lambda s: 0, send_fn=boom, recv_fn=boom,
+    )
+    np.testing.assert_array_equal(out["w"], flat["w"])
+    np.testing.assert_array_equal(out["b"], flat["b"])
+
+
+needs_native = pytest.mark.skipif(
+    subprocess.run(["which", "g++"], capture_output=True).returncode != 0,
+    reason="no C++ toolchain",
+)
+
+
+@needs_native
+def test_on_mesh_p2p_reshard_matches_offline(tmp_path):
+    """A live 2-rank world reshards a 4-world state through
+    ``m4t.send``/``m4t.recv``: survivor r holds old shards r and r+2,
+    every rank walks the same plan order, and each destination shard
+    must equal direct global slicing."""
+    script = textwrap.dedent(f"""
+        import sys; sys.path.insert(0, {REPO!r})
+        import numpy as np
+        import mpi4jax_tpu as m4t
+        from mpi4jax_tpu.runtime import shm
+        from mpi4jax_tpu.resilience.reshard import (
+            LeafSpec, plan_reshard, execute_plan_on_mesh,
+            reader_from_shards, shard_slices,
+        )
+
+        rank, size = shm.rank(), shm.size()
+        assert size == 2
+        specs = {{"w": LeafSpec(shape=(10,), dtype="float32"),
+                  "b": LeafSpec(shape=(3,), dtype="float32",
+                                kind="replicated")}}
+        g = {{"w": np.arange(10, dtype=np.float32) * 2.0,
+              "b": np.asarray([7.0, 8.0, 9.0], np.float32)}}
+        # survivor r holds old-world shards r and r + 2
+        shards = {{
+            (k, s): np.ascontiguousarray(g[k][shard_slices(spec, 4, s)])
+            for k, spec in specs.items() for s in range(4)
+            if s % 2 == rank
+        }}
+        plan = plan_reshard(specs, 4, 2)
+        out = execute_plan_on_mesh(
+            plan, rank, reader_from_shards(shards, specs, 4),
+            src_owner=lambda s: s % 2,
+        )
+        np.testing.assert_array_equal(
+            out["w"], g["w"][5 * rank:5 * (rank + 1)])
+        np.testing.assert_array_equal(out["b"], g["b"])
+        m4t.barrier()
+        print(f"ONMESH{{rank}} OK")
+    """)
+    path = str(tmp_path / "onmesh.py")
+    with open(path, "w") as f:
+        f.write(script)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.launch", "-n", "2", path],
+        env=env, capture_output=True, text=True, timeout=240, cwd=REPO,
+    )
+    assert res.returncode == 0, res.stderr
+    assert "ONMESH0 OK" in res.stdout and "ONMESH1 OK" in res.stdout
+
+
+# ---------------------------------------------------------------------
+# tier-1 wiring for the package selftests
+# ---------------------------------------------------------------------
+
+
+def test_selftest_function_direct():
+    assert reshard.selftest() == 0

@@ -43,10 +43,12 @@ import jax.numpy as jnp
 import mpi4jax_tpu as m4t
 from mpi4jax_tpu.observability import events
 from mpi4jax_tpu.resilience import (
+    PREEMPT_EXIT,
     CheckpointManager,
     FaultPlan,
     FaultPlanError,
     InjectedFault,
+    PreemptGuard,
     RetryPolicy,
     Supervisor,
     classify,
@@ -238,6 +240,65 @@ def test_probability_zero_never_fires_and_is_seeded():
         _emit_n("AllReduce", 8)
         outcomes.append(plan2.rules[0].fired)
     assert outcomes[0] == outcomes[1]
+
+
+def test_preempt_rule_parses_with_crash_scoping():
+    plan = FaultPlan.parse(json.dumps([
+        {"rank": [2, 3], "op": "AllReduce", "nth": 6,
+         "action": "preempt", "attempt": 0},
+        {"rank": "*", "op": "Barrier", "action": "preempt", "p": 0.5},
+    ]))
+    assert [r.action for r in plan.rules] == ["preempt", "preempt"]
+    assert plan.rules[0].attempt == 0 and plan.rules[0].nth == 6
+    plan.validate_world(4)
+    with pytest.raises(FaultPlanError, match="out of range"):
+        plan.validate_world(3)
+
+
+def test_preempt_fires_sigterm_once(elastic_sigterm_flag):
+    """The preempt action delivers SIGTERM to this process at exactly
+    the Nth matching emission — and is survivable (the handler runs,
+    execution continues)."""
+    flag = elastic_sigterm_flag
+    plan = FaultPlan.parse(
+        '[{"rank": 0, "op": "AllReduce", "nth": 2, "action": "preempt"}]'
+    )
+    faults.arm(plan, rank=0)
+    _emit_n("AllReduce", 1)
+    assert flag() == 0
+    _emit_n("AllReduce", 3)  # nth=2 fires once; later matches don't
+    assert flag() == 1
+    assert plan.rules[0].fired == 1
+
+
+@pytest.fixture
+def elastic_sigterm_flag():
+    """Temporarily swap in a counting SIGTERM handler (and restore the
+    previous one) so preempt-action tests observe the signal instead
+    of dying on it."""
+    import signal as _signal
+
+    hits = []
+    prev = _signal.signal(_signal.SIGTERM, lambda s, f: hits.append(s))
+    try:
+        yield lambda: len(hits)
+    finally:
+        _signal.signal(_signal.SIGTERM, prev)
+
+
+def test_preempt_guard_flag_and_exit(elastic_sigterm_flag):
+    import signal as _signal
+
+    guard = PreemptGuard()  # replaces the fixture's handler; restored after
+    assert not guard.preempted
+    guard.exit_if_preempted()  # no-op while unflagged
+    os.kill(os.getpid(), _signal.SIGTERM)
+    assert guard.preempted
+    saved = []
+    with pytest.raises(SystemExit) as exc:
+        guard.exit_if_preempted(save_fn=lambda: saved.append(1))
+    assert exc.value.code == PREEMPT_EXIT == 143
+    assert saved == [1]
 
 
 def test_delay_actually_sleeps():
@@ -476,6 +537,102 @@ def test_classify_matrix():
             {"source": "a.py:3"}]}],
     }]}
     assert classify(mm_static, 1)["reason"] == "mismatch_static_attributed"
+
+
+def test_classify_preempted():
+    # a rank declared preemption on the way out: transient, named
+    assert classify(None, PREEMPT_EXIT) == {
+        "klass": "transient", "reason": "preempted", "kinds": [],
+    }
+    # survivors' logs show hang/missing shapes — still "preempted"
+    hangish = {"findings": [
+        {"kind": "hang", "rank": 0, "verdict": "hung"},
+        {"kind": "missing_rank", "rank": 3, "world": 4},
+    ]}
+    v = classify(hangish, PREEMPT_EXIT)
+    assert v["klass"] == "transient" and v["reason"] == "preempted"
+    assert v["kinds"] == ["hang", "missing_rank"]
+    # but a MISMATCH still wins: a diverged program that also got
+    # preempted will diverge again
+    mm = {"findings": [{"kind": "mismatch", "seq": 2, "groups": []}]}
+    assert classify(mm, PREEMPT_EXIT)["klass"] == "deterministic"
+
+
+def test_supervisor_extra_fn_audits_world_transitions(tmp_path):
+    """The elastic launcher records world-size transitions through
+    extra_fn; the audit record must carry them (the doctor's
+    supervisor timeline narrates exactly these fields)."""
+    audit = str(tmp_path / "supervisor.jsonl")
+    worlds = {0: 4, 1: 2}
+    state = {"attempt": 0}
+
+    def run_fn(attempt, resume):
+        state["attempt"] = attempt
+        return PREEMPT_EXIT if attempt == 0 else 0
+
+    def extra_fn(attempt):
+        rec = {"world": worlds[attempt]}
+        if attempt == 0:
+            rec.update(
+                preempted_ranks=[2, 3], next_world=2,
+                resharded_from_step=5, resharded_from_world=4,
+            )
+        return rec
+
+    sup = Supervisor(
+        run_fn,
+        policy=RetryPolicy(retries=2, backoff_s=0.0, jitter=0.0),
+        diagnose_fn=lambda attempt: None,
+        resume_fn=lambda: 5,
+        extra_fn=extra_fn,
+        audit_path=audit,
+        sleep_fn=lambda s: None,
+    )
+    assert sup.run() == 0
+    recs = events.read(audit)
+    assert [r["action"] for r in recs] == ["retry", "done"]
+    first = recs[0]
+    assert first["world"] == 4 and first["next_world"] == 2
+    assert first["preempted_ranks"] == [2, 3]
+    assert first["resharded_from_step"] == 5
+    assert first["reason"] == "preempted"
+    assert recs[1]["world"] == 2 and "next_world" not in recs[1]
+    # a broken extra_fn must not break the supervisor
+    sup2 = Supervisor(
+        lambda a, r: 0,
+        policy=RetryPolicy(retries=0),
+        extra_fn=lambda a: 1 / 0,
+        sleep_fn=lambda s: None,
+    )
+    assert sup2.run() == 0
+
+
+def test_doctor_narrates_supervisor_timeline(tmp_path):
+    from mpi4jax_tpu.observability import doctor
+
+    rundir = tmp_path / "run"
+    attempt = rundir / "attempt00"
+    attempt.mkdir(parents=True)
+    log = events.EventLog(str(rundir / "supervisor.jsonl"))
+    log.append(events.event(
+        "supervisor", attempt=0, exit_code=143, klass="transient",
+        reason="preempted", action="retry", world=4,
+        preempted_ranks=[2, 3], next_world=2, resharded_from_step=5,
+        resharded_from_world=4, resume_step=5,
+    ))
+    log.append(events.event(
+        "supervisor", attempt=1, exit_code=0, klass="clean",
+        reason="exit_zero", action="done", world=2,
+    ))
+    # found from the attempt dir (one level below the audit log)
+    recs = doctor.load_supervisor_audit([str(attempt)])
+    assert len(recs) == 2
+    text = doctor.format_supervisor_timeline(recs)
+    assert "attempt 0: world 4" in text
+    assert "rank(s) 2,3 preempted" in text
+    assert "ELASTIC: world 4 -> 2" in text
+    assert "step 5 (world 4) resharded for 2 rank(s)" in text
+    assert "attempt 1: world 2" in text and "clean" in text
 
 
 def test_retry_policy_backoff():
@@ -753,6 +910,168 @@ def test_chaos_crash_resume_bitwise_identical(tmp_path):
         if r["kind"] == "fault"
     ]
     assert len(fault_recs) == 1 and fault_recs[0]["action"] == "crash"
+
+
+# the elastic chaos shape: an eager loop whose state is genuinely
+# *sharded* over the world (each rank owns a slice of w), committed
+# every step via the two-phase m4t-ckpt/2 protocol. Gradients are
+# assembled so each position receives exactly one rank's contribution
+# (+ zeros), which makes the final params bit-identical across world
+# sizes — the elastic resume has no tolerance to hide behind.
+_ELASTIC_TRAIN = """
+import sys
+import numpy as np
+import jax.numpy as jnp
+import mpi4jax_tpu as m4t
+from mpi4jax_tpu.runtime import shm
+from mpi4jax_tpu.resilience import ckpt, reshard, PreemptGuard, resume_step
+
+STEPS = 8
+G = 8
+rank, size = shm.rank(), shm.size()
+guard = PreemptGuard()
+mgr = ckpt.CheckpointManager(sys.argv[1], keep=3, world=size)
+specs = {"w": reshard.LeafSpec(shape=(G,), dtype="float32"),
+         "s": reshard.LeafSpec(shape=(), dtype="int32",
+                               kind="replicated")}
+lo, hi = reshard.shard_extent(G, size, rank)
+w = np.zeros(hi - lo, np.float32)
+start = 0
+r = resume_step()
+if r is not None:
+    info = mgr.at_step(r, world=size)
+    if info is not None:
+        w = ckpt.load_shard(info, rank)["w"]
+        start = info.step + 1
+        print(f"RESUMED{rank}@{info.step}", file=sys.stderr)
+data = np.arange(G, dtype=np.float32)
+for step in range(start, STEPS):
+    guard.exit_if_preempted()  # grace: last committed step wins
+    part = np.zeros(G, np.float32)
+    part[lo:hi] = data[lo:hi] * (step + 1)
+    g = np.asarray(m4t.allreduce(jnp.asarray(part)))
+    w = w + np.float32(0.1) * g[lo:hi]
+    mgr.stage_shard(step, rank, {"w": w, "s": np.int32(step)}, specs)
+    m4t.barrier()
+    if rank == 0:
+        mgr.commit_sharded(step, specs)
+    m4t.barrier()
+final = np.asarray(m4t.allreduce(jnp.asarray(np.pad(w, (lo, G - hi)))))
+print(f"FINAL{rank} " + final.tobytes().hex())
+"""
+
+
+@needs_native
+@pytest.mark.chaos
+@pytest.mark.elastic
+@pytest.mark.slow
+def test_elastic_preempt_shrinks_world_and_resumes(tmp_path):
+    """ISSUE-9 acceptance: ranks 2 and 3 of a 4-rank world are
+    preempted (SIGTERM) at step 5; the elastic supervisor counts the
+    survivors, reshards the step-5 checkpoint 4→2, restarts at world
+    2, and the final parameters are bit-for-bit the uninterrupted
+    2-rank run's. The supervisor audit records the transition and the
+    doctor narrates it."""
+    # uninterrupted 2-rank reference
+    ref_ckpt = str(tmp_path / "ckpt_ref")
+    ref = _launch(tmp_path, 2, _ELASTIC_TRAIN, script_args=(ref_ckpt,))
+    assert ref.returncode == 0, ref.stderr
+    want = _finals(ref.stdout)
+    assert set(want) == {"0", "1"}, ref.stdout
+
+    chaos_ckpt = str(tmp_path / "ckpt_chaos")
+    rundir = str(tmp_path / "run")
+    plan = tmp_path / "plan.json"
+    plan.write_text(json.dumps([{
+        "rank": [2, 3], "op": "AllReduce", "nth": 6,
+        "action": "preempt", "attempt": 0,
+    }]))
+    res = _launch(
+        tmp_path, 4, _ELASTIC_TRAIN,
+        "--events-dir", rundir,
+        "--fault-plan", str(plan),
+        "--retries", "2", "--backoff", "0.1",
+        "--resume-dir", chaos_ckpt,
+        "--elastic", "--min-ranks", "2",
+        script_args=(chaos_ckpt,),
+        timeout=400,
+    )
+    assert res.returncode == 0, res.stderr
+    assert "injecting preempt" in res.stderr
+    assert "preemption signature" in res.stderr
+    assert "shrinking world 4 -> 2" in res.stderr
+    assert "resharding step" in res.stderr
+    assert "RESUMED0@" in res.stderr and "RESUMED1@" in res.stderr
+    # bit-for-bit against the 2-rank reference: ranks 0/1 of attempt 1
+    got = _finals(res.stdout)
+    assert got["0"] == want["0"] and got["1"] == want["1"]
+    # audit trail carries the world transition + reshard provenance
+    recs = events.read(os.path.join(rundir, "supervisor.jsonl"))
+    assert [r["action"] for r in recs] == ["retry", "done"]
+    assert recs[0]["reason"] == "preempted"
+    assert recs[0]["world"] == 4 and recs[0]["next_world"] == 2
+    assert recs[0]["preempted_ranks"] == [2, 3]
+    assert isinstance(recs[0]["resharded_from_step"], int)
+    assert recs[1]["world"] == 2
+    # the resharded checkpoint records its provenance
+    from mpi4jax_tpu.resilience.ckpt import CheckpointManager as CM
+
+    info = CM(chaos_ckpt, world=2).latest_valid(world=2)
+    assert info is not None and info.world == 2
+    steps_seen = CM(chaos_ckpt).steps()
+    resharded = CM(chaos_ckpt, world=2).at_step(
+        recs[0]["resharded_from_step"], world=2)
+    assert resharded is not None, steps_seen
+    assert resharded.manifest["resharded_from"]["world"] == 4
+    # the doctor narrates the recovery from the attempt artifacts
+    from mpi4jax_tpu.observability import doctor
+
+    audit = doctor.load_supervisor_audit(
+        [os.path.join(rundir, "attempt00")])
+    text = doctor.format_supervisor_timeline(audit)
+    assert "ELASTIC: world 4 -> 2" in text
+
+
+@needs_native
+@pytest.mark.chaos
+@pytest.mark.elastic
+@pytest.mark.slow
+def test_elastic_below_min_ranks_gives_up(tmp_path):
+    """Fewer survivors than --min-ranks is a give-up, not a smaller
+    world: nothing is respawned and the audit says why."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    rundir = str(tmp_path / "run")
+    plan = tmp_path / "plan.json"
+    plan.write_text(json.dumps([{
+        "rank": 1, "op": "AllReduce", "nth": 3,
+        "action": "preempt", "attempt": 0,
+    }]))
+    res = _launch(
+        tmp_path, 2, _ELASTIC_TRAIN,
+        "--events-dir", rundir,
+        "--fault-plan", str(plan),
+        "--retries", "2", "--backoff", "0.1",
+        "--resume-dir", ckpt_dir,
+        "--elastic", "--min-ranks", "2",
+        script_args=(ckpt_dir,),
+        timeout=400,
+    )
+    assert res.returncode != 0
+    assert "below --min-ranks 2; giving up" in res.stderr
+    recs = events.read(os.path.join(rundir, "supervisor.jsonl"))
+    assert recs[0]["reason"] == "preempted"
+    assert "elastic_blocked" in recs[1]
+    # no attempt after the block actually spawned a world
+    assert "attempt 1 not spawned" in res.stderr
+
+
+def test_launch_elastic_flag_validation(tmp_path):
+    res = _launch(tmp_path, 1, "print('x')", "--elastic")
+    assert res.returncode == 2
+    assert "--elastic requires" in res.stderr
+    res2 = _launch(tmp_path, 1, "print('x')", "--min-ranks", "2")
+    assert res2.returncode == 2
+    assert "--min-ranks cannot exceed -n" in res2.stderr
 
 
 @needs_native
