@@ -1,0 +1,175 @@
+"""Serving-plane load generator: jobs/hour + queue-latency percentiles.
+
+Drives the queue-draining supervisor (``mpi4jax_tpu/serving``) the way
+traffic would: submit a batch of jobs across several tenants, then
+serve until the queue drains, measuring
+
+- **drain wall clock** (the headline ``value`` — lower is better, the
+  BENCH trajectory convention),
+- **jobs/hour** (throughput at this spawn cost),
+- **queue-wait p50/p99** (submit -> admit latency under backlog).
+
+Two modes:
+
+- default: every job really spawns a 1-rank world through
+  ``launch.spawn_world`` (``python -c pass``) — the number includes
+  the true per-world spawn cost the serving plane pays;
+- ``--stub``: a no-op runner — the control plane alone (spool I/O,
+  scheduling, audit), the ceiling the spawn cost is measured against.
+
+Emits the benchmark JSON line on stdout (the BENCH ``parsed`` record)
+and, with ``--out BENCH_rNN_serve.json``, the full round wrapper —
+the ``serve`` variant trajectory ``perf gate`` covers::
+
+    python benchmarks/serve_loadgen.py --jobs 24 --out BENCH_r10_serve.json
+    python -m mpi4jax_tpu.observability.perf gate --variant serve
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("MPI4JAX_TPU_SKIP_VERSION_CHECK", "1")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+METRIC = "serve_loadgen_drain"
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[i]
+
+
+def run_loadgen(jobs: int, tenants: int, nproc: int, *, stub: bool,
+                queue_cap: int):
+    from mpi4jax_tpu.serving import Server, Spool
+
+    with tempfile.TemporaryDirectory() as tmp:
+        spool = Spool(os.path.join(tmp, "spool"))
+        spool.configure(queue_cap)
+        t0 = time.monotonic()
+        accepted = 0
+        shed = 0
+        for i in range(jobs):
+            r = spool.submit({
+                "id": f"load-{i:04d}",
+                "tenant": f"t{i % tenants}",
+                "cmd": ["-c", "pass"],
+                "nproc": 1,
+            })
+            if r["status"] == "queued":
+                accepted += 1
+            else:
+                shed += 1
+        runner = None
+        if stub:
+            runner = lambda spec, world, d, attempt, resume: (0, [])  # noqa: E731
+        server = Server(
+            spool, nproc=nproc, max_jobs=accepted, poll_s=0.01,
+            runner=runner, log=lambda msg: None,
+        )
+        rc = server.serve()
+        wall_s = time.monotonic() - t0
+        waits = sorted(
+            float(rec.get("queue_wait_s") or 0.0)
+            for rec in spool.done()
+            if rec.get("outcome") == "completed"
+        )
+        completed = len(waits)
+        return {
+            "rc": rc,
+            "wall_s": wall_s,
+            "accepted": accepted,
+            "shed": shed,
+            "completed": completed,
+            "jobs_per_hour": (
+                3600.0 * completed / wall_s if wall_s > 0 else None
+            ),
+            "queue_wait_p50_s": _pct(waits, 0.50),
+            "queue_wait_p99_s": _pct(waits, 0.99),
+        }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--jobs", type=int, default=24,
+                        help="jobs to submit (default %(default)s — "
+                        "keep it fixed so rounds stay comparable)")
+    parser.add_argument("--tenants", type=int, default=3)
+    parser.add_argument("-n", "--nproc", type=int, default=1,
+                        help="mesh capacity in ranks")
+    parser.add_argument("--queue-cap", type=int, default=None,
+                        help="bounded-queue capacity "
+                        "(default: jobs, so nothing is shed)")
+    parser.add_argument("--stub", action="store_true",
+                        help="stub runner: control-plane overhead only")
+    parser.add_argument("--out", default=None, metavar="BENCH.json",
+                        help="also write the BENCH round wrapper here")
+    parser.add_argument("--round", type=int, default=None,
+                        help="round number for the wrapper (default: "
+                        "parsed from --out filename)")
+    args = parser.parse_args(argv)
+
+    cap = args.queue_cap if args.queue_cap is not None else args.jobs
+    result = run_loadgen(
+        args.jobs, args.tenants, args.nproc,
+        stub=args.stub, queue_cap=cap,
+    )
+    mode = "stub" if args.stub else "spawn"
+    print(
+        f"# serve_loadgen [{mode}]: {result['completed']}/"
+        f"{result['accepted']} job(s) drained in "
+        f"{result['wall_s']:.2f}s ({result['jobs_per_hour']:.0f} "
+        f"jobs/h); queue wait p50 {result['queue_wait_p50_s']:.3f}s "
+        f"p99 {result['queue_wait_p99_s']:.3f}s; rc={result['rc']}",
+        file=sys.stderr,
+    )
+    record = {
+        "metric": METRIC,
+        "value": round(result["wall_s"], 3),
+        "unit": "s",
+        "vs_baseline": None,
+        "nproc": args.nproc,
+        "fused": None,
+        "jobs": args.jobs,
+        "mode": mode,
+        "jobs_per_hour": round(result["jobs_per_hour"], 1),
+        "queue_wait_p50_s": round(result["queue_wait_p50_s"], 4),
+        "queue_wait_p99_s": round(result["queue_wait_p99_s"], 4),
+    }
+    line = json.dumps(record)
+    print(line)
+    if args.out:
+        rnd = args.round
+        if rnd is None:
+            import re
+
+            m = re.search(r"BENCH_r(\d+)", os.path.basename(args.out))
+            rnd = int(m.group(1)) if m else 0
+        with open(args.out, "w") as f:
+            json.dump({
+                "n": rnd,
+                "cmd": "python benchmarks/serve_loadgen.py "
+                       f"--jobs {args.jobs} -n {args.nproc}"
+                       + (" --stub" if args.stub else ""),
+                "rc": result["rc"],
+                "tail": line + "\n",
+                "parsed": record,
+            }, f, indent=1)
+            f.write("\n")
+        print(f"# wrote {args.out}", file=sys.stderr)
+    return 0 if result["rc"] == 0 and (
+        result["completed"] == result["accepted"]
+    ) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,6 +79,13 @@ world), so collective routing falls back to the default policy by
 construction. The ``supervisor.jsonl`` audit records every world-size
 transition (old world, new world, reshard source step) and the doctor
 narrates them post-mortem.
+
+Serving plane (``mpi4jax_tpu/serving/``, ``python -m
+mpi4jax_tpu.serving serve``): a long-lived queue-draining supervisor
+multiplexes many submitted jobs over this machine through the same
+spawn path — :func:`make_world_args` + :func:`spawn_world` are the
+reuse seam it (and any other harness) drives, so per-rank environment
+construction lives in exactly one place (:func:`rank_env`).
 """
 
 from __future__ import annotations
@@ -276,6 +283,107 @@ def _verify_prelaunch(args, world=None) -> int:
 _PREEMPT_RCS = (143, -signal.SIGTERM)
 
 
+def make_world_args(**overrides):
+    """An args namespace carrying every field :func:`spawn_world` and
+    :func:`_verify_prelaunch` read, at the CLI defaults.
+
+    The reuse seam for harnesses that spawn worlds without going
+    through the argv parser — the serving plane
+    (``mpi4jax_tpu/serving/``) builds one of these per job attempt.
+    Unknown field names are a :class:`TypeError`, so a harness cannot
+    silently set a flag the spawn path never reads.
+    """
+    args = argparse.Namespace(
+        nproc=1, module=None, cmd=[],
+        events_dir=None, hang_timeout=0.0, heartbeat=5.0,
+        doctor=False, live=False, live_grace=None, dashboard=False,
+        metrics_port=None, perf=False, plan=None, tune=False,
+        verify=False, static_check="off", fault_plan=None,
+        retries=0, backoff=1.0, resume_dir=None,
+        elastic=False, min_ranks=1,
+        plan_cache_env=None, _live_report=None,
+    )
+    for key, value in overrides.items():
+        if not hasattr(args, key):
+            raise TypeError(f"make_world_args: unknown field {key!r}")
+        setattr(args, key, value)
+    return args
+
+
+def rank_env(
+    rank,
+    world,
+    *,
+    shm_name,
+    shm_gen,
+    launcher_pid=None,
+    base_env=None,
+    extra_env=None,
+    events_dir=None,
+    heartbeat=5.0,
+    static_check="off",
+    fault_plan=None,
+    fault_attempt=0,
+    plan_cache=None,
+    resume_step=None,
+    runtime_sampling=False,
+    perf_watch=False,
+):
+    """The environment one spawned rank runs under — world membership
+    (shm segment name + generation nonce + rank/size), telemetry
+    arming, plan cache, fault plan, and resume step. Extracted from
+    the spawn loop so every harness that launches ranks (the CLI
+    launcher, the serving plane, tests) builds rank environments
+    through one seam and cannot drift."""
+    env = dict(os.environ if base_env is None else base_env)
+    if extra_env:
+        env.update({str(k): str(v) for k, v in extra_env.items()})
+    env.update(
+        M4T_SHM_NAME=shm_name,
+        M4T_RANK=str(rank),
+        M4T_SIZE=str(world),
+        M4T_SHM_GEN=str(shm_gen),
+        # world membership is for *direct* children only:
+        # runtime/shm.py refuses to join when the parent pid doesn't
+        # match, so a rank's own subprocesses (pytest spawning helper
+        # scripts) never attach as duplicate ranks of the live world
+        M4T_LAUNCHER_PID=str(
+            os.getpid() if launcher_pid is None else launcher_pid
+        ),
+        JAX_PLATFORMS="cpu",
+    )
+    if static_check and static_check != "off":
+        env["M4T_STATIC_CHECK"] = static_check
+    if fault_plan:
+        env["M4T_FAULT_PLAN"] = fault_plan
+        env["M4T_FAULT_ATTEMPT"] = str(fault_attempt)
+    if plan_cache:
+        # arm the collective plan cache in every rank
+        # (planner/dispatch.py validates and arms at import)
+        env["M4T_PLAN_CACHE"] = plan_cache
+    if resume_step is not None:
+        env["M4T_RESUME_STEP"] = str(resume_step)
+    if events_dir:
+        # literal {rank} on purpose: each child resolves the template
+        # from its own M4T_RANK (events.py), so the launcher and any
+        # grandchildren agree on the layout
+        env.update(
+            M4T_TELEMETRY="1",
+            M4T_TELEMETRY_EVENTS=os.path.join(
+                events_dir, "events-rank{rank}.jsonl"
+            ),
+            M4T_TELEMETRY_FSYNC="1",
+            M4T_FLIGHT_RECORDER_DIR=events_dir,
+            M4T_HEARTBEAT=str(heartbeat),
+        )
+        if runtime_sampling:
+            env.update(
+                M4T_TELEMETRY_RUNTIME="1",
+                M4T_PERF_WATCH="1" if perf_watch else "0",
+            )
+    return env
+
+
 def _spawn_world(
     args,
     events_dir,
@@ -284,6 +392,7 @@ def _spawn_world(
     resume_step=None,
     fault_plan_env=None,
     world=None,
+    extra_env=None,
 ):
     """Spawn and babysit one world of ``world`` ranks (default
     ``-n``); returns ``(exit_code, preempted_ranks)``.
@@ -315,55 +424,24 @@ def _spawn_world(
     preempted = set()
     try:
         for rank in range(world):
-            env = dict(os.environ)
-            env.update(
-                M4T_SHM_NAME=shm_name,
-                M4T_RANK=str(rank),
-                M4T_SIZE=str(world),
-                M4T_SHM_GEN=str(shm_gen),
-                # world membership is for *direct* children only:
-                # runtime/shm.py refuses to join when the parent pid
-                # doesn't match, so a rank's own subprocesses (pytest
-                # spawning helper scripts) never attach as duplicate
-                # ranks of the live world
-                M4T_LAUNCHER_PID=str(os.getpid()),
-                JAX_PLATFORMS="cpu",
+            # --tune needs the runtime latency samples (the measured
+            # side of the sweep); --live needs them for the exec-start
+            # wedge evidence, straggler samples, and the anomaly feed
+            env = rank_env(
+                rank, world,
+                shm_name=shm_name,
+                shm_gen=shm_gen,
+                extra_env=extra_env,
+                events_dir=events_dir,
+                heartbeat=args.heartbeat,
+                static_check=args.static_check,
+                fault_plan=fault_plan_env,
+                fault_attempt=attempt,
+                plan_cache=getattr(args, "plan_cache_env", None),
+                resume_step=resume_step,
+                runtime_sampling=(args.perf or args.tune or args.live),
+                perf_watch=(args.perf or args.live),
             )
-            if args.static_check != "off":
-                env["M4T_STATIC_CHECK"] = args.static_check
-            if fault_plan_env:
-                env["M4T_FAULT_PLAN"] = fault_plan_env
-                env["M4T_FAULT_ATTEMPT"] = str(attempt)
-            if getattr(args, "plan_cache_env", None):
-                # arm the collective plan cache in every rank
-                # (planner/dispatch.py validates and arms at import)
-                env["M4T_PLAN_CACHE"] = args.plan_cache_env
-            if resume_step is not None:
-                env["M4T_RESUME_STEP"] = str(resume_step)
-            if events_dir:
-                # literal {rank} on purpose: each child resolves the
-                # template from its own M4T_RANK (events.py), so the
-                # launcher and any grandchildren agree on the layout
-                env.update(
-                    M4T_TELEMETRY="1",
-                    M4T_TELEMETRY_EVENTS=os.path.join(
-                        events_dir, "events-rank{rank}.jsonl"
-                    ),
-                    M4T_TELEMETRY_FSYNC="1",
-                    M4T_FLIGHT_RECORDER_DIR=events_dir,
-                    M4T_HEARTBEAT=str(args.heartbeat),
-                )
-                if args.perf or args.tune or args.live:
-                    # --tune needs the runtime latency samples (the
-                    # measured side of the sweep); --live needs them
-                    # for the exec-start wedge evidence, straggler
-                    # samples, and the anomaly feed
-                    env.update(
-                        M4T_TELEMETRY_RUNTIME="1",
-                        M4T_PERF_WATCH=(
-                            "1" if (args.perf or args.live) else "0"
-                        ),
-                    )
             cmd = [sys.executable]
             if os.environ.get("M4T_LAUNCH_COVERAGE"):
                 # Run each rank under parallel-mode coverage so CI can
@@ -553,6 +631,12 @@ def _spawn_world(
             os.unlink(path)
         except OSError:
             pass
+
+
+#: public name of the one-attempt spawn primitive: harnesses that
+#: multiplex many worlds over this machine (``mpi4jax_tpu/serving/``)
+#: call this with a :func:`make_world_args` namespace per attempt
+spawn_world = _spawn_world
 
 
 def main(argv=None):

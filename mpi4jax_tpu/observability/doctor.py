@@ -841,6 +841,119 @@ def format_supervisor_timeline(records: List[Dict[str, Any]]) -> str:
 
 
 # ---------------------------------------------------------------------
+# serving timeline (queue-level narration)
+# ---------------------------------------------------------------------
+
+
+def load_serving_audit(
+    inputs: Iterable[str],
+) -> List[Dict[str, Any]]:
+    """``serving.jsonl`` records found beside the given inputs or up
+    to three levels up — a doctor pointed at a single job attempt
+    (``SPOOL/jobs/<id>/attempt00``) finds the queue-level audit the
+    serving supervisor writes at ``SPOOL/``. One rank log explains a
+    crash; the serving audit explains what the *queue* did around it
+    (admission, rejection, world shrink, drain)."""
+    seen: set = set()
+    records: List[Dict[str, Any]] = []
+    for item in inputs:
+        d = item if os.path.isdir(item) else os.path.dirname(item)
+        d = os.path.abspath(d)
+        cands = [d]
+        for _ in range(3):
+            cands.append(os.path.dirname(cands[-1]))
+        for cand in cands:
+            path = os.path.join(cand, "serving.jsonl")
+            if path in seen:
+                continue
+            seen.add(path)
+            if not os.path.exists(path):
+                continue
+            try:
+                records.extend(
+                    r for r in events.iter_records(path)
+                    if r.get("kind") == "serving"
+                )
+            except OSError:
+                continue
+    return records
+
+
+def format_serving_timeline(records: List[Dict[str, Any]]) -> str:
+    """Narrate the serving plane's queue history: every submit /
+    reject / admit / outcome, plus world-capacity transitions and the
+    drain — so a spool that shed load at 2 a.m. and finished smaller
+    explains itself in the morning."""
+    out = [f"serving timeline ({len(records)} event(s)):"]
+    for r in records:
+        event = r.get("event", "?")
+        job = r.get("job")
+        tag = f" job {job}" if job else ""
+        if event == "serve_start":
+            line = (
+                f"  serve start: world {r.get('world')}, queue "
+                f"capacity {r.get('capacity')}"
+                + (", elastic" if r.get("elastic") else "")
+                + (", verify" if r.get("verify") else "")
+            )
+        elif event == "submitted":
+            line = (
+                f"  submitted:{tag} (tenant {r.get('tenant')}, "
+                f"nproc {r.get('nproc')}, depth {r.get('depth')})"
+            )
+        elif event == "rejected":
+            line = f"  REJECTED:{tag} — {r.get('reason')}"
+            if r.get("reason") == "queue_full":
+                line += (
+                    f" (depth {r.get('depth')} >= capacity "
+                    f"{r.get('capacity')})"
+                )
+        elif event == "admitted":
+            line = (
+                f"  admitted:{tag} at world {r.get('world')} after "
+                f"{r.get('queue_wait_s', 0):.3g}s in queue"
+            )
+        elif event == "world":
+            line = (
+                f"  ELASTIC: world {r.get('world')} -> "
+                f"{r.get('next_world')}"
+            )
+            pre = r.get("preempted_ranks")
+            if pre:
+                line += (
+                    f"; rank(s) {','.join(str(p) for p in pre)} "
+                    "preempted"
+                )
+            if r.get("resharded_from_step") is not None:
+                line += (
+                    f"; checkpoint step {r['resharded_from_step']} "
+                    f"(world {r.get('resharded_from_world')}) "
+                    "resharded"
+                )
+            if r.get("reason"):
+                line += f" [{r['reason']}]"
+        elif event in ("completed", "failed"):
+            line = (
+                f"  {event}:{tag} (world {r.get('world')}, "
+                f"{r.get('attempts')} attempt(s)"
+            )
+            if event == "failed":
+                line += f", {r.get('reason')}"
+            line += ")"
+        elif event == "drain_requested":
+            line = "  drain requested: admission closed"
+        elif event == "drained":
+            line = (
+                f"  drained: queue empty after {r.get('jobs')} "
+                f"job(s) at world {r.get('world')}"
+            )
+        else:
+            line = f"  {event}:{tag}"
+        out.append(line)
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------
 
@@ -937,6 +1050,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         straggler_min_samples=args.straggler_min_samples,
     )
     if report is None:
+        # no per-rank telemetry — but the supervisor/serving audit
+        # trails may still tell the story (a spool of jobs that never
+        # armed telemetry, or a run whose sinks were swept)
+        audit = load_supervisor_audit(args.inputs)
+        serving = load_serving_audit(args.inputs)
+        if not args.json and (audit or serving):
+            if audit:
+                print(format_supervisor_timeline(audit))
+            if serving:
+                print(format_serving_timeline(serving))
+            return 0
         print("doctor: no usable records in the given inputs", file=sys.stderr)
         return 2
     if args.static:
@@ -992,6 +1116,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             # attempts failed, how they were classified, and any
             # world-size transitions (preemption -> shrink -> reshard)
             print(format_supervisor_timeline(audit))
+        serving = load_serving_audit(args.inputs)
+        if serving:
+            # the queue-level story: admission, load shed, capacity
+            # transitions, drain (mpi4jax_tpu/serving)
+            print(format_serving_timeline(serving))
     if args.perf:
         from . import perf
 

@@ -19,8 +19,13 @@ newest checkpoint that is actually whole. That is this module:
 - **Validity** — :meth:`CheckpointManager.latest_valid` walks steps
   newest-first and returns the first one whose manifest parses, whose
   step tag matches its directory, whose data exists, and (when asked)
-  whose world size / pytree fingerprint match the resuming program —
-  a checkpoint from a differently-shaped model or a different world
+  whose world size / pytree fingerprint match the resuming program.
+  The scan tolerates *vanishing* step dirs: keep-K retention in a
+  concurrent writer (the serving plane's drain path reads while a
+  resident job checkpoints) may delete a step between the directory
+  listing and the manifest read — that step simply reads as invalid
+  and the scan falls through to an older one. A checkpoint from a
+  differently-shaped model or a differently-sized world likewise
   must not be silently loaded into this one. A checkpoint that is
   valid *except* for its world size is never silently skipped: by
   default the skip is logged, and under ``allow_reshard=True`` it is
@@ -217,13 +222,22 @@ class CheckpointManager:
         if not isinstance(manifest, dict) or manifest.get("step") != step:
             return None  # renamed/copied dir whose tag lies
         data = os.path.join(path, DATA_NAME)
-        if manifest.get("schema") == MANIFEST_SCHEMA_V2:
-            if not self._v2_data_complete(data, manifest):
-                return None  # truncated shard layout
-        elif not os.path.exists(data) or (
-            os.path.isdir(data) and not os.listdir(data)
-        ):
-            return None  # manifest without data: truncated by hand
+        try:
+            if manifest.get("schema") == MANIFEST_SCHEMA_V2:
+                if not self._v2_data_complete(data, manifest):
+                    return None  # truncated shard layout
+            elif not os.path.exists(data) or (
+                os.path.isdir(data) and not os.listdir(data)
+            ):
+                return None  # manifest without data: truncated by hand
+        except OSError:
+            # keep-K retention (this process's or a concurrent
+            # writer's prune — real under serving, where the drain
+            # path reads while a job writes) deleted the step dir
+            # between our listing and this read. A vanished
+            # checkpoint reads as "not valid", never as a crash:
+            # latest_valid falls through to an older committed step.
+            return None
         if fingerprint is not None and manifest.get("fingerprint") not in (
             None, fingerprint
         ):

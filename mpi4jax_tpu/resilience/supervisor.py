@@ -107,7 +107,16 @@ class PreemptGuard:
     — the flight recorder still dumps from its own atexit hook on the
     way out, so a preempted rank leaves the same artifact trail a
     crashed one does, plus the checkpoint. ``install=False`` builds an
-    unarmed guard (tests)."""
+    unarmed guard (tests).
+
+    A **second** notice arriving while the grace checkpoint is already
+    running escalates to an immediate exit with :data:`PREEMPT_EXIT`:
+    the platform is done waiting, and re-entering the checkpoint from
+    the handler would interrupt the very save this thread is
+    mid-write in. The interrupted save is torn-but-harmless (the
+    tmp+rename commit protocol never exposes it) and the previous
+    committed step remains the resume point — losing one step beats
+    wedging in a recursive save until SIGKILL."""
 
     exit_code = PREEMPT_EXIT
 
@@ -116,8 +125,17 @@ class PreemptGuard:
         self.preempted = False
         self.signum = signum
         self._count = 0
+        self._checkpointing = False
         if install:
             signal.signal(signum, self._on_signal)
+
+    def _exit_now(self) -> None:
+        """Immediate exit from the signal handler. ``os._exit`` on
+        purpose: atexit hooks and finalizers may allocate/lock, which
+        a handler interrupting a checkpoint write must not do (the
+        flight recorder's SIGTERM dump already ran at the *first*
+        notice if armed). Patched by the double-signal unit test."""
+        os._exit(self.exit_code)
 
     def _on_signal(self, signum, frame):
         self.preempted = True
@@ -134,17 +152,36 @@ class PreemptGuard:
                 sys.stderr.flush()
             except Exception:
                 pass
+        elif self._checkpointing:
+            # escalation: the grace window is over mid-checkpoint —
+            # no re-entrant save, just leave with the preemption code
+            try:
+                sys.stderr.write(
+                    "m4t.resilience: second preemption notice during "
+                    "the grace checkpoint — exiting immediately with "
+                    f"{PREEMPT_EXIT} (last committed step wins)\n"
+                )
+                sys.stderr.flush()
+            except Exception:
+                pass
+            self._exit_now()
 
     def exit_if_preempted(
         self, save_fn: Optional[Callable[[], Any]] = None
     ) -> None:
         """Call at a step boundary: if a notice arrived, run
         ``save_fn`` (the checkpoint) and leave with
-        :data:`PREEMPT_EXIT`."""
+        :data:`PREEMPT_EXIT`. While ``save_fn`` runs the guard is in
+        its *checkpointing* window: a further notice exits on the
+        spot instead of re-entering the save."""
         if not self.preempted:
             return
         if save_fn is not None:
-            save_fn()
+            self._checkpointing = True
+            try:
+                save_fn()
+            finally:
+                self._checkpointing = False
         sys.exit(self.exit_code)
 
 

@@ -1,0 +1,39 @@
+"""Serving plane: a queue-draining multi-job supervisor.
+
+Turns the one-shot launcher into something that sustains traffic: a
+filesystem job spool with atomic claims and bounded backpressure
+(:mod:`.spool`), FIFO + per-tenant round-robin scheduling
+(:mod:`.scheduler`), a long-lived server that runs every job in its
+own fault domain and survives overload, job failure and host loss
+(:mod:`.server`), and a queue-level OpenMetrics exporter
+(:mod:`.export`). CLI::
+
+    python -m mpi4jax_tpu.serving serve  SPOOL -n 4 [--elastic ...]
+    python -m mpi4jax_tpu.serving submit SPOOL --cmd script.py ...
+    python -m mpi4jax_tpu.serving status SPOOL [--json]
+    python -m mpi4jax_tpu.serving drain  SPOOL [--wait]
+    python -m mpi4jax_tpu.serving --selftest
+
+See ``docs/serving.md`` for the job-spec schema, the scheduler policy
+table, backpressure semantics, and a drain walkthrough.
+"""
+
+from .scheduler import FairScheduler
+from .server import Server
+from .spool import (
+    JOB_SCHEMA,
+    JobSpec,
+    JobSpecError,
+    Spool,
+    parse_job,
+)
+
+__all__ = [
+    "JOB_SCHEMA",
+    "FairScheduler",
+    "JobSpec",
+    "JobSpecError",
+    "Server",
+    "Spool",
+    "parse_job",
+]

@@ -1,0 +1,469 @@
+"""``python -m mpi4jax_tpu.serving``: the serving-plane CLI.
+
+Subcommands:
+
+- ``serve SPOOL -n N`` — run the queue-draining supervisor over the
+  spool: claim jobs fairly, run each in its own fault domain, shrink
+  elastically on preemption (``--elastic --min-ranks K``), gate
+  admission through the static verifier (``--verify``), export queue
+  metrics (``SPOOL/metrics.prom``, ``--metrics-port``).
+- ``submit SPOOL [--spec JOB.json | flags + argv]`` — validate and
+  enqueue one job; prints the JSON response. Exit 0 = queued, 3 =
+  rejected (queue_full / draining / duplicate_id — the explicit
+  backpressure contract), 2 = invalid spec.
+- ``status SPOOL`` — queue depth, running and finished jobs.
+- ``drain SPOOL [--wait]`` — stop admission (new submits are
+  rejected) and, with ``--wait``, block until the queue is empty.
+- ``--selftest`` — device-free exercise of the whole control plane
+  (spool protocol, scheduler fairness, server loop under a stub
+  runner including elastic shrink over a real resharded checkpoint,
+  exporter contract). No devices, no subprocess worlds; wired into
+  tier-1 by ``tests/test_serving.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from .scheduler import FairScheduler
+from .server import Server
+from .spool import JobSpecError, Spool, parse_job
+
+
+def _cmd_serve(args) -> int:
+    spool = Spool(args.spool)
+    if args.queue_cap is not None:
+        spool.configure(args.queue_cap)
+    try:
+        server = Server(
+            spool,
+            nproc=args.nproc,
+            elastic=args.elastic,
+            min_ranks=args.min_ranks,
+            verify=args.verify,
+            poll_s=args.poll,
+            max_jobs=args.max_jobs,
+            idle_exit_s=args.idle_exit,
+            metrics_port=args.metrics_port,
+        )
+    except ValueError as e:
+        print(f"serve: {e}", file=sys.stderr)
+        return 2
+    return server.serve()
+
+
+def _cmd_submit(args) -> int:
+    spool = Spool(args.spool)
+    if args.spec:
+        text = args.spec
+        if os.path.exists(text):
+            with open(text) as f:
+                text = f.read()
+        try:
+            obj = json.loads(text)
+        except json.JSONDecodeError as e:
+            print(f"submit: spec is not valid JSON: {e}",
+                  file=sys.stderr)
+            return 2
+    else:
+        if not args.cmd and not args.module:
+            print(
+                "submit: need --spec, --module, or an argv to run "
+                "(e.g. `submit SPOOL script.py arg`)", file=sys.stderr,
+            )
+            return 2
+        obj = {"cmd": list(args.cmd) or None, "module": args.module}
+        obj = {k: v for k, v in obj.items() if v is not None}
+    # explicit flags override/augment the spec body
+    for key, value in (
+        ("id", args.id), ("tenant", args.tenant),
+        ("nproc", args.nproc), ("timeout_s", args.timeout),
+        ("retries", args.retries), ("backoff_s", args.backoff),
+        ("resume_dir", args.resume_dir),
+        ("fault_plan", args.fault_plan),
+    ):
+        if value is not None:
+            obj[key] = value
+    if args.verify:
+        obj["verify"] = True
+    try:
+        response = spool.submit(obj)
+    except JobSpecError as e:
+        print(f"submit: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(response))
+    return 0 if response.get("status") == "queued" else 3
+
+
+def _cmd_status(args) -> int:
+    spool = Spool(args.spool)
+    status = spool.status()
+    if args.json:
+        print(json.dumps(status, indent=1))
+        return 0
+    print(
+        f"spool {status['root']}: depth {status['depth']}/"
+        f"{status['capacity']}"
+        + (" [draining]" if status["draining"] else "")
+    )
+    for state in ("pending", "running"):
+        for job in status[state]:
+            print(
+                f"  {state:>7}  {job['job']}  tenant={job['tenant']} "
+                f"nproc={job['nproc']}"
+            )
+    for job in status["done"]:
+        print(
+            f"  {job.get('outcome', '?'):>7}  {job.get('job')}  "
+            f"tenant={job.get('tenant')}"
+        )
+    if status["outcomes"]:
+        print("  outcomes: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(status["outcomes"].items())
+        ))
+    return 0
+
+
+def _cmd_drain(args) -> int:
+    spool = Spool(args.spool)
+    spool.request_drain(note=args.note or "")
+    print(f"drain: requested on {spool.root}", file=sys.stderr)
+    if not args.wait:
+        return 0
+    deadline = time.monotonic() + args.timeout
+    while time.monotonic() < deadline:
+        status = spool.status()
+        if not status["pending"] and not status["running"]:
+            print("drain: queue empty", file=sys.stderr)
+            return 0
+        time.sleep(args.poll)
+    print(
+        f"drain: queue not empty after {args.timeout:g}s",
+        file=sys.stderr,
+    )
+    return 1
+
+
+# ---------------------------------------------------------------------
+# selftest (device-free; wired into tier-1)
+# ---------------------------------------------------------------------
+
+
+def selftest() -> int:  # noqa: C901 — one linear smoke script
+    import tempfile
+
+    import numpy as np
+
+    from . import export as sexport
+    from ..resilience import ckpt as _ckpt
+    from ..resilience.reshard import LeafSpec
+
+    # -- job-spec validation: every bad field is named -----------------
+    for bad, needle in (
+        ("{not json", "not valid JSON"),
+        ("[]", "JSON object"),
+        ('{"cmd": ["x"], "nope": 1}', "unknown field"),
+        ('{"cmd": ["x"], "module": "m"}', "exactly one"),
+        ('{"module": "m", "nproc": 0}', "nproc"),
+        ('{"cmd": [], "nproc": 1}', "cmd"),
+        ('{"cmd": ["x"], "timeout_s": -1}', "timeout_s"),
+        ('{"cmd": ["x"], "retries": -2}', "retries"),
+        ('{"cmd": ["x"], "tenant": "bad tenant!"}', "tenant"),
+        ('{"cmd": ["x"], "id": "no spaces allowed"}', "id"),
+        ('{"cmd": ["x"], "env": {"A": 1}}', "env"),
+        ('{"cmd": ["x"], "fault_plan": {"faults": []}}', "fault_plan"),
+    ):
+        try:
+            parse_job(bad)
+        except JobSpecError as e:
+            assert needle in str(e), (bad, e)
+        else:
+            raise AssertionError(f"spec {bad!r} should not parse")
+    spec = parse_job({"cmd": ["-c", "pass"], "tenant": "t0",
+                      "nproc": 2, "retries": 1})
+    assert spec.nproc == 2 and spec.target == "-c"
+
+    with tempfile.TemporaryDirectory() as tmp:
+        # -- spool protocol: submit/claim/finish, bounded queue --------
+        spool = Spool(os.path.join(tmp, "spool"))
+        spool.configure(3)
+        assert spool.capacity == 3
+        ids = []
+        for i, tenant in enumerate(("a", "a", "b")):
+            r = spool.submit({
+                "id": f"j{i}", "tenant": tenant, "cmd": ["-c", "pass"],
+            })
+            assert r["status"] == "queued", r
+            ids.append(r["job"])
+        full = spool.submit({"id": "j3", "cmd": ["-c", "pass"]})
+        assert full == {
+            "job": "j3", "status": "rejected", "reason": "queue_full",
+            "depth": 3, "capacity": 3,
+        }, full
+        dup = spool.submit({"id": "j0", "cmd": ["-c", "pass"]})
+        # queue_full outranks duplicate detection at depth 3; drain one
+        assert dup["status"] == "rejected"
+        assert spool.depth() == 3
+
+        # -- scheduler: FIFO within tenant, round-robin across ---------
+        sched = FairScheduler()
+        picked = []
+        pending = spool.pending()
+        while pending:
+            s = sched.pick(pending)
+            picked.append((s.id, s.tenant))
+            assert spool.claim(s) is not None
+            spool.finish(s, "completed", queue_wait_s=0.0, run_s=0.0,
+                         attempts=1, world=1)
+            spool.audit("completed", job=s.id, tenant=s.tenant)
+            pending = spool.pending()
+        # a, then b (round-robin cuts a's second job), then a again
+        assert picked == [("j0", "a"), ("j2", "b"), ("j1", "a")], picked
+        assert sched.pick([]) is None
+        # double-claim: the loser of the rename race gets None
+        r = spool.submit({"id": "j4", "cmd": ["-c", "pass"]})
+        (s4,) = spool.pending()
+        assert spool.claim(s4) is not None
+        assert spool.claim(s4) is None
+        spool.finish(s4, "completed")
+        # duplicate id now rejected explicitly (j0 lives in done/)
+        dup = spool.submit({"id": "j0", "cmd": ["-c", "pass"]})
+        assert dup["status"] == "rejected" and (
+            dup["reason"] == "duplicate_id"
+        ), dup
+
+        # -- drain: new submits rejected, queue still drains -----------
+        spool.request_drain("selftest")
+        assert spool.draining()
+        r = spool.submit({"id": "late", "cmd": ["-c", "pass"]})
+        assert r["status"] == "rejected" and r["reason"] == "draining"
+
+        # -- server loop under a stub runner ---------------------------
+        # fresh spool: 4 jobs — one clean, one transient-then-clean
+        # (retries budget), one always-failing, one preempted under
+        # --elastic with a real m4t-ckpt/2 checkpoint resharded 2 -> 1
+        spool2 = Spool(os.path.join(tmp, "spool2"))
+        spool2.configure(8)
+        ckroot = os.path.join(tmp, "ck")
+        mgr = _ckpt.CheckpointManager(ckroot, keep=2, world=2)
+        mgr.save_sharded(
+            5, {"w": np.arange(8.0, dtype=np.float64)},
+            {"w": LeafSpec(shape=(8,), dtype="float64")},
+        )
+        for obj in (
+            {"id": "clean", "tenant": "a", "cmd": ["-c", "pass"],
+             "nproc": 2},
+            {"id": "flaky", "tenant": "b", "cmd": ["-c", "pass"],
+             "nproc": 2, "retries": 2, "backoff_s": 0.0},
+            {"id": "bad", "tenant": "a", "cmd": ["-c", "pass"],
+             "nproc": 2, "retries": 1, "backoff_s": 0.0},
+            {"id": "pre", "tenant": "c", "cmd": ["-c", "pass"],
+             "nproc": 2, "retries": 2, "backoff_s": 0.0,
+             "resume_dir": ckroot},
+        ):
+            assert spool2.submit(obj)["status"] == "queued"
+
+        calls = []
+
+        def stub_runner(spec, world, events_dir, attempt, resume_step):
+            calls.append((spec.id, world, attempt, resume_step))
+            assert events_dir and os.path.isdir(events_dir)
+            if spec.id == "flaky":
+                return (1, []) if attempt == 0 else (0, [])
+            if spec.id == "bad":
+                return 1, []
+            if spec.id == "pre" and attempt == 0:
+                return 143, [1]  # rank 1 preempted: capacity lost
+            return 0, []
+
+        server = Server(
+            spool2, nproc=2, elastic=True, min_ranks=1,
+            max_jobs=4, poll_s=0.01, runner=stub_runner,
+            log=lambda msg: None,
+        )
+        rc = server.serve()
+        assert rc == 0, rc
+        assert server.capacity == 1  # shrank when "pre" lost a rank
+        outcomes = {
+            rec["id"]: rec["outcome"] for rec in spool2.done()
+        }
+        assert outcomes == {
+            "clean": "completed", "flaky": "completed",
+            "bad": "failed", "pre": "completed",
+        }, outcomes
+        # the preempted job resumed from the *resharded* step at the
+        # shrunk world; its checkpoint now exists at world 1
+        pre_calls = [c for c in calls if c[0] == "pre"]
+        assert pre_calls[0][1] == 2 and pre_calls[1][1] == 1, pre_calls
+        assert pre_calls[1][3] == 5, pre_calls  # resumed at step 5
+        info = _ckpt.CheckpointManager(ckroot, world=1).latest_valid(
+            world=1
+        )
+        assert info is not None and info.manifest[
+            "resharded_from"]["world"] == 2
+        # the audit accounts for every job id, and the world transition
+        recs = spool2.audit_records()
+        by_event = {}
+        for r in recs:
+            by_event.setdefault(r["event"], []).append(r)
+        done_ids = {
+            r["job"] for e in ("completed", "failed", "rejected")
+            for r in by_event.get(e, [])
+        }
+        assert done_ids == {"clean", "flaky", "bad", "pre"}, done_ids
+        (world_rec,) = by_event["world"]
+        assert world_rec["world"] == 2 and world_rec["next_world"] == 1
+        assert world_rec["resharded_from_step"] == 5
+        assert world_rec["preempted_ranks"] == [1]
+
+        # per-job fault domain: "bad" burned its own retry budget only
+        bad_calls = [c for c in calls if c[0] == "bad"]
+        assert len(bad_calls) == 2, bad_calls
+
+        # -- admission gate: an unprovable job is rejected -------------
+        spool3 = Spool(os.path.join(tmp, "spool3"))
+        assert spool3.submit(
+            {"id": "nope", "cmd": ["-c", "pass"], "verify": True}
+        )["status"] == "queued"
+        server3 = Server(
+            spool3, nproc=2, max_jobs=1, poll_s=0.01,
+            runner=stub_runner,
+            verify_fn=lambda spec, world: False,
+            log=lambda msg: None,
+        )
+        assert server3.serve() == 0
+        (rec,) = spool3.done()
+        assert rec["outcome"] == "rejected"
+        assert rec["reason"] == "verify_failed"
+
+        # -- exporter contract -----------------------------------------
+        snap = sexport.serving_snapshot(spool2)
+        assert snap["counts"]["completed"] == 3
+        assert snap["counts"]["failed"] == 1
+        assert snap["world"] == 1  # last audited transition
+        text = sexport.render_serving_metrics(snap)
+        assert text.endswith("# EOF\n")
+        for needle in (
+            "m4t_serve_queue_depth 0",
+            'm4t_serve_jobs_total{outcome="completed"} 3',
+            'm4t_serve_jobs_total{outcome="failed"} 1',
+            "m4t_serve_world 1",
+            'm4t_serve_job_attempts{job="pre",tenant="c"} 2',
+        ):
+            assert needle in text, (needle, text)
+        path = sexport.write_serving_prom(spool2)
+        assert os.path.exists(path)
+        assert open(path).read() == sexport.render_serving_metrics(
+            sexport.serving_snapshot(spool2)
+        )
+        # rejected reasons are split out (spool1 saw all three kinds)
+        text1 = sexport.render_serving_metrics(
+            sexport.serving_snapshot(spool)
+        )
+        assert 'm4t_serve_rejected_total{reason="queue_full"} 2' in text1
+        assert 'm4t_serve_rejected_total{reason="draining"} 1' in text1
+
+    print("serving selftest ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--selftest" in argv:
+        return selftest()
+    # everything after a standalone `--` is the job's argv, verbatim —
+    # argparse.REMAINDER would otherwise swallow the submit flags too
+    job_argv: list = []
+    if argv and argv[0] == "submit" and "--" in argv:
+        split = argv.index("--")
+        job_argv = argv[split + 1:]
+        argv = argv[:split]
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi4jax_tpu.serving", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("serve", help="run the serving supervisor")
+    p.add_argument("spool", help="spool directory (created if absent)")
+    p.add_argument("-n", "--nproc", type=int, required=True,
+                   help="mesh capacity in ranks")
+    p.add_argument("--elastic", action="store_true",
+                   help="treat preemption exits (143/SIGTERM) as "
+                   "capacity loss: drain, reshard the resident job's "
+                   "checkpoint, continue smaller")
+    p.add_argument("--min-ranks", type=int, default=1, metavar="K",
+                   help="elastic floor: below K survivors the server "
+                   "stops with exit 1 (default %(default)s)")
+    p.add_argument("--verify", action="store_true",
+                   help="admission gate: prove every job's declared "
+                   "entry points deadlock-free at its world before "
+                   "it runs (unprovable jobs are rejected)")
+    p.add_argument("--queue-cap", type=int, default=None, metavar="C",
+                   help="pin the bounded-queue capacity (submits past "
+                   "it are rejected queue_full)")
+    p.add_argument("--poll", type=float, default=0.2, metavar="S",
+                   help="idle poll period (default %(default)s)")
+    p.add_argument("--max-jobs", type=int, default=None, metavar="N",
+                   help="exit 0 after serving N jobs (harness bound)")
+    p.add_argument("--idle-exit", type=float, default=None,
+                   metavar="S",
+                   help="exit 0 after S idle seconds (harness bound)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="P",
+                   help="serve queue OpenMetrics on "
+                   "http://127.0.0.1:P/metrics (0 = free port)")
+    p.set_defaults(fn=_cmd_serve)
+
+    p = sub.add_parser("submit", help="enqueue one job")
+    p.add_argument("spool")
+    p.add_argument("--spec", default=None, metavar="FILE|JSON",
+                   help="full job spec (m4t-job/1) as a file or "
+                   "inline JSON; flags below override its fields")
+    p.add_argument("--id", default=None)
+    p.add_argument("--tenant", default=None)
+    p.add_argument("-n", "--nproc", type=int, default=None)
+    p.add_argument("--timeout", type=float, default=None, metavar="S",
+                   help="per-job deadline (grace-kill past it)")
+    p.add_argument("--retries", type=int, default=None, metavar="K")
+    p.add_argument("--backoff", type=float, default=None, metavar="S")
+    p.add_argument("--resume-dir", default=None, metavar="CKPTROOT")
+    p.add_argument("--fault-plan", default=None, metavar="SPEC",
+                   help="chaos: per-job fault plan (path or inline "
+                   "JSON)")
+    p.add_argument("--verify", action="store_true",
+                   help="gate this job through the static verifier")
+    p.add_argument("-m", dest="module", default=None,
+                   help="run a module instead of a script")
+    p.add_argument("cmd", nargs="*",
+                   help="argv appended to `python`; put it after a "
+                   "standalone `--` when it starts with a dash "
+                   "(e.g. `submit SPOOL --id j1 -- -c pass`)")
+    p.set_defaults(fn=_cmd_submit)
+
+    p = sub.add_parser("status", help="queue + outcome summary")
+    p.add_argument("spool")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=_cmd_status)
+
+    p = sub.add_parser("drain", help="stop admission; optionally wait "
+                       "for the queue to empty")
+    p.add_argument("spool")
+    p.add_argument("--wait", action="store_true")
+    p.add_argument("--timeout", type=float, default=300.0, metavar="S")
+    p.add_argument("--poll", type=float, default=0.5, metavar="S")
+    p.add_argument("--note", default=None)
+    p.set_defaults(fn=_cmd_drain)
+
+    args = parser.parse_args(argv)
+    if job_argv:
+        args.cmd = list(getattr(args, "cmd", []) or []) + job_argv
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,156 @@
+"""Queue-level OpenMetrics export for the serving plane.
+
+Per-*run* metrics already exist: every job attempt writes a
+``launch --events-dir``-layout directory, so the PR 8 live plane
+(``observability/{live,export}.py``) renders per-op throughput,
+achieved GB/s and verdicts for any single job. What it cannot see is
+the *queue* — admission, rejection, depth, wait. This module adds
+that layer, built from the spool's own artifacts (``serving.jsonl``
+plus the done records), rendered through the same exposition helpers
+(:mod:`..observability.export`), and written atomically to
+``SPOOL/metrics.prom`` (plus an optional localhost HTTP endpoint via
+``serve --metrics-port``).
+
+Families (all prefixed ``m4t_serve_``)::
+
+    m4t_serve_queue_depth                     gauge   pending jobs
+    m4t_serve_queue_capacity                  gauge   bounded-queue cap
+    m4t_serve_running                         gauge   claimed jobs
+    m4t_serve_world                           gauge   mesh capacity (ranks)
+    m4t_serve_draining                        gauge   1 while draining
+    m4t_serve_jobs_total{outcome=}            counter submitted/admitted/
+                                                      completed/failed
+    m4t_serve_rejected_total{reason=}         counter load-shed by reason
+    m4t_serve_job_queue_wait_seconds{job=,tenant=} gauge per finished job
+    m4t_serve_job_run_seconds{job=,tenant=}   gauge   per finished job
+    m4t_serve_job_attempts{job=,tenant=}      gauge   per finished job
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Union
+
+from ..observability import export as _export
+from .spool import Spool
+
+PROM_NAME = "metrics.prom"
+
+
+def serving_snapshot(
+    spool: Union[Spool, str],
+    *,
+    capacity: Optional[int] = None,
+) -> Dict[str, Any]:
+    """One coherent view of the queue: depth/running now (directory
+    scan), cumulative outcome counters (audit scan), and per-finished-
+    job wait/run durations (done records). ``capacity`` is the live
+    server's current mesh world; when absent (offline render) the
+    last audited world transition — or serve_start — wins."""
+    if not isinstance(spool, Spool):
+        spool = Spool(spool)
+    counts: Dict[str, int] = {}
+    rejected: Dict[str, int] = {}
+    world = None
+    for rec in spool.audit_records():
+        event = rec.get("event")
+        if event in ("submitted", "admitted", "completed", "failed"):
+            counts[event] = counts.get(event, 0) + 1
+        elif event == "rejected":
+            reason = str(rec.get("reason", "?"))
+            rejected[reason] = rejected.get(reason, 0) + 1
+        elif event == "serve_start":
+            world = rec.get("world", world)
+        elif event == "world":
+            world = rec.get("next_world", world)
+    jobs = []
+    for rec in spool.done():
+        jobs.append({
+            "job": rec.get("id"),
+            "tenant": rec.get("tenant"),
+            "outcome": rec.get("outcome"),
+            "queue_wait_s": rec.get("queue_wait_s"),
+            "run_s": rec.get("run_s"),
+            "attempts": rec.get("attempts"),
+        })
+    return {
+        "depth": spool.depth(),
+        "capacity": spool.capacity,
+        "running": len(spool.running()),
+        "world": capacity if capacity is not None else world,
+        "draining": spool.draining(),
+        "counts": counts,
+        "rejected": rejected,
+        "jobs": jobs,
+    }
+
+
+def render_serving_metrics(snap: Dict[str, Any]) -> str:
+    """OpenMetrics 1.0 text (with the mandatory ``# EOF``) for a
+    :func:`serving_snapshot`."""
+    out: list = []
+    g = _export._Family(out, "m4t_serve_queue_depth", "gauge",
+                        "Jobs waiting in the spool's pending queue.")
+    g.sample(snap.get("depth", 0))
+    g = _export._Family(out, "m4t_serve_queue_capacity", "gauge",
+                        "Bounded-queue capacity; submits past it are "
+                        "rejected (queue_full).")
+    g.sample(snap.get("capacity"))
+    g = _export._Family(out, "m4t_serve_running", "gauge",
+                        "Jobs currently claimed by a server.")
+    g.sample(snap.get("running", 0))
+    g = _export._Family(out, "m4t_serve_world", "gauge",
+                        "Current mesh capacity in ranks (shrinks on "
+                        "preemption under --elastic).")
+    g.sample(snap.get("world"))
+    g = _export._Family(out, "m4t_serve_draining", "gauge",
+                        "1 while a drain is requested, else 0.")
+    g.sample(1 if snap.get("draining") else 0)
+
+    c = _export._Family(out, "m4t_serve_jobs_total", "counter",
+                        "Jobs by lifecycle outcome.")
+    for outcome in ("submitted", "admitted", "completed", "failed"):
+        c.sample(snap.get("counts", {}).get(outcome, 0),
+                 outcome=outcome)
+    c = _export._Family(out, "m4t_serve_rejected_total", "counter",
+                        "Load-shed and admission rejections by reason.")
+    for reason, n in sorted(snap.get("rejected", {}).items()):
+        c.sample(n, reason=reason)
+
+    w = _export._Family(out, "m4t_serve_job_queue_wait_seconds",
+                        "gauge",
+                        "Queue wait (submit -> admit) per finished "
+                        "job.")
+    r = _export._Family(out, "m4t_serve_job_run_seconds", "gauge",
+                        "Admit -> finish wall clock per finished job.")
+    a = _export._Family(out, "m4t_serve_job_attempts", "gauge",
+                        "World attempts each finished job consumed.")
+    for job in snap.get("jobs", []):
+        labels = {
+            "job": job.get("job") or "?",
+            "tenant": job.get("tenant") or "?",
+        }
+        w.sample(job.get("queue_wait_s"), **labels)
+        r.sample(job.get("run_s"), **labels)
+        a.sample(job.get("attempts"), **labels)
+
+    out.append("# EOF")
+    return "\n".join(out) + "\n"
+
+
+def write_serving_prom(
+    spool: Union[Spool, str],
+    *,
+    capacity: Optional[int] = None,
+    path: Optional[str] = None,
+) -> str:
+    """Atomic ``metrics.prom`` snapshot in the spool root (tmp+rename
+    via the shared exposition writer — a scraper never reads a torn
+    file)."""
+    if not isinstance(spool, Spool):
+        spool = Spool(spool)
+    snap = serving_snapshot(spool, capacity=capacity)
+    text = render_serving_metrics(snap)
+    if path is None:
+        path = os.path.join(spool.root, PROM_NAME)
+    return _export.write_prom(path, text)

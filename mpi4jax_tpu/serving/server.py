@@ -1,0 +1,542 @@
+"""The queue-draining serving supervisor.
+
+One long-lived process multiplexes many submitted SPMD jobs over one
+machine's worth of mesh capacity, treating overload, job failure and
+capacity loss as routine events:
+
+- **Admission** — a claimed job can be gated through the static
+  verifier (``analysis``'s ``launch --verify`` path): a program the
+  schedule simulator cannot prove deadlock-free is *rejected* before
+  it can wedge the shared mesh, with the finding on the audit trail.
+- **Fair scheduling** — FIFO + per-tenant round-robin
+  (:mod:`.scheduler`); one world runs at a time, sized
+  ``min(job.nproc, current capacity)``.
+- **Per-job fault domains** — each job runs under its *own*
+  :class:`~..resilience.supervisor.Supervisor` with its own
+  :class:`~..resilience.supervisor.RetryPolicy` budget: a MISMATCH
+  (deterministic, per the doctor) fails that job only; transient
+  verdicts (hang, crash, straggler) retry it from its own
+  ``resume_dir`` checkpoints; the server keeps serving either way.
+  A job's deadline (``timeout_s``) is enforced by the spawn path's
+  hang watchdog — terminate, grace window for flight-recorder dumps,
+  then kill — so a wedged job cannot hold the queue hostage.
+- **Capacity loss** — a rank exiting with the preemption signature
+  (``PREEMPT_EXIT`` 143 / SIGTERM) under ``--elastic`` means the mesh
+  lost a host, not that the job is buggy: the server shrinks its
+  capacity, reshards the resident job's newest ``m4t-ckpt/2``
+  checkpoint to the smaller world through the bounded-memory planner
+  (``resilience/reshard.py``), re-proves the program at the shrunk
+  world when verification is on, resumes the job there, and serves
+  every subsequent job at the smaller world. Every world transition
+  is audited in ``serving.jsonl`` and narrated by the doctor.
+- **Observability** — each job attempt gets its own events dir
+  (``jobs/<id>/attempt<k>/``, the ``launch --events-dir`` layout), so
+  the live plane, streaming doctor, and per-run OpenMetrics export
+  all work per job; the queue-level exporter (:mod:`.export`) adds
+  jobs-admitted/rejected/completed/failed counters and queue-depth
+  gauges, refreshed into ``metrics.prom`` and optionally served on
+  localhost HTTP.
+
+The world-spawning side is injectable (``runner=``), which is what
+makes the whole control plane device-free-testable: the selftest and
+most tests drive it with a stub runner and never fork a rank.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..resilience.supervisor import RetryPolicy, Supervisor
+from .scheduler import FairScheduler
+from .spool import JobSpec, Spool
+
+#: a runner maps (spec, world, events_dir, attempt, resume_step) to
+#: ``(exit_code, preempted_ranks)`` — the ``launch.spawn_world``
+#: contract
+Runner = Callable[
+    [JobSpec, int, Optional[str], int, Optional[int]],
+    Tuple[int, List[int]],
+]
+
+
+def _default_log(msg: str) -> None:
+    sys.stderr.write(f"m4t.serving: {msg}\n")
+
+
+class Server:
+    """Claim jobs from a :class:`~.spool.Spool` and run each one to a
+    final audited outcome. See the module docstring for semantics."""
+
+    def __init__(
+        self,
+        spool: Spool,
+        *,
+        nproc: int,
+        elastic: bool = False,
+        min_ranks: int = 1,
+        verify: bool = False,
+        poll_s: float = 0.2,
+        max_jobs: Optional[int] = None,
+        idle_exit_s: Optional[float] = None,
+        runner: Optional[Runner] = None,
+        verify_fn: Optional[Callable[[JobSpec, int], bool]] = None,
+        metrics_port: Optional[int] = None,
+        log: Callable[[str], None] = _default_log,
+    ):
+        if nproc < 1:
+            raise ValueError("serve needs nproc >= 1")
+        if min_ranks < 1 or min_ranks > nproc:
+            raise ValueError("min_ranks must be in [1, nproc]")
+        self.spool = spool
+        self.capacity = int(nproc)
+        self.elastic = bool(elastic)
+        self.min_ranks = int(min_ranks)
+        self.verify = bool(verify)
+        self.poll_s = float(poll_s)
+        self.max_jobs = max_jobs
+        self.idle_exit_s = idle_exit_s
+        self.scheduler = FairScheduler()
+        self._runner = runner or self._launch_runner
+        self._verify_fn = verify_fn or self._launch_verify
+        self.metrics_port = metrics_port
+        self._http = None
+        self._log = log
+        self.jobs_served = 0
+        #: set when capacity fell below min_ranks: serving cannot
+        #: honestly continue, the loop exits nonzero
+        self.capacity_lost = False
+
+    # -- default spawn/verify backends (the launch.py reuse seam) ------
+
+    def _world_args(self, spec: JobSpec, world: int):
+        from .. import launch
+
+        return launch.make_world_args(
+            nproc=world,
+            cmd=list(spec.cmd or []),
+            module=spec.module,
+            hang_timeout=float(spec.timeout_s or 0.0),
+        )
+
+    def _launch_runner(
+        self,
+        spec: JobSpec,
+        world: int,
+        events_dir: Optional[str],
+        attempt: int,
+        resume_step: Optional[int],
+    ) -> Tuple[int, List[int]]:
+        from .. import launch
+
+        args = self._world_args(spec, world)
+        args.elastic = self.elastic  # preempt-first settle window
+        fault_plan_env = None
+        if spec.fault_plan is not None:
+            fault_plan_env = (
+                spec.fault_plan if isinstance(spec.fault_plan, str)
+                else json.dumps(spec.fault_plan)
+            )
+        return launch.spawn_world(
+            args,
+            events_dir,
+            attempt=attempt,
+            resume_step=resume_step,
+            fault_plan_env=fault_plan_env,
+            world=world,
+            extra_env=spec.env,
+        )
+
+    def _launch_verify(self, spec: JobSpec, world: int) -> bool:
+        """The admission gate: prove the job's declared entry points
+        deadlock-free at ``world`` ranks before it touches the mesh
+        (``launch --verify`` semantics, reused verbatim)."""
+        from .. import launch
+
+        args = self._world_args(spec, world)
+        try:
+            return launch._verify_prelaunch(args, world=world) == 0
+        except Exception as exc:
+            self._log(f"job {spec.id}: verify failed: {exc!r}")
+            return False
+
+    # -- metrics -------------------------------------------------------
+
+    def _write_metrics(self) -> None:
+        from . import export as _sexport
+
+        try:
+            _sexport.write_serving_prom(
+                self.spool, capacity=self.capacity,
+            )
+        except Exception:
+            pass  # metrics must never take the queue down
+
+    def _start_metrics(self) -> None:
+        if self.metrics_port is None:
+            return
+        from ..observability import export as _oexport
+        from . import export as _sexport
+
+        def render() -> str:
+            return _sexport.render_serving_metrics(
+                _sexport.serving_snapshot(
+                    self.spool, capacity=self.capacity
+                )
+            )
+
+        self._http = _oexport.serve(render, port=self.metrics_port)
+        self._log(
+            "serving OpenMetrics on "
+            f"http://127.0.0.1:{self._http.server_port}/metrics"
+        )
+
+    def _stop_metrics(self) -> None:
+        if self._http is not None:
+            try:
+                self._http.shutdown()
+            except Exception:
+                pass
+            self._http = None
+
+    # -- elastic capacity ----------------------------------------------
+
+    def _set_capacity(self, new_world: int, **audit: Any) -> None:
+        old = self.capacity
+        if new_world == old:
+            return
+        self.capacity = int(new_world)
+        self.spool.audit(
+            "world", world=old, next_world=self.capacity, **audit
+        )
+        self._log(
+            f"mesh capacity {old} -> {self.capacity} rank(s)"
+        )
+
+    def _shrink_for(self, spec: JobSpec, state: Dict[str, Any]):
+        """Preemption mid-job under ``--elastic``: shrink capacity to
+        the survivors, reshard the job's newest checkpoint to the new
+        world, re-verify there, and return the step the next attempt
+        resumes from (None = from scratch). Mirrors the launcher's
+        elastic path; the difference is that the shrink outlives the
+        job — every later job serves at the smaller world too."""
+        old_world = state["world"]
+        lost = len(state["preempted"])
+        new_world = old_world - lost
+        pre = ",".join(str(p) for p in state["preempted"])
+        self._log(
+            f"job {spec.id}: {lost} rank(s) preempted ({pre}); "
+            f"draining and shrinking world {old_world} -> {new_world}"
+        )
+        if new_world < self.min_ranks:
+            state["blocked"] = (
+                f"only {new_world} survivor(s) of {old_world} — below "
+                f"--min-ranks {self.min_ranks}"
+            )
+            self._set_capacity(
+                max(new_world, 0), job=spec.id,
+                reason="preempted_below_min",
+            )
+            self.capacity_lost = True
+            self._log(f"job {spec.id}: {state['blocked']}; giving up")
+            return None
+        resume = None
+        reshard_src = None
+        if spec.resume_dir:
+            try:
+                from ..resilience import reshard as _reshard
+                from ..resilience.ckpt import CheckpointManager
+
+                mgr = CheckpointManager(spec.resume_dir, world=new_world)
+                info = mgr.latest_valid(
+                    world=new_world, allow_reshard=True
+                )
+                if info is None:
+                    self._log(
+                        f"job {spec.id}: no valid checkpoint to carry "
+                        "over; resuming from step 0"
+                    )
+                elif not info.world_mismatch:
+                    resume = info.step
+                elif not info.sharded:
+                    self._log(
+                        f"job {spec.id}: checkpoint step {info.step} "
+                        f"predates m4t-ckpt/2 and cannot be resharded; "
+                        "resuming from step 0"
+                    )
+                else:
+                    new_info = _reshard.reshard_checkpoint(
+                        mgr, info, new_world,
+                        log=lambda m: self._log(f"job {spec.id}: {m}"),
+                    )
+                    resume = new_info.step
+                    reshard_src = {
+                        "step": info.step, "world": info.world,
+                    }
+            except Exception as exc:
+                self._log(
+                    f"job {spec.id}: reshard failed ({exc!r}); "
+                    "resuming from step 0"
+                )
+                resume = None
+        if (self.verify or spec.verify) and not self._verify_fn(
+            spec, new_world
+        ):
+            state["blocked"] = (
+                f"verify failed at the shrunk world {new_world}"
+            )
+            self._log(f"job {spec.id}: {state['blocked']}; giving up")
+            self._set_capacity(new_world, job=spec.id)
+            return None
+        state["transition"] = {
+            "world": old_world,
+            "next_world": new_world,
+            "resharded_from": reshard_src,
+        }
+        state["world"] = new_world
+        audit: Dict[str, Any] = {"job": spec.id, "preempted_ranks":
+                                 list(state["preempted"])}
+        if reshard_src:
+            audit["resharded_from_step"] = reshard_src["step"]
+            audit["resharded_from_world"] = reshard_src["world"]
+        self._set_capacity(new_world, **audit)
+        return resume
+
+    # -- one job -------------------------------------------------------
+
+    def run_job(self, spec: JobSpec) -> str:
+        """Run one claimed job to a final outcome; returns it
+        (``completed`` / ``failed`` / ``rejected``). Never raises —
+        a job is its own fault domain."""
+        try:
+            return self._run_job(spec)
+        except Exception as exc:
+            self._log(f"job {spec.id}: internal error: {exc!r}")
+            try:
+                self.spool.finish(
+                    spec, "failed", reason="internal_error",
+                    error=repr(exc),
+                )
+                self.spool.audit(
+                    "failed", job=spec.id, tenant=spec.tenant,
+                    reason="internal_error", error=repr(exc),
+                )
+            except Exception:
+                pass
+            return "failed"
+
+    def _run_job(self, spec: JobSpec) -> str:
+        t0 = time.time()
+        wait_s = max(0.0, t0 - (spec.submitted_t or t0))
+        world = min(spec.nproc, self.capacity)
+        self.spool.audit(
+            "admitted", job=spec.id, tenant=spec.tenant, world=world,
+            requested_nproc=spec.nproc, queue_wait_s=round(wait_s, 6),
+        )
+        if (self.verify or spec.verify) and not self._verify_fn(
+            spec, world
+        ):
+            # the unprovable program never touches the shared mesh
+            self.spool.finish(
+                spec, "rejected", reason="verify_failed", world=world,
+                queue_wait_s=wait_s,
+            )
+            self.spool.audit(
+                "rejected", job=spec.id, tenant=spec.tenant,
+                reason="verify_failed", world=world,
+            )
+            return "rejected"
+
+        jobdir = self.spool.job_dir(spec.id)
+        state: Dict[str, Any] = {
+            "world": world, "world_ran": world, "preempted": [],
+            "transition": None, "blocked": None, "dir": None,
+        }
+
+        def attempt_dir(attempt: int) -> str:
+            d = os.path.join(jobdir, f"attempt{attempt:02d}")
+            os.makedirs(d, exist_ok=True)
+            return d
+
+        def run_fn(attempt: int, resume_step: Optional[int]) -> int:
+            if state["blocked"]:
+                self._log(
+                    f"job {spec.id}: attempt {attempt} not spawned: "
+                    f"{state['blocked']}"
+                )
+                return 1
+            d = attempt_dir(attempt)
+            state["dir"] = d
+            state["world_ran"] = state["world"]
+            self._log(
+                f"job {spec.id}: attempt {attempt} "
+                f"(world {state['world']})"
+                + (f", resuming from step {resume_step}"
+                   if resume_step is not None else "")
+            )
+            rc, preempted = self._runner(
+                spec, state["world"], d, attempt, resume_step
+            )
+            state["preempted"] = list(preempted or [])
+            return rc
+
+        def diagnose_fn(attempt: int):
+            d = state.get("dir")
+            if not d:
+                return None
+            try:
+                from ..observability import doctor
+
+                return doctor.diagnose([d])
+            except Exception:
+                return None
+
+        def resume_fn():
+            try:
+                if self.elastic and state["preempted"]:
+                    return self._shrink_for(spec, state)
+                if spec.resume_dir:
+                    from ..resilience.ckpt import CheckpointManager
+
+                    info = CheckpointManager(
+                        spec.resume_dir, world=state["world"]
+                    ).latest_valid(world=state["world"])
+                    return None if info is None else info.step
+            except Exception as exc:
+                self._log(
+                    f"job {spec.id}: checkpoint scan failed: {exc!r}"
+                )
+            return None
+
+        def extra_fn(attempt: int) -> Dict[str, Any]:
+            rec: Dict[str, Any] = {
+                "job": spec.id, "tenant": spec.tenant,
+                "world": state["world_ran"],
+            }
+            if state["preempted"]:
+                rec["preempted_ranks"] = list(state["preempted"])
+            transition = state["transition"]
+            if transition is not None:
+                rec["next_world"] = transition["next_world"]
+                src = transition.get("resharded_from")
+                if src:
+                    rec["resharded_from_step"] = src["step"]
+                    rec["resharded_from_world"] = src["world"]
+                state["transition"] = None
+            if state["blocked"]:
+                rec["elastic_blocked"] = state["blocked"]
+            return rec
+
+        sup = Supervisor(
+            run_fn,
+            policy=RetryPolicy(
+                retries=spec.retries, backoff_s=spec.backoff_s
+            ),
+            diagnose_fn=diagnose_fn,
+            resume_fn=resume_fn,
+            extra_fn=extra_fn,
+            audit_path=self.spool.audit_path,
+            log=self._log,
+        )
+        rc = sup.run()
+        run_s = time.time() - t0
+        last = sup.attempts[-1] if sup.attempts else {}
+        common = dict(
+            world=state["world_ran"],
+            attempts=len(sup.attempts),
+            queue_wait_s=round(wait_s, 6),
+            run_s=round(run_s, 6),
+        )
+        if rc == 0:
+            self.spool.finish(spec, "completed", **common)
+            self.spool.audit(
+                "completed", job=spec.id, tenant=spec.tenant, **common
+            )
+            return "completed"
+        reason = state["blocked"] or last.get("reason", "exit_nonzero")
+        self.spool.finish(
+            spec, "failed", exit_code=rc, klass=last.get("klass"),
+            reason=reason, **common,
+        )
+        self.spool.audit(
+            "failed", job=spec.id, tenant=spec.tenant, exit_code=rc,
+            klass=last.get("klass"), reason=reason, **common,
+        )
+        return "failed"
+
+    # -- the loop ------------------------------------------------------
+
+    def serve(self) -> int:
+        """Drain the queue until told to stop. Exits 0 after a drain
+        (or ``max_jobs`` / ``idle_exit_s`` bound, for harnesses);
+        exits 1 when capacity fell below ``min_ranks`` — the mesh can
+        no longer honestly serve."""
+        self.spool.audit(
+            "serve_start", world=self.capacity,
+            capacity=self.spool.capacity, pid=os.getpid(),
+            elastic=self.elastic, verify=self.verify,
+        )
+        self._log(
+            f"serving from {self.spool.root} at world "
+            f"{self.capacity} (queue capacity {self.spool.capacity}"
+            + (", elastic" if self.elastic else "")
+            + (", verify" if self.verify else "") + ")"
+        )
+        self._start_metrics()
+        idle_since = time.monotonic()
+        rc = 0
+        try:
+            while True:
+                if (
+                    self.max_jobs is not None
+                    and self.jobs_served >= self.max_jobs
+                ):
+                    self._log(f"served {self.jobs_served} job(s); done")
+                    break
+                pending = self.spool.pending()
+                spec = self.scheduler.pick(pending)
+                if spec is None:
+                    if self.spool.draining():
+                        self.spool.audit(
+                            "drained", jobs=self.jobs_served,
+                            world=self.capacity,
+                        )
+                        self._log(
+                            "drained: queue empty after "
+                            f"{self.jobs_served} job(s); exiting"
+                        )
+                        break
+                    if (
+                        self.idle_exit_s is not None
+                        and time.monotonic() - idle_since
+                        > self.idle_exit_s
+                    ):
+                        self._log("idle bound reached; exiting")
+                        break
+                    self._write_metrics()
+                    time.sleep(self.poll_s)
+                    continue
+                idle_since = time.monotonic()
+                claimed = self.spool.claim(spec)
+                if claimed is None:
+                    continue  # a peer server won the rename
+                self.run_job(claimed)
+                self.jobs_served += 1
+                self._write_metrics()
+                if self.capacity_lost:
+                    self._log(
+                        "capacity below --min-ranks; cannot keep "
+                        "serving"
+                    )
+                    rc = 1
+                    break
+        except KeyboardInterrupt:
+            self._log("interrupted; exiting")
+            rc = 130
+        finally:
+            self._write_metrics()
+            self._stop_metrics()
+        return rc

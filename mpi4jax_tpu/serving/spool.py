@@ -1,0 +1,553 @@
+"""Filesystem job spool: durable submit, atomic claim, audited finish.
+
+The serving plane's queue is a directory, on purpose. Submitters and
+the serving supervisor are different processes (often different
+shells, possibly different machines sharing a filesystem), and the
+spool must survive any of them dying mid-operation:
+
+- **Submit** writes a validated job spec to ``pending/`` through the
+  ``ckpt.py`` tmp+rename idiom — a spec either exists whole or not at
+  all; a submitter killed mid-write leaves only ``.tmp-*`` litter,
+  swept on the next submit.
+- **Claim** is a single ``os.replace`` of the spec from ``pending/``
+  to ``running/`` — atomic on POSIX, so two servers racing for the
+  same job cannot both win (the loser's rename raises and it moves
+  on).
+- **Finish** writes the final record (spec + outcome) to ``done/``
+  and removes the ``running/`` entry, so every job is in exactly one
+  of pending/running/done at any instant a scanner looks.
+- **Backpressure is bounded and explicit**: a submit that would push
+  the queue past the configured capacity is *rejected* with
+  ``{"status": "rejected", "reason": "queue_full"}`` and a load-shed
+  audit record — the queue can never grow without bound, and every
+  shed job is on the record rather than silently dropped.
+- **Drain** is a sentinel file: once requested, new submits are
+  rejected (``reason: "draining"``) while the server finishes what is
+  already queued and running, then exits.
+
+Every transition appends to ``serving.jsonl`` (the JSONL event schema
+the rest of the repo speaks — the doctor narrates it, the exporter
+counts it), keyed by job id, so the audit accounts for every job ever
+submitted: each id ends ``completed``, ``failed``, or ``rejected``.
+
+Entry filenames are ``<20-digit submit time_ns>-<job id>.json``: the
+lexicographic directory order *is* FIFO submit order, which is what
+the fair scheduler's per-tenant queues are built from.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+JOB_SCHEMA = "m4t-job/1"
+SPOOL_SCHEMA = "m4t-spool/1"
+
+PENDING_DIR = "pending"
+RUNNING_DIR = "running"
+DONE_DIR = "done"
+JOBS_DIR = "jobs"
+AUDIT_NAME = "serving.jsonl"
+CONFIG_NAME = "spool.json"
+DRAIN_SENTINEL = "DRAIN"
+
+#: default bounded-queue capacity (pending jobs) when the spool was
+#: never configured; ``serve --queue-cap`` / ``Spool.configure`` pin it
+DEFAULT_CAPACITY = 16
+
+_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+_ENTRY_RE = re.compile(r"^(\d{20})-(.+)\.json$")
+
+#: job-spec fields accepted by :func:`parse_job`; anything else is a
+#: typo caught at submit time, not a knob that silently does nothing
+_JOB_FIELDS = frozenset({
+    "schema", "id", "tenant", "cmd", "module", "nproc", "timeout_s",
+    "retries", "backoff_s", "verify", "resume_dir", "fault_plan", "env",
+    "submitted_t",
+})
+
+
+class JobSpecError(ValueError):
+    """A job spec that cannot mean what was written."""
+
+
+@dataclass
+class JobSpec:
+    """One validated job: what to run, at what size, under which
+    tenant, with what per-job recovery budget."""
+
+    id: str
+    tenant: str = "default"
+    cmd: Optional[List[str]] = None    # argv appended to `python`
+    module: Optional[str] = None       # or: run a module (python -m)
+    nproc: int = 1
+    timeout_s: float = 0.0             # per-job deadline (0 = none)
+    retries: int = 0                   # per-job RetryPolicy budget
+    backoff_s: float = 0.5
+    verify: bool = False               # per-job admission gate opt-in
+    resume_dir: Optional[str] = None   # per-job CheckpointManager root
+    fault_plan: Any = None             # chaos: per-job M4T_FAULT_PLAN
+    env: Optional[Dict[str, str]] = None
+    submitted_t: Optional[float] = None
+    #: spool entry filename (set by the spool, never serialized)
+    entry: str = field(default="", compare=False)
+
+    @property
+    def target(self) -> str:
+        """What ``analysis --verify`` should import: the module, or
+        the first argv element (a script path)."""
+        return self.module if self.module else (self.cmd or ["?"])[0]
+
+    def to_json(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "schema": JOB_SCHEMA,
+            "tenant": self.tenant,
+            "nproc": self.nproc,
+            "timeout_s": self.timeout_s,
+            "retries": self.retries,
+            "backoff_s": self.backoff_s,
+            "verify": self.verify,
+        }
+        if self.id:
+            out["id"] = self.id
+        if self.cmd is not None:
+            out["cmd"] = list(self.cmd)
+        if self.module is not None:
+            out["module"] = self.module
+        if self.resume_dir is not None:
+            out["resume_dir"] = self.resume_dir
+        if self.fault_plan is not None:
+            out["fault_plan"] = self.fault_plan
+        if self.env:
+            out["env"] = dict(self.env)
+        if self.submitted_t is not None:
+            out["submitted_t"] = self.submitted_t
+        return out
+
+
+def _want(obj: Dict[str, Any], key: str, default: Any) -> Any:
+    value = obj.get(key, default)
+    return default if value is None else value
+
+
+def parse_job(obj: Any, *, job_id: Optional[str] = None) -> JobSpec:
+    """Validate a decoded job spec (or JSON string) into a
+    :class:`JobSpec`; raises :class:`JobSpecError` naming the field
+    that is wrong, never a bare traceback."""
+    if isinstance(obj, (str, bytes)):
+        try:
+            obj = json.loads(obj)
+        except json.JSONDecodeError as e:
+            raise JobSpecError(f"job spec is not valid JSON: {e}")
+    if not isinstance(obj, dict):
+        raise JobSpecError("job spec must be a JSON object")
+    unknown = set(obj) - _JOB_FIELDS
+    if unknown:
+        raise JobSpecError(f"job spec: unknown field(s) {sorted(unknown)}")
+    schema = obj.get("schema", JOB_SCHEMA)
+    if schema != JOB_SCHEMA:
+        raise JobSpecError(
+            f"job spec: schema {schema!r} != {JOB_SCHEMA!r}"
+        )
+    jid = obj.get("id", job_id)
+    if jid is not None and (
+        not isinstance(jid, str) or not _ID_RE.match(jid)
+    ):
+        raise JobSpecError(
+            f"job spec: id must match {_ID_RE.pattern} (got {jid!r})"
+        )
+    tenant = _want(obj, "tenant", "default")
+    if not isinstance(tenant, str) or not _ID_RE.match(tenant):
+        raise JobSpecError(
+            f"job spec: tenant must match {_ID_RE.pattern} "
+            f"(got {tenant!r})"
+        )
+    cmd = obj.get("cmd")
+    module = obj.get("module")
+    if (cmd is None) == (module is None):
+        raise JobSpecError(
+            "job spec: exactly one of 'cmd' (argv list) or 'module' "
+            "is required"
+        )
+    if cmd is not None and (
+        not isinstance(cmd, list) or not cmd
+        or not all(isinstance(c, str) for c in cmd)
+    ):
+        raise JobSpecError(
+            f"job spec: cmd must be a non-empty list of strings "
+            f"(got {cmd!r})"
+        )
+    if module is not None and (
+        not isinstance(module, str) or not module
+    ):
+        raise JobSpecError("job spec: module must be a non-empty string")
+    nproc = _want(obj, "nproc", 1)
+    if not isinstance(nproc, int) or isinstance(nproc, bool) or nproc < 1:
+        raise JobSpecError(
+            f"job spec: nproc must be a positive integer (got {nproc!r})"
+        )
+    timeout_s = _want(obj, "timeout_s", 0.0)
+    if not isinstance(timeout_s, (int, float)) or isinstance(
+        timeout_s, bool
+    ) or timeout_s < 0:
+        raise JobSpecError(
+            f"job spec: timeout_s must be a non-negative number "
+            f"(got {timeout_s!r})"
+        )
+    retries = _want(obj, "retries", 0)
+    if not isinstance(retries, int) or isinstance(retries, bool) or (
+        retries < 0
+    ):
+        raise JobSpecError(
+            f"job spec: retries must be a non-negative integer "
+            f"(got {retries!r})"
+        )
+    backoff_s = _want(obj, "backoff_s", 0.5)
+    if not isinstance(backoff_s, (int, float)) or isinstance(
+        backoff_s, bool
+    ) or backoff_s < 0:
+        raise JobSpecError(
+            f"job spec: backoff_s must be a non-negative number "
+            f"(got {backoff_s!r})"
+        )
+    verify = _want(obj, "verify", False)
+    if not isinstance(verify, bool):
+        raise JobSpecError("job spec: verify must be a boolean")
+    resume_dir = obj.get("resume_dir")
+    if resume_dir is not None and not isinstance(resume_dir, str):
+        raise JobSpecError("job spec: resume_dir must be a string path")
+    fault_plan = obj.get("fault_plan")
+    if fault_plan is not None:
+        # parse now so a chaos job with a typo'd plan is rejected at
+        # submit, not after it claimed mesh time
+        from ..resilience.faults import FaultPlan, FaultPlanError
+
+        try:
+            if isinstance(fault_plan, str):
+                FaultPlan.load(fault_plan)
+            else:
+                FaultPlan.parse(fault_plan)
+        except FaultPlanError as e:
+            raise JobSpecError(f"job spec: fault_plan: {e}")
+    env = obj.get("env")
+    if env is not None and (
+        not isinstance(env, dict)
+        or not all(
+            isinstance(k, str) and isinstance(v, str)
+            for k, v in env.items()
+        )
+    ):
+        raise JobSpecError(
+            "job spec: env must map strings to strings"
+        )
+    submitted_t = obj.get("submitted_t")
+    if submitted_t is not None and (
+        not isinstance(submitted_t, (int, float))
+        or isinstance(submitted_t, bool)
+    ):
+        raise JobSpecError("job spec: submitted_t must be a number")
+    return JobSpec(
+        id=jid or "",
+        tenant=tenant,
+        cmd=None if cmd is None else list(cmd),
+        module=module,
+        nproc=nproc,
+        timeout_s=float(timeout_s),
+        retries=retries,
+        backoff_s=float(backoff_s),
+        verify=verify,
+        resume_dir=resume_dir,
+        fault_plan=fault_plan,
+        env=None if env is None else dict(env),
+        submitted_t=None if submitted_t is None else float(submitted_t),
+    )
+
+
+class Spool:
+    """The on-disk queue. Safe for concurrent submitters and one (or
+    more — claims are atomic) serving supervisors."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        for sub in (PENDING_DIR, RUNNING_DIR, DONE_DIR, JOBS_DIR):
+            os.makedirs(os.path.join(self.root, sub), exist_ok=True)
+        self.audit_path = os.path.join(self.root, AUDIT_NAME)
+
+    # -- audit --------------------------------------------------------
+
+    def audit(self, event: str, **fields: Any) -> None:
+        """Append one ``kind="serving"`` record to ``serving.jsonl``.
+        Best-effort: auditing must never mask the outcome it records."""
+        from ..observability import events
+
+        try:
+            events.EventLog(self.audit_path).append(
+                events.event("serving", event=event, t=time.time(),
+                             **fields)
+            )
+        except OSError:
+            pass
+
+    def audit_records(self) -> List[Dict[str, Any]]:
+        from ..observability import events
+
+        try:
+            return [
+                r for r in events.iter_records(self.audit_path)
+                if r.get("kind") == "serving"
+            ]
+        except OSError:
+            return []
+
+    # -- capacity / drain ---------------------------------------------
+
+    def configure(self, capacity: int) -> None:
+        """Pin the bounded-queue capacity (atomic tmp+rename)."""
+        capacity = int(capacity)
+        if capacity < 1:
+            raise ValueError("spool capacity must be >= 1")
+        path = os.path.join(self.root, CONFIG_NAME)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({
+                "schema": SPOOL_SCHEMA, "capacity": capacity,
+                "t": time.time(),
+            }, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    @property
+    def capacity(self) -> int:
+        try:
+            with open(os.path.join(self.root, CONFIG_NAME)) as f:
+                cap = json.load(f).get("capacity")
+            return int(cap) if cap else DEFAULT_CAPACITY
+        except (OSError, json.JSONDecodeError, TypeError, ValueError):
+            return DEFAULT_CAPACITY
+
+    def request_drain(self, note: str = "") -> None:
+        path = os.path.join(self.root, DRAIN_SENTINEL)
+        if not os.path.exists(path):
+            with open(path, "w") as f:
+                f.write(note or "drain requested\n")
+            self.audit("drain_requested", note=note)
+
+    def draining(self) -> bool:
+        return os.path.exists(os.path.join(self.root, DRAIN_SENTINEL))
+
+    # -- paths --------------------------------------------------------
+
+    def _dir(self, sub: str) -> str:
+        return os.path.join(self.root, sub)
+
+    def job_dir(self, job_id: str) -> str:
+        d = os.path.join(self.root, JOBS_DIR, job_id)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _entries(self, sub: str) -> List[str]:
+        try:
+            names = os.listdir(self._dir(sub))
+        except OSError:
+            return []
+        return sorted(n for n in names if _ENTRY_RE.match(n))
+
+    def _known_ids(self) -> set:
+        ids = set()
+        for sub in (PENDING_DIR, RUNNING_DIR, DONE_DIR):
+            for name in self._entries(sub):
+                m = _ENTRY_RE.match(name)
+                if m:
+                    ids.add(m.group(2))
+        return ids
+
+    # -- submit -------------------------------------------------------
+
+    def _sweep_tmp(self, sub: str) -> None:
+        d = self._dir(sub)
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return
+        for name in names:
+            if name.startswith(".tmp-"):
+                try:
+                    os.unlink(os.path.join(d, name))
+                except OSError:
+                    pass
+
+    def submit(self, obj: Any) -> Dict[str, Any]:
+        """Validate and enqueue one job. Returns a response dict::
+
+            {"job": <id>, "status": "queued"}
+            {"job": <id>, "status": "rejected", "reason": ...}
+
+        Overload (``queue_full``), drain (``draining``) and duplicate
+        ids (``duplicate_id``) are *rejections* — explicit, audited
+        load-shed, never silent drops or unbounded queue growth. A
+        spec that does not validate raises :class:`JobSpecError`
+        instead (there may be no id to account for)."""
+        spec = parse_job(obj)
+        now = time.time()
+        t_ns = time.time_ns()
+        if not spec.id:
+            spec.id = f"job-{t_ns:x}-{os.getpid() % 0xFFFF:04x}"
+        spec.submitted_t = now
+        if self.draining():
+            self.audit(
+                "rejected", job=spec.id, tenant=spec.tenant,
+                reason="draining",
+            )
+            return {
+                "job": spec.id, "status": "rejected",
+                "reason": "draining",
+            }
+        depth = len(self._entries(PENDING_DIR))
+        cap = self.capacity
+        if depth >= cap:
+            # the load-shed record: who was shed, at what depth,
+            # against what cap — overload is routine, not invisible
+            self.audit(
+                "rejected", job=spec.id, tenant=spec.tenant,
+                reason="queue_full", depth=depth, capacity=cap,
+            )
+            return {
+                "job": spec.id, "status": "rejected",
+                "reason": "queue_full", "depth": depth, "capacity": cap,
+            }
+        if spec.id in self._known_ids():
+            self.audit(
+                "rejected", job=spec.id, tenant=spec.tenant,
+                reason="duplicate_id",
+            )
+            return {
+                "job": spec.id, "status": "rejected",
+                "reason": "duplicate_id",
+            }
+        self._sweep_tmp(PENDING_DIR)
+        entry = f"{t_ns:020d}-{spec.id}.json"
+        spec.entry = entry
+        final = os.path.join(self._dir(PENDING_DIR), entry)
+        tmp = os.path.join(self._dir(PENDING_DIR), f".tmp-{entry}")
+        with open(tmp, "w") as f:
+            json.dump(spec.to_json(), f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+        self.audit(
+            "submitted", job=spec.id, tenant=spec.tenant,
+            nproc=spec.nproc, depth=depth + 1,
+        )
+        return {"job": spec.id, "status": "queued"}
+
+    # -- scanning -----------------------------------------------------
+
+    def _load_entry(self, sub: str, name: str) -> Optional[JobSpec]:
+        try:
+            with open(os.path.join(self._dir(sub), name)) as f:
+                obj = json.load(f)
+            spec = parse_job(obj)
+        except (OSError, json.JSONDecodeError, JobSpecError):
+            return None  # claimed by a peer mid-read, or torn by hand
+        spec.entry = name
+        return spec
+
+    def pending(self) -> List[JobSpec]:
+        """Queued jobs in FIFO submit order (entries that vanish
+        mid-scan were claimed by a peer — skipped, not fatal)."""
+        out = []
+        for name in self._entries(PENDING_DIR):
+            spec = self._load_entry(PENDING_DIR, name)
+            if spec is not None:
+                out.append(spec)
+        return out
+
+    def running(self) -> List[JobSpec]:
+        out = []
+        for name in self._entries(RUNNING_DIR):
+            spec = self._load_entry(RUNNING_DIR, name)
+            if spec is not None:
+                out.append(spec)
+        return out
+
+    def done(self) -> List[Dict[str, Any]]:
+        """Finished job records (spec + outcome fields), oldest first."""
+        out = []
+        for name in self._entries(DONE_DIR):
+            try:
+                with open(os.path.join(self._dir(DONE_DIR), name)) as f:
+                    out.append(json.load(f))
+            except (OSError, json.JSONDecodeError):
+                continue
+        return out
+
+    def depth(self) -> int:
+        return len(self._entries(PENDING_DIR))
+
+    # -- claim / finish -----------------------------------------------
+
+    def claim(self, spec: JobSpec) -> Optional[JobSpec]:
+        """Atomically move ``spec`` from pending to running; None if a
+        peer won the race (its rename already consumed the entry)."""
+        src = os.path.join(self._dir(PENDING_DIR), spec.entry)
+        dst = os.path.join(self._dir(RUNNING_DIR), spec.entry)
+        try:
+            os.replace(src, dst)
+        except OSError:
+            return None
+        self.audit("claimed", job=spec.id, tenant=spec.tenant)
+        return spec
+
+    def finish(self, spec: JobSpec, outcome: str, **extra: Any) -> None:
+        """Record the final outcome (``completed`` / ``failed`` /
+        ``rejected``) in ``done/`` and clear the running entry."""
+        record = dict(spec.to_json())
+        record.update(outcome=outcome, finished_t=time.time(), **extra)
+        final = os.path.join(self._dir(DONE_DIR), spec.entry)
+        tmp = os.path.join(self._dir(DONE_DIR), f".tmp-{spec.entry}")
+        with open(tmp, "w") as f:
+            json.dump(record, f, indent=1, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+        try:
+            os.unlink(os.path.join(self._dir(RUNNING_DIR), spec.entry))
+        except OSError:
+            pass
+
+    # -- status -------------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        done = self.done()
+        outcomes: Dict[str, int] = {}
+        for rec in done:
+            key = str(rec.get("outcome", "?"))
+            outcomes[key] = outcomes.get(key, 0) + 1
+        return {
+            "root": self.root,
+            "capacity": self.capacity,
+            "draining": self.draining(),
+            "depth": self.depth(),
+            "pending": [
+                {"job": s.id, "tenant": s.tenant, "nproc": s.nproc}
+                for s in self.pending()
+            ],
+            "running": [
+                {"job": s.id, "tenant": s.tenant, "nproc": s.nproc}
+                for s in self.running()
+            ],
+            "done": [
+                {
+                    "job": rec.get("id"),
+                    "tenant": rec.get("tenant"),
+                    "outcome": rec.get("outcome"),
+                }
+                for rec in done
+            ],
+            "outcomes": outcomes,
+        }

@@ -301,6 +301,85 @@ def test_preempt_guard_flag_and_exit(elastic_sigterm_flag):
     assert saved == [1]
 
 
+def test_preempt_guard_second_signal_mid_checkpoint_escalates():
+    """ISSUE-10 satellite: a second SIGTERM arriving while the guard
+    is already inside the grace checkpoint escalates to an immediate
+    PREEMPT_EXIT — no re-entrant checkpoint (the save this thread is
+    mid-write in must not be re-entered from the handler)."""
+    import signal as _signal
+
+    guard = PreemptGuard(install=False)
+    exits = []
+
+    class _Escaped(BaseException):
+        pass
+
+    def fake_exit():
+        exits.append(guard.exit_code)
+        raise _Escaped()  # stand-in for os._exit: never returns
+
+    guard._exit_now = fake_exit
+    guard._on_signal(_signal.SIGTERM, None)  # first notice: flag only
+    assert guard.preempted and exits == []
+
+    saves = []
+
+    def save_fn():
+        saves.append("started")
+        guard._on_signal(_signal.SIGTERM, None)  # notice mid-save
+        saves.append("finished")  # unreachable: escalation left first
+
+    with pytest.raises(_Escaped):
+        guard.exit_if_preempted(save_fn=save_fn)
+    assert exits == [PREEMPT_EXIT]
+    assert saves == ["started"]  # the checkpoint was NOT re-entered
+    assert guard._checkpointing is False  # window closed on the way out
+
+
+def test_preempt_guard_second_signal_outside_checkpoint_waits():
+    """Two notices *before* the step boundary keep waiting: the loop
+    still gets to finish its step and take the grace checkpoint."""
+    import signal as _signal
+
+    guard = PreemptGuard(install=False)
+    exits = []
+    guard._exit_now = lambda: exits.append(True)
+    guard._on_signal(_signal.SIGTERM, None)
+    guard._on_signal(_signal.SIGTERM, None)
+    assert guard.preempted and exits == []
+    saved = []
+    with pytest.raises(SystemExit) as exc:
+        guard.exit_if_preempted(save_fn=lambda: saved.append(1))
+    assert exc.value.code == PREEMPT_EXIT and saved == [1]
+
+
+def test_preempt_guard_double_sigterm_real_signal(elastic_sigterm_flag):
+    """Same escalation through real signal delivery: the second
+    os.kill lands while save_fn runs, and the handler exits on the
+    spot instead of letting the checkpoint finish."""
+    import signal as _signal
+
+    guard = PreemptGuard()  # fixture restores the handler afterwards
+
+    class _Escaped(BaseException):
+        pass
+
+    def fake_exit():
+        raise _Escaped()
+
+    guard._exit_now = fake_exit
+    os.kill(os.getpid(), _signal.SIGTERM)
+    assert guard.preempted
+
+    def save_fn():
+        os.kill(os.getpid(), _signal.SIGTERM)
+        time.sleep(0.05)  # the handler runs before this returns
+        raise AssertionError("checkpoint survived the second notice")
+
+    with pytest.raises(_Escaped):
+        guard.exit_if_preempted(save_fn=save_fn)
+
+
 def test_delay_actually_sleeps():
     plan = FaultPlan.parse(
         '[{"rank": 0, "op": "AllReduce", "action": "delay", "ms": 120}]'
@@ -447,6 +526,55 @@ def test_manager_sweeps_tmp_litter(tmp_path):
     mgr.save(1, {"w": 1})
     assert not os.path.exists(litter)
     assert mgr.steps() == [1]
+
+
+def test_latest_valid_tolerates_step_dir_vanishing_mid_scan(tmp_path):
+    """ISSUE-10 satellite: keep-K retention in a concurrent writer
+    (real under serving — the drain path reads while a resident job
+    checkpoints) can delete a step dir between this reader's listing
+    and its manifest read. The scan must fall through to an older
+    committed step, never raise."""
+    import shutil as _shutil
+
+    mgr = _json_mgr(tmp_path / "ckpt", keep=5, world=2)
+    for step in (1, 2, 3):
+        mgr.save(step, {"w": step}, fingerprint="fp")
+
+    orig_steps = mgr.steps
+
+    def racing_steps():
+        # the concurrent writer's prune lands right after our listing
+        listed = orig_steps()
+        _shutil.rmtree(os.path.join(mgr.root, "step_00000003"),
+                       ignore_errors=True)
+        return listed
+
+    mgr.steps = racing_steps
+    info = mgr.latest_valid(fingerprint="fp", world=2)
+    assert info is not None and info.step == 2
+    # same tolerance between the data existence check and the data
+    # listing (the narrowest window): a listdir that hits a vanished
+    # dir reads as "invalid", not a crash
+    mgr.steps = orig_steps
+    real_listdir = os.listdir
+    data2 = os.path.join(mgr.root, "step_00000002", "data")
+
+    def racing_listdir(path="."):
+        if os.fspath(path) == data2:
+            raise FileNotFoundError(2, "vanished mid-scan", path)
+        return real_listdir(path)
+
+    # make step 2's data a directory so the listdir branch runs
+    os.unlink(data2)
+    os.makedirs(data2)
+    with open(os.path.join(data2, "payload"), "w") as f:
+        f.write("{}")
+    os.listdir = racing_listdir
+    try:
+        info = mgr.latest_valid(fingerprint="fp", world=2)
+    finally:
+        os.listdir = real_listdir
+    assert info is not None and info.step == 1
 
 
 def test_manager_atomic_layout(tmp_path):
