@@ -9,20 +9,31 @@ serve until the queue drains, measuring
 - **jobs/hour** (throughput at this spawn cost),
 - **queue-wait p50/p99** (submit -> admit latency under backlog).
 
-Two modes:
+Three modes:
 
 - default: every job really spawns a 1-rank world through
   ``launch.spawn_world`` (``python -c pass``) — the number includes
   the true per-world spawn cost the serving plane pays;
 - ``--stub``: a no-op runner — the control plane alone (spool I/O,
-  scheduling, audit), the ceiling the spawn cost is measured against.
+  scheduling, audit), the ceiling the spawn cost is measured against;
+- ``--warm``: the resident-pool comparison (``serving/pool.py``).
+  The *same* job mix — payloads that ``import mpi4jax_tpu``, i.e.
+  jobs that pay the real python + jax + package import a serving
+  workload pays — is drained twice: once cold (a fresh spawned world
+  per job) and once through a warm pool (workers spawned once, pool
+  warmup excluded, payloads executed in-process against resident
+  imports). The headline ``value`` is the warm drain wall clock; the
+  record carries per-job latency for both paths and their ratio
+  (``speedup`` — the acceptance bar is >= 10x).
 
 Emits the benchmark JSON line on stdout (the BENCH ``parsed`` record)
-and, with ``--out BENCH_rNN_serve.json``, the full round wrapper —
-the ``serve`` variant trajectory ``perf gate`` covers::
+and, with ``--out BENCH_rNN_serve[_warm].json``, the full round
+wrapper — the ``serve`` / ``serve_warm`` variant trajectories ``perf
+gate`` covers::
 
     python benchmarks/serve_loadgen.py --jobs 24 --out BENCH_r10_serve.json
-    python -m mpi4jax_tpu.observability.perf gate --variant serve
+    python benchmarks/serve_loadgen.py --warm --out BENCH_r11_serve_warm.json
+    python -m mpi4jax_tpu.observability.perf gate --variant serve_warm
 """
 
 from __future__ import annotations
@@ -39,6 +50,11 @@ os.environ.setdefault("MPI4JAX_TPU_SKIP_VERSION_CHECK", "1")
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 METRIC = "serve_loadgen_drain"
+METRIC_WARM = "serve_loadgen_warm_drain"
+
+#: the --warm job payload: a job that pays what real serving jobs pay
+#: (python + jax + package import) cold, and nothing warm
+WARM_PAYLOAD = ["-c", "import mpi4jax_tpu"]
 
 
 def _pct(sorted_vals, q):
@@ -49,12 +65,30 @@ def _pct(sorted_vals, q):
 
 
 def run_loadgen(jobs: int, tenants: int, nproc: int, *, stub: bool,
-                queue_cap: int):
+                queue_cap: int, payload=None, warm: bool = False):
     from mpi4jax_tpu.serving import Server, Spool
 
     with tempfile.TemporaryDirectory() as tmp:
         spool = Spool(os.path.join(tmp, "spool"))
         spool.configure(queue_cap)
+        pool = None
+        if warm:
+            from mpi4jax_tpu.serving.pool import WorkerPool
+
+            pool = WorkerPool(
+                os.path.join(spool.root, "pool"), nproc,
+                audit=spool.audit, log=lambda msg: None,
+            )
+            pool.start()
+            # exclude the one-time pool warmup: the claim under test
+            # is steady-state dispatch latency, which is what repeats
+            # per job — spawn+import happened once, before traffic
+            deadline = time.monotonic() + 120.0
+            while pool.idle_count() < nproc:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("warm pool never became ready")
+                pool.check()
+                time.sleep(0.02)
         t0 = time.monotonic()
         accepted = 0
         shed = 0
@@ -62,7 +96,7 @@ def run_loadgen(jobs: int, tenants: int, nproc: int, *, stub: bool,
             r = spool.submit({
                 "id": f"load-{i:04d}",
                 "tenant": f"t{i % tenants}",
-                "cmd": ["-c", "pass"],
+                "cmd": list(payload) if payload else ["-c", "pass"],
                 "nproc": 1,
             })
             if r["status"] == "queued":
@@ -74,10 +108,14 @@ def run_loadgen(jobs: int, tenants: int, nproc: int, *, stub: bool,
             runner = lambda spec, world, d, attempt, resume: (0, [])  # noqa: E731
         server = Server(
             spool, nproc=nproc, max_jobs=accepted, poll_s=0.01,
-            runner=runner, log=lambda msg: None,
+            runner=runner, pool=pool, log=lambda msg: None,
         )
-        rc = server.serve()
-        wall_s = time.monotonic() - t0
+        try:
+            rc = server.serve()
+            wall_s = time.monotonic() - t0
+        finally:
+            if pool is not None:
+                pool.stop(grace_s=2.0)
         waits = sorted(
             float(rec.get("queue_wait_s") or 0.0)
             for rec in spool.done()
@@ -90,6 +128,7 @@ def run_loadgen(jobs: int, tenants: int, nproc: int, *, stub: bool,
             "accepted": accepted,
             "shed": shed,
             "completed": completed,
+            "job_s": wall_s / completed if completed else None,
             "jobs_per_hour": (
                 3600.0 * completed / wall_s if wall_s > 0 else None
             ),
@@ -111,6 +150,10 @@ def main(argv=None) -> int:
                         "(default: jobs, so nothing is shed)")
     parser.add_argument("--stub", action="store_true",
                         help="stub runner: control-plane overhead only")
+    parser.add_argument("--warm", action="store_true",
+                        help="cold-spawn vs warm-pool comparison over "
+                        "an import-paying job mix (the serve_warm "
+                        "BENCH variant)")
     parser.add_argument("--out", default=None, metavar="BENCH.json",
                         help="also write the BENCH round wrapper here")
     parser.add_argument("--round", type=int, default=None,
@@ -119,32 +162,79 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     cap = args.queue_cap if args.queue_cap is not None else args.jobs
-    result = run_loadgen(
-        args.jobs, args.tenants, args.nproc,
-        stub=args.stub, queue_cap=cap,
-    )
-    mode = "stub" if args.stub else "spawn"
-    print(
-        f"# serve_loadgen [{mode}]: {result['completed']}/"
-        f"{result['accepted']} job(s) drained in "
-        f"{result['wall_s']:.2f}s ({result['jobs_per_hour']:.0f} "
-        f"jobs/h); queue wait p50 {result['queue_wait_p50_s']:.3f}s "
-        f"p99 {result['queue_wait_p99_s']:.3f}s; rc={result['rc']}",
-        file=sys.stderr,
-    )
-    record = {
-        "metric": METRIC,
-        "value": round(result["wall_s"], 3),
-        "unit": "s",
-        "vs_baseline": None,
-        "nproc": args.nproc,
-        "fused": None,
-        "jobs": args.jobs,
-        "mode": mode,
-        "jobs_per_hour": round(result["jobs_per_hour"], 1),
-        "queue_wait_p50_s": round(result["queue_wait_p50_s"], 4),
-        "queue_wait_p99_s": round(result["queue_wait_p99_s"], 4),
-    }
+    if args.warm:
+        cold = run_loadgen(
+            args.jobs, args.tenants, args.nproc,
+            stub=False, queue_cap=cap, payload=WARM_PAYLOAD,
+        )
+        warm = run_loadgen(
+            args.jobs, args.tenants, args.nproc,
+            stub=False, queue_cap=cap, payload=WARM_PAYLOAD,
+            warm=True,
+        )
+        result = warm
+        speedup = (
+            cold["job_s"] / warm["job_s"]
+            if cold["job_s"] and warm["job_s"] else None
+        )
+        print(
+            f"# serve_loadgen [warm]: {warm['completed']}/"
+            f"{warm['accepted']} job(s): cold {cold['job_s']:.3f}s/job "
+            f"({cold['wall_s']:.2f}s drain) vs warm "
+            f"{warm['job_s']:.4f}s/job ({warm['wall_s']:.2f}s drain) "
+            f"— {speedup:.1f}x; rc cold={cold['rc']} warm={warm['rc']}",
+            file=sys.stderr,
+        )
+        record = {
+            "metric": METRIC_WARM,
+            "value": round(warm["wall_s"], 3),
+            "unit": "s",
+            "vs_baseline": None,
+            "nproc": args.nproc,
+            "fused": None,
+            "jobs": args.jobs,
+            "mode": "warm",
+            "cold_wall_s": round(cold["wall_s"], 3),
+            "cold_job_s": round(cold["job_s"], 4),
+            "warm_job_s": round(warm["job_s"], 4),
+            "speedup": round(speedup, 1) if speedup else None,
+            "jobs_per_hour": round(warm["jobs_per_hour"], 1),
+            "queue_wait_p50_s": round(warm["queue_wait_p50_s"], 4),
+            "queue_wait_p99_s": round(warm["queue_wait_p99_s"], 4),
+        }
+        result = {
+            **warm,
+            "rc": max(cold["rc"], warm["rc"]),
+            "completed": min(cold["completed"], warm["completed"]),
+            "accepted": max(cold["accepted"], warm["accepted"]),
+        }
+    else:
+        result = run_loadgen(
+            args.jobs, args.tenants, args.nproc,
+            stub=args.stub, queue_cap=cap,
+        )
+        mode = "stub" if args.stub else "spawn"
+        print(
+            f"# serve_loadgen [{mode}]: {result['completed']}/"
+            f"{result['accepted']} job(s) drained in "
+            f"{result['wall_s']:.2f}s ({result['jobs_per_hour']:.0f} "
+            f"jobs/h); queue wait p50 {result['queue_wait_p50_s']:.3f}s "
+            f"p99 {result['queue_wait_p99_s']:.3f}s; rc={result['rc']}",
+            file=sys.stderr,
+        )
+        record = {
+            "metric": METRIC,
+            "value": round(result["wall_s"], 3),
+            "unit": "s",
+            "vs_baseline": None,
+            "nproc": args.nproc,
+            "fused": None,
+            "jobs": args.jobs,
+            "mode": mode,
+            "jobs_per_hour": round(result["jobs_per_hour"], 1),
+            "queue_wait_p50_s": round(result["queue_wait_p50_s"], 4),
+            "queue_wait_p99_s": round(result["queue_wait_p99_s"], 4),
+        }
     line = json.dumps(record)
     print(line)
     if args.out:
@@ -159,7 +249,8 @@ def main(argv=None) -> int:
                 "n": rnd,
                 "cmd": "python benchmarks/serve_loadgen.py "
                        f"--jobs {args.jobs} -n {args.nproc}"
-                       + (" --stub" if args.stub else ""),
+                       + (" --stub" if args.stub else "")
+                       + (" --warm" if args.warm else ""),
                 "rc": result["rc"],
                 "tail": line + "\n",
                 "parsed": record,

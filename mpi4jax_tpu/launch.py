@@ -328,21 +328,28 @@ def rank_env(
     resume_step=None,
     runtime_sampling=False,
     perf_watch=False,
+    mesh=True,
 ):
     """The environment one spawned rank runs under — world membership
     (shm segment name + generation nonce + rank/size), telemetry
     arming, plan cache, fault plan, and resume step. Extracted from
     the spawn loop so every harness that launches ranks (the CLI
     launcher, the serving plane, tests) builds rank environments
-    through one seam and cannot drift."""
+    through one seam and cannot drift.
+
+    ``mesh=False`` keeps the rank *identity* (``M4T_RANK`` /
+    ``M4T_SIZE`` — telemetry, fault scoping, group bookkeeping) but
+    withholds the shm segment coordinates, so importing the package
+    does **not** join a native world. The serving plane's resident
+    worker pool (``serving/pool.py``) spawns un-meshed workers by
+    default: warm processes that serve in-process payloads and can be
+    killed/respawned one at a time without wedging segment peers."""
     env = dict(os.environ if base_env is None else base_env)
     if extra_env:
         env.update({str(k): str(v) for k, v in extra_env.items()})
     env.update(
-        M4T_SHM_NAME=shm_name,
         M4T_RANK=str(rank),
         M4T_SIZE=str(world),
-        M4T_SHM_GEN=str(shm_gen),
         # world membership is for *direct* children only:
         # runtime/shm.py refuses to join when the parent pid doesn't
         # match, so a rank's own subprocesses (pytest spawning helper
@@ -352,6 +359,16 @@ def rank_env(
         ),
         JAX_PLATFORMS="cpu",
     )
+    if mesh:
+        env.update(
+            M4T_SHM_NAME=shm_name,
+            M4T_SHM_GEN=str(shm_gen),
+        )
+    else:
+        # an un-meshed worker must not inherit a live world's segment
+        # coordinates from the harness environment either
+        env.pop("M4T_SHM_NAME", None)
+        env.pop("M4T_SHM_GEN", None)
     if static_check and static_check != "off":
         env["M4T_STATIC_CHECK"] = static_check
     if fault_plan:

@@ -947,6 +947,53 @@ def format_serving_timeline(records: List[Dict[str, Any]]) -> str:
                 f"  drained: queue empty after {r.get('jobs')} "
                 f"job(s) at world {r.get('world')}"
             )
+        elif event == "pool_start":
+            line = (
+                f"  warm pool: {r.get('size')} resident worker(s)"
+                + (", meshed" if r.get("mesh") else "")
+                + f", heartbeat {r.get('heartbeat_s')}s / deadline "
+                f"{r.get('deadline_s')}s"
+            )
+        elif event == "pool_quarantine":
+            line = (
+                f"  POOL: worker {r.get('worker')} quarantined — "
+                f"{r.get('reason')}"
+                + (f" (rc {r.get('rc')})" if r.get("rc") is not None
+                   else "")
+                + (f",{tag}" if job else "")
+            )
+        elif event == "pool_respawn":
+            line = (
+                f"  POOL: worker {r.get('worker')} respawned "
+                f"(incarnation {r.get('incarnation')})"
+            )
+        elif event == "pool_retired":
+            line = (
+                f"  POOL: worker {r.get('worker')} preempted — slot "
+                f"retired, capacity {r.get('capacity')}"
+                + (f",{tag}" if job else "")
+            )
+        elif event == "pool_strike":
+            line = (
+                f"  POOL: strike {r.get('strikes')}/"
+                f"{r.get('max_strikes')} against{tag} "
+                f"({r.get('reason')})"
+            )
+        elif event == "pool_poisoned":
+            line = (
+                f"  POOL: POISONED{tag} after {r.get('strikes')} "
+                "wedged attempt(s) — further dispatch refused"
+            )
+        elif event == "pool_hygiene":
+            line = (
+                f"  POOL: worker {r.get('worker')} failed the "
+                f"post-job hygiene check after{tag}"
+            )
+        elif event == "pool_stop":
+            line = (
+                f"  warm pool stopped after {r.get('jobs')} work "
+                f"item(s), {r.get('respawns')} respawn(s)"
+            )
         else:
             line = f"  {event}:{tag}"
         out.append(line)

@@ -336,3 +336,19 @@ def start_heartbeat(
         target=run, name="m4t-heartbeat", daemon=True
     ).start()
     return stop.set
+
+
+def silence_heartbeat() -> None:
+    """Stop the daemon heartbeat thread without starting a
+    replacement. Used by the ``wedge`` fault action
+    (``resilience/faults.py``) to reproduce the failure shape where
+    not even the heartbeat thread makes progress (a process wedged in
+    native code holding the GIL): emissions stop *and* heartbeats
+    stop, but the process never exits — only an external heartbeat
+    deadline (the serving pool doctor's) can name it. Idempotent; a
+    later :func:`start_heartbeat` re-arms normally."""
+    global _heartbeat_stop
+    with _heartbeat_lock:
+        if _heartbeat_stop is not None:
+            _heartbeat_stop.set()
+            _heartbeat_stop = None

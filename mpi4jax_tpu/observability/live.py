@@ -149,6 +149,47 @@ class TailReader:
         return out
 
 
+class HeartbeatTail:
+    """Bounded-memory liveness tracker over one sink.
+
+    A :class:`TailReader` that keeps only *timestamps*, not records —
+    the tail a process that lives for hours can afford to run against
+    a sink that grows for hours. The serving plane's resident worker
+    pool (``serving/pool.py``) runs one per worker: the pool doctor's
+    quarantine deadline is "no fresh heartbeat for N seconds", and
+    freshness here is **arrival time** (when this poll first saw the
+    completed line), so a respawned worker appending to the same sink
+    can never look alive on its dead predecessor's heartbeats —
+    arrival times only move forward.
+    """
+
+    def __init__(self, path: str, *, clock: Callable[[], float] = time.monotonic):
+        self.reader = TailReader(path)
+        self.clock = clock
+        #: arrival time (clock) of the newest heartbeat / any record
+        self.last_heartbeat_t: Optional[float] = None
+        self.last_record_t: Optional[float] = None
+        self.records = 0
+
+    def poll(self) -> int:
+        """Drain the sink once; returns how many new records arrived."""
+        recs = self.reader.poll()
+        if not recs:
+            return 0
+        now = self.clock()
+        self.records += len(recs)
+        self.last_record_t = now
+        if any(r.get("kind") == "heartbeat" for r in recs):
+            self.last_heartbeat_t = now
+        return len(recs)
+
+    def heartbeat_age(self, now: Optional[float] = None) -> Optional[float]:
+        """Seconds since a heartbeat last *arrived* (None before any)."""
+        if self.last_heartbeat_t is None:
+            return None
+        return max(0.0, (self.clock() if now is None else now) - self.last_heartbeat_t)
+
+
 # ---------------------------------------------------------------------
 # run-directory aggregation
 # ---------------------------------------------------------------------

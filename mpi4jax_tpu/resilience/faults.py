@@ -33,6 +33,15 @@ Actions:
   Nth on (a synthetic straggler);
 - ``hang`` — stop emitting forever (heartbeats continue from their
   daemon thread, so the doctor's verdict is *hung*, not *dead*);
+- ``wedge`` — ``hang``'s silent sibling: block forever inside the
+  emission hook *and* silence the heartbeat daemon
+  (``events.silence_heartbeat``). No emissions, no heartbeats, no
+  exit — the shape of a process wedged in native code holding the
+  GIL, where not even the heartbeat thread runs. Invisible to
+  anything that waits for an exit code; only an external heartbeat
+  deadline — the serving pool doctor's
+  (``serving/pool.py``) — can name it, which is exactly what makes
+  pool wedge-detection deterministically testable device-free;
 - ``crash`` — ``mode: "exception"`` (default) raises
   :class:`InjectedFault` at the emission site, ``mode: "sigkill"``
   sends this process SIGKILL (no atexit, no recorder dump — the
@@ -83,7 +92,7 @@ KNOWN_OPS = frozenset({
     "Scatter", "Send", "Sendrecv",
 })
 
-ACTIONS = ("delay", "hang", "crash", "slowdown", "preempt")
+ACTIONS = ("delay", "hang", "crash", "slowdown", "preempt", "wedge")
 CRASH_MODES = ("exception", "sigkill")
 
 
@@ -462,6 +471,16 @@ def _perform(rule: FaultRule, op: str, fp: str) -> None:
         # stop emitting forever; the heartbeat daemon thread keeps
         # running, so the doctor sees "alive but stuck" — the verdict
         # a rank wedged inside a collective would earn
+        while True:
+            time.sleep(3600.0)
+    if rule.action == "wedge":
+        # hang's silent sibling: stop the heartbeat daemon too, then
+        # block — no emissions, no heartbeats, no exit. Only an
+        # external heartbeat deadline (the serving pool doctor's)
+        # can detect this process state.
+        from ..observability import events
+
+        events.silence_heartbeat()
         while True:
             time.sleep(3600.0)
     if rule.action == "crash":

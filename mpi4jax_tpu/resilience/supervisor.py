@@ -299,6 +299,10 @@ class Supervisor:
     launcher uses it to put world-size transitions (old world, new
     world, reshard source step) on the ``supervisor.jsonl`` record so
     the doctor can narrate an elastic recovery post-mortem.
+    ``abort_fn(attempt) -> reason|None`` is consulted before every
+    retry a transient verdict would otherwise earn: a non-None reason
+    vetoes the remaining budget (audited ``action: "abort"`` with that
+    reason) — the serving pool's poisoned-job two-strikes rule.
     """
 
     def __init__(
@@ -309,6 +313,7 @@ class Supervisor:
         diagnose_fn: Optional[Callable[[int], Optional[Dict[str, Any]]]] = None,
         resume_fn: Optional[Callable[[], Optional[int]]] = None,
         extra_fn: Optional[Callable[[int], Dict[str, Any]]] = None,
+        abort_fn: Optional[Callable[[int], Optional[str]]] = None,
         audit_path: Optional[str] = None,
         sleep_fn: Callable[[float], None] = time.sleep,
         log: Optional[Callable[[str], None]] = None,
@@ -318,6 +323,7 @@ class Supervisor:
         self.diagnose_fn = diagnose_fn or (lambda attempt: None)
         self.resume_fn = resume_fn or (lambda: None)
         self.extra_fn = extra_fn or (lambda attempt: {})
+        self.abort_fn = abort_fn or (lambda attempt: None)
         self.audit_path = audit_path
         self.sleep_fn = sleep_fn
         self.log = log or (lambda msg: None)
@@ -372,18 +378,39 @@ class Supervisor:
             verdict = classify(report, exit_code)
             last = attempt == self.policy.retries
             retrying = verdict["klass"] == "transient" and not last
+            # an external veto on further attempts: the serving pool
+            # uses this for its two-strikes poisoned-job rule — a job
+            # that keeps wedging workers must stop consuming the mesh
+            # even while its transient-looking retry budget remains
+            abort_reason = None
+            if retrying:
+                try:
+                    abort_reason = self.abort_fn(attempt)
+                except Exception:
+                    abort_reason = None
+                if abort_reason:
+                    retrying = False
             delay = self.policy.delay(attempt + 1, self._rng) if retrying else 0.0
             next_resume = self.resume_fn() if retrying else None
             self._audit_attempt(attempt, {
                 "attempt": attempt,
                 "exit_code": exit_code,
                 "klass": verdict["klass"],
-                "reason": verdict["reason"],
+                "reason": abort_reason or verdict["reason"],
                 "finding_kinds": verdict["kinds"],
-                "action": "retry" if retrying else "give_up",
+                "action": (
+                    "retry" if retrying
+                    else "abort" if abort_reason else "give_up"
+                ),
                 "backoff_s": round(delay, 3),
                 "resume_step": next_resume,
             })
+            if abort_reason:
+                self.log(
+                    f"supervisor: attempt {attempt} failed and further "
+                    f"attempts are vetoed ({abort_reason}); giving up"
+                )
+                return exit_code
             if verdict["klass"] == "deterministic":
                 self.log(
                     f"supervisor: attempt {attempt} failed "

@@ -8,14 +8,21 @@ own fault domain and survives overload, job failure and host loss
 (:mod:`.server`), and a queue-level OpenMetrics exporter
 (:mod:`.export`). CLI::
 
-    python -m mpi4jax_tpu.serving serve  SPOOL -n 4 [--elastic ...]
+    python -m mpi4jax_tpu.serving serve  SPOOL -n 4 [--warm] [--elastic ...]
     python -m mpi4jax_tpu.serving submit SPOOL --cmd script.py ...
     python -m mpi4jax_tpu.serving status SPOOL [--json]
     python -m mpi4jax_tpu.serving drain  SPOOL [--wait]
     python -m mpi4jax_tpu.serving --selftest
 
+``serve --warm`` arms the self-healing resident worker pool
+(:mod:`.pool`): rank processes spawned once that loop on filesystem
+mailboxes, keeping imports/compile/plan caches warm across jobs,
+watched by a pool doctor that quarantines and respawns wedged,
+crashed, and leaky workers and poisons jobs that wedge workers twice.
+
 See ``docs/serving.md`` for the job-spec schema, the scheduler policy
-table, backpressure semantics, and a drain walkthrough.
+table, backpressure semantics, the warm-pool lifecycle, and a drain
+walkthrough.
 """
 
 from .scheduler import FairScheduler
@@ -35,5 +42,19 @@ __all__ = [
     "JobSpecError",
     "Server",
     "Spool",
+    "WorkerPool",
+    "job_comm",
     "parse_job",
 ]
+
+
+def __getattr__(name):
+    # lazy on purpose: the worker entry point is `python -m
+    # mpi4jax_tpu.serving.pool`, and an eager `from .pool import ...`
+    # here would put the module in sys.modules before runpy executes
+    # it as __main__ (the classic double-import warning)
+    if name in ("WorkerPool", "job_comm"):
+        from . import pool as _pool
+
+        return getattr(_pool, name)
+    raise AttributeError(name)

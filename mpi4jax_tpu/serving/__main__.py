@@ -38,6 +38,19 @@ def _cmd_serve(args) -> int:
     spool = Spool(args.spool)
     if args.queue_cap is not None:
         spool.configure(args.queue_cap)
+    pool = None
+    if args.warm:
+        from .pool import WorkerPool
+
+        pool = WorkerPool(
+            os.path.join(spool.root, "pool"),
+            args.nproc,
+            heartbeat_s=args.pool_heartbeat,
+            deadline_s=args.pool_deadline,
+            mesh=args.mesh,
+            elastic=args.elastic,
+            audit=spool.audit,
+        )
     try:
         server = Server(
             spool,
@@ -49,11 +62,18 @@ def _cmd_serve(args) -> int:
             max_jobs=args.max_jobs,
             idle_exit_s=args.idle_exit,
             metrics_port=args.metrics_port,
+            pool=pool,
         )
     except ValueError as e:
         print(f"serve: {e}", file=sys.stderr)
         return 2
-    return server.serve()
+    if pool is not None:
+        pool.start()
+    try:
+        return server.serve()
+    finally:
+        if pool is not None:
+            pool.stop()
 
 
 def _cmd_submit(args) -> int:
@@ -100,9 +120,14 @@ def _cmd_submit(args) -> int:
 
 
 def _cmd_status(args) -> int:
+    from . import export as sexport
+
     spool = Spool(args.spool)
     status = spool.status()
+    pool = sexport.pool_snapshot(spool)
     if args.json:
+        if pool is not None:
+            status = dict(status, pool=pool)
         print(json.dumps(status, indent=1))
         return 0
     print(
@@ -125,6 +150,26 @@ def _cmd_status(args) -> int:
         print("  outcomes: " + ", ".join(
             f"{k}={v}" for k, v in sorted(status["outcomes"].items())
         ))
+    if pool is not None:
+        counters = pool.get("counters", {})
+        print(
+            f"  warm pool: {pool.get('capacity')}/{pool.get('size')} "
+            f"slot(s), {counters.get('respawns', 0)} respawn(s), "
+            f"{sum((counters.get('quarantines') or {}).values())} "
+            f"quarantine(s), {counters.get('poisoned', 0)} poisoned "
+            "job(s)"
+        )
+        ages = pool.get("heartbeat_age_s", {})
+        for worker in pool.get("workers", []):
+            rank = worker.get("rank")
+            age = ages.get(str(rank))
+            print(
+                f"    worker {rank}: {worker.get('state'):>11}  "
+                f"inc {worker.get('incarnation')}  "
+                f"served {worker.get('jobs_served')}  "
+                + (f"beat {age:.1f}s ago" if age is not None
+                   else "no heartbeat")
+            )
     return 0
 
 
@@ -367,6 +412,197 @@ def selftest() -> int:  # noqa: C901 — one linear smoke script
         assert 'm4t_serve_rejected_total{reason="queue_full"} 2' in text1
         assert 'm4t_serve_rejected_total{reason="draining"} 1' in text1
 
+        # ======== resident warm pool (serving/pool.py) ================
+        import threading
+
+        from . import pool as pool_mod
+
+        # -- work-item execution + the hygiene contract ----------------
+        base = {"schema": pool_mod.WORK_SCHEMA, "item": "i", "job": "j"}
+        r = pool_mod.run_item({**base, "cmd": ["-c", "pass"]})
+        assert r["rc"] == 0 and r["hygiene"]["clean"], r
+        r = pool_mod.run_item(
+            {**base, "cmd": ["-c", "import sys; sys.exit(7)"]}
+        )
+        assert r["rc"] == 7, r
+        r = pool_mod.run_item(
+            {**base, "cmd": ["-c", "raise ValueError('boom')"]}
+        )
+        assert r["rc"] == 1 and "ValueError" in r["error"], r
+        # env bleed is named AND rolled back
+        r = pool_mod.run_item({**base, "cmd": [
+            "-c", "import os; os.environ['M4T_SELFTEST_BLEED'] = '1'",
+        ]})
+        assert r["hygiene"]["env_bleed"] == ["M4T_SELFTEST_BLEED"], r
+        assert not r["hygiene"]["clean"]
+        assert "M4T_SELFTEST_BLEED" not in os.environ
+        # a plan the payload armed itself is a violation...
+        r = pool_mod.run_item({**base, "cmd": [
+            "-c",
+            "from mpi4jax_tpu.resilience import faults; "
+            "faults.arm(faults.FaultPlan.parse("
+            "{'faults': [{'op': '*', 'action': 'delay', 'ms': 1}]}))",
+        ]})
+        assert r["hygiene"]["fault_armed"] and not r["hygiene"]["clean"]
+        # ...one the job declared is scoped to it and unscoped after
+        from ..resilience import faults as _faults
+
+        r = pool_mod.run_item({
+            **base, "cmd": ["-c", "pass"],
+            "fault_plan": {
+                "faults": [{"op": "*", "action": "delay", "ms": 1}]
+            },
+        })
+        assert r["rc"] == 0 and r["hygiene"]["clean"], r
+        assert _faults.active_plan is None
+        # sub-mesh packing: the payload sees its GroupComm partition
+        r = pool_mod.run_item({
+            **base,
+            "cmd": ["-c",
+                    "from mpi4jax_tpu.serving.pool import job_comm; "
+                    "c = job_comm(); "
+                    "assert c.groups == ((1, 2), (0,), (3,)), c.groups"],
+            "group": {"ranks": [1, 2], "rank": 0, "size": 2,
+                      "world": 4},
+        })
+        assert r["rc"] == 0, r
+
+        # -- pool doctor: quarantine / respawn / two-strikes -----------
+        class _ThreadWorker:
+            """Stub handle: the real mailbox + hygiene code paths,
+            driven by an in-process thread instead of a subprocess.
+            A job env carrying STUB_WEDGE makes it claim the item,
+            stop heartbeating, and never answer — the wedge shape."""
+
+            def __init__(self, p, w):
+                self.rc = None
+                self.pid = None
+                self._stop = threading.Event()
+                self._root, self._rank = p.root, w.rank
+                self._inc = w.incarnation
+                self._t = threading.Thread(target=self._run,
+                                           daemon=True)
+                self._t.start()
+
+            def poll(self):
+                return self.rc
+
+            def terminate(self):
+                self._stop.set()
+
+            kill = terminate
+
+            def wait(self, timeout=None):
+                self._t.join(timeout)
+
+            def _run(self):
+                from ..observability import events as ev
+
+                sink = ev.EventLog(
+                    pool_mod.worker_sink(self._root, self._rank)
+                )
+                wdir = pool_mod.worker_dir(self._root, self._rank)
+                inbox = os.path.join(wdir, pool_mod.INBOX_DIR)
+                outbox = os.path.join(wdir, pool_mod.OUTBOX_DIR)
+                cur = os.path.join(wdir, "current.json")
+                while not self._stop.is_set():
+                    sink.append(ev.event(
+                        "heartbeat", source="stub", t=time.time(),
+                    ))
+                    name = pool_mod._oldest_entry(inbox)
+                    if name is not None:
+                        try:
+                            os.replace(os.path.join(inbox, name), cur)
+                            with open(cur) as f:
+                                item = json.load(f)
+                        except (OSError, json.JSONDecodeError):
+                            continue
+                        if (item.get("env") or {}).get("STUB_WEDGE"):
+                            self._stop.wait(60.0)
+                            return
+                        res = pool_mod.run_item(
+                            item, worker=self._rank,
+                            incarnation=self._inc,
+                        )
+                        pool_mod._write_json_atomic(
+                            os.path.join(
+                                outbox, f"{item['item']}.json"
+                            ),
+                            res,
+                        )
+                        try:
+                            os.unlink(cur)
+                        except OSError:
+                            pass
+                    time.sleep(0.01)
+
+        spool4 = Spool(os.path.join(tmp, "spool4"))
+        pool = pool_mod.WorkerPool(
+            os.path.join(spool4.root, "pool"), 2,
+            spawn_fn=lambda p, w: _ThreadWorker(p, w),
+            heartbeat_s=0.05, deadline_s=0.5, start_deadline_s=10.0,
+            check_s=0.01, audit=spool4.audit, log=lambda m: None,
+        )
+        pool.start(doctor=False)
+        for obj in (
+            {"id": "warm", "tenant": "a", "cmd": ["-c", "pass"],
+             "nproc": 2},
+            {"id": "leaky", "tenant": "a", "cmd": [
+                "-c", "import os; os.environ['M4T_LEAK'] = '1'",
+            ]},
+            {"id": "wedger", "tenant": "b", "cmd": ["-c", "pass"],
+             "env": {"STUB_WEDGE": "1"}, "retries": 3,
+             "backoff_s": 0.0},
+        ):
+            assert spool4.submit(obj)["status"] == "queued"
+        server4 = Server(
+            spool4, nproc=2, max_jobs=3, poll_s=0.01, pool=pool,
+            log=lambda msg: None,
+        )
+        rc = server4.serve()
+        pool._write_state(force=True)
+        assert rc == 0, rc
+        outcomes = {
+            rec["id"]: rec["outcome"] for rec in spool4.done()
+        }
+        assert outcomes == {
+            "warm": "completed", "leaky": "completed",
+            "wedger": "failed",
+        }, outcomes
+        failed = [rec for rec in spool4.done() if rec["id"] == "wedger"]
+        assert failed[0]["reason"] == "poisoned", failed
+        # two strikes: exactly two wedged quarantines, then refusal
+        assert pool.strikes("wedger") == 2
+        assert pool.poisoned("wedger")
+        q = pool.counters["quarantines"]
+        assert q.get("wedged") == 2, q
+        assert q.get("hygiene") == 1, q  # "leaky" dirtied its worker
+        assert pool.counters["respawns"] == 3, pool.counters
+        # every slot healed: back to a live incarnation
+        by_event = {}
+        for rec in spool4.audit_records():
+            by_event.setdefault(rec["event"], []).append(rec)
+        for needle in ("pool_start", "pool_dispatch",
+                       "pool_quarantine", "pool_respawn",
+                       "pool_strike", "pool_poisoned",
+                       "pool_hygiene"):
+            assert by_event.get(needle), (needle, sorted(by_event))
+        # exporter: per-worker health + pool counters
+        snap4 = sexport.serving_snapshot(spool4)
+        assert snap4["pool"] and snap4["pool"]["size"] == 2
+        text4 = sexport.render_serving_metrics(snap4)
+        for needle in (
+            "m4t_pool_capacity 2",
+            'm4t_pool_quarantines_total{reason="wedged"} 2',
+            'm4t_pool_quarantines_total{reason="hygiene"} 1',
+            "m4t_pool_respawns_total 3",
+            "m4t_pool_poisoned_total 1",
+            'm4t_pool_worker_alive{worker="0"}',
+            'm4t_pool_worker_last_heartbeat_age{worker="1"}',
+        ):
+            assert needle in text4, (needle, text4)
+        pool.stop(grace_s=0.2)
+
     print("serving selftest ok")
     return 0
 
@@ -417,6 +653,28 @@ def main(argv=None) -> int:
                    metavar="P",
                    help="serve queue OpenMetrics on "
                    "http://127.0.0.1:P/metrics (0 = free port)")
+    p.add_argument("--warm", action="store_true",
+                   help="resident warm pool: spawn -n worker "
+                   "processes once (serving/pool.py) and dispatch "
+                   "jobs to them as mailbox work items — imports, "
+                   "compile caches and the plan cache stay warm "
+                   "across jobs; the pool doctor quarantines and "
+                   "respawns wedged/crashed/leaky workers")
+    p.add_argument("--mesh", action="store_true",
+                   help="with --warm: spawn the pool as one resident "
+                   "shm world so payloads can run real cross-worker "
+                   "collectives over their sub-mesh (job_comm()); "
+                   "default is un-meshed workers that can be killed "
+                   "and respawned independently")
+    p.add_argument("--pool-heartbeat", type=float, default=0.5,
+                   metavar="S",
+                   help="with --warm: worker heartbeat period "
+                   "(default %(default)s)")
+    p.add_argument("--pool-deadline", type=float, default=None,
+                   metavar="S",
+                   help="with --warm: quarantine a worker after S "
+                   "seconds without a fresh heartbeat (default "
+                   "max(6 heartbeats, 3s))")
     p.set_defaults(fn=_cmd_serve)
 
     p = sub.add_parser("submit", help="enqueue one job")

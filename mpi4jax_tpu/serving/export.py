@@ -24,17 +24,81 @@ Families (all prefixed ``m4t_serve_``)::
     m4t_serve_job_queue_wait_seconds{job=,tenant=} gauge per finished job
     m4t_serve_job_run_seconds{job=,tenant=}   gauge   per finished job
     m4t_serve_job_attempts{job=,tenant=}      gauge   per finished job
+
+With a resident warm pool (``serving/pool.py`` — ``serve --warm``),
+per-worker health joins the exposition, read from the pool's atomic
+``pool.json`` state snapshot plus the per-worker heartbeat sinks::
+
+    m4t_pool_size / m4t_pool_capacity         gauge   slots / not retired
+    m4t_pool_worker_alive{worker=}            gauge   1 = idle/busy now
+    m4t_pool_worker_jobs_served{worker=}      gauge   payloads completed
+    m4t_pool_worker_last_heartbeat_age{worker=} gauge seconds since beat
+    m4t_pool_worker_incarnation{worker=}      gauge   respawn generation
+    m4t_pool_quarantines_total{reason=}       counter by quarantine reason
+    m4t_pool_respawns_total                   counter fresh incarnations
+    m4t_pool_retired_total                    counter preempted slots
+    m4t_pool_poisoned_total                   counter two-strikes jobs
 """
 
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Dict, Optional, Union
 
 from ..observability import export as _export
 from .spool import Spool
 
 PROM_NAME = "metrics.prom"
+
+#: pool root inside the spool (``serve --warm`` convention)
+POOL_DIR = "pool"
+
+
+def pool_snapshot(
+    spool: Union[Spool, str],
+) -> Optional[Dict[str, Any]]:
+    """The warm pool's health, read entirely from its on-disk
+    artifacts (``pool.json`` + per-worker sinks) so ``serving
+    status`` and the exporter see the same truth a restarted server
+    would. None when no pool ever ran in this spool."""
+    root = spool.root if isinstance(spool, Spool) else os.path.abspath(spool)
+    pool_root = os.path.join(root, POOL_DIR)
+    state_path = os.path.join(pool_root, "pool.json")
+    if not os.path.exists(state_path):
+        return None
+    import json
+
+    from ..observability import events
+    from . import pool as _pool
+
+    try:
+        with open(state_path) as f:
+            state = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(state, dict) or state.get("schema") != _pool.POOL_SCHEMA:
+        return None
+    now = time.time()
+    ages: Dict[str, Optional[float]] = {}
+    for w in state.get("workers", []):
+        rank = w.get("rank")
+        last_t = None
+        try:
+            for rec in events.iter_records(
+                _pool.worker_sink(pool_root, rank)
+            ):
+                if rec.get("kind") == "heartbeat":
+                    t = rec.get("t")
+                    if isinstance(t, (int, float)):
+                        last_t = t
+        except OSError:
+            pass
+        ages[str(rank)] = (
+            None if last_t is None else max(0.0, now - last_t)
+        )
+    state["heartbeat_age_s"] = ages
+    return state
 
 
 def serving_snapshot(
@@ -82,6 +146,7 @@ def serving_snapshot(
         "counts": counts,
         "rejected": rejected,
         "jobs": jobs,
+        "pool": pool_snapshot(spool),
     }
 
 
@@ -133,6 +198,64 @@ def render_serving_metrics(snap: Dict[str, Any]) -> str:
         w.sample(job.get("queue_wait_s"), **labels)
         r.sample(job.get("run_s"), **labels)
         a.sample(job.get("attempts"), **labels)
+
+    pool = snap.get("pool")
+    if pool:
+        g = _export._Family(out, "m4t_pool_size", "gauge",
+                            "Resident worker slots the pool was "
+                            "started with.")
+        g.sample(pool.get("size"))
+        g = _export._Family(out, "m4t_pool_capacity", "gauge",
+                            "Slots not permanently retired by "
+                            "preemption.")
+        g.sample(pool.get("capacity"))
+        alive = _export._Family(out, "m4t_pool_worker_alive", "gauge",
+                                "1 while the worker is idle or busy "
+                                "(0: starting, quarantined, or "
+                                "retired).")
+        served = _export._Family(out, "m4t_pool_worker_jobs_served",
+                                 "gauge",
+                                 "Work items this worker slot has "
+                                 "completed (across incarnations).")
+        inc = _export._Family(out, "m4t_pool_worker_incarnation",
+                              "gauge",
+                              "Respawn generation of the slot's "
+                              "current process.")
+        age = _export._Family(out, "m4t_pool_worker_last_heartbeat_age",
+                              "gauge",
+                              "Seconds since the worker's last "
+                              "heartbeat record.")
+        ages = pool.get("heartbeat_age_s", {})
+        for worker in pool.get("workers", []):
+            labels = {"worker": str(worker.get("rank"))}
+            alive.sample(
+                1 if worker.get("state") in ("idle", "busy") else 0,
+                **labels,
+            )
+            served.sample(worker.get("jobs_served"), **labels)
+            inc.sample(worker.get("incarnation"), **labels)
+            age.sample(ages.get(str(worker.get("rank"))), **labels)
+        counters = pool.get("counters", {})
+        c = _export._Family(out, "m4t_pool_quarantines_total",
+                            "counter",
+                            "Worker quarantines by reason (wedged, "
+                            "exited, hygiene, job_timeout, "
+                            "peer_lost, start_timeout).")
+        for reason, n in sorted(
+            (counters.get("quarantines") or {}).items()
+        ):
+            c.sample(n, reason=reason)
+        c = _export._Family(out, "m4t_pool_respawns_total", "counter",
+                            "Fresh worker incarnations spawned after "
+                            "quarantines.")
+        c.sample(counters.get("respawns", 0))
+        c = _export._Family(out, "m4t_pool_retired_total", "counter",
+                            "Slots permanently lost to preemption "
+                            "(elastic).")
+        c.sample(counters.get("retired", 0))
+        c = _export._Family(out, "m4t_pool_poisoned_total", "counter",
+                            "Jobs poisoned by the two-strikes rule.")
+        c.sample(counters.get("poisoned", 0))
 
     out.append("# EOF")
     return "\n".join(out) + "\n"

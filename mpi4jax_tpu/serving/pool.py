@@ -1,0 +1,1143 @@
+"""Self-healing resident worker pool: warm serving that survives
+wedged, crashed, and leaky workers.
+
+PR 10's serving plane spawns a cold world per job, so every job pays
+python + jax import and compile latency, and a dead rank costs a
+whole-world teardown. This module keeps a pool of **resident
+workers** — rank processes spawned once through the launcher's
+``rank_env`` seam — that loop on a per-worker filesystem mailbox and
+execute job payloads *in-process*: imports stay imported, compile and
+plan caches stay warm (``M4T_PLAN_CACHE`` arms once at worker start
+and routes every subsequent job), and dispatching a job costs one
+fsync'd file rename instead of a world spawn.
+
+A pool that lives for hours is a robustness problem first, so the
+core of this module is the **pool doctor**:
+
+- **Heartbeats** — every worker runs the library heartbeat daemon
+  (``observability/events.start_heartbeat``) into its own per-worker
+  sink (``POOL/events-rank<k>.jsonl``); the controller tails the
+  sinks with the live plane's machinery
+  (``observability/live.HeartbeatTail`` over ``TailReader`` —
+  torn-line and rotation safe, bounded memory). Freshness is arrival
+  time, so a respawned worker can never look alive on its dead
+  predecessor's heartbeats.
+- **Quarantine + respawn** — a worker that exits, misses its
+  heartbeat deadline (``wedged`` — the failure shape the ``wedge``
+  fault action reproduces deterministically: no emissions, no
+  heartbeats, no exit), overruns its job's ``timeout_s``
+  (``job_timeout``), or fails the post-job **hygiene check** is
+  quarantined (killed, audited) and respawned as a fresh incarnation
+  appending to the same sink. In-flight jobs on the worker's
+  sub-mesh fail that attempt — their peers are respawned too
+  (``peer_lost``: a gang member may be blocked on the dead rank) —
+  and retry under their existing per-job
+  :class:`~..resilience.supervisor.Supervisor`.
+- **Hygiene check** — after every payload the worker proves it left
+  no state for the next job to trip over: the telemetry registry is
+  reset, leaked point-to-point sends are drained
+  (``token.drain_pending_sends`` — a payload that left one is
+  reported, not inherited), the fault-plan arming is unscoped
+  (``faults.disarm``; a plan the *payload* armed is a violation),
+  and the job's environment overlay is rolled back (new ``M4T_*``
+  keys a payload exported are named as bleed). An unclean worker
+  still returns its job's result — then gets quarantined, because a
+  respawn is the only state reset that proves anything.
+- **Poisoned jobs (two strikes)** — a job whose attempts *wedge* its
+  workers twice (``wedged`` / ``job_timeout`` quarantines) is marked
+  **poisoned**: further dispatch is refused and the job fails with
+  ``reason: "poisoned"`` on ``serving.jsonl`` (via the supervisor's
+  ``abort_fn`` veto), so one bad program degrades to one failed job,
+  never to a pool that wedges two workers per retry forever.
+- **Elastic capacity loss** — a worker that exits with the
+  preemption signature (143 / SIGTERM) under ``elastic=True`` is
+  *retired*, not respawned: pool capacity shrinks permanently and
+  the in-flight job goes through the PR 9/10 reshard path in
+  ``server.py`` (checkpoint resharded to the smaller sub-mesh).
+
+**Sub-mesh packing** — a job asking for ``k`` ranks is dispatched to
+``k`` idle workers; the packing is expressed as a
+:class:`~..comm.GroupComm` partition of the pool (the job's workers
+as one group, everyone else singleton), serialized into the work
+item, and rebuilt inside the payload via :func:`job_comm` so job code
+can run collectives over exactly its sub-mesh. ``server.py`` gates
+each job's ``--verify`` proof at the *sub-mesh* world, and packs
+concurrent jobs onto disjoint groups. By default workers are spawned
+**un-meshed** (``rank_env(mesh=False)``: rank identity without shm
+segment coordinates) so a single worker can be killed and respawned
+without wedging segment peers; ``mesh=True`` spawns the pool as one
+resident shm world for payloads that need real cross-worker
+collectives.
+
+Mailbox protocol (``m4t-work/1``), all writes tmp+fsync+rename (the
+``ckpt.py`` idiom — an item/result either exists whole or not at
+all)::
+
+    POOL/
+      pool.json                    # atomic controller state snapshot
+      events-rank<k>.jsonl         # per-worker sink (heartbeats, pool
+                                   #   lifecycle, payload emissions)
+      worker<k>/
+        inbox/<ns>-<item>.json     # work items, FIFO by filename
+        current.json               # the claimed item (crash evidence)
+        outbox/<item>.json         # results (m4t-result/1)
+        STOP                       # drain sentinel: exit the loop
+
+Worker entry point: ``python -m mpi4jax_tpu.serving.pool POOL --rank
+K`` (spawned by :class:`WorkerPool`; runnable by hand for debugging).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+WORK_SCHEMA = "m4t-work/1"
+RESULT_SCHEMA = "m4t-result/1"
+POOL_SCHEMA = "m4t-pool/1"
+
+STATE_NAME = "pool.json"
+STOP_SENTINEL = "STOP"
+INBOX_DIR = "inbox"
+OUTBOX_DIR = "outbox"
+
+#: rank exit signatures that read "preemption honored" (launch.py's)
+_PREEMPT_RCS = (143, -signal.SIGTERM)
+
+#: quarantine reasons that count as the job *wedging* its workers —
+#: the strikes behind the poisoned-job rule. A plain worker crash
+#: (``exited``) is the per-job retry budget's problem; a wedge
+#: occupies workers until a deadline names it, which is what must not
+#: be allowed to repeat indefinitely.
+STRIKE_REASONS = frozenset({"wedged", "job_timeout"})
+
+#: default quarantine policy knobs
+DEFAULT_HEARTBEAT_S = 0.5
+DEFAULT_MAX_STRIKES = 2
+
+
+def _write_json_atomic(path: str, obj: Any) -> str:
+    """The spool/ckpt idiom: whole file or no file."""
+    tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1, default=str)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+# ---------------------------------------------------------------------
+# worker side: the resident loop
+# ---------------------------------------------------------------------
+
+
+def worker_dir(root: str, rank: int) -> str:
+    return os.path.join(os.fspath(root), f"worker{rank}")
+
+
+def worker_sink(root: str, rank: int) -> str:
+    return os.path.join(os.fspath(root), f"events-rank{rank}.jsonl")
+
+
+def job_comm():
+    """The :class:`~..comm.GroupComm` for the current work item's
+    sub-mesh, or None outside a pool job. Payload helper: the job's
+    workers form one group (the payload's collectives stay inside its
+    sub-mesh), every other pool rank is a singleton."""
+    raw = os.environ.get("M4T_POOL_GROUP", "")
+    if not raw:
+        return None
+    info = json.loads(raw)
+    ranks = [int(r) for r in info.get("ranks", [])]
+    world = int(info.get("world", len(ranks)))
+    members = set(ranks)
+    groups = (tuple(ranks),) + tuple(
+        (r,) for r in range(world) if r not in members
+    )
+    from ..comm import GroupComm
+
+    return GroupComm(groups)
+
+
+def job_group_rank() -> Optional[int]:
+    """This worker's rank *within its job's sub-mesh* (None outside a
+    pool job)."""
+    raw = os.environ.get("M4T_POOL_GROUP", "")
+    if not raw:
+        return None
+    return int(json.loads(raw).get("rank", 0))
+
+
+def _exec_payload(item: Dict[str, Any]) -> None:
+    """Run the job payload in-process — the whole point of the warm
+    pool: ``sys.modules`` (jax included) and every compile cache the
+    process accumulated stay hot across jobs."""
+    import runpy
+
+    module = item.get("module")
+    cmd = list(item.get("cmd") or [])
+    if module:
+        sys.argv = [module] + cmd
+        runpy.run_module(module, run_name="__main__", alter_sys=True)
+        return
+    if not cmd:
+        raise ValueError("work item has neither 'module' nor 'cmd'")
+    if cmd[0] == "-c":
+        code = cmd[1] if len(cmd) > 1 else ""
+        sys.argv = ["-c"] + cmd[2:]
+        exec(compile(code, "<m4t-work-item>", "exec"),
+             {"__name__": "__main__"})
+        return
+    sys.argv = list(cmd)
+    runpy.run_path(cmd[0], run_name="__main__")
+
+
+def hygiene_sweep(
+    saved_env: Dict[str, str],
+    *,
+    had_plan: bool = False,
+    applied_keys: Optional[set] = None,
+) -> Dict[str, Any]:
+    """The post-job state-bleed check, and the cleanup it verifies.
+
+    Contract (``docs/serving.md``): after a payload returns, the
+    worker must look like it never ran it — telemetry registry reset,
+    no pending point-to-point sends, no fault plan armed, no new
+    ``M4T_*`` environment. Each violation is *repaired* (drained /
+    disarmed / rolled back) **and reported**: repair protects the next
+    job if the controller is gone, the report gets this worker
+    quarantined so the repair is never silently trusted.
+    """
+    report: Dict[str, Any] = {"clean": True}
+    applied = applied_keys or set()
+
+    # leaked point-to-point sends: a payload that traced a send with
+    # no matching recv left poison for the next trace
+    try:
+        from .. import token
+
+        leaks = token.drain_pending_sends()
+        n = sum(len(rs) for _, rs in leaks)
+        report["pending_sends"] = n
+        if n:
+            report["clean"] = False
+    except Exception:
+        report["pending_sends"] = None
+
+    # fault-plan arming must not outlive the job that declared it;
+    # a plan the *payload* armed itself is a violation either way
+    try:
+        from ..resilience import faults
+
+        armed = faults.active_plan is not None
+        faults.disarm()
+        report["fault_armed"] = bool(armed and not had_plan)
+        if report["fault_armed"]:
+            report["clean"] = False
+    except Exception:
+        report["fault_armed"] = None
+
+    # roll back the job's environment overlay; any *other* M4T_ key
+    # the payload exported is named as bleed
+    bleed = sorted(
+        k for k in os.environ
+        if k not in saved_env and k.startswith("M4T_")
+        and k not in applied
+    )
+    os.environ.clear()
+    os.environ.update(saved_env)
+    report["env_bleed"] = bleed
+    if bleed:
+        report["clean"] = False
+
+    # per-job telemetry counters: the next job starts at zero
+    try:
+        from ..observability import metrics
+
+        metrics.reset()
+        report["metrics_reset"] = True
+    except Exception:
+        report["metrics_reset"] = False
+        report["clean"] = False
+    return report
+
+
+def run_item(
+    item: Dict[str, Any], *, worker: int = 0, incarnation: int = 0
+) -> Dict[str, Any]:
+    """Execute one work item and return its ``m4t-result/1`` record
+    (rc + error + hygiene report). Never raises: the worker loop must
+    survive any payload."""
+    t0 = time.monotonic()
+    saved_env = dict(os.environ)
+    saved_argv = list(sys.argv)
+    group = item.get("group") or {}
+    overlay: Dict[str, str] = {
+        str(k): str(v) for k, v in (item.get("env") or {}).items()
+    }
+    if item.get("job"):
+        overlay["M4T_JOB_ID"] = str(item["job"])
+    if group:
+        overlay["M4T_POOL_GROUP"] = json.dumps(group)
+    if item.get("resume_step") is not None:
+        overlay["M4T_RESUME_STEP"] = str(item["resume_step"])
+    os.environ.update(overlay)
+
+    rc, err = 0, None
+    plan_spec = item.get("fault_plan")
+    had_plan = plan_spec is not None
+    if had_plan:
+        try:
+            from ..resilience import faults
+
+            plan = (
+                faults.FaultPlan.load(plan_spec)
+                if isinstance(plan_spec, str)
+                else faults.FaultPlan.parse(plan_spec)
+            )
+            faults.arm(
+                plan,
+                rank=int(group.get("rank", 0)),
+                attempt=int(item.get("attempt", 0)),
+            )
+        except Exception as exc:
+            rc, err = 2, f"fault plan failed to arm: {exc!r}"
+    if rc == 0:
+        try:
+            _exec_payload(item)
+        except SystemExit as exc:
+            code = exc.code
+            if code in (None, 0):
+                rc = 0
+            else:
+                rc = code if isinstance(code, int) else 1
+                err = f"SystemExit({code!r})"
+        except BaseException as exc:  # noqa: BLE001 — worker survives all
+            rc, err = 1, repr(exc)
+    hygiene = hygiene_sweep(
+        saved_env, had_plan=had_plan, applied_keys=set(overlay)
+    )
+    sys.argv = saved_argv
+    return {
+        "schema": RESULT_SCHEMA,
+        "item": item.get("item"),
+        "job": item.get("job"),
+        "attempt": item.get("attempt", 0),
+        "rc": rc,
+        "error": err,
+        "elapsed_s": round(time.monotonic() - t0, 6),
+        "hygiene": hygiene,
+        "worker": worker,
+        "incarnation": incarnation,
+    }
+
+
+def _oldest_entry(inbox: str) -> Optional[str]:
+    try:
+        names = [
+            n for n in os.listdir(inbox)
+            if n.endswith(".json") and not n.startswith(".tmp-")
+        ]
+    except OSError:
+        return None
+    return min(names) if names else None
+
+
+def worker_loop(
+    root: str,
+    rank: int,
+    *,
+    incarnation: int = 0,
+    heartbeat_s: float = DEFAULT_HEARTBEAT_S,
+    poll_s: float = 0.02,
+) -> int:
+    """The resident loop one pool worker runs until its STOP sentinel
+    appears: heartbeat, claim the oldest inbox item, execute it
+    in-process, write the result, sweep hygiene, repeat."""
+    from ..observability import events
+
+    wdir = worker_dir(root, rank)
+    inbox = os.path.join(wdir, INBOX_DIR)
+    outbox = os.path.join(wdir, OUTBOX_DIR)
+    for d in (inbox, outbox):
+        os.makedirs(d, exist_ok=True)
+    stop_path = os.path.join(wdir, STOP_SENTINEL)
+    current = os.path.join(wdir, "current.json")
+
+    # the library heartbeat daemon into this worker's sink — the pool
+    # doctor's liveness signal. Restarted after every job because a
+    # payload may have replaced it (start_heartbeat is idempotent) or
+    # silenced it (the wedge shape never returns here anyway).
+    events.start_heartbeat(heartbeat_s, source="pool-worker")
+    events.emit(events.event(
+        "pool", event="worker_start", worker=rank,
+        incarnation=incarnation, pid=os.getpid(), t=time.time(),
+    ))
+    served = 0
+    while True:
+        if os.path.exists(stop_path):
+            events.emit(events.event(
+                "pool", event="worker_stop", worker=rank,
+                incarnation=incarnation, jobs=served, t=time.time(),
+            ))
+            return 0
+        name = _oldest_entry(inbox)
+        if name is None:
+            time.sleep(poll_s)
+            continue
+        try:
+            os.replace(os.path.join(inbox, name), current)
+        except OSError:
+            continue  # swept by a respawn mid-claim
+        try:
+            with open(current) as f:
+                item = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            item = None
+        if not isinstance(item, dict) or item.get("schema") != WORK_SCHEMA:
+            try:
+                os.unlink(current)
+            except OSError:
+                pass
+            continue
+        events.emit(events.event(
+            "pool", event="job_start", worker=rank,
+            job=item.get("job"), item=item.get("item"),
+            attempt=item.get("attempt", 0), t=time.time(),
+        ))
+        result = run_item(item, worker=rank, incarnation=incarnation)
+        served += 1
+        _write_json_atomic(
+            os.path.join(outbox, f"{item.get('item')}.json"), result
+        )
+        try:
+            os.unlink(current)
+        except OSError:
+            pass
+        events.emit(events.event(
+            "pool", event="job_done", worker=rank, job=item.get("job"),
+            item=item.get("item"), rc=result["rc"],
+            clean=result["hygiene"].get("clean"),
+            elapsed_s=result["elapsed_s"], t=time.time(),
+        ))
+        events.start_heartbeat(heartbeat_s, source="pool-worker")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi4jax_tpu.serving.pool",
+        description="resident pool worker (spawned by WorkerPool)",
+    )
+    parser.add_argument("root")
+    parser.add_argument("--rank", type=int, required=True)
+    parser.add_argument("--incarnation", type=int, default=0)
+    parser.add_argument("--heartbeat", type=float,
+                        default=DEFAULT_HEARTBEAT_S)
+    parser.add_argument("--poll", type=float, default=0.02)
+    args = parser.parse_args(argv)
+
+    # the warm import: everything a payload needs is resident before
+    # the first work item arrives (and the shm world is joined here
+    # when the pool was spawned meshed)
+    import mpi4jax_tpu  # noqa: F401
+
+    return worker_loop(
+        args.root, args.rank,
+        incarnation=args.incarnation,
+        heartbeat_s=args.heartbeat,
+        poll_s=args.poll,
+    )
+
+
+# ---------------------------------------------------------------------
+# controller side: spawn, dispatch, doctor
+# ---------------------------------------------------------------------
+
+
+@dataclass
+class PoolWorker:
+    """Controller-side view of one worker slot."""
+
+    rank: int
+    state: str = "starting"  # starting|idle|busy|quarantined|retired
+    handle: Any = None
+    incarnation: int = 0
+    jobs_served: int = 0
+    quarantines: int = 0
+    job: Optional[str] = None
+    item: Optional[str] = None
+    group_rank: Optional[int] = None
+    spawned_t: float = 0.0
+    last_rc: Optional[int] = None
+
+
+class _Dispatch:
+    """In-flight gang state for one job attempt."""
+
+    def __init__(self, job: str, attempt: int, workers: List[PoolWorker]):
+        self.job = job
+        self.attempt = attempt
+        self.workers = list(workers)
+        self.results: Dict[int, Dict[str, Any]] = {}  # group rank ->
+        self.failed: Optional[str] = None
+        self.failed_rc: Optional[int] = None
+        self.preempted: List[int] = []  # group ranks
+        self.struck = False
+
+    def group_index(self, pool_rank: int) -> int:
+        for i, w in enumerate(self.workers):
+            if w.rank == pool_rank:
+                return i
+        return -1
+
+
+class WorkerPool:
+    """Spawn, feed, watch, and heal a set of resident workers.
+
+    ``spawn_fn(pool, worker) -> handle`` is the injectable seam that
+    makes the whole controller device-free-testable (the selftest and
+    most tests drive it with stubs and never fork a worker); a handle
+    needs ``poll() -> rc|None``, ``terminate()``, ``kill()`` and may
+    carry ``pid``. The default spawns ``python -m
+    mpi4jax_tpu.serving.pool`` with an environment built by
+    ``launch.rank_env`` — the same seam every other world in this
+    repo is spawned through.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        size: int,
+        *,
+        spawn_fn: Optional[Callable[["WorkerPool", PoolWorker], Any]] = None,
+        heartbeat_s: float = DEFAULT_HEARTBEAT_S,
+        deadline_s: Optional[float] = None,
+        start_deadline_s: Optional[float] = None,
+        check_s: float = 0.05,
+        poll_s: float = 0.01,
+        acquire_timeout_s: float = 60.0,
+        mesh: bool = False,
+        plan_cache: Optional[str] = None,
+        elastic: bool = False,
+        max_strikes: int = DEFAULT_MAX_STRIKES,
+        audit: Optional[Callable[..., None]] = None,
+        log: Optional[Callable[[str], None]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if size < 1:
+            raise ValueError("pool needs size >= 1")
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.size = int(size)
+        self.heartbeat_s = float(heartbeat_s)
+        #: quarantine deadline: this long without a *fresh* heartbeat
+        #: means wedged (several missed beats, never a close call)
+        self.deadline_s = (
+            float(deadline_s) if deadline_s is not None
+            else max(6.0 * self.heartbeat_s, 3.0)
+        )
+        #: a starting worker pays a cold import before its first beat
+        self.start_deadline_s = (
+            float(start_deadline_s) if start_deadline_s is not None
+            else max(self.deadline_s, 30.0)
+        )
+        self.check_s = float(check_s)
+        self.poll_s = float(poll_s)
+        self.acquire_timeout_s = float(acquire_timeout_s)
+        self.mesh = bool(mesh)
+        self.plan_cache = plan_cache
+        self.elastic = bool(elastic)
+        self.max_strikes = int(max_strikes)
+        self._audit_fn = audit
+        self._log = log or (lambda msg: sys.stderr.write(
+            f"m4t.pool: {msg}\n"
+        ))
+        self.clock = clock
+        self._spawn_fn = spawn_fn or WorkerPool._default_spawn
+        self.workers = [PoolWorker(rank=r) for r in range(self.size)]
+        self.counters: Dict[str, Any] = {
+            "quarantines": {}, "respawns": 0, "retired": 0,
+            "dispatched": 0, "poisoned": 0,
+        }
+        self._strikes: Dict[str, int] = {}
+        self._poisoned: set = set()
+        self._dispatches: Dict[str, _Dispatch] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._dirty = True
+        from ..observability import live as _live
+
+        self._tails = {
+            w.rank: _live.HeartbeatTail(
+                worker_sink(self.root, w.rank), clock=clock
+            )
+            for w in self.workers
+        }
+        import random
+        import uuid
+
+        self._shm_name = f"/m4t_pool_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+        self._shm_gen = random.getrandbits(32) | 1
+
+    # -- audit / state -------------------------------------------------
+
+    def _audit(self, event: str, **fields: Any) -> None:
+        if self._audit_fn is not None:
+            try:
+                self._audit_fn(event, **fields)
+            except Exception:
+                pass
+
+    def _write_state(self, force: bool = False) -> None:
+        with self._lock:
+            if not (self._dirty or force):
+                return
+            self._dirty = False
+            state = {
+                "schema": POOL_SCHEMA,
+                "t": time.time(),
+                "size": self.size,
+                "capacity": self.capacity(),
+                "mesh": self.mesh,
+                "heartbeat_s": self.heartbeat_s,
+                "deadline_s": self.deadline_s,
+                "counters": {
+                    "quarantines": dict(self.counters["quarantines"]),
+                    "respawns": self.counters["respawns"],
+                    "retired": self.counters["retired"],
+                    "dispatched": self.counters["dispatched"],
+                    "poisoned": self.counters["poisoned"],
+                },
+                "poisoned_jobs": sorted(self._poisoned),
+                "workers": [
+                    {
+                        "rank": w.rank,
+                        "state": w.state,
+                        "incarnation": w.incarnation,
+                        "jobs_served": w.jobs_served,
+                        "quarantines": w.quarantines,
+                        "job": w.job,
+                        "pid": getattr(w.handle, "pid", None),
+                        "last_rc": w.last_rc,
+                    }
+                    for w in self.workers
+                ],
+            }
+        try:
+            _write_json_atomic(
+                os.path.join(self.root, STATE_NAME), state
+            )
+        except OSError:
+            pass  # state snapshots must never take the pool down
+
+    # -- spawning ------------------------------------------------------
+
+    @staticmethod
+    def _default_spawn(pool: "WorkerPool", worker: PoolWorker):
+        from .. import launch
+
+        env = launch.rank_env(
+            worker.rank, pool.size,
+            shm_name=pool._shm_name,
+            shm_gen=pool._shm_gen,
+            events_dir=pool.root,
+            heartbeat=pool.heartbeat_s,
+            plan_cache=pool.plan_cache,
+            mesh=pool.mesh,
+            # a resident sink must not grow without bound; the tailers
+            # are rotation-transparent
+            extra_env={"M4T_TELEMETRY_MAX_MB": "8"},
+        )
+        cmd = [
+            sys.executable, "-m", "mpi4jax_tpu.serving.pool",
+            pool.root,
+            "--rank", str(worker.rank),
+            "--incarnation", str(worker.incarnation),
+            "--heartbeat", str(pool.heartbeat_s),
+            "--poll", str(pool.poll_s),
+        ]
+        return subprocess.Popen(cmd, env=env)
+
+    def _clean_mailbox(self, worker: PoolWorker) -> None:
+        wdir = worker_dir(self.root, worker.rank)
+        for sub in (INBOX_DIR, OUTBOX_DIR):
+            d = os.path.join(wdir, sub)
+            os.makedirs(d, exist_ok=True)
+            try:
+                names = os.listdir(d)
+            except OSError:
+                names = []
+            for name in names:
+                try:
+                    os.unlink(os.path.join(d, name))
+                except OSError:
+                    pass
+        for name in (STOP_SENTINEL, "current.json"):
+            try:
+                os.unlink(os.path.join(wdir, name))
+            except OSError:
+                pass
+
+    def _spawn(self, worker: PoolWorker) -> None:
+        with self._lock:
+            worker.incarnation += 1
+            self._clean_mailbox(worker)
+            worker.state = "starting"
+            worker.job = None
+            worker.item = None
+            worker.group_rank = None
+            worker.spawned_t = self.clock()
+            worker.handle = self._spawn_fn(self, worker)
+            self._dirty = True
+
+    def start(self, *, doctor: bool = True) -> "WorkerPool":
+        """Spawn every worker; with ``doctor=True`` also start the
+        health-check thread (tests drive :meth:`check` by hand)."""
+        self._audit(
+            "pool_start", size=self.size, mesh=self.mesh,
+            heartbeat_s=self.heartbeat_s, deadline_s=self.deadline_s,
+            elastic=self.elastic,
+        )
+        self._log(
+            f"starting {self.size} resident worker(s) in {self.root}"
+            + (" (meshed)" if self.mesh else "")
+        )
+        for w in self.workers:
+            self._spawn(w)
+        if doctor:
+            self._thread = threading.Thread(
+                target=self._doctor_loop, name="m4t-pool-doctor",
+                daemon=True,
+            )
+            self._thread.start()
+        self._write_state(force=True)
+        return self
+
+    @staticmethod
+    def _end_handle(handle: Any) -> None:
+        for meth in ("terminate", "kill"):
+            try:
+                getattr(handle, meth)()
+            except Exception:
+                pass
+        try:
+            handle.wait(timeout=5.0)
+        except Exception:
+            pass
+
+    def stop(self, *, grace_s: float = 5.0) -> None:
+        """Drain the pool: STOP sentinels, a grace window for clean
+        exits, then terminate/kill stragglers."""
+        self._stop.set()
+        with self._lock:
+            workers = list(self.workers)
+        for w in workers:
+            try:
+                with open(os.path.join(
+                    worker_dir(self.root, w.rank), STOP_SENTINEL
+                ), "w") as f:
+                    f.write("pool stop\n")
+            except OSError:
+                pass
+        deadline = self.clock() + grace_s
+        while self.clock() < deadline:
+            if all(
+                w.handle is None or w.handle.poll() is not None
+                for w in workers
+            ):
+                break
+            time.sleep(0.02)
+        for w in workers:
+            if w.handle is not None and w.handle.poll() is None:
+                self._end_handle(w.handle)
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        self._audit(
+            "pool_stop",
+            jobs=sum(w.jobs_served for w in workers),
+            respawns=self.counters["respawns"],
+        )
+        self._dirty = True
+        self._write_state(force=True)
+
+    # -- health --------------------------------------------------------
+
+    def capacity(self) -> int:
+        """Worker slots not permanently retired by preemption."""
+        return sum(1 for w in self.workers if w.state != "retired")
+
+    def idle_count(self) -> int:
+        with self._lock:
+            return sum(1 for w in self.workers if w.state == "idle")
+
+    def poisoned(self, job_id: str) -> bool:
+        with self._lock:
+            return job_id in self._poisoned
+
+    def strikes(self, job_id: str) -> int:
+        with self._lock:
+            return self._strikes.get(job_id, 0)
+
+    def _doctor_loop(self) -> None:
+        while not self._stop.wait(self.check_s):
+            try:
+                self.check()
+            except Exception as exc:  # pragma: no cover — must not die
+                self._log(f"doctor check failed: {exc!r}")
+        self._write_state()
+
+    def check(self) -> None:
+        """One pool-doctor pass: reap exits, enforce heartbeat
+        deadlines, flip started workers to idle. Called continuously
+        by the doctor thread and by every in-flight dispatch wait (so
+        single-threaded tests are deterministic)."""
+        with self._lock:
+            for tail in self._tails.values():
+                tail.poll()
+            now = self.clock()
+            for w in self.workers:
+                if w.state in ("quarantined", "retired"):
+                    continue
+                if w.handle is None:
+                    continue
+                try:
+                    rc = w.handle.poll()
+                except Exception:
+                    rc = None
+                if rc is not None:
+                    w.last_rc = rc
+                    if rc == 0 and self._stop.is_set():
+                        continue  # clean drain exit
+                    if self.elastic and rc in _PREEMPT_RCS:
+                        self._retire(w, rc)
+                    else:
+                        self._quarantine(w, "exited", rc=rc)
+                    continue
+                tail = self._tails[w.rank]
+                beat = tail.last_heartbeat_t
+                fresh = beat is not None and beat >= w.spawned_t
+                if w.state == "starting":
+                    if fresh:
+                        w.state = "idle"
+                        self._dirty = True
+                        self._log(
+                            f"worker {w.rank} ready (incarnation "
+                            f"{w.incarnation})"
+                        )
+                    elif now - w.spawned_t > self.start_deadline_s:
+                        self._quarantine(w, "start_timeout")
+                    continue
+                ref = beat if fresh else w.spawned_t
+                if now - ref > self.deadline_s:
+                    self._quarantine(w, "wedged")
+        self._write_state()
+
+    def _retire(self, worker: PoolWorker, rc: int) -> None:
+        """Preemption under ``elastic``: the slot is capacity lost,
+        not a bug — never respawned. The in-flight job's attempt
+        fails with the preempted group rank on record so the server's
+        reshard path can shrink it."""
+        worker.quarantines += 1
+        self.counters["retired"] += 1
+        self._dirty = True
+        job = worker.job
+        self._audit(
+            "pool_retired", worker=worker.rank, rc=rc, job=job,
+            incarnation=worker.incarnation, capacity=self.capacity() - 1,
+        )
+        self._log(
+            f"worker {worker.rank} preempted (rc {rc}); retiring the "
+            f"slot — pool capacity {self.capacity() - 1}"
+        )
+        worker.state = "retired"
+        worker.handle = None
+        if job:
+            self._fail_dispatch(job, "preempted", worker, rc=rc)
+
+    def _quarantine(
+        self, worker: PoolWorker, reason: str, rc: Optional[int] = None
+    ) -> None:
+        """Kill + audit + respawn one worker; fail its in-flight
+        dispatch (and respawn the gang peers the dead rank may have
+        wedged)."""
+        worker.quarantines += 1
+        q = self.counters["quarantines"]
+        q[reason] = q.get(reason, 0) + 1
+        self._dirty = True
+        job = worker.job
+        self._audit(
+            "pool_quarantine", worker=worker.rank, reason=reason,
+            rc=rc, job=job, incarnation=worker.incarnation,
+        )
+        self._log(
+            f"worker {worker.rank} quarantined ({reason}"
+            + (f", rc {rc}" if rc is not None else "")
+            + (f", job {job}" if job else "") + ")"
+        )
+        if worker.handle is not None:
+            self._end_handle(worker.handle)
+            worker.handle = None
+        worker.state = "quarantined"
+        if job:
+            self._fail_dispatch(job, reason, worker, rc=rc)
+        if not self._stop.is_set():
+            self._spawn(worker)
+            self.counters["respawns"] += 1
+            self._audit(
+                "pool_respawn", worker=worker.rank,
+                incarnation=worker.incarnation,
+            )
+
+    def _fail_dispatch(
+        self,
+        job: str,
+        reason: str,
+        worker: PoolWorker,
+        rc: Optional[int] = None,
+    ) -> None:
+        d = self._dispatches.get(job)
+        if d is None:
+            return
+        idx = d.group_index(worker.rank)
+        if rc is not None and rc in _PREEMPT_RCS and idx >= 0:
+            if idx not in d.preempted:
+                d.preempted.append(idx)
+        already_failing = d.failed is not None
+        if not already_failing:
+            d.failed = reason
+            d.failed_rc = rc
+        if reason in STRIKE_REASONS and not d.struck:
+            # one strike per attempt, however many workers it wedged
+            d.struck = True
+            n = self._strikes.get(job, 0) + 1
+            self._strikes[job] = n
+            self._audit(
+                "pool_strike", job=job, strikes=n,
+                max_strikes=self.max_strikes, reason=reason,
+            )
+            if n >= self.max_strikes and job not in self._poisoned:
+                self._poisoned.add(job)
+                self.counters["poisoned"] += 1
+                self._audit(
+                    "pool_poisoned", job=job, strikes=n,
+                    reason=reason,
+                )
+                self._log(
+                    f"job {job} poisoned after {n} wedged attempt(s); "
+                    "further dispatch refused"
+                )
+        if not already_failing:
+            # a gang member may be blocked on the lost rank forever;
+            # fresh incarnations are the only safe retry substrate
+            for peer in list(d.workers):
+                if peer is worker:
+                    continue
+                if peer.state == "busy" and peer.job == job:
+                    self._quarantine(peer, "peer_lost")
+
+    # -- dispatch ------------------------------------------------------
+
+    def _acquire(
+        self, world: int, job: str
+    ) -> Optional[List[PoolWorker]]:
+        deadline = self.clock() + self.acquire_timeout_s
+        while True:
+            with self._lock:
+                if job in self._poisoned:
+                    return None
+                if self.capacity() < world:
+                    self._audit(
+                        "pool_refused", job=job, reason="capacity",
+                        capacity=self.capacity(), world=world,
+                    )
+                    return None
+                idle = [w for w in self.workers if w.state == "idle"]
+                if len(idle) >= world:
+                    chosen = idle[:world]
+                    for i, w in enumerate(chosen):
+                        w.state = "busy"
+                        w.job = job
+                        w.group_rank = i
+                    self._dirty = True
+                    return chosen
+            if self.clock() > deadline:
+                self._audit(
+                    "pool_refused", job=job, reason="busy_timeout",
+                    world=world,
+                )
+                return None
+            self.check()
+            time.sleep(self.check_s)
+
+    def _write_item(
+        self, worker: PoolWorker, item: Dict[str, Any]
+    ) -> None:
+        inbox = os.path.join(
+            worker_dir(self.root, worker.rank), INBOX_DIR
+        )
+        os.makedirs(inbox, exist_ok=True)
+        name = f"{time.time_ns():020d}-{item['item']}.json"
+        _write_json_atomic(os.path.join(inbox, name), item)
+
+    def _timeout_job(self, job: str) -> None:
+        with self._lock:
+            d = self._dispatches.get(job)
+            if d is None or d.failed is not None:
+                return
+            busy = [
+                w for w in d.workers
+                if w.state == "busy" and w.job == job
+            ]
+            for w in busy:
+                self._quarantine(w, "job_timeout")
+
+    def runner(
+        self,
+        spec: Any,
+        world: int,
+        events_dir: Optional[str],
+        attempt: int,
+        resume_step: Optional[int],
+    ) -> Any:
+        """The serving plane's ``Runner`` contract, warm: dispatch
+        ``spec`` to ``world`` idle workers as work items and wait for
+        the gang's results (or for the doctor to fail the attempt).
+        Returns ``(exit_code, preempted_group_ranks)`` exactly like
+        ``launch.spawn_world``."""
+        job = str(spec.id)
+        if self.poisoned(job):
+            self._audit("pool_refused", job=job, reason="poisoned")
+            self._log(f"job {job}: dispatch refused (poisoned)")
+            return 1, []
+        workers = self._acquire(int(world), job)
+        if workers is None:
+            return 1, []
+        d = _Dispatch(job, attempt, workers)
+        with self._lock:
+            self._dispatches[job] = d
+            self.counters["dispatched"] += 1
+            self._dirty = True
+        ranks = [w.rank for w in workers]
+        # the sub-mesh this job packs onto, validated as a real
+        # GroupComm partition of the pool (job group + singletons)
+        from ..comm import GroupComm
+
+        members = set(ranks)
+        GroupComm(
+            (tuple(ranks),) + tuple(
+                (r,) for r in range(self.size) if r not in members
+            )
+        )
+        self._audit(
+            "pool_dispatch", job=job, attempt=attempt, world=world,
+            workers=ranks,
+        )
+        for i, w in enumerate(workers):
+            item_id = f"{job}.a{attempt:02d}.g{i:02d}"
+            w.item = item_id
+            self._write_item(w, {
+                "schema": WORK_SCHEMA,
+                "item": item_id,
+                "job": job,
+                "attempt": attempt,
+                "cmd": list(spec.cmd) if spec.cmd else None,
+                "module": spec.module,
+                "env": dict(spec.env) if spec.env else None,
+                "fault_plan": spec.fault_plan,
+                "resume_step": resume_step,
+                "events_dir": events_dir,
+                "timeout_s": spec.timeout_s,
+                "group": {
+                    "ranks": ranks, "rank": i, "size": len(ranks),
+                    "world": self.size,
+                },
+            })
+        timeout = float(getattr(spec, "timeout_s", 0.0) or 0.0)
+        deadline = self.clock() + timeout if timeout > 0 else None
+        rc: Optional[int] = None
+        try:
+            while rc is None:
+                self.check()
+                # collect results; release each worker as its slice
+                # lands (hygiene-checked on the way out)
+                with self._lock:
+                    pending = [
+                        w for w in d.workers
+                        if w.group_rank is not None
+                        and w.group_rank not in d.results
+                        and w.state == "busy" and w.job == job
+                    ]
+                for w in pending:
+                    path = os.path.join(
+                        worker_dir(self.root, w.rank), OUTBOX_DIR,
+                        f"{w.item}.json",
+                    )
+                    try:
+                        with open(path) as f:
+                            result = json.load(f)
+                    except (OSError, json.JSONDecodeError):
+                        continue
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                    with self._lock:
+                        d.results[w.group_rank] = result
+                        w.state = "idle"
+                        w.job = None
+                        w.item = None
+                        w.group_rank = None
+                        w.jobs_served += 1
+                        self._dirty = True
+                    hygiene = result.get("hygiene") or {}
+                    if not hygiene.get("clean", True):
+                        self._audit(
+                            "pool_hygiene", job=job, worker=w.rank,
+                            report=hygiene,
+                        )
+                        self._quarantine(w, "hygiene")
+                with self._lock:
+                    if d.failed is not None:
+                        if d.preempted and d.failed == "preempted":
+                            rc = 143
+                        elif d.failed in ("wedged", "job_timeout"):
+                            rc = 124
+                        else:
+                            rc = d.failed_rc if d.failed_rc else 1
+                        break
+                    if len(d.results) >= len(d.workers):
+                        rc = 0
+                        for g in sorted(d.results):
+                            r = int(d.results[g].get("rc", 1) or 0)
+                            if r != 0:
+                                rc = r
+                                break
+                        break
+                if deadline is not None and self.clock() > deadline:
+                    self._log(
+                        f"job {job}: deadline {timeout:g}s exceeded; "
+                        "quarantining its workers"
+                    )
+                    self._timeout_job(job)
+                    continue
+                time.sleep(min(self.check_s, 0.005))
+        finally:
+            with self._lock:
+                self._dispatches.pop(job, None)
+        return rc, sorted(d.preempted)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,6 +47,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -85,6 +86,7 @@ class Server:
         runner: Optional[Runner] = None,
         verify_fn: Optional[Callable[[JobSpec, int], bool]] = None,
         metrics_port: Optional[int] = None,
+        pool: Optional[Any] = None,
         log: Callable[[str], None] = _default_log,
     ):
         if nproc < 1:
@@ -100,11 +102,19 @@ class Server:
         self.max_jobs = max_jobs
         self.idle_exit_s = idle_exit_s
         self.scheduler = FairScheduler()
+        #: the resident warm pool (serving/pool.py), if armed: jobs
+        #: become work items on its mailboxes instead of spawned
+        #: worlds, and the serve loop packs concurrent jobs onto
+        #: disjoint sub-meshes
+        self._pool = pool
+        if pool is not None and runner is None:
+            runner = pool.runner
         self._runner = runner or self._launch_runner
         self._verify_fn = verify_fn or self._launch_verify
         self.metrics_port = metrics_port
         self._http = None
         self._log = log
+        self._metrics_lock = threading.Lock()
         self.jobs_served = 0
         #: set when capacity fell below min_ranks: serving cannot
         #: honestly continue, the loop exits nonzero
@@ -169,9 +179,10 @@ class Server:
         from . import export as _sexport
 
         try:
-            _sexport.write_serving_prom(
-                self.spool, capacity=self.capacity,
-            )
+            with self._metrics_lock:
+                _sexport.write_serving_prom(
+                    self.spool, capacity=self.capacity,
+                )
         except Exception:
             pass  # metrics must never take the queue down
 
@@ -225,19 +236,27 @@ class Server:
         job — every later job serves at the smaller world too."""
         old_world = state["world"]
         lost = len(state["preempted"])
-        new_world = old_world - lost
+        if self._pool is not None:
+            # the pool already retired the preempted slots; capacity
+            # is whatever survives, and the job resumes at the
+            # largest sub-mesh that still fits it
+            new_cap = self._pool.capacity()
+            new_world = min(old_world, new_cap)
+        else:
+            new_world = old_world - lost
+            new_cap = new_world
         pre = ",".join(str(p) for p in state["preempted"])
         self._log(
             f"job {spec.id}: {lost} rank(s) preempted ({pre}); "
             f"draining and shrinking world {old_world} -> {new_world}"
         )
-        if new_world < self.min_ranks:
+        if new_cap < self.min_ranks:
             state["blocked"] = (
-                f"only {new_world} survivor(s) of {old_world} — below "
+                f"only {new_cap} survivor(s) — below "
                 f"--min-ranks {self.min_ranks}"
             )
             self._set_capacity(
-                max(new_world, 0), job=spec.id,
+                max(new_cap, 0), job=spec.id,
                 reason="preempted_below_min",
             )
             self.capacity_lost = True
@@ -289,7 +308,7 @@ class Server:
                 f"verify failed at the shrunk world {new_world}"
             )
             self._log(f"job {spec.id}: {state['blocked']}; giving up")
-            self._set_capacity(new_world, job=spec.id)
+            self._set_capacity(new_cap, job=spec.id)
             return None
         state["transition"] = {
             "world": old_world,
@@ -302,7 +321,7 @@ class Server:
         if reshard_src:
             audit["resharded_from_step"] = reshard_src["step"]
             audit["resharded_from_world"] = reshard_src["world"]
-        self._set_capacity(new_world, **audit)
+        self._set_capacity(new_cap, **audit)
         return resume
 
     # -- one job -------------------------------------------------------
@@ -430,6 +449,14 @@ class Server:
                 rec["elastic_blocked"] = state["blocked"]
             return rec
 
+        def abort_fn(attempt: int) -> Optional[str]:
+            # the pool's two-strikes rule: a job that keeps wedging
+            # workers is poisoned — retrying it would degrade the
+            # pool, so the remaining budget is vetoed
+            if self._pool is not None and self._pool.poisoned(spec.id):
+                return "poisoned"
+            return None
+
         sup = Supervisor(
             run_fn,
             policy=RetryPolicy(
@@ -438,6 +465,7 @@ class Server:
             diagnose_fn=diagnose_fn,
             resume_fn=resume_fn,
             extra_fn=extra_fn,
+            abort_fn=abort_fn,
             audit_path=self.spool.audit_path,
             log=self._log,
         )
@@ -456,7 +484,14 @@ class Server:
                 "completed", job=spec.id, tenant=spec.tenant, **common
             )
             return "completed"
-        reason = state["blocked"] or last.get("reason", "exit_nonzero")
+        if self._pool is not None and self._pool.poisoned(spec.id):
+            # however the last attempt's exit classified, the final
+            # word on a poisoned job is "poisoned"
+            reason = "poisoned"
+        else:
+            reason = state["blocked"] or last.get(
+                "reason", "exit_nonzero"
+            )
         self.spool.finish(
             spec, "failed", exit_code=rc, klass=last.get("klass"),
             reason=reason, **common,
@@ -478,14 +513,24 @@ class Server:
             "serve_start", world=self.capacity,
             capacity=self.spool.capacity, pid=os.getpid(),
             elastic=self.elastic, verify=self.verify,
+            warm_pool=(self._pool.size if self._pool is not None
+                       else None),
         )
         self._log(
             f"serving from {self.spool.root} at world "
             f"{self.capacity} (queue capacity {self.spool.capacity}"
             + (", elastic" if self.elastic else "")
-            + (", verify" if self.verify else "") + ")"
+            + (", verify" if self.verify else "")
+            + (f", warm pool of {self._pool.size}"
+               if self._pool is not None else "")
+            + ")"
         )
         self._start_metrics()
+        if self._pool is not None:
+            try:
+                return self._serve_concurrent()
+            finally:
+                self._stop_metrics()
         idle_since = time.monotonic()
         rc = 0
         try:
@@ -539,4 +584,99 @@ class Server:
         finally:
             self._write_metrics()
             self._stop_metrics()
+        return rc
+
+    # -- the warm-pool loop: concurrent jobs on disjoint sub-meshes ----
+
+    def _serve_concurrent(self) -> int:
+        """The serve loop when a resident pool is armed. Claimed jobs
+        run in their own threads (each still under its own per-job
+        Supervisor — the fault-domain contract is unchanged) so that
+        several jobs can occupy disjoint sub-meshes of the pool at
+        once; the head of the queue is never skipped (a job that does
+        not fit yet blocks later jobs — FIFO fairness over packing
+        greed)."""
+        pool = self._pool
+        running: Dict[str, threading.Thread] = {}
+        idle_since = time.monotonic()
+        rc = 0
+        try:
+            while True:
+                # one pool-doctor pass per loop turn: reap worker
+                # exits, enforce heartbeat deadlines, flip started
+                # workers idle (the doctor thread does this too when
+                # armed; harnesses without it stay deterministic)
+                try:
+                    pool.check()
+                except Exception:
+                    pass
+                # reap finished job threads
+                done = [j for j, t in running.items()
+                        if not t.is_alive()]
+                for j in done:
+                    running.pop(j).join()
+                    self.jobs_served += 1
+                    self._write_metrics()
+                if self.capacity_lost and not running:
+                    self._log(
+                        "capacity below --min-ranks; cannot keep "
+                        "serving"
+                    )
+                    rc = 1
+                    break
+                if (
+                    self.max_jobs is not None
+                    and self.jobs_served + len(running) >= self.max_jobs
+                ):
+                    if running:
+                        time.sleep(self.poll_s)
+                        continue
+                    self._log(f"served {self.jobs_served} job(s); done")
+                    break
+                spec = self.scheduler.pick(self.spool.pending())
+                if spec is None:
+                    if not running:
+                        if self.spool.draining():
+                            self.spool.audit(
+                                "drained", jobs=self.jobs_served,
+                                world=self.capacity,
+                            )
+                            self._log(
+                                "drained: queue empty after "
+                                f"{self.jobs_served} job(s); exiting"
+                            )
+                            break
+                        if (
+                            self.idle_exit_s is not None
+                            and time.monotonic() - idle_since
+                            > self.idle_exit_s
+                        ):
+                            self._log("idle bound reached; exiting")
+                            break
+                        self._write_metrics()
+                    time.sleep(self.poll_s)
+                    continue
+                idle_since = time.monotonic()
+                world = min(spec.nproc, max(self.capacity, 1))
+                if pool.idle_count() < world:
+                    # head-of-line job does not fit yet: wait for a
+                    # sub-mesh, don't leapfrog it
+                    time.sleep(self.poll_s)
+                    continue
+                claimed = self.spool.claim(spec)
+                if claimed is None:
+                    continue  # a peer server won the rename
+                t = threading.Thread(
+                    target=self.run_job, args=(claimed,),
+                    name=f"m4t-job-{claimed.id}",
+                )
+                t.start()
+                running[claimed.id] = t
+        except KeyboardInterrupt:
+            self._log("interrupted; exiting")
+            rc = 130
+        finally:
+            for t in running.values():
+                t.join(timeout=10.0)
+            self._write_metrics()
         return rc

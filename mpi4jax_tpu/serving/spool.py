@@ -40,6 +40,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -276,6 +277,9 @@ class Spool:
         for sub in (PENDING_DIR, RUNNING_DIR, DONE_DIR, JOBS_DIR):
             os.makedirs(os.path.join(self.root, sub), exist_ok=True)
         self.audit_path = os.path.join(self.root, AUDIT_NAME)
+        # the warm pool's serve loop audits from concurrent job
+        # threads; one writer at a time keeps lines whole
+        self._audit_lock = threading.Lock()
 
     # -- audit --------------------------------------------------------
 
@@ -285,10 +289,11 @@ class Spool:
         from ..observability import events
 
         try:
-            events.EventLog(self.audit_path).append(
-                events.event("serving", event=event, t=time.time(),
-                             **fields)
-            )
+            with self._audit_lock:
+                events.EventLog(self.audit_path).append(
+                    events.event("serving", event=event, t=time.time(),
+                                 **fields)
+                )
         except OSError:
             pass
 

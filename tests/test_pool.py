@@ -1,0 +1,742 @@
+"""Resident warm worker pool (``mpi4jax_tpu/serving/pool.py``).
+
+Covers the ISSUE-11 acceptance surface:
+
+- the ``wedge`` fault action: parses, scopes like ``hang``, silences
+  the heartbeat daemon before blocking (the deterministic pool-doctor
+  test shape);
+- ``observability/live.HeartbeatTail``: bounded-memory liveness over
+  one sink, freshness by *arrival* time (a respawned worker never
+  looks alive on its predecessor's heartbeats);
+- mailbox protocol: atomic item/result writes, FIFO claim order;
+- ``run_item``: in-process payload execution (exit codes, exceptions,
+  argv shapes) and the hygiene contract — pending-send drain, fault
+  plan unscoping, env-bleed rollback, telemetry registry reset,
+  sub-mesh ``job_comm()`` exposure;
+- the pool doctor (stub handles, fake clock — fully deterministic):
+  ready-on-first-beat, wedged / exited / start-timeout quarantines
+  with respawn, elastic retirement on preemption exits, gang
+  ``peer_lost`` teardown;
+- dispatch: runner round-trip over real mailbox files, job deadline
+  -> ``job_timeout`` quarantine, the two-strikes poisoned rule
+  (strike, poison, refuse), hygiene quarantine after a leaky job;
+- exporter + doctor narration of pool health;
+- real resident workers (subprocess): warm round-trip smoke, and the
+  slow chaos e2e — SIGKILL one worker mid-job, assert the pool
+  respawns, the job retries, and every queued job id ends terminal.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from mpi4jax_tpu.observability import doctor, events, live
+from mpi4jax_tpu.resilience import faults
+from mpi4jax_tpu.serving import Server, Spool, parse_job
+from mpi4jax_tpu.serving import export as sexport
+from mpi4jax_tpu.serving import pool as pool_mod
+from mpi4jax_tpu.serving.pool import WorkerPool
+
+pytestmark = [pytest.mark.serving, pytest.mark.pool]
+
+
+# ---------------------------------------------------------------------
+# the wedge fault action
+# ---------------------------------------------------------------------
+
+
+def test_wedge_action_parses_like_hang():
+    plan = faults.FaultPlan.parse({"faults": [
+        {"rank": 1, "op": "AllReduce", "nth": 3, "action": "wedge"},
+    ]})
+    rule = plan.rules[0]
+    assert rule.action == "wedge" and rule.nth == 3 and rule.rank == 1
+    plan.validate_world(2)
+    with pytest.raises(faults.FaultPlanError):
+        plan.validate_world(1)  # rank 1 out of range, like any action
+
+
+def test_wedge_action_rejected_fields_still_checked():
+    with pytest.raises(faults.FaultPlanError, match="action"):
+        faults.FaultPlan.parse({"faults": [{"op": "*",
+                                            "action": "wedgie"}]})
+
+
+def test_wedge_silences_heartbeat_then_blocks(monkeypatch):
+    silenced = []
+    monkeypatch.setattr(
+        events, "silence_heartbeat", lambda: silenced.append(True)
+    )
+
+    class _Break(Exception):
+        pass
+
+    def _no_sleep(s):
+        raise _Break
+
+    monkeypatch.setattr(faults.time, "sleep", _no_sleep)
+    plan = faults.FaultPlan.parse({"faults": [
+        {"rank": 0, "op": "AllReduce", "nth": 1, "action": "wedge"},
+    ]})
+    faults.arm(plan, rank=0, attempt=0)
+    try:
+        with pytest.raises(_Break):
+            faults.on_emission(
+                "AllReduce", cid="t", nbytes=4, dtype="float32",
+                shape=(1,), axes=[], world=2,
+            )
+    finally:
+        faults.disarm()
+    # the heartbeat daemon was silenced BEFORE the block: from the
+    # outside the process is now emission-less, heartbeat-less, and
+    # alive — only a heartbeat deadline can name it
+    assert silenced == [True]
+
+
+def test_silence_heartbeat_stops_the_daemon(tmp_path, monkeypatch):
+    sink = events.EventLog(str(tmp_path / "s.jsonl"))
+    monkeypatch.setattr(events, "get_sink", lambda: sink)
+    monkeypatch.setattr(events, "_sink", sink, raising=False)
+    stop = events.start_heartbeat(0.01, source="t")
+    try:
+        time.sleep(0.05)
+        events.silence_heartbeat()
+        n = len([r for r in events.read(str(tmp_path / "s.jsonl"))
+                 if r.get("kind") == "heartbeat"])
+        assert n >= 1
+        time.sleep(0.05)
+        n2 = len([r for r in events.read(str(tmp_path / "s.jsonl"))
+                  if r.get("kind") == "heartbeat"])
+        assert n2 == n  # no beats after the silence
+    finally:
+        stop()
+
+
+# ---------------------------------------------------------------------
+# HeartbeatTail
+# ---------------------------------------------------------------------
+
+
+def test_heartbeat_tail_freshness_is_arrival_time(tmp_path):
+    path = str(tmp_path / "s.jsonl")
+    now = [100.0]
+    tail = live.HeartbeatTail(path, clock=lambda: now[0])
+    assert tail.poll() == 0
+    assert tail.heartbeat_age() is None
+    log = events.EventLog(path)
+    # the record's own t is ancient — freshness must come from when
+    # the tail first SAW the line, not from what the line claims
+    log.append(events.event("heartbeat", source="w", t=1.0))
+    assert tail.poll() == 1
+    assert tail.heartbeat_age() == 0.0
+    now[0] = 103.0
+    assert tail.heartbeat_age() == 3.0
+    log.append(events.event("pool", event="job_start"))
+    assert tail.poll() == 1
+    assert tail.heartbeat_age() == 3.0  # non-heartbeats don't refresh
+    assert tail.last_record_t == 103.0
+    assert tail.records == 2
+
+
+# ---------------------------------------------------------------------
+# mailbox protocol + run_item
+# ---------------------------------------------------------------------
+
+
+def test_mailbox_writes_are_atomic_and_fifo(tmp_path):
+    inbox = str(tmp_path / "inbox")
+    os.makedirs(inbox)
+    for i in (3, 1, 2):
+        pool_mod._write_json_atomic(
+            os.path.join(inbox, f"{i:020d}-it{i}.json"), {"i": i}
+        )
+    assert not [n for n in os.listdir(inbox) if n.startswith(".tmp-")]
+    assert pool_mod._oldest_entry(inbox) == f"{1:020d}-it1.json"
+
+
+BASE = {"schema": pool_mod.WORK_SCHEMA, "item": "i0", "job": "j0"}
+
+
+def test_run_item_payload_shapes():
+    assert pool_mod.run_item(
+        {**BASE, "cmd": ["-c", "pass"]})["rc"] == 0
+    assert pool_mod.run_item(
+        {**BASE, "cmd": ["-c", "import sys; sys.exit(9)"]})["rc"] == 9
+    r = pool_mod.run_item(
+        {**BASE, "cmd": ["-c", "raise RuntimeError('x')"]})
+    assert r["rc"] == 1 and "RuntimeError" in r["error"]
+    r = pool_mod.run_item({**BASE, "cmd": [
+        "-c", "import sys; assert sys.argv[1:] == ['a', 'b']", "a", "b",
+    ]})
+    assert r["rc"] == 0, r
+    r = pool_mod.run_item({**BASE})
+    assert r["rc"] == 1 and "module" in r["error"]
+
+
+def test_run_item_hygiene_env_bleed_named_and_rolled_back():
+    r = pool_mod.run_item({**BASE, "cmd": [
+        "-c", "import os; os.environ['M4T_TEST_BLEED'] = '1'",
+    ]})
+    assert r["hygiene"]["env_bleed"] == ["M4T_TEST_BLEED"]
+    assert not r["hygiene"]["clean"]
+    assert "M4T_TEST_BLEED" not in os.environ
+
+
+def test_run_item_hygiene_pending_sends(monkeypatch):
+    import mpi4jax_tpu.token as token
+
+    monkeypatch.setattr(
+        token, "drain_pending_sends",
+        lambda: [("trace", [{"op": "Send"}, {"op": "Send"}])],
+    )
+    r = pool_mod.run_item({**BASE, "cmd": ["-c", "pass"]})
+    assert r["hygiene"]["pending_sends"] == 2
+    assert not r["hygiene"]["clean"]
+
+
+def test_run_item_hygiene_fault_plan_scoping():
+    # a plan the payload armed itself is a violation...
+    r = pool_mod.run_item({**BASE, "cmd": [
+        "-c",
+        "from mpi4jax_tpu.resilience import faults; "
+        "faults.arm(faults.FaultPlan.parse("
+        "{'faults': [{'op': '*', 'action': 'delay', 'ms': 1}]}))",
+    ]})
+    assert r["hygiene"]["fault_armed"] and not r["hygiene"]["clean"]
+    assert faults.active_plan is None
+    # ...one the job declared is scoped to the job and unscoped after
+    r = pool_mod.run_item({
+        **BASE, "cmd": ["-c", "pass"],
+        "fault_plan": {"faults": [
+            {"op": "*", "action": "delay", "ms": 1},
+        ]},
+    })
+    assert r["rc"] == 0 and r["hygiene"]["clean"]
+    assert faults.active_plan is None
+
+
+def test_run_item_exposes_sub_mesh_group():
+    r = pool_mod.run_item({
+        **BASE,
+        "cmd": ["-c",
+                "import os, json; "
+                "from mpi4jax_tpu.serving.pool import job_comm, "
+                "job_group_rank; "
+                "c = job_comm(); "
+                "assert c.groups == ((2, 3), (0,), (1,)), c.groups; "
+                "assert job_group_rank() == 1"],
+        "group": {"ranks": [2, 3], "rank": 1, "size": 2, "world": 4},
+    })
+    assert r["rc"] == 0, r
+    assert "M4T_POOL_GROUP" not in os.environ
+
+
+def test_run_item_resume_step_scoped():
+    r = pool_mod.run_item({
+        **BASE,
+        "cmd": ["-c",
+                "import os; "
+                "assert os.environ['M4T_RESUME_STEP'] == '7'"],
+        "resume_step": 7,
+    })
+    assert r["rc"] == 0, r
+    assert "M4T_RESUME_STEP" not in os.environ
+
+
+# ---------------------------------------------------------------------
+# the pool doctor (stub handles, fake clock)
+# ---------------------------------------------------------------------
+
+
+class _Handle:
+    def __init__(self):
+        self.rc = None
+        self.ended = False
+        self.pid = 4242
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        self.ended = True
+
+    kill = terminate
+
+    def wait(self, timeout=None):
+        pass
+
+
+def _mkpool(tmp_path, n=2, **kw):
+    now = [0.0]
+    audits = []
+    opts = dict(
+        heartbeat_s=0.5, deadline_s=2.0, start_deadline_s=10.0,
+        check_s=0.001,
+    )
+    opts.update(kw)
+    pool = WorkerPool(
+        str(tmp_path / "pool"), n,
+        spawn_fn=lambda p, w: _Handle(),
+        audit=lambda event, **f: audits.append(
+            {"event": event, **f}),
+        log=lambda m: None,
+        clock=lambda: now[0],
+        **opts,
+    )
+    pool.start(doctor=False)
+    return pool, now, audits
+
+
+def _beat(pool, rank):
+    events.EventLog(
+        pool_mod.worker_sink(pool.root, rank)
+    ).append(events.event("heartbeat", source="w", t=time.time()))
+
+
+def test_worker_ready_on_first_fresh_beat(tmp_path):
+    pool, now, _ = _mkpool(tmp_path)
+    assert [w.state for w in pool.workers] == ["starting", "starting"]
+    _beat(pool, 0)
+    pool.check()
+    assert pool.workers[0].state == "idle"
+    assert pool.workers[1].state == "starting"
+    assert pool.idle_count() == 1
+
+
+def test_wedged_worker_quarantined_and_respawned(tmp_path):
+    pool, now, audits = _mkpool(tmp_path)
+    for r in (0, 1):
+        _beat(pool, r)
+    pool.check()
+    assert pool.idle_count() == 2
+    now[0] = 3.0  # > deadline_s with no fresh beat: wedged
+    pool.check()
+    assert all(w.state == "starting" for w in pool.workers)
+    assert all(w.incarnation == 2 for w in pool.workers)
+    assert pool.counters["quarantines"] == {"wedged": 2}
+    assert pool.counters["respawns"] == 2
+    kinds = [a["event"] for a in audits]
+    assert kinds.count("pool_quarantine") == 2
+    assert kinds.count("pool_respawn") == 2
+    # the respawned incarnation becomes ready on its own fresh beat
+    _beat(pool, 0)
+    pool.check()
+    assert pool.workers[0].state == "idle"
+
+
+def test_exited_worker_quarantined_with_rc(tmp_path):
+    pool, now, audits = _mkpool(tmp_path, n=1)
+    _beat(pool, 0)
+    pool.check()
+    pool.workers[0].handle.rc = 1
+    pool.check()
+    assert pool.workers[0].incarnation == 2
+    assert pool.counters["quarantines"] == {"exited": 1}
+    (q,) = [a for a in audits if a["event"] == "pool_quarantine"]
+    assert q["reason"] == "exited" and q["rc"] == 1
+
+
+def test_start_timeout_quarantines_a_mute_worker(tmp_path):
+    pool, now, _ = _mkpool(tmp_path, n=1)
+    now[0] = 11.0  # > start_deadline_s, never a beat
+    pool.check()
+    assert pool.counters["quarantines"] == {"start_timeout": 1}
+    assert pool.workers[0].incarnation == 2
+
+
+def test_elastic_preemption_retires_the_slot(tmp_path):
+    pool, now, audits = _mkpool(tmp_path, elastic=True)
+    for r in (0, 1):
+        _beat(pool, r)
+    pool.check()
+    pool.workers[1].handle.rc = 143
+    pool.check()
+    assert pool.workers[1].state == "retired"
+    assert pool.workers[1].incarnation == 1  # never respawned
+    assert pool.capacity() == 1
+    assert pool.counters["retired"] == 1
+    assert [a for a in audits if a["event"] == "pool_retired"]
+    # a retired slot stays retired through later checks
+    now[0] = 100.0
+    pool.check()
+    assert pool.workers[1].state == "retired"
+
+
+def _serve_stub(pool, rank, *, rc=0, hygiene=None):
+    """Play one worker turn by hand: claim the oldest inbox item and
+    answer it (the controller-side test's half of the mailbox)."""
+    wdir = pool_mod.worker_dir(pool.root, rank)
+    inbox = os.path.join(wdir, pool_mod.INBOX_DIR)
+    deadline = time.monotonic() + 10.0
+    while True:
+        name = pool_mod._oldest_entry(inbox)
+        if name is not None:
+            break
+        if time.monotonic() > deadline:
+            raise AssertionError("no work item arrived")
+        time.sleep(0.005)
+    with open(os.path.join(inbox, name)) as f:
+        item = json.load(f)
+    os.unlink(os.path.join(inbox, name))
+    result = {
+        "schema": pool_mod.RESULT_SCHEMA,
+        "item": item["item"], "job": item["job"],
+        "attempt": item["attempt"], "rc": rc, "error": None,
+        "elapsed_s": 0.0,
+        "hygiene": hygiene or {"clean": True},
+        "worker": rank, "incarnation": 1,
+    }
+    pool_mod._write_json_atomic(
+        os.path.join(wdir, pool_mod.OUTBOX_DIR,
+                     f"{item['item']}.json"),
+        result,
+    )
+    return item
+
+
+def test_runner_round_trip_over_the_mailbox(tmp_path):
+    pool, now, audits = _mkpool(tmp_path, n=2)
+    for r in (0, 1):
+        _beat(pool, r)
+    pool.check()
+    spec = parse_job({"id": "j1", "cmd": ["-c", "pass"], "nproc": 2})
+    out = []
+    t = threading.Thread(
+        target=lambda: out.append(
+            pool.runner(spec, 2, None, 0, None)),
+    )
+    t.start()
+    items = [_serve_stub(pool, 0), _serve_stub(pool, 1)]
+    t.join(timeout=10.0)
+    assert not t.is_alive()
+    assert out == [(0, [])]
+    # work items carried the sub-mesh partition
+    assert items[0]["group"] == {
+        "ranks": [0, 1], "rank": 0, "size": 2, "world": 2,
+    }
+    assert items[1]["group"]["rank"] == 1
+    assert all(w.state == "idle" for w in pool.workers)
+    assert [w.jobs_served for w in pool.workers] == [1, 1]
+    assert [a["event"] for a in audits].count("pool_dispatch") == 1
+
+
+def test_runner_nonzero_payload_rc_propagates(tmp_path):
+    pool, now, _ = _mkpool(tmp_path, n=1)
+    _beat(pool, 0)
+    pool.check()
+    spec = parse_job({"id": "j2", "cmd": ["-c", "x"]})
+    out = []
+    t = threading.Thread(
+        target=lambda: out.append(pool.runner(spec, 1, None, 0, None)))
+    t.start()
+    _serve_stub(pool, 0, rc=5)
+    t.join(timeout=10.0)
+    assert out == [(5, [])]
+
+
+def test_hygiene_failure_completes_the_job_but_heals_the_worker(
+    tmp_path,
+):
+    pool, now, audits = _mkpool(tmp_path, n=1)
+    _beat(pool, 0)
+    pool.check()
+    spec = parse_job({"id": "leaky", "cmd": ["-c", "pass"]})
+    out = []
+    t = threading.Thread(
+        target=lambda: out.append(pool.runner(spec, 1, None, 0, None)))
+    t.start()
+    _serve_stub(pool, 0, rc=0, hygiene={
+        "clean": False, "pending_sends": 3,
+    })
+    t.join(timeout=10.0)
+    # the job's result stands...
+    assert out == [(0, [])]
+    # ...but the dirty worker was quarantined and respawned
+    assert pool.counters["quarantines"] == {"hygiene": 1}
+    assert pool.workers[0].incarnation == 2
+    assert [a for a in audits if a["event"] == "pool_hygiene"]
+
+
+def test_two_strikes_poisons_the_job(tmp_path):
+    # a huge heartbeat deadline isolates the *job* deadline: this is
+    # the native-wedge shape where the heartbeat daemon still runs
+    # but the payload never finishes
+    pool, now, audits = _mkpool(
+        tmp_path, n=1, deadline_s=1000.0, start_deadline_s=2000.0,
+    )
+    _beat(pool, 0)
+    pool.check()
+    spec = parse_job({
+        "id": "wedger", "cmd": ["-c", "x"], "timeout_s": 5.0,
+    })
+
+    def _attempt(attempt):
+        out = []
+        t = threading.Thread(target=lambda: out.append(
+            pool.runner(spec, 1, None, attempt, None)))
+        t.start()
+        # wait for the dispatch, then blow the job deadline
+        inbox = os.path.join(
+            pool_mod.worker_dir(pool.root, 0), pool_mod.INBOX_DIR)
+        deadline = time.monotonic() + 10.0
+        while pool_mod._oldest_entry(inbox) is None:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        now[0] += 100.0
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+        # ready the respawned incarnation for the next attempt
+        _beat(pool, 0)
+        pool.check()
+        return out[0]
+
+    assert _attempt(0) == (124, [])
+    assert pool.strikes("wedger") == 1 and not pool.poisoned("wedger")
+    assert _attempt(1) == (124, [])
+    assert pool.strikes("wedger") == 2 and pool.poisoned("wedger")
+    # the third dispatch is refused outright — no worker is consumed
+    assert pool.runner(spec, 1, None, 2, None) == (1, [])
+    kinds = [a["event"] for a in audits]
+    assert kinds.count("pool_strike") == 2
+    assert kinds.count("pool_poisoned") == 1
+    (refused,) = [a for a in audits if a["event"] == "pool_refused"]
+    assert refused["reason"] == "poisoned"
+    assert pool.counters["quarantines"] == {"job_timeout": 2}
+
+
+def test_gang_peer_lost_teardown(tmp_path):
+    pool, now, audits = _mkpool(tmp_path, n=2)
+    for r in (0, 1):
+        _beat(pool, r)
+    pool.check()
+    spec = parse_job({"id": "gang", "cmd": ["-c", "x"], "nproc": 2})
+    out = []
+    t = threading.Thread(
+        target=lambda: out.append(pool.runner(spec, 2, None, 0, None)))
+    t.start()
+    deadline = time.monotonic() + 10.0
+    while pool.idle_count() != 0:
+        assert time.monotonic() < deadline
+        time.sleep(0.005)
+    pool.workers[0].handle.rc = -signal.SIGKILL  # rank 0 vanishes
+    t.join(timeout=10.0)
+    assert not t.is_alive()
+    (rc, preempted) = out[0]
+    assert rc == -signal.SIGKILL and preempted == []
+    q = pool.counters["quarantines"]
+    # the dead rank AND its possibly-blocked gang peer were respawned
+    assert q == {"exited": 1, "peer_lost": 1}, q
+    assert all(w.incarnation == 2 for w in pool.workers)
+    # a plain crash is not a wedge: no strike, no poison
+    assert pool.strikes("gang") == 0 and not pool.poisoned("gang")
+
+
+def test_runner_reports_preempted_group_ranks(tmp_path):
+    pool, now, _ = _mkpool(tmp_path, n=2, elastic=True)
+    for r in (0, 1):
+        _beat(pool, r)
+    pool.check()
+    spec = parse_job({"id": "pre", "cmd": ["-c", "x"], "nproc": 2})
+    out = []
+    t = threading.Thread(
+        target=lambda: out.append(pool.runner(spec, 2, None, 0, None)))
+    t.start()
+    deadline = time.monotonic() + 10.0
+    while pool.idle_count() != 0:
+        assert time.monotonic() < deadline
+        time.sleep(0.005)
+    pool.workers[1].handle.rc = 143
+    t.join(timeout=10.0)
+    (rc, preempted) = out[0]
+    assert rc == 143 and preempted == [1]
+    assert pool.workers[1].state == "retired"
+    assert pool.capacity() == 1
+
+
+# ---------------------------------------------------------------------
+# exporter + doctor narration
+# ---------------------------------------------------------------------
+
+
+def test_pool_snapshot_and_metrics_families(tmp_path):
+    pool, now, _ = _mkpool(tmp_path, n=2)
+    _beat(pool, 0)
+    pool.check()
+    pool.workers[1].handle.rc = 2
+    pool.check()
+    pool._write_state(force=True)
+    # pool_snapshot reads only on-disk artifacts — point it at the
+    # spool root the pool dir lives under
+    snap = sexport.pool_snapshot(str(tmp_path))
+    assert snap is not None and snap["size"] == 2
+    assert snap["counters"]["quarantines"] == {"exited": 1}
+    assert snap["heartbeat_age_s"]["0"] is not None
+    text = sexport.render_serving_metrics({
+        "depth": 0, "capacity": 4, "running": 0, "world": 2,
+        "draining": False, "counts": {}, "rejected": {}, "jobs": [],
+        "pool": snap,
+    })
+    for needle in (
+        "m4t_pool_size 2",
+        "m4t_pool_capacity 2",
+        'm4t_pool_quarantines_total{reason="exited"} 1',
+        "m4t_pool_respawns_total 1",
+        'm4t_pool_worker_alive{worker="0"} 1',
+        'm4t_pool_worker_incarnation{worker="1"} 2',
+        'm4t_pool_worker_last_heartbeat_age{worker="0"}',
+    ):
+        assert needle in text, (needle, text)
+    assert text.endswith("# EOF\n")
+
+
+def test_no_pool_no_families(tmp_path):
+    spool = Spool(str(tmp_path / "sp"))
+    assert sexport.pool_snapshot(spool) is None
+    text = sexport.render_serving_metrics(
+        sexport.serving_snapshot(spool))
+    assert "m4t_pool_" not in text
+
+
+def test_doctor_narrates_pool_events(tmp_path):
+    spool = Spool(str(tmp_path / "sp"))
+    spool.audit("pool_start", size=2, mesh=False, heartbeat_s=0.5,
+                deadline_s=3.0)
+    spool.audit("pool_quarantine", worker=1, reason="wedged", job="j")
+    spool.audit("pool_respawn", worker=1, incarnation=2)
+    spool.audit("pool_strike", job="j", strikes=1, max_strikes=2,
+                reason="wedged")
+    spool.audit("pool_poisoned", job="j", strikes=2, reason="wedged")
+    spool.audit("pool_retired", worker=0, rc=143, capacity=1, job="k")
+    spool.audit("pool_stop", jobs=5, respawns=1)
+    text = doctor.format_serving_timeline(
+        doctor.load_serving_audit([spool.root]))
+    for needle in (
+        "warm pool: 2 resident worker(s)",
+        "worker 1 quarantined — wedged",
+        "respawned (incarnation 2)",
+        "strike 1/2 against job j",
+        "POISONED job j",
+        "worker 0 preempted — slot retired, capacity 1",
+        "warm pool stopped after 5 work item(s)",
+    ):
+        assert needle in text, (needle, text)
+
+
+# ---------------------------------------------------------------------
+# real resident workers (subprocess)
+# ---------------------------------------------------------------------
+
+
+def test_real_warm_pool_round_trip(tmp_path):
+    """One resident worker, two jobs: both complete warm (the second
+    re-uses the first's imports — no respawn, one incarnation)."""
+    spool = Spool(str(tmp_path / "sp"))
+    for i in range(2):
+        assert spool.submit({
+            "id": f"w{i}", "cmd": ["-c", "import mpi4jax_tpu"],
+        })["status"] == "queued"
+    pool = WorkerPool(
+        os.path.join(spool.root, "pool"), 1,
+        heartbeat_s=0.2, audit=spool.audit, log=lambda m: None,
+    )
+    server = Server(
+        spool, nproc=1, max_jobs=2, poll_s=0.02, pool=pool,
+        log=lambda m: None,
+    )
+    pool.start()
+    try:
+        rc = server.serve()
+    finally:
+        pool.stop(grace_s=2.0)
+    assert rc == 0
+    outcomes = {r["id"]: r["outcome"] for r in spool.done()}
+    assert outcomes == {"w0": "completed", "w1": "completed"}
+    w = pool.workers[0]
+    assert w.jobs_served == 2 and w.incarnation == 1
+    assert pool.counters["respawns"] == 0
+    # state snapshot is on disk for the offline exporter / status CLI
+    snap = sexport.pool_snapshot(spool)
+    assert snap["workers"][0]["jobs_served"] == 2
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_worker_kill_respawns_and_no_job_lost(tmp_path):
+    """ISSUE-11 acceptance: SIGKILL one resident worker mid-job. The
+    pool quarantines and respawns it, the in-flight job retries under
+    its per-job Supervisor and completes, the queued jobs drain, and
+    every submitted job id ends terminal in ``serving.jsonl``."""
+    spool = Spool(str(tmp_path / "sp"))
+    spool.configure(8)
+    assert spool.submit({
+        "id": "victim", "tenant": "a",
+        "cmd": ["-c", "import time; time.sleep(4.0)"],
+        "retries": 2, "backoff_s": 0.1,
+    })["status"] == "queued"
+    for i in range(3):
+        assert spool.submit({
+            "id": f"q{i}", "tenant": "b", "cmd": ["-c", "pass"],
+        })["status"] == "queued"
+
+    pool = WorkerPool(
+        os.path.join(spool.root, "pool"), 2,
+        heartbeat_s=0.2, audit=spool.audit, log=lambda m: None,
+    )
+    server = Server(
+        spool, nproc=2, max_jobs=4, poll_s=0.02, pool=pool,
+        log=lambda m: None,
+    )
+    pool.start()
+    out = []
+    t = threading.Thread(target=lambda: out.append(server.serve()))
+    t.start()
+    try:
+        # find the worker running "victim" and kill it mid-job
+        deadline = time.monotonic() + 60.0
+        target = None
+        while target is None:
+            assert time.monotonic() < deadline, "victim never dispatched"
+            for w in pool.workers:
+                if w.job == "victim" and w.state == "busy" and (
+                    w.handle is not None
+                ):
+                    target = (w.rank, w.handle.pid)
+            time.sleep(0.05)
+        time.sleep(0.5)  # well inside the payload's sleep
+        os.kill(target[1], signal.SIGKILL)
+        t.join(timeout=120.0)
+        assert not t.is_alive(), "serve loop never drained"
+    finally:
+        pool.stop(grace_s=2.0)
+        if t.is_alive():
+            t.join(timeout=10.0)
+    assert out == [0]
+
+    # zero jobs lost: every id terminal, the victim retried clean
+    done = {r["id"]: r for r in spool.done()}
+    assert {j: r["outcome"] for j, r in done.items()} == {
+        "victim": "completed", "q0": "completed",
+        "q1": "completed", "q2": "completed",
+    }
+    assert done["victim"]["attempts"] == 2
+    terminal = {}
+    for r in spool.audit_records():
+        if r["event"] in ("completed", "failed", "rejected"):
+            terminal[r["job"]] = r["event"]
+    assert set(terminal) == {"victim", "q0", "q1", "q2"}
+    assert all(v == "completed" for v in terminal.values())
+
+    # the pool healed: the killed slot runs a fresh incarnation
+    killed = pool.workers[target[0]]
+    assert killed.incarnation == 2
+    assert pool.counters["respawns"] >= 1
+    q = pool.counters["quarantines"]
+    assert q.get("exited", 0) >= 1, q
+    kinds = [r["event"] for r in spool.audit_records()]
+    assert "pool_quarantine" in kinds and "pool_respawn" in kinds
+    # a crash is not a wedge: the victim was never poisoned
+    assert not pool.poisoned("victim")
