@@ -64,6 +64,18 @@ def _pct(sorted_vals, q):
     return sorted_vals[i]
 
 
+def _stage_fields(result):
+    """The per-stage breakdown carried in the BENCH record so `perf
+    gate` cohorts can catch a queue-wait or dispatch regression that
+    total drain time averages away."""
+    out = {}
+    for key in ("dispatch_p50_s", "dispatch_p99_s",
+                "run_p50_s", "run_p99_s"):
+        value = result.get(key)
+        out[key] = round(value, 4) if value is not None else None
+    return out
+
+
 def run_loadgen(jobs: int, tenants: int, nproc: int, *, stub: bool,
                 queue_cap: int, payload=None, warm: bool = False):
     from mpi4jax_tpu.serving import Server, Spool
@@ -116,10 +128,24 @@ def run_loadgen(jobs: int, tenants: int, nproc: int, *, stub: bool,
         finally:
             if pool is not None:
                 pool.stop(grace_s=2.0)
-        waits = sorted(
-            float(rec.get("queue_wait_s") or 0.0)
-            for rec in spool.done()
+        done_ok = [
+            rec for rec in spool.done()
             if rec.get("outcome") == "completed"
+        ]
+        waits = sorted(
+            float(rec.get("queue_wait_s") or 0.0) for rec in done_ok
+        )
+        runs = sorted(
+            float(rec.get("run_s") or 0.0) for rec in done_ok
+        )
+        # per-stage breakdown from the lifecycle spans (PR 12): the
+        # dispatch stage is queue-machinery time the queue-wait and
+        # run numbers both hide — a control-plane regression shows up
+        # here first, before total drain time moves
+        dispatch = sorted(
+            float(s.get("dur_s") or 0.0)
+            for s in spool.span_records()
+            if s.get("span") == "dispatch"
         )
         completed = len(waits)
         return {
@@ -134,6 +160,10 @@ def run_loadgen(jobs: int, tenants: int, nproc: int, *, stub: bool,
             ),
             "queue_wait_p50_s": _pct(waits, 0.50),
             "queue_wait_p99_s": _pct(waits, 0.99),
+            "dispatch_p50_s": _pct(dispatch, 0.50),
+            "dispatch_p99_s": _pct(dispatch, 0.99),
+            "run_p50_s": _pct(runs, 0.50),
+            "run_p99_s": _pct(runs, 0.99),
         }
 
 
@@ -201,6 +231,7 @@ def main(argv=None) -> int:
             "jobs_per_hour": round(warm["jobs_per_hour"], 1),
             "queue_wait_p50_s": round(warm["queue_wait_p50_s"], 4),
             "queue_wait_p99_s": round(warm["queue_wait_p99_s"], 4),
+            **_stage_fields(warm),
         }
         result = {
             **warm,
@@ -234,6 +265,7 @@ def main(argv=None) -> int:
             "jobs_per_hour": round(result["jobs_per_hour"], 1),
             "queue_wait_p50_s": round(result["queue_wait_p50_s"], 4),
             "queue_wait_p99_s": round(result["queue_wait_p99_s"], 4),
+            **_stage_fields(result),
         }
     line = json.dumps(record)
     print(line)

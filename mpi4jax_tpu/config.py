@@ -115,6 +115,20 @@ Resilience (``resilience/``):
   segment header (``runtime/shm.py``; closes the stale-segment TOCTOU
   of ADVICE.md round 5).
 
+Per-job distributed tracing (serving plane, ``docs/observability.md``
+"Per-job tracing & SLOs"):
+
+- ``M4T_TRACE_ID``: the job's trace id, minted at ``serving submit``
+  (additive ``m4t-job/1`` field) and exported to every rank /
+  work-item by ``launch.rank_env`` and the warm pool's per-item env
+  overlay. When set, every emission/exec/latency/flight-recorder
+  record gains a ``trace`` field (armed-only: unset, the record
+  schema is byte-identical), so span, audit, and per-rank collective
+  records across all planes join on one key.
+- ``M4T_JOB_ID``: the serving-plane job id, stamped the same way as
+  ``job`` (set by the warm pool since PR 11; the cold spawn path sets
+  it too now).
+
 Flight recorder (``observability/recorder.py``):
 
 - ``M4T_FLIGHT_RECORDER``: set falsy to disable the always-cheap

@@ -78,13 +78,16 @@ def log_emission(
     shape: Optional[Sequence[int]] = None,
     impl: Optional[str] = None,
     plan: Optional[str] = None,
+    trace: Optional[str] = None,
+    job: Optional[str] = None,
 ) -> str:
     """Record a trace-time emission; returns the correlation id.
 
     Prints the reference-format log line when debug logging is on, and
     feeds the telemetry registry + JSONL event sink when telemetry is
     on. The structured fields (``nbytes``/``dtype``/``axes``/``world``/
-    ``annotation``) are only consulted on the telemetry path.
+    ``annotation``/``trace``/``job``) are only consulted on the
+    telemetry path.
     """
     ident = cid or new_cid()
     if _logging:
@@ -101,6 +104,8 @@ def log_emission(
             shape=shape,
             impl=impl,
             plan=plan,
+            trace=trace,
+            job=job,
         )
         _obs.events.emit(record)
     return ident

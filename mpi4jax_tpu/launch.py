@@ -302,6 +302,7 @@ def make_world_args(**overrides):
         retries=0, backoff=1.0, resume_dir=None,
         elastic=False, min_ranks=1,
         plan_cache_env=None, _live_report=None,
+        trace_id=None, job_id=None,
     )
     for key, value in overrides.items():
         if not hasattr(args, key):
@@ -329,6 +330,8 @@ def rank_env(
     runtime_sampling=False,
     perf_watch=False,
     mesh=True,
+    trace_id=None,
+    job_id=None,
 ):
     """The environment one spawned rank runs under — world membership
     (shm segment name + generation nonce + rank/size), telemetry
@@ -343,7 +346,13 @@ def rank_env(
     does **not** join a native world. The serving plane's resident
     worker pool (``serving/pool.py``) spawns un-meshed workers by
     default: warm processes that serve in-process payloads and can be
-    killed/respawned one at a time without wedging segment peers."""
+    killed/respawned one at a time without wedging segment peers.
+
+    ``trace_id``/``job_id`` export the serving plane's per-job trace
+    context (``M4T_TRACE_ID``/``M4T_JOB_ID``): every telemetry record
+    the rank writes then carries the job's trace id, which is what
+    lets the multi-plane trace merge and the SLO attribution join a
+    job's collective slices to its lifecycle spans."""
     env = dict(os.environ if base_env is None else base_env)
     if extra_env:
         env.update({str(k): str(v) for k, v in extra_env.items()})
@@ -380,6 +389,10 @@ def rank_env(
         env["M4T_PLAN_CACHE"] = plan_cache
     if resume_step is not None:
         env["M4T_RESUME_STEP"] = str(resume_step)
+    if trace_id:
+        env["M4T_TRACE_ID"] = str(trace_id)
+    if job_id:
+        env["M4T_JOB_ID"] = str(job_id)
     if events_dir:
         # literal {rank} on purpose: each child resolves the template
         # from its own M4T_RANK (events.py), so the launcher and any
@@ -410,9 +423,15 @@ def _spawn_world(
     fault_plan_env=None,
     world=None,
     extra_env=None,
+    span_fn=None,
 ):
     """Spawn and babysit one world of ``world`` ranks (default
     ``-n``); returns ``(exit_code, preempted_ranks)``.
+
+    ``span_fn(name, t0, t1)``, when given, receives one ``spawn``
+    lifecycle span covering the fork loop (all ranks Popen'd) — the
+    serving plane records it on the job's trace so a cold-spawn-bound
+    job is attributable from the span chain alone.
 
     One *attempt* in supervisor terms: a fresh shm segment name and
     generation nonce every time, so a restarted world can never attach
@@ -440,6 +459,7 @@ def _spawn_world(
     monitor = None
     preempted = set()
     try:
+        spawn_t0 = time.time()
         for rank in range(world):
             # --tune needs the runtime latency samples (the measured
             # side of the sweep); --live needs them for the exec-start
@@ -458,6 +478,8 @@ def _spawn_world(
                 resume_step=resume_step,
                 runtime_sampling=(args.perf or args.tune or args.live),
                 perf_watch=(args.perf or args.live),
+                trace_id=getattr(args, "trace_id", None),
+                job_id=getattr(args, "job_id", None),
             )
             cmd = [sys.executable]
             if os.environ.get("M4T_LAUNCH_COVERAGE"):
@@ -471,6 +493,11 @@ def _spawn_world(
                 cmd += ["-m", args.module]
             cmd += args.cmd
             procs.append(subprocess.Popen(cmd, env=env))
+        if span_fn is not None:
+            try:
+                span_fn("spawn", spawn_t0, time.time())
+            except Exception:
+                pass  # span recording must never take the world down
 
         if args.live and events_dir:
             # launcher-side live telemetry plane: tail the per-rank

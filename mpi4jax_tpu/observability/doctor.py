@@ -1000,6 +1000,21 @@ def format_serving_timeline(records: List[Dict[str, Any]]) -> str:
     return "\n".join(out)
 
 
+def _print_slo_breaches(inputs: Iterable[str]) -> None:
+    """Narrate SLO-breach verdicts (``SPOOL/slo.jsonl``, written by
+    ``serving/slo.py``) found beside the inputs: each breached job is
+    named with its dominant stage ("83% queue-wait -> capacity, not
+    compute"). Best-effort, like every other narration section."""
+    try:
+        from ..serving import slo as _slo
+
+        records = _slo.load_slo_verdicts(inputs)
+        if records:
+            print(_slo.format_slo_breaches(records))
+    except Exception:
+        pass
+
+
 # ---------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------
@@ -1107,6 +1122,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(format_supervisor_timeline(audit))
             if serving:
                 print(format_serving_timeline(serving))
+                _print_slo_breaches(args.inputs)
             return 0
         print("doctor: no usable records in the given inputs", file=sys.stderr)
         return 2
@@ -1168,6 +1184,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             # the queue-level story: admission, load shed, capacity
             # transitions, drain (mpi4jax_tpu/serving)
             print(format_serving_timeline(serving))
+            _print_slo_breaches(args.inputs)
     if args.perf:
         from . import perf
 

@@ -91,6 +91,26 @@ def expand_rank_template(path: str, rank: Optional[int] = None) -> str:
         "{rank}", str(current_rank() if rank is None else rank)
     )
 
+
+def current_trace() -> Optional[str]:
+    """The distributed trace id this process is serving under
+    (``M4T_TRACE_ID``), or None outside a traced job.
+
+    Minted at ``serving.spool.submit`` and threaded through every
+    spawn/dispatch seam (``launch.rank_env``, the warm pool's work-item
+    overlay), it is the one key every plane's records join on. Read
+    from the environment on purpose — the warm pool applies it as a
+    per-work-item overlay in a long-lived process, so an import-time
+    snapshot would pin the first job's id forever."""
+    return os.environ.get("M4T_TRACE_ID") or None
+
+
+def current_job() -> Optional[str]:
+    """The serving-plane job id this process is executing
+    (``M4T_JOB_ID``), or None outside a served job. Same dynamic-read
+    contract as :func:`current_trace`."""
+    return os.environ.get("M4T_JOB_ID") or None
+
 #: the shared timestamp format (BENCH_r*_probes.jsonl / PROGRESS.jsonl)
 TS_FORMAT = "%Y-%m-%dT%H:%M:%SZ"
 
@@ -307,13 +327,21 @@ def heartbeat(source: str, **fields: Any) -> Optional[Dict[str, Any]]:
 
 
 def start_heartbeat(
-    interval_s: Optional[float] = None, *, source: str = "heartbeat"
+    interval_s: Optional[float] = None,
+    *,
+    source: str = "heartbeat",
+    **fields: Any,
 ) -> Callable[[], None]:
     """Start a daemon thread emitting a ``heartbeat`` record every
     ``interval_s`` seconds (default ``M4T_HEARTBEAT``, else 5 s);
     returns a zero-argument stopper. Idempotent: a second call
     replaces the previous thread. A no-op stopper is returned when no
     sink is configured — heartbeats without a sink have no reader.
+
+    Extra ``fields`` are stamped on every beat — the serving pool
+    restarts its heartbeat with ``job=<id>`` around each work item so
+    a staleness verdict is attributable to the job that wedged the
+    worker, not just the worker slot.
     """
     global _heartbeat_stop
     if get_sink() is None:
@@ -329,9 +357,9 @@ def start_heartbeat(
         n = 0
         while not stop.wait(period):
             n += 1
-            heartbeat(source, n=n, period_s=period)
+            heartbeat(source, n=n, period_s=period, **fields)
 
-    heartbeat(source, n=0, period_s=period)
+    heartbeat(source, n=0, period_s=period, **fields)
     threading.Thread(
         target=run, name="m4t-heartbeat", daemon=True
     ).start()

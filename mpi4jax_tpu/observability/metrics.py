@@ -185,6 +185,8 @@ class MetricsRegistry:
         shape: Optional[Sequence[int]] = None,
         impl: Optional[str] = None,
         plan: Optional[str] = None,
+        trace: Optional[str] = None,
+        job: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Count one trace-time op emission; returns the record stored
         in the emission ring (shared schema with the JSONL event log).
@@ -193,9 +195,11 @@ class MetricsRegistry:
         (global across ops — the doctor's cross-rank alignment key)
         and ``op_seq`` (per op, also exposed as ``snapshot()['ops']
         [op]['seq']``); both restart from 1 after :meth:`reset`.
-        ``impl``/``plan`` (the planner's routing stamp) are recorded
-        only when given — unarmed emissions stay schema-identical to
-        pre-planner records.
+        ``impl``/``plan`` (the planner's routing stamp) and
+        ``trace``/``job`` (the serving plane's per-job trace context,
+        ``M4T_TRACE_ID``/``M4T_JOB_ID``) are recorded only when given
+        — unarmed emissions stay schema-identical to pre-planner /
+        pre-tracing records.
         """
         record = {
             "kind": "emission",
@@ -213,6 +217,10 @@ class MetricsRegistry:
             record["impl"] = str(impl)
             if plan is not None:
                 record["plan"] = str(plan)
+        if trace is not None:
+            record["trace"] = str(trace)
+        if job is not None:
+            record["job"] = str(job)
         key = _axes_key(axes)
         with self._lock:
             m = self._ops.get(op)
@@ -253,15 +261,21 @@ class MetricsRegistry:
         from . import events
 
         if events.get_sink() is not None:
-            events.emit(
-                {
-                    "kind": "exec",
-                    "cid": cid,
-                    "op": rec["op"] if rec else None,
-                    "seq": rec["seq"] if rec else None,
-                    "t": time.time(),
-                }
-            )
+            exec_rec = {
+                "kind": "exec",
+                "cid": cid,
+                "op": rec["op"] if rec else None,
+                "seq": rec["seq"] if rec else None,
+                "t": time.time(),
+            }
+            # trace context is inherited from the emission record so
+            # exec/latency rows join the same per-job trace; absent
+            # (unarmed) the schema is byte-identical to before
+            if rec and rec.get("trace") is not None:
+                exec_rec["trace"] = rec["trace"]
+            if rec and rec.get("job") is not None:
+                exec_rec["job"] = rec["job"]
+            events.emit(exec_rec)
 
     def mark_runtime_end(self, cid: str, op: str) -> Optional[float]:
         """Host-callback hook: the op finished; records the latency
@@ -285,16 +299,19 @@ class MetricsRegistry:
             rec = self._cid_rec.get(cid)
         from . import events, perf
 
-        events.emit(
-            {
-                "kind": "latency",
-                "cid": cid,
-                "op": op,
-                "seq": rec["seq"] if rec else None,
-                "seconds": sample,
-                "t": time.time(),
-            }
-        )
+        lat_rec = {
+            "kind": "latency",
+            "cid": cid,
+            "op": op,
+            "seq": rec["seq"] if rec else None,
+            "seconds": sample,
+            "t": time.time(),
+        }
+        if rec and rec.get("trace") is not None:
+            lat_rec["trace"] = rec["trace"]
+        if rec and rec.get("job") is not None:
+            lat_rec["job"] = rec["job"]
+        events.emit(lat_rec)
         perf.observe_runtime(op, sample, record=rec, cid=cid)
         return sample
 

@@ -95,11 +95,14 @@ class FlightRecorder:
         world: Optional[int] = None,
         impl: Optional[str] = None,
         plan: Optional[str] = None,
+        trace: Optional[str] = None,
+        job: Optional[str] = None,
     ) -> int:
         """Append one emission; returns its sequence number (0 when
         the recorder is disabled). ``impl``/``plan`` are the planner's
-        routing stamp (only present when the dispatch seam is armed;
-        they do not participate in :func:`fingerprint` — a re-routed
+        routing stamp and ``trace``/``job`` the serving plane's
+        per-job trace context (only present when armed; none of them
+        participate in :func:`fingerprint` — a re-routed or re-traced
         collective is still the *same* collective to the cross-rank
         doctor)."""
         if not self._enabled:
@@ -120,6 +123,10 @@ class FlightRecorder:
             entry["impl"] = str(impl)
             if plan is not None:
                 entry["plan"] = str(plan)
+        if trace is not None:
+            entry["trace"] = str(trace)
+        if job is not None:
+            entry["job"] = str(job)
         with self._lock:
             self._seq += 1
             entry["seq"] = self._seq

@@ -1,10 +1,10 @@
-"""Export merged per-rank telemetry to Chrome trace-event JSON.
+"""Export merged telemetry to Chrome trace-event JSON (Perfetto).
 
-The output loads in Perfetto (ui.perfetto.dev) or ``chrome://tracing``
-and turns the JSONL artifacts into the picture a human actually wants
-of a multi-rank run:
+Two export shapes share one rendering core:
 
-- one **process track per rank** (``pid`` = rank, labeled ``rank N``),
+**Single run** (the PR 2 layout, ``launch --events-dir``): one
+process track per rank (``pid`` = rank, labeled ``rank N``), with
+
 - **duration slices** for every runtime latency sample (``ph: "X"`` —
   start reconstructed as ``t - seconds``), on the rank's "runtime"
   thread,
@@ -19,17 +19,29 @@ of a multi-rank run:
   (``observability/costmodel.py``), so a degrading link shows up as
   a falling "achieved GB/s" curve right in the timeline.
 
-Timestamps are microseconds relative to the earliest record across
-all ranks, so unsynchronized-but-same-host ranks line up the way they
-actually interleaved (cross-host clock skew shows up as track offset,
-which is itself diagnostic).
+**Merged serving trace** (``--serve SPOOL``): one Perfetto file for a
+whole spool of jobs. Every job gets its *own* process group — a
+lifecycle track carrying its span chain (``observability/spans.py``:
+``queued -> verify -> dispatch -> run -> result`` plus
+``attempt<k>``/``spawn``/``warm_dispatch``/``reshard`` children) and
+one track per rank with that job's collective slices, joined by the
+trace id minted at submit (``m4t-job/1`` ``trace`` field; warm-pool
+worker sinks interleave many jobs, so only trace-stamped records are
+attributed). Tracks are keyed by **(job, rank)** — two jobs' rank-0
+streams can never land on one track — and carry
+``process_sort_index`` metadata ordering the file tenant-by-tenant,
+job-by-job, so Perfetto renders per-tenant groups with each job's
+per-rank activity nested under its ``run`` span.
 
-Same inputs as the doctor: event-sink files, flight-recorder dumps,
-or a directory of both (``launch --events-dir``).
+Timestamps are microseconds relative to the earliest record across
+all inputs, so unsynchronized-but-same-host processes line up the way
+they actually interleaved (cross-host clock skew shows up as track
+offset, which is itself diagnostic).
 
 CLI::
 
     python -m mpi4jax_tpu.observability.trace RUNDIR -o trace.json
+    python -m mpi4jax_tpu.observability.trace --serve SPOOL -o out.json
 """
 
 from __future__ import annotations
@@ -52,14 +64,173 @@ _THREAD_NAMES = {
     TID_HEARTBEAT: "heartbeat",
 }
 
+#: thread ids within a job's lifecycle process track
+TID_LIFECYCLE = 0
+TID_ATTEMPTS = 1
+
+#: pids in a merged serving trace: job ``i`` owns the contiguous block
+#: ``[i * JOB_PID_STRIDE, (i+1) * JOB_PID_STRIDE)`` — lifecycle track
+#: first, then one pid per rank — so (job, rank) can never collide
+JOB_PID_STRIDE = 100
+
 
 def _micros(t: float, t0: float) -> float:
     return round((t - t0) * 1e6, 1)
 
 
+def _process_meta(
+    events: List[Dict[str, Any]],
+    pid: int,
+    name: str,
+    sort_index: int,
+    thread_names: Dict[int, str],
+) -> None:
+    events.append(
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": name},
+        }
+    )
+    events.append(
+        {
+            "name": "process_sort_index",
+            "ph": "M",
+            "pid": pid,
+            "tid": 0,
+            "args": {"sort_index": sort_index},
+        }
+    )
+    for tid, tname in thread_names.items():
+        events.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": tname},
+            }
+        )
+
+
+def _rank_events(
+    trace_events: List[Dict[str, Any]],
+    records: List[Dict[str, Any]],
+    *,
+    pid: int,
+    t0: float,
+) -> None:
+    """Render one rank's records (emissions, latency samples,
+    heartbeats, payload + achieved-GB/s counters) onto process
+    ``pid``. Shared by the single-run and merged-serving exports."""
+    # latency -> emission join keys for the achieved-GB/s counter
+    # (cid is exact; seq is the fallback for older latency logs)
+    by_cid: Dict[str, Dict[str, Any]] = {}
+    by_seq: Dict[Any, Dict[str, Any]] = {}
+    for rec in records:
+        if rec.get("kind") in ("emission", "recorder"):
+            if rec.get("cid"):
+                by_cid.setdefault(rec["cid"], rec)
+            if rec.get("seq") is not None:
+                by_seq.setdefault(rec["seq"], rec)
+
+    cumulative_bytes = 0
+    for rec in records:
+        kind = rec.get("kind")
+        t = rec.get("t")
+        if not isinstance(t, (int, float)):
+            continue
+        if kind in ("emission", "recorder"):
+            args = {
+                k: rec[k]
+                for k in ("seq", "cid", "bytes", "dtype", "world",
+                          "trace", "job")
+                if rec.get(k) is not None
+            }
+            if rec.get("axes"):
+                args["axes"] = ",".join(str(a) for a in rec["axes"])
+            trace_events.append(
+                {
+                    "name": rec.get("op", "?"),
+                    "ph": "i",
+                    "s": "t",  # thread-scoped instant
+                    "pid": pid,
+                    "tid": TID_EMISSIONS,
+                    "ts": _micros(t, t0),
+                    "args": args,
+                }
+            )
+            cumulative_bytes += int(rec.get("bytes") or 0)
+            trace_events.append(
+                {
+                    "name": "payload bytes",
+                    "ph": "C",
+                    "pid": pid,
+                    "ts": _micros(t, t0),
+                    "args": {"cumulative": cumulative_bytes},
+                }
+            )
+        elif kind == "latency":
+            seconds = rec.get("seconds")
+            if not isinstance(seconds, (int, float)) or seconds < 0:
+                continue
+            args = {
+                k: rec[k]
+                for k in ("seq", "cid", "trace", "job")
+                if rec.get(k) is not None
+            }
+            trace_events.append(
+                {
+                    "name": rec.get("op", "?"),
+                    "ph": "X",
+                    "pid": pid,
+                    "tid": TID_RUNTIME,
+                    "ts": _micros(t - seconds, t0),
+                    "dur": round(seconds * 1e6, 1),
+                    "args": args,
+                }
+            )
+            emission = by_cid.get(rec.get("cid") or "") or by_seq.get(
+                rec.get("seq")
+            )
+            if emission is not None and seconds > 0:
+                gbps = costmodel.achieved_gbps(
+                    costmodel.record_cost(emission), seconds
+                )
+                if gbps is not None:
+                    trace_events.append(
+                        {
+                            "name": "achieved GB/s",
+                            "ph": "C",
+                            "pid": pid,
+                            "ts": _micros(t, t0),
+                            "args": {"gbps": round(gbps, 6)},
+                        }
+                    )
+        elif kind == "heartbeat":
+            trace_events.append(
+                {
+                    "name": "heartbeat",
+                    "ph": "i",
+                    "s": "t",
+                    "pid": pid,
+                    "tid": TID_HEARTBEAT,
+                    "ts": _micros(t, t0),
+                    "args": {
+                        k: rec[k]
+                        for k in ("source", "n", "job")
+                        if rec.get(k) is not None
+                    },
+                }
+            )
+
+
 def build_trace(by_rank: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
-    """Build the Chrome trace-event object from rank-grouped records
-    (the :func:`mpi4jax_tpu.observability.doctor.load` output)."""
+    """Build the single-run Chrome trace-event object from
+    rank-grouped records (the
+    :func:`mpi4jax_tpu.observability.doctor.load` output)."""
     times = [
         rec["t"]
         for recs in by_rank.values()
@@ -70,131 +241,152 @@ def build_trace(by_rank: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
 
     trace_events: List[Dict[str, Any]] = []
     for rank in sorted(by_rank):
-        trace_events.append(
-            {
-                "name": "process_name",
-                "ph": "M",
-                "pid": rank,
-                "tid": 0,
-                "args": {"name": f"rank {rank}"},
-            }
+        _process_meta(
+            trace_events, rank, f"rank {rank}", rank, _THREAD_NAMES
         )
-        for tid, tname in _THREAD_NAMES.items():
-            trace_events.append(
-                {
-                    "name": "thread_name",
-                    "ph": "M",
-                    "pid": rank,
-                    "tid": tid,
-                    "args": {"name": tname},
-                }
-            )
-
-        # latency -> emission join keys for the achieved-GB/s counter
-        # (cid is exact; seq is the fallback for older latency logs)
-        by_cid: Dict[str, Dict[str, Any]] = {}
-        by_seq: Dict[Any, Dict[str, Any]] = {}
-        for rec in by_rank[rank]:
-            if rec.get("kind") in ("emission", "recorder"):
-                if rec.get("cid"):
-                    by_cid.setdefault(rec["cid"], rec)
-                if rec.get("seq") is not None:
-                    by_seq.setdefault(rec["seq"], rec)
-
-        cumulative_bytes = 0
-        for rec in by_rank[rank]:
-            kind = rec.get("kind")
-            t = rec.get("t")
-            if not isinstance(t, (int, float)):
-                continue
-            if kind in ("emission", "recorder"):
-                args = {
-                    k: rec[k]
-                    for k in ("seq", "cid", "bytes", "dtype", "world")
-                    if rec.get(k) is not None
-                }
-                if rec.get("axes"):
-                    args["axes"] = ",".join(str(a) for a in rec["axes"])
-                trace_events.append(
-                    {
-                        "name": rec.get("op", "?"),
-                        "ph": "i",
-                        "s": "t",  # thread-scoped instant
-                        "pid": rank,
-                        "tid": TID_EMISSIONS,
-                        "ts": _micros(t, t0),
-                        "args": args,
-                    }
-                )
-                cumulative_bytes += int(rec.get("bytes") or 0)
-                trace_events.append(
-                    {
-                        "name": "payload bytes",
-                        "ph": "C",
-                        "pid": rank,
-                        "ts": _micros(t, t0),
-                        "args": {"cumulative": cumulative_bytes},
-                    }
-                )
-            elif kind == "latency":
-                seconds = rec.get("seconds")
-                if not isinstance(seconds, (int, float)) or seconds < 0:
-                    continue
-                args = {
-                    k: rec[k]
-                    for k in ("seq", "cid")
-                    if rec.get(k) is not None
-                }
-                trace_events.append(
-                    {
-                        "name": rec.get("op", "?"),
-                        "ph": "X",
-                        "pid": rank,
-                        "tid": TID_RUNTIME,
-                        "ts": _micros(t - seconds, t0),
-                        "dur": round(seconds * 1e6, 1),
-                        "args": args,
-                    }
-                )
-                emission = by_cid.get(rec.get("cid") or "") or by_seq.get(
-                    rec.get("seq")
-                )
-                if emission is not None and seconds > 0:
-                    gbps = costmodel.achieved_gbps(
-                        costmodel.record_cost(emission), seconds
-                    )
-                    if gbps is not None:
-                        trace_events.append(
-                            {
-                                "name": "achieved GB/s",
-                                "ph": "C",
-                                "pid": rank,
-                                "ts": _micros(t, t0),
-                                "args": {"gbps": round(gbps, 6)},
-                            }
-                        )
-            elif kind == "heartbeat":
-                trace_events.append(
-                    {
-                        "name": "heartbeat",
-                        "ph": "i",
-                        "s": "t",
-                        "pid": rank,
-                        "tid": TID_HEARTBEAT,
-                        "ts": _micros(t, t0),
-                        "args": {
-                            k: rec[k]
-                            for k in ("source", "n")
-                            if rec.get(k) is not None
-                        },
-                    }
-                )
+        _rank_events(trace_events, by_rank[rank], pid=rank, t0=t0)
     return {
         "traceEvents": trace_events,
         "displayTimeUnit": "ms",
         "otherData": {
             "producer": "mpi4jax_tpu.observability.trace",
             "ranks": sorted(by_rank),
+        },
+    }
+
+
+# ---------------------------------------------------------------------
+# merged serving trace (--serve SPOOL)
+# ---------------------------------------------------------------------
+
+
+def load_serve(spool_root: str) -> Dict[str, Any]:
+    """Collect one spool's jobs for :func:`build_serve_trace`: spans
+    and tenant identity from ``serving.jsonl``, each job's per-rank
+    records from its attempt dirs and the (trace-filtered) warm-pool
+    sinks (``spans.collect_job_records``)."""
+    import os
+
+    from . import events as _events
+    from . import spans as _spans
+
+    spool_root = os.path.abspath(spool_root)
+    audit_path = os.path.join(spool_root, "serving.jsonl")
+    records = list(_events.iter_records(audit_path))
+    spans_by_job = _spans.chains(records)
+    tenants: Dict[str, str] = {}
+    order: Dict[str, float] = {}
+    for rec in records:
+        if rec.get("kind") == "serving" and rec.get("job"):
+            job = str(rec["job"])
+            if rec.get("tenant"):
+                tenants.setdefault(job, str(rec["tenant"]))
+    for job, spans in spans_by_job.items():
+        order[job] = min(
+            (float(s.get("t0") or 0.0) for s in spans), default=0.0
+        )
+    jobs = []
+    for job in sorted(
+        spans_by_job,
+        key=lambda j: (tenants.get(j, "default"), order.get(j, 0.0), j),
+    ):
+        spans = spans_by_job[job]
+        trace_id = next(
+            (s.get("trace") for s in spans if s.get("trace")), None
+        )
+        jobs.append({
+            "id": job,
+            "tenant": tenants.get(job, "default"),
+            "trace": trace_id,
+            "spans": spans,
+            "by_rank": _spans.collect_job_records(
+                spool_root, job, trace_id
+            ),
+        })
+    return {"jobs": jobs}
+
+
+def build_serve_trace(serve_data: Dict[str, Any]) -> Dict[str, Any]:
+    """Render the multi-job, multi-plane trace: per-tenant process
+    groups, one lifecycle track per job, and the job's per-rank
+    collective slices keyed by (job, rank)."""
+    from . import spans as _spans
+
+    jobs = serve_data.get("jobs") or []
+    times: List[float] = []
+    for job in jobs:
+        for span in job.get("spans") or []:
+            for key in ("t0", "t1"):
+                if isinstance(span.get(key), (int, float)):
+                    times.append(float(span[key]))
+        for recs in (job.get("by_rank") or {}).values():
+            times.extend(
+                float(r["t"]) for r in recs
+                if isinstance(r.get("t"), (int, float))
+            )
+    t0 = min(times) if times else 0.0
+
+    trace_events: List[Dict[str, Any]] = []
+    for i, job in enumerate(jobs):
+        base = i * JOB_PID_STRIDE
+        label = f"{job.get('tenant', 'default')}/{job.get('id')}"
+        _process_meta(
+            trace_events, base, f"{label} · lifecycle", base,
+            {TID_LIFECYCLE: "lifecycle", TID_ATTEMPTS: "attempts"},
+        )
+        for span in job.get("spans") or []:
+            s0, s1 = span.get("t0"), span.get("t1")
+            if not isinstance(s0, (int, float)) or not isinstance(
+                s1, (int, float)
+            ):
+                continue
+            args = {
+                k: span[k]
+                for k in ("trace", "attempt", "exit_code", "outcome",
+                          "reason", "world", "workers", "passed",
+                          "resume_step", "from_world", "to_world")
+                if span.get(k) is not None
+            }
+            trace_events.append(
+                {
+                    "name": span.get("span", "?"),
+                    "ph": "X",
+                    "pid": base,
+                    "tid": (
+                        TID_ATTEMPTS
+                        if _spans.is_child(span.get("span", ""))
+                        else TID_LIFECYCLE
+                    ),
+                    "ts": _micros(float(s0), t0),
+                    "dur": round(max(0.0, float(s1) - float(s0)) * 1e6, 1),
+                    "args": args,
+                }
+            )
+        by_rank = job.get("by_rank") or {}
+        for rank in sorted(by_rank):
+            # the (job, rank) key: pid is unique per job AND per rank,
+            # so two jobs' rank-0 streams render on separate tracks
+            pid = base + 1 + int(rank)
+            _process_meta(
+                trace_events, pid, f"{label} · rank {rank}", pid,
+                _THREAD_NAMES,
+            )
+            _rank_events(trace_events, by_rank[rank], pid=pid, t0=t0)
+    return {
+        "traceEvents": trace_events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "mpi4jax_tpu.observability.trace",
+            "jobs": [
+                {
+                    "job": job.get("id"),
+                    "tenant": job.get("tenant"),
+                    "trace": job.get("trace"),
+                    "pid": i * JOB_PID_STRIDE,
+                    "ranks": sorted(job.get("by_rank") or {}),
+                }
+                for i, job in enumerate(jobs)
+            ],
         },
     }
 
@@ -215,6 +407,20 @@ def export(
     return obj
 
 
+def export_serve(
+    spool_root: str, out_path: str
+) -> Optional[Dict[str, Any]]:
+    """Merge one spool's spans + per-job telemetry into a single
+    Perfetto file; None when the spool holds no spans."""
+    serve_data = load_serve(spool_root)
+    if not serve_data["jobs"]:
+        return None
+    obj = build_serve_trace(serve_data)
+    with open(out_path, "w") as f:
+        json.dump(obj, f, sort_keys=True)
+    return obj
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m mpi4jax_tpu.observability.trace",
@@ -222,13 +428,40 @@ def main(argv: Optional[List[str]] = None) -> int:
         "trace-event JSON (Perfetto-loadable).",
     )
     parser.add_argument(
-        "inputs", nargs="+", help="per-rank .jsonl files or directories"
+        "inputs", nargs="*",
+        help="per-rank .jsonl files or directories",
+    )
+    parser.add_argument(
+        "--serve", metavar="SPOOL", default=None,
+        help="merged serving trace: render every job in the spool as "
+        "its own process group (lifecycle spans + per-rank collective "
+        "slices joined by trace id) instead of a single-run export",
     )
     parser.add_argument(
         "-o", "--output", required=True, metavar="OUT.json",
         help="trace file to write",
     )
     args = parser.parse_args(argv)
+    if args.serve:
+        if args.inputs:
+            parser.error("--serve takes the spool root, not inputs")
+        obj = export_serve(args.serve, args.output)
+        if obj is None:
+            print(
+                f"trace: no span records in {args.serve} (is it a "
+                "spool root with serving.jsonl?)",
+                file=sys.stderr,
+            )
+            return 2
+        meta = obj["otherData"]["jobs"]
+        print(
+            f"# {len(obj['traceEvents'])} trace events from "
+            f"{len(meta)} job(s) -> {args.output}",
+            file=sys.stderr,
+        )
+        return 0
+    if not args.inputs:
+        parser.error("inputs required (or use --serve SPOOL)")
     obj = export(args.inputs, args.output)
     if obj is None:
         print("trace: no usable records in the given inputs", file=sys.stderr)

@@ -165,6 +165,12 @@ def _telemetry_prologue(
     impl = plan_id = None
     if decision is not None:
         impl, plan_id = decision.impl, decision.plan_id
+    # Serving-plane trace context (armed by M4T_TRACE_ID/M4T_JOB_ID —
+    # launch.rank_env and the warm pool's per-item env overlay): two
+    # env reads when unarmed, and the record schema is byte-identical
+    # without them, same contract as the planner stamp above.
+    trace = _obs.events.current_trace()
+    job = _obs.events.current_job()
     # Flight recorder first (observability/recorder.py): unconditional
     # and telemetry-independent — its ring is the post-mortem record of
     # what this rank was about to emit, kept even when every other
@@ -179,6 +185,8 @@ def _telemetry_prologue(
         world=world,
         impl=impl,
         plan=plan_id,
+        trace=trace,
+        job=job,
     )
     debug.log_emission(
         opname,
@@ -192,6 +200,8 @@ def _telemetry_prologue(
         shape=shape,
         impl=impl,
         plan=plan_id,
+        trace=trace,
+        job=job,
     )
     debug.log_runtime(bound_comm, ident, opname, details)
     # Fault injection LAST (resilience/faults.py): the recorder ring

@@ -303,6 +303,11 @@ class Supervisor:
     retry a transient verdict would otherwise earn: a non-None reason
     vetoes the remaining budget (audited ``action: "abort"`` with that
     reason) — the serving pool's poisoned-job two-strikes rule.
+    ``span_fn(name, t0, t1, **fields)`` receives one
+    ``attempt<k>`` lifecycle span per attempt (start/end of that
+    attempt's ``run_fn``, with ``attempt`` and ``exit_code`` fields) —
+    the serving plane routes these onto the job's distributed trace
+    (``observability/spans.py``); best-effort, like the audit.
     """
 
     def __init__(
@@ -314,6 +319,7 @@ class Supervisor:
         resume_fn: Optional[Callable[[], Optional[int]]] = None,
         extra_fn: Optional[Callable[[int], Dict[str, Any]]] = None,
         abort_fn: Optional[Callable[[int], Optional[str]]] = None,
+        span_fn: Optional[Callable[..., None]] = None,
         audit_path: Optional[str] = None,
         sleep_fn: Callable[[float], None] = time.sleep,
         log: Optional[Callable[[str], None]] = None,
@@ -324,6 +330,7 @@ class Supervisor:
         self.resume_fn = resume_fn or (lambda: None)
         self.extra_fn = extra_fn or (lambda attempt: {})
         self.abort_fn = abort_fn or (lambda attempt: None)
+        self.span_fn = span_fn
         self.audit_path = audit_path
         self.sleep_fn = sleep_fn
         self.log = log or (lambda msg: None)
@@ -353,11 +360,25 @@ class Supervisor:
         extra.update(record)
         self._audit(extra)
 
+    def _span(self, name: str, t0: float, t1: float, **fields: Any) -> None:
+        if self.span_fn is None:
+            return
+        try:
+            self.span_fn(name, t0, t1, **fields)
+        except Exception:
+            pass  # span recording must never mask the run's outcome
+
     def run(self) -> int:
         resume: Optional[int] = resume_step()  # inherit if nested
         exit_code = 0
         for attempt in range(self.policy.retries + 1):
+            attempt_t0 = time.time()
             exit_code = self.run_fn(attempt, resume)
+            self._span(
+                f"attempt{attempt}", attempt_t0, time.time(),
+                attempt=attempt, exit_code=exit_code,
+                resume_step=resume,
+            )
             if exit_code == 0:
                 self._audit_attempt(attempt, {
                     "attempt": attempt, "exit_code": 0,

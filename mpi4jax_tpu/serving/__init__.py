@@ -20,9 +20,17 @@ mailboxes, keeping imports/compile/plan caches warm across jobs,
 watched by a pool doctor that quarantines and respawns wedged,
 crashed, and leaky workers and poisons jobs that wedge workers twice.
 
+Every submitted job carries a **trace id** (minted at submit, additive
+``m4t-job/1`` field) that threads through every plane — lifecycle
+spans on ``serving.jsonl`` (``observability/spans.py``), rank
+environments (``M4T_TRACE_ID``), and armed per-emission telemetry
+stamps — so ``trace --serve SPOOL`` renders one merged Perfetto file
+per spool and ``serve --slo 'p99_latency_s=2.0'`` (:mod:`.slo`)
+attributes SLO breaches to the stage that ate the time.
+
 See ``docs/serving.md`` for the job-spec schema, the scheduler policy
-table, backpressure semantics, the warm-pool lifecycle, and a drain
-walkthrough.
+table, backpressure semantics, the warm-pool lifecycle, the
+SLO-config reference, and a drain walkthrough.
 """
 
 from .scheduler import FairScheduler
@@ -40,11 +48,14 @@ __all__ = [
     "FairScheduler",
     "JobSpec",
     "JobSpecError",
+    "SLOWatch",
     "Server",
     "Spool",
     "WorkerPool",
     "job_comm",
     "parse_job",
+    "parse_slo",
+    "slo",
 ]
 
 
@@ -57,4 +68,12 @@ def __getattr__(name):
         from . import pool as _pool
 
         return getattr(_pool, name)
+    if name in ("SLOWatch", "parse_slo", "slo"):
+        # importlib on purpose: `from . import slo` inside
+        # __getattr__("slo") re-enters this hook through the import
+        # system's hasattr check — instant recursion
+        import importlib
+
+        _slo = importlib.import_module(".slo", __name__)
+        return _slo if name == "slo" else getattr(_slo, name)
     raise AttributeError(name)

@@ -38,6 +38,17 @@ def _cmd_serve(args) -> int:
     spool = Spool(args.spool)
     if args.queue_cap is not None:
         spool.configure(args.queue_cap)
+    slo = None
+    if args.slo:
+        from .slo import SLOError, SLOWatch, parse_slo
+
+        try:
+            slo = SLOWatch(
+                spool, parse_slo(args.slo), min_jobs=args.slo_min_jobs
+            )
+        except SLOError as e:
+            print(f"serve: {e}", file=sys.stderr)
+            return 2
     pool = None
     if args.warm:
         from .pool import WorkerPool
@@ -50,6 +61,7 @@ def _cmd_serve(args) -> int:
             mesh=args.mesh,
             elastic=args.elastic,
             audit=spool.audit,
+            span=spool.span,
         )
     try:
         server = Server(
@@ -63,6 +75,7 @@ def _cmd_serve(args) -> int:
             idle_exit_s=args.idle_exit,
             metrics_port=args.metrics_port,
             pool=pool,
+            slo=slo,
         )
     except ValueError as e:
         print(f"serve: {e}", file=sys.stderr)
@@ -675,6 +688,18 @@ def main(argv=None) -> int:
                    help="with --warm: quarantine a worker after S "
                    "seconds without a fresh heartbeat (default "
                    "max(6 heartbeats, 3s))")
+    p.add_argument("--slo", default=None, metavar="SPEC",
+                   help="declarative SLOs (serving/slo.py): inline "
+                   "'p99_latency_s=2.0[,error_rate=0.05]', inline "
+                   "JSON, or a slo.json path with per-tenant "
+                   "overrides; breaches land as deduped verdict "
+                   "events in SPOOL/slo.jsonl (+ retune "
+                   "recommendations when communication dominates) "
+                   "and the doctor narrates the dominant stage")
+    p.add_argument("--slo-min-jobs", type=int, default=1, metavar="N",
+                   help="finished jobs a tenant needs before its "
+                   "percentile objectives are judged (default "
+                   "%(default)s)")
     p.set_defaults(fn=_cmd_serve)
 
     p = sub.add_parser("submit", help="enqueue one job")

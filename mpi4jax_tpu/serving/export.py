@@ -25,6 +25,17 @@ Families (all prefixed ``m4t_serve_``)::
     m4t_serve_job_run_seconds{job=,tenant=}   gauge   per finished job
     m4t_serve_job_attempts{job=,tenant=}      gauge   per finished job
 
+SLO attribution layer (``serving/slo.py`` — PR 12)::
+
+    m4t_serve_job_latency_seconds{tenant=}    histogram completed-job
+                                                      latency (queue
+                                                      wait + run)
+    m4t_serve_stage_seconds{tenant=,stage=,quantile=} gauge p50/p99 of
+                                                      queue_wait / run
+                                                      per tenant
+    m4t_serve_slo_breaches_total{tenant=,objective=}  counter deduped
+                                                      breach verdicts
+
 With a resident warm pool (``serving/pool.py`` — ``serve --warm``),
 per-worker health joins the exposition, read from the pool's atomic
 ``pool.json`` state snapshot plus the per-worker heartbeat sinks::
@@ -53,6 +64,22 @@ PROM_NAME = "metrics.prom"
 
 #: pool root inside the spool (``serve --warm`` convention)
 POOL_DIR = "pool"
+
+#: latency histogram bucket bounds in seconds (Prometheus-style
+#: upper-inclusive ``le`` edges; +Inf is implicit)
+LATENCY_BUCKETS_S = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(
+        len(sorted_vals) - 1,
+        max(0, int(round(q * (len(sorted_vals) - 1)))),
+    )
+    return sorted_vals[i]
 
 
 def pool_snapshot(
@@ -137,6 +164,19 @@ def serving_snapshot(
             "run_s": rec.get("run_s"),
             "attempts": rec.get("attempts"),
         })
+    slo_breaches: Dict[Any, int] = {}
+    try:
+        from . import slo as _slo
+
+        for rec in _slo.load_slo_verdicts([spool.root]):
+            finding = rec.get("finding") or {}
+            key = (
+                str(finding.get("tenant", "?")),
+                str(finding.get("objective", "?")),
+            )
+            slo_breaches[key] = slo_breaches.get(key, 0) + 1
+    except Exception:
+        pass
     return {
         "depth": spool.depth(),
         "capacity": spool.capacity,
@@ -146,6 +186,7 @@ def serving_snapshot(
         "counts": counts,
         "rejected": rejected,
         "jobs": jobs,
+        "slo_breaches": slo_breaches,
         "pool": pool_snapshot(spool),
     }
 
@@ -198,6 +239,70 @@ def render_serving_metrics(snap: Dict[str, Any]) -> str:
         w.sample(job.get("queue_wait_s"), **labels)
         r.sample(job.get("run_s"), **labels)
         a.sample(job.get("attempts"), **labels)
+
+    # -- SLO attribution layer (serving/slo.py) ------------------------
+    by_tenant: Dict[str, Dict[str, list]] = {}
+    for job in snap.get("jobs", []):
+        if job.get("outcome") != "completed":
+            continue
+        tenant = str(job.get("tenant") or "?")
+        wait = float(job.get("queue_wait_s") or 0.0)
+        run = float(job.get("run_s") or 0.0)
+        t = by_tenant.setdefault(tenant, {"latency": [], "wait": [],
+                                          "run": []})
+        t["latency"].append(wait + run)
+        t["wait"].append(wait)
+        t["run"].append(run)
+    out.append("# TYPE m4t_serve_job_latency_seconds histogram")
+    out.append(
+        "# HELP m4t_serve_job_latency_seconds Completed-job latency "
+        "(queue wait + run) per tenant."
+    )
+    for tenant in sorted(by_tenant):
+        latencies = by_tenant[tenant]["latency"]
+        cumulative = 0
+        for edge in LATENCY_BUCKETS_S:
+            cumulative = sum(1 for v in latencies if v <= edge)
+            out.append(
+                "m4t_serve_job_latency_seconds_bucket"
+                + _export._labels(sorted(
+                    {"tenant": tenant, "le": _export._num(edge)}.items()
+                ))
+                + f" {cumulative}"
+            )
+        out.append(
+            "m4t_serve_job_latency_seconds_bucket"
+            + _export._labels(sorted(
+                {"tenant": tenant, "le": "+Inf"}.items()
+            ))
+            + f" {len(latencies)}"
+        )
+        out.append(
+            "m4t_serve_job_latency_seconds_count"
+            + _export._labels([("tenant", tenant)])
+            + f" {len(latencies)}"
+        )
+        out.append(
+            "m4t_serve_job_latency_seconds_sum"
+            + _export._labels([("tenant", tenant)])
+            + f" {_export._num(sum(latencies))}"
+        )
+    g = _export._Family(out, "m4t_serve_stage_seconds", "gauge",
+                        "Per-tenant stage latency quantiles "
+                        "(queue_wait / run, p50 / p99).")
+    for tenant in sorted(by_tenant):
+        for stage, key in (("queue_wait", "wait"), ("run", "run")):
+            vals = sorted(by_tenant[tenant][key])
+            for quantile, q in (("p50", 0.50), ("p99", 0.99)):
+                g.sample(_pct(vals, q), tenant=tenant, stage=stage,
+                         quantile=quantile)
+    c = _export._Family(out, "m4t_serve_slo_breaches_total", "counter",
+                        "Deduped SLO-breach verdicts by tenant and "
+                        "objective (serving/slo.py).")
+    for (tenant, objective), n in sorted(
+        (snap.get("slo_breaches") or {}).items()
+    ):
+        c.sample(n, tenant=tenant, objective=objective)
 
     pool = snap.get("pool")
     if pool:

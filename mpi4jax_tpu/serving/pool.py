@@ -285,6 +285,12 @@ def run_item(
     }
     if item.get("job"):
         overlay["M4T_JOB_ID"] = str(item["job"])
+    if item.get("trace"):
+        # the job's distributed trace id: every emission the payload
+        # makes in this warm process is stamped with it (ops/_core.py),
+        # which is what attributes this worker's shared sink records
+        # to the submitting job
+        overlay["M4T_TRACE_ID"] = str(item["trace"])
     if group:
         overlay["M4T_POOL_GROUP"] = json.dumps(group)
     if item.get("resume_step") is not None:
@@ -413,6 +419,19 @@ def worker_loop(
             job=item.get("job"), item=item.get("item"),
             attempt=item.get("attempt", 0), t=time.time(),
         ))
+        # while the payload runs, heartbeats name the job occupying
+        # this worker: a staleness verdict (HeartbeatTail deadline,
+        # `wedged`/`job_timeout` quarantine) is then attributable to
+        # the job that wedged the slot, not just the slot — the
+        # evidence trail behind the two-strikes poisoning rule
+        busy_fields: Dict[str, Any] = {}
+        if item.get("job"):
+            busy_fields["job"] = item["job"]
+        if item.get("trace"):
+            busy_fields["trace"] = item["trace"]
+        events.start_heartbeat(
+            heartbeat_s, source="pool-worker", **busy_fields
+        )
         result = run_item(item, worker=rank, incarnation=incarnation)
         served += 1
         _write_json_atomic(
@@ -531,6 +550,7 @@ class WorkerPool:
         elastic: bool = False,
         max_strikes: int = DEFAULT_MAX_STRIKES,
         audit: Optional[Callable[..., None]] = None,
+        span: Optional[Callable[..., None]] = None,
         log: Optional[Callable[[str], None]] = None,
         clock: Callable[[], float] = time.monotonic,
     ):
@@ -559,6 +579,11 @@ class WorkerPool:
         self.elastic = bool(elastic)
         self.max_strikes = int(max_strikes)
         self._audit_fn = audit
+        #: ``span(name, job=, t0=, t1=, trace=, **fields)`` — the
+        #: Spool.span seam: the runner records one ``warm_dispatch``
+        #: lifecycle span per attempt (mailbox hand-off latency, the
+        #: warm analog of the cold path's ``spawn`` span)
+        self._span_fn = span
         self._log = log or (lambda msg: sys.stderr.write(
             f"m4t.pool: {msg}\n"
         ))
@@ -1017,6 +1042,8 @@ class WorkerPool:
         Returns ``(exit_code, preempted_group_ranks)`` exactly like
         ``launch.spawn_world``."""
         job = str(spec.id)
+        trace = getattr(spec, "trace", None)
+        dispatch_t0 = time.time()
         if self.poisoned(job):
             self._audit("pool_refused", job=job, reason="poisoned")
             self._log(f"job {job}: dispatch refused (poisoned)")
@@ -1051,6 +1078,7 @@ class WorkerPool:
                 "schema": WORK_SCHEMA,
                 "item": item_id,
                 "job": job,
+                "trace": trace,
                 "attempt": attempt,
                 "cmd": list(spec.cmd) if spec.cmd else None,
                 "module": spec.module,
@@ -1064,6 +1092,18 @@ class WorkerPool:
                     "world": self.size,
                 },
             })
+        if self._span_fn is not None:
+            # acquire + item fan-out: the warm path's whole dispatch
+            # cost — the number the cold path's `spawn` span is
+            # measured against
+            try:
+                self._span_fn(
+                    "warm_dispatch", job=job, t0=dispatch_t0,
+                    t1=time.time(), trace=trace, attempt=attempt,
+                    world=int(world), workers=ranks,
+                )
+            except Exception:
+                pass
         timeout = float(getattr(spec, "timeout_s", 0.0) or 0.0)
         deadline = self.clock() + timeout if timeout > 0 else None
         rc: Optional[int] = None

@@ -87,6 +87,7 @@ class Server:
         verify_fn: Optional[Callable[[JobSpec, int], bool]] = None,
         metrics_port: Optional[int] = None,
         pool: Optional[Any] = None,
+        slo: Optional[Any] = None,
         log: Callable[[str], None] = _default_log,
     ):
         if nproc < 1:
@@ -109,10 +110,18 @@ class Server:
         self._pool = pool
         if pool is not None and runner is None:
             runner = pool.runner
+        if pool is not None and getattr(pool, "_span_fn", None) is None:
+            # the pool's warm_dispatch spans belong on the same trace
+            # the server's chain spans land on — wire its span seam to
+            # this spool unless a harness already did
+            pool._span_fn = spool.span
         self._runner = runner or self._launch_runner
         self._verify_fn = verify_fn or self._launch_verify
         self.metrics_port = metrics_port
         self._http = None
+        #: the SLO watch (serving/slo.py), if armed: evaluated after
+        #: every finished job, breaches land as deduped verdict events
+        self._slo = slo
         self._log = log
         self._metrics_lock = threading.Lock()
         self.jobs_served = 0
@@ -130,7 +139,22 @@ class Server:
             cmd=list(spec.cmd or []),
             module=spec.module,
             hang_timeout=float(spec.timeout_s or 0.0),
+            # per-job trace context: every rank's telemetry records
+            # join the job's span chain on this key
+            trace_id=spec.trace,
+            job_id=spec.id,
         )
+
+    def _job_span(self, spec: JobSpec, name: str, t0: float, t1: float,
+                  **fields: Any) -> None:
+        """One lifecycle span on this job's trace (best-effort)."""
+        try:
+            self.spool.span(
+                name, job=spec.id, t0=t0, t1=t1, trace=spec.trace,
+                tenant=spec.tenant, **fields,
+            )
+        except Exception:
+            pass
 
     def _launch_runner(
         self,
@@ -158,6 +182,9 @@ class Server:
             fault_plan_env=fault_plan_env,
             world=world,
             extra_env=spec.env,
+            span_fn=lambda name, t0, t1: self._job_span(
+                spec, name, t0, t1, attempt=attempt, world=world,
+            ),
         )
 
     def _launch_verify(self, spec: JobSpec, world: int) -> bool:
@@ -287,6 +314,7 @@ class Server:
                         "resuming from step 0"
                     )
                 else:
+                    reshard_t0 = time.time()
                     new_info = _reshard.reshard_checkpoint(
                         mgr, info, new_world,
                         log=lambda m: self._log(f"job {spec.id}: {m}"),
@@ -295,6 +323,11 @@ class Server:
                     reshard_src = {
                         "step": info.step, "world": info.world,
                     }
+                    self._job_span(
+                        spec, "reshard", reshard_t0, time.time(),
+                        from_world=info.world, to_world=new_world,
+                        step=info.step,
+                    )
             except Exception as exc:
                 self._log(
                     f"job {spec.id}: reshard failed ({exc!r}); "
@@ -331,7 +364,7 @@ class Server:
         (``completed`` / ``failed`` / ``rejected``). Never raises —
         a job is its own fault domain."""
         try:
-            return self._run_job(spec)
+            outcome = self._run_job(spec)
         except Exception as exc:
             self._log(f"job {spec.id}: internal error: {exc!r}")
             try:
@@ -345,7 +378,21 @@ class Server:
                 )
             except Exception:
                 pass
-            return "failed"
+            outcome = "failed"
+        self._check_slo()
+        return outcome
+
+    def _check_slo(self) -> None:
+        """Evaluate the armed SLO config over the finished jobs; new
+        breaches land as verdict events (serving/slo.py). Best-effort
+        like metrics: attribution must never take the queue down."""
+        if self._slo is None:
+            return
+        try:
+            for breach in self._slo.check():
+                self._log(self._slo.narrate(breach))
+        except Exception:
+            pass
 
     def _run_job(self, spec: JobSpec) -> str:
         t0 = time.time()
@@ -354,20 +401,34 @@ class Server:
         self.spool.audit(
             "admitted", job=spec.id, tenant=spec.tenant, world=world,
             requested_nproc=spec.nproc, queue_wait_s=round(wait_s, 6),
+            trace=spec.trace,
         )
-        if (self.verify or spec.verify) and not self._verify_fn(
-            spec, world
-        ):
-            # the unprovable program never touches the shared mesh
-            self.spool.finish(
-                spec, "rejected", reason="verify_failed", world=world,
-                queue_wait_s=wait_s,
+        # the chain spans share boundary clock reads on purpose:
+        # queued.t1 == verify.t0 == ... — gaplessness by construction,
+        # which is exactly what the span-chain property test asserts
+        self._job_span(
+            spec, "queued", (spec.submitted_t or t0), t0,
+            depth_wait_s=round(wait_s, 6),
+        )
+        t_gate = t0
+        if self.verify or spec.verify:
+            verified = self._verify_fn(spec, world)
+            t_gate = time.time()
+            self._job_span(
+                spec, "verify", t0, t_gate, world=world,
+                passed=verified,
             )
-            self.spool.audit(
-                "rejected", job=spec.id, tenant=spec.tenant,
-                reason="verify_failed", world=world,
-            )
-            return "rejected"
+            if not verified:
+                # the unprovable program never touches the shared mesh
+                self.spool.finish(
+                    spec, "rejected", reason="verify_failed",
+                    world=world, queue_wait_s=wait_s,
+                )
+                self.spool.audit(
+                    "rejected", job=spec.id, tenant=spec.tenant,
+                    reason="verify_failed", world=world,
+                )
+                return "rejected"
 
         jobdir = self.spool.job_dir(spec.id)
         state: Dict[str, Any] = {
@@ -466,10 +527,21 @@ class Server:
             resume_fn=resume_fn,
             extra_fn=extra_fn,
             abort_fn=abort_fn,
+            span_fn=lambda name, s0, s1, **f: self._job_span(
+                spec, name, s0, s1, **f
+            ),
             audit_path=self.spool.audit_path,
             log=self._log,
         )
+        t_run = time.time()
+        self._job_span(spec, "dispatch", t_gate, t_run, world=world)
         rc = sup.run()
+        t_run_end = time.time()
+        self._job_span(
+            spec, "run", t_run, t_run_end,
+            attempts=len(sup.attempts), exit_code=rc,
+            world=state["world_ran"],
+        )
         run_s = time.time() - t0
         last = sup.attempts[-1] if sup.attempts else {}
         common = dict(
@@ -482,6 +554,10 @@ class Server:
             self.spool.finish(spec, "completed", **common)
             self.spool.audit(
                 "completed", job=spec.id, tenant=spec.tenant, **common
+            )
+            self._job_span(
+                spec, "result", t_run_end, time.time(),
+                outcome="completed",
             )
             return "completed"
         if self._pool is not None and self._pool.poisoned(spec.id):
@@ -499,6 +575,10 @@ class Server:
         self.spool.audit(
             "failed", job=spec.id, tenant=spec.tenant, exit_code=rc,
             klass=last.get("klass"), reason=reason, **common,
+        )
+        self._job_span(
+            spec, "result", t_run_end, time.time(),
+            outcome="failed", reason=reason,
         )
         return "failed"
 

@@ -1,0 +1,535 @@
+"""Per-tenant SLOs over the serving plane, with stage attribution.
+
+The span chain (``observability/spans.py``) decomposes every job's
+wall clock; this module turns that decomposition into objectives and
+verdicts:
+
+- **Latency breakdown** — :func:`job_breakdown` splits one finished
+  job into ``queue_wait / verify / dispatch / spawn|warm_dispatch /
+  comm / compute / result`` seconds. The communication share comes
+  from the PR 4 attribution join over the job's own telemetry
+  records (``spans.collect_job_records``: dedicated attempt dirs on
+  the cold path, trace-id-filtered resident-worker sinks on the warm
+  path): every runtime ``latency`` sample is collective time, so
+  ``comm`` is the per-rank mean of sampled collective seconds and
+  ``compute`` is the run remainder.
+- **Objectives** — a declarative config (``serve --slo
+  'p99_latency_s=2.0'`` inline, or a JSON file with per-tenant
+  overrides) over per-tenant percentiles of finished-job latency
+  (queue wait + run), queue wait alone, and the failure rate::
+
+      {"default": {"p99_latency_s": 2.0},
+       "tenants": {"bulk": {"p99_latency_s": 30.0,
+                            "error_rate": 0.1}}}
+
+- **Breach verdicts** — :class:`SLOWatch` evaluates after every
+  finished job and appends *deduped* verdict events to
+  ``SPOOL/slo.jsonl`` in the exact shape the PR 8 retune loop
+  consumes (``{"kind": "verdict", "finding": {...}, "klass": ...}``),
+  plus a ``retune`` recommendation carrying the breached job's plan
+  keys whenever the dominant stage is communication — so ``planner
+  tune --from-verdicts SPOOL`` can re-pin from an SLO breach the same
+  way it re-pins from a live straggler. Every breach is also audited
+  (``event: "slo_breach"``) on ``serving.jsonl``.
+- **Narration** — :func:`narrate` names the dominant stage in
+  operator language (``job j7: 83% queue-wait -> capacity, not
+  compute``); the doctor prints it whenever a spool with SLO verdicts
+  is diagnosed.
+
+Import-light (stdlib only) like the rest of the offline stack.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple, Union
+
+from .spool import Spool
+
+SLO_LOG_NAME = "slo.jsonl"
+
+#: recognised objective keys
+_QUANTILE_RE = re.compile(r"^p(\d{2})_(latency|queue_wait)_s$")
+_SCALAR_OBJECTIVES = frozenset({"error_rate"})
+
+#: stage -> what the dominant stage means for the operator
+STAGE_ADVICE = {
+    "queue_wait": "capacity, not compute",
+    "verify": "admission gate",
+    "dispatch": "control-plane overhead",
+    "spawn": "cold spawn latency — consider serve --warm",
+    "warm_dispatch": "pool dispatch latency",
+    "comm": "communication-bound — retune candidates recorded",
+    "compute": "compute-bound",
+    "result": "bookkeeping",
+}
+
+
+class SLOError(ValueError):
+    """An SLO config that cannot mean what was written."""
+
+
+def _check_objectives(obj: Dict[str, Any], where: str) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for key, value in obj.items():
+        if not _QUANTILE_RE.match(key) and key not in _SCALAR_OBJECTIVES:
+            raise SLOError(
+                f"slo: unknown objective {key!r} in {where} (want "
+                f"pNN_latency_s / pNN_queue_wait_s / error_rate)"
+            )
+        if not isinstance(value, (int, float)) or isinstance(
+            value, bool
+        ) or value < 0:
+            raise SLOError(
+                f"slo: {where}: {key} must be a non-negative number "
+                f"(got {value!r})"
+            )
+        out[key] = float(value)
+    return out
+
+
+def parse_slo(spec: Union[str, Dict[str, Any]]) -> Dict[str, Any]:
+    """Parse an SLO config into ``{"default": {...}, "tenants":
+    {...}}``. Accepts the inline ``k=v[,k=v...]`` CLI form, a path to
+    a JSON file, or a decoded/inline JSON object (flat = default for
+    every tenant, or the full two-level shape)."""
+    if isinstance(spec, str):
+        text = spec.strip()
+        if os.path.exists(text):
+            with open(text) as f:
+                try:
+                    spec = json.load(f)
+                except json.JSONDecodeError as e:
+                    raise SLOError(f"slo: {text}: not valid JSON: {e}")
+        elif text.startswith("{"):
+            try:
+                spec = json.loads(text)
+            except json.JSONDecodeError as e:
+                raise SLOError(f"slo: not valid JSON: {e}")
+        else:
+            obj: Dict[str, Any] = {}
+            for part in text.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                key, sep, value = part.partition("=")
+                if not sep:
+                    raise SLOError(
+                        f"slo: expected objective=threshold, got {part!r}"
+                    )
+                try:
+                    obj[key.strip()] = float(value)
+                except ValueError:
+                    raise SLOError(
+                        f"slo: {key.strip()}: threshold {value!r} is "
+                        "not a number"
+                    )
+            spec = obj
+    if not isinstance(spec, dict):
+        raise SLOError("slo: config must be a JSON object")
+    if "default" in spec or "tenants" in spec:
+        unknown = set(spec) - {"default", "tenants"}
+        if unknown:
+            raise SLOError(f"slo: unknown section(s) {sorted(unknown)}")
+        default = _check_objectives(spec.get("default") or {}, "default")
+        tenants_in = spec.get("tenants") or {}
+        if not isinstance(tenants_in, dict):
+            raise SLOError("slo: tenants must be an object")
+        tenants = {
+            str(t): _check_objectives(o or {}, f"tenant {t!r}")
+            for t, o in tenants_in.items()
+        }
+    else:
+        default = _check_objectives(spec, "default")
+        tenants = {}
+    if not default and not any(tenants.values()):
+        raise SLOError("slo: config declares no objectives")
+    return {"default": default, "tenants": tenants}
+
+
+def objectives_for(config: Dict[str, Any], tenant: str) -> Dict[str, float]:
+    """Effective objectives for one tenant: default, overridden per
+    tenant key by key."""
+    out = dict(config.get("default") or {})
+    out.update((config.get("tenants") or {}).get(tenant) or {})
+    return out
+
+
+def _pct(sorted_vals: List[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    i = min(
+        len(sorted_vals) - 1,
+        max(0, int(round(q * (len(sorted_vals) - 1)))),
+    )
+    return sorted_vals[i]
+
+
+# ---------------------------------------------------------------------
+# per-job stage breakdown
+# ---------------------------------------------------------------------
+
+
+def _span_dur(
+    spans: List[Dict[str, Any]], name: str
+) -> float:
+    return sum(
+        float(s.get("dur_s") or 0.0) for s in spans
+        if s.get("span") == name
+    )
+
+
+def comm_seconds(by_rank: Dict[int, List[Dict[str, Any]]]) -> float:
+    """Per-rank mean of sampled collective seconds — the cid->latency
+    attribution join's time-side aggregate (the bandwidth side lives
+    in ``observability/perf.py``). 0.0 when runtime sampling was off
+    (the breakdown then reports the whole run as compute, honestly
+    labelled by ``sampled=False``)."""
+    if not by_rank:
+        return 0.0
+    per_rank = []
+    for recs in by_rank.values():
+        total = sum(
+            float(r.get("seconds") or 0.0)
+            for r in recs
+            if r.get("kind") == "latency"
+            and isinstance(r.get("seconds"), (int, float))
+            and r["seconds"] >= 0
+        )
+        per_rank.append(total)
+    live = [t for t in per_rank if t > 0]
+    return sum(live) / len(live) if live else 0.0
+
+
+def job_breakdown(
+    root: str,
+    job_id: str,
+    *,
+    spans: Optional[List[Dict[str, Any]]] = None,
+    trace: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Decompose one job's wall clock into stage seconds. ``spans``
+    may be pre-loaded (one ``span_records()`` read serves many jobs);
+    otherwise the spool's audit log is read."""
+    from ..observability import spans as _spans
+
+    if spans is None:
+        spans = [
+            s for s in _spans.load_spans([root])
+            if s.get("job") == job_id
+        ]
+    else:
+        spans = [s for s in spans if s.get("job") == job_id]
+    if trace is None:
+        trace = next(
+            (s.get("trace") for s in spans if s.get("trace")), None
+        )
+    run_s = _span_dur(spans, "run")
+    spawn_s = _span_dur(spans, "spawn")
+    warm_s = _span_dur(spans, "warm_dispatch")
+    reshard_s = _span_dur(spans, "reshard")
+    by_rank = _spans.collect_job_records(root, job_id, trace)
+    comm_s = min(comm_seconds(by_rank), max(0.0, run_s))
+    stages: Dict[str, float] = {
+        "queue_wait": _span_dur(spans, "queued"),
+        "verify": _span_dur(spans, "verify"),
+        "dispatch": _span_dur(spans, "dispatch"),
+        "spawn": spawn_s,
+        "warm_dispatch": warm_s,
+        "reshard": reshard_s,
+        "comm": comm_s,
+        "compute": max(
+            0.0, run_s - spawn_s - warm_s - reshard_s - comm_s
+        ),
+        "result": _span_dur(spans, "result"),
+    }
+    total = sum(stages.values())
+    return {
+        "job": job_id,
+        "trace": trace,
+        "stages": {k: round(v, 9) for k, v in stages.items()},
+        "total_s": round(total, 9),
+        "run_s": round(run_s, 9),
+        "sampled": comm_s > 0.0,
+        "ranks": sorted(by_rank),
+    }
+
+
+def dominant_stage(breakdown: Dict[str, Any]) -> Tuple[str, float]:
+    """The stage that ate the job, as ``(name, share-of-total)``."""
+    stages = breakdown.get("stages") or {}
+    total = float(breakdown.get("total_s") or 0.0)
+    if not stages or total <= 0:
+        return "compute", 0.0
+    name = max(stages, key=lambda k: stages[k])
+    return name, stages[name] / total
+
+
+# ---------------------------------------------------------------------
+# evaluation
+# ---------------------------------------------------------------------
+
+
+def evaluate(
+    spool: Union[Spool, str],
+    config: Dict[str, Any],
+    *,
+    min_jobs: int = 1,
+) -> List[Dict[str, Any]]:
+    """Check every tenant's objectives against its finished jobs.
+    Returns breaches (worst-job attributed); an objective with fewer
+    than ``min_jobs`` finished jobs is not judged."""
+    if not isinstance(spool, Spool):
+        spool = Spool(spool)
+    by_tenant: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in spool.done():
+        tenant = str(rec.get("tenant") or "default")
+        by_tenant.setdefault(tenant, []).append(rec)
+    span_recs = spool.span_records()
+    breaches: List[Dict[str, Any]] = []
+    for tenant in sorted(by_tenant):
+        objectives = objectives_for(config, tenant)
+        if not objectives:
+            continue
+        finished = by_tenant[tenant]
+        done_ok = [
+            r for r in finished if r.get("outcome") == "completed"
+        ]
+        latencies = sorted(
+            float(r.get("queue_wait_s") or 0.0)
+            + float(r.get("run_s") or 0.0)
+            for r in done_ok
+        )
+        waits = sorted(
+            float(r.get("queue_wait_s") or 0.0) for r in done_ok
+        )
+        failed = [r for r in finished if r.get("outcome") == "failed"]
+        for objective, threshold in sorted(objectives.items()):
+            observed: Optional[float] = None
+            pool: List[Dict[str, Any]] = done_ok
+            if objective == "error_rate":
+                if len(finished) >= min_jobs and finished:
+                    observed = len(failed) / len(finished)
+                pool = failed or finished
+            else:
+                m = _QUANTILE_RE.match(objective)
+                q = int(m.group(1)) / 100.0
+                vals = latencies if m.group(2) == "latency" else waits
+                if len(vals) >= min_jobs:
+                    observed = _pct(vals, q)
+            if observed is None or observed <= threshold:
+                continue
+            worst = max(
+                pool,
+                key=lambda r: (
+                    float(r.get("queue_wait_s") or 0.0)
+                    + float(r.get("run_s") or 0.0)
+                ),
+                default=None,
+            ) if pool else None
+            breach: Dict[str, Any] = {
+                "tenant": tenant,
+                "objective": objective,
+                "threshold": threshold,
+                "observed": round(float(observed), 9),
+                "jobs": len(finished),
+            }
+            if worst is not None:
+                bd = job_breakdown(
+                    spool.root, str(worst.get("id")),
+                    spans=span_recs, trace=worst.get("trace"),
+                )
+                stage, share = dominant_stage(bd)
+                breach.update(
+                    job=worst.get("id"),
+                    trace=bd.get("trace") or worst.get("trace"),
+                    dominant_stage=stage,
+                    dominant_share=round(share, 6),
+                    stages=bd["stages"],
+                )
+            breaches.append(breach)
+    return breaches
+
+
+def narrate(breach: Dict[str, Any]) -> str:
+    """The operator sentence: name the job, the dominant stage, and
+    what it implies."""
+    stage = breach.get("dominant_stage") or "?"
+    share = breach.get("dominant_share")
+    head = (
+        f"SLO breach [{breach.get('tenant')}]: "
+        f"{breach.get('objective')} = {breach.get('observed'):.3g} "
+        f"> {breach.get('threshold'):.3g}"
+    )
+    if breach.get("job") is None or share is None:
+        return head
+    label = "queue-wait" if stage == "queue_wait" else stage
+    return (
+        f"{head} — job {breach['job']}: {share * 100.0:.0f}% {label} "
+        f"→ {STAGE_ADVICE.get(stage, stage)}"
+    )
+
+
+# ---------------------------------------------------------------------
+# the watch: dedupe + verdict/retune emission
+# ---------------------------------------------------------------------
+
+
+class SLOWatch:
+    """Evaluate on demand; emit each breach exactly once.
+
+    The dedupe key is ``(tenant, objective, worst job)``: a breach
+    re-observed over the same evidence stays quiet, a *new* worst job
+    (the breach moved, or got worse somewhere else) speaks again —
+    the streaming doctor's once-per-key convention.
+    """
+
+    def __init__(
+        self,
+        spool: Union[Spool, str],
+        config: Dict[str, Any],
+        *,
+        verdict_log: Optional[str] = None,
+        min_jobs: int = 1,
+    ):
+        self.spool = spool if isinstance(spool, Spool) else Spool(spool)
+        self.config = config
+        self.min_jobs = int(min_jobs)
+        self.verdict_log = verdict_log or os.path.join(
+            self.spool.root, SLO_LOG_NAME
+        )
+        self._seen: set = set()
+
+    def _append(self, record: Dict[str, Any]) -> None:
+        from ..observability import events
+
+        try:
+            events.EventLog(self.verdict_log).append(record)
+        except OSError:
+            pass  # the verdict log must never take the queue down
+
+    def _plan_keys(self, breach: Dict[str, Any]) -> List[str]:
+        """Plan keys of the breached job's plannable emissions — what
+        ``planner tune --from-verdicts`` should sweep."""
+        try:
+            from .. import config as _config
+            from ..observability import spans as _spans
+            from ..planner import plan as _plan
+
+            platform = _config.PLATFORM_CLASS or "cpu"
+            by_rank = _spans.collect_job_records(
+                self.spool.root, str(breach.get("job")),
+                breach.get("trace"),
+            )
+            records = [
+                r for recs in by_rank.values() for r in recs
+                if r.get("kind") in ("emission", "recorder")
+            ]
+            return _plan.keys_from_records(records, platform)
+        except Exception:
+            return []
+
+    def check(self) -> List[Dict[str, Any]]:
+        """One evaluation pass; returns (and emits) the new breaches."""
+        new: List[Dict[str, Any]] = []
+        for breach in evaluate(
+            self.spool, self.config, min_jobs=self.min_jobs
+        ):
+            key = (
+                breach["tenant"], breach["objective"],
+                breach.get("job"),
+            )
+            if key in self._seen:
+                continue
+            self._seen.add(key)
+            new.append(breach)
+            finding = {"kind": "slo_breach"}
+            finding.update(breach)
+            # the PR 8 verdict-event shape: stream_doctor appends the
+            # same {kind, finding, klass, t} envelope to live.jsonl —
+            # SLO breaches are capacity/performance trouble, i.e. the
+            # supervisor's *transient* class, never deterministic
+            self._append({
+                "kind": "verdict",
+                "finding": finding,
+                "klass": "transient",
+                "t": time.time(),
+            })
+            self.spool.audit(
+                "slo_breach",
+                tenant=breach["tenant"],
+                objective=breach["objective"],
+                observed=breach["observed"],
+                threshold=breach["threshold"],
+                job=breach.get("job"),
+                trace=breach.get("trace"),
+                dominant_stage=breach.get("dominant_stage"),
+            )
+            if breach.get("dominant_stage") == "comm":
+                plan_keys = self._plan_keys(breach)
+                if plan_keys:
+                    self._append({
+                        "kind": "retune",
+                        "reason": "slo_breach",
+                        "op": None,
+                        "rank": None,
+                        "plan_keys": plan_keys,
+                        "detail": {
+                            "tenant": breach["tenant"],
+                            "objective": breach["objective"],
+                            "observed": breach["observed"],
+                            "threshold": breach["threshold"],
+                            "job": breach.get("job"),
+                        },
+                        "t": time.time(),
+                    })
+        return new
+
+    @staticmethod
+    def narrate(breach: Dict[str, Any]) -> str:
+        return narrate(breach)
+
+
+def load_slo_verdicts(inputs: Iterable[str]) -> List[Dict[str, Any]]:
+    """``slo.jsonl`` verdict records found beside the given inputs or
+    up to three levels up (the ``load_serving_audit`` discovery walk,
+    so the doctor pointed at one job attempt finds the spool's SLO
+    trail)."""
+    from ..observability import events
+
+    seen: set = set()
+    records: List[Dict[str, Any]] = []
+    for item in inputs:
+        d = item if os.path.isdir(item) else os.path.dirname(item)
+        d = os.path.abspath(d)
+        cands = [d]
+        for _ in range(3):
+            cands.append(os.path.dirname(cands[-1]))
+        for cand in cands:
+            path = os.path.join(cand, SLO_LOG_NAME)
+            if path in seen:
+                continue
+            seen.add(path)
+            if not os.path.exists(path):
+                continue
+            try:
+                records.extend(
+                    r for r in events.iter_records(path)
+                    if r.get("kind") == "verdict"
+                    and (r.get("finding") or {}).get("kind")
+                    == "slo_breach"
+                )
+            except OSError:
+                continue
+    return records
+
+
+def format_slo_breaches(records: List[Dict[str, Any]]) -> str:
+    """The doctor's SLO section: one narration line per breach."""
+    lines = [f"SLO breaches ({len(records)} verdict(s)):"]
+    for rec in records:
+        lines.append("  " + narrate(rec.get("finding") or {}))
+    return "\n".join(lines)

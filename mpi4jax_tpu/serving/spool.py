@@ -61,6 +61,7 @@ DRAIN_SENTINEL = "DRAIN"
 DEFAULT_CAPACITY = 16
 
 _ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+_TRACE_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,127}$")
 _ENTRY_RE = re.compile(r"^(\d{20})-(.+)\.json$")
 
 #: job-spec fields accepted by :func:`parse_job`; anything else is a
@@ -68,7 +69,7 @@ _ENTRY_RE = re.compile(r"^(\d{20})-(.+)\.json$")
 _JOB_FIELDS = frozenset({
     "schema", "id", "tenant", "cmd", "module", "nproc", "timeout_s",
     "retries", "backoff_s", "verify", "resume_dir", "fault_plan", "env",
-    "submitted_t",
+    "submitted_t", "trace",
 })
 
 
@@ -94,6 +95,11 @@ class JobSpec:
     fault_plan: Any = None             # chaos: per-job M4T_FAULT_PLAN
     env: Optional[Dict[str, str]] = None
     submitted_t: Optional[float] = None
+    #: distributed trace id (additive ``m4t-job/1`` field): minted at
+    #: submit when absent, exported to every rank / work item as
+    #: ``M4T_TRACE_ID``, stamped on every span and audit record — the
+    #: one key all of this job's telemetry joins on
+    trace: Optional[str] = None
     #: spool entry filename (set by the spool, never serialized)
     entry: str = field(default="", compare=False)
 
@@ -127,6 +133,8 @@ class JobSpec:
             out["env"] = dict(self.env)
         if self.submitted_t is not None:
             out["submitted_t"] = self.submitted_t
+        if self.trace is not None:
+            out["trace"] = self.trace
         return out
 
 
@@ -251,6 +259,14 @@ def parse_job(obj: Any, *, job_id: Optional[str] = None) -> JobSpec:
         or isinstance(submitted_t, bool)
     ):
         raise JobSpecError("job spec: submitted_t must be a number")
+    trace = obj.get("trace")
+    if trace is not None and (
+        not isinstance(trace, str) or not _TRACE_RE.match(trace)
+    ):
+        raise JobSpecError(
+            f"job spec: trace must match {_TRACE_RE.pattern} "
+            f"(got {trace!r})"
+        )
     return JobSpec(
         id=jid or "",
         tenant=tenant,
@@ -265,6 +281,7 @@ def parse_job(obj: Any, *, job_id: Optional[str] = None) -> JobSpec:
         fault_plan=fault_plan,
         env=None if env is None else dict(env),
         submitted_t=None if submitted_t is None else float(submitted_t),
+        trace=trace,
     )
 
 
@@ -304,6 +321,48 @@ class Spool:
             return [
                 r for r in events.iter_records(self.audit_path)
                 if r.get("kind") == "serving"
+            ]
+        except OSError:
+            return []
+
+    # -- spans ---------------------------------------------------------
+
+    def span(
+        self,
+        name: str,
+        *,
+        job: str,
+        t0: float,
+        t1: float,
+        trace: Optional[str] = None,
+        tenant: Optional[str] = None,
+        **fields: Any,
+    ) -> None:
+        """Append one ``kind="span"`` lifecycle record
+        (``observability/spans.py``, schema ``m4t-span/1``) to
+        ``serving.jsonl``. Same best-effort contract as :meth:`audit`:
+        the queue must keep serving even when its trace cannot be
+        written."""
+        from ..observability import events, spans as _spans
+
+        try:
+            with self._audit_lock:
+                events.EventLog(self.audit_path).append(
+                    _spans.span_record(
+                        name, job=job, t0=t0, t1=t1, trace=trace,
+                        tenant=tenant, **fields,
+                    )
+                )
+        except OSError:
+            pass
+
+    def span_records(self) -> List[Dict[str, Any]]:
+        from ..observability import events
+
+        try:
+            return [
+                r for r in events.iter_records(self.audit_path)
+                if r.get("kind") == "span"
             ]
         except OSError:
             return []
@@ -402,6 +461,11 @@ class Spool:
         t_ns = time.time_ns()
         if not spec.id:
             spec.id = f"job-{t_ns:x}-{os.getpid() % 0xFFFF:04x}"
+        if not spec.trace:
+            # the trace id is born here, at admission to the system:
+            # everything downstream (spans, rank env, emission stamps)
+            # inherits it rather than minting its own
+            spec.trace = f"tr-{t_ns:x}-{os.getpid() % 0xFFFF:04x}"
         spec.submitted_t = now
         if self.draining():
             self.audit(
@@ -446,9 +510,9 @@ class Spool:
         os.replace(tmp, final)
         self.audit(
             "submitted", job=spec.id, tenant=spec.tenant,
-            nproc=spec.nproc, depth=depth + 1,
+            nproc=spec.nproc, depth=depth + 1, trace=spec.trace,
         )
-        return {"job": spec.id, "status": "queued"}
+        return {"job": spec.id, "status": "queued", "trace": spec.trace}
 
     # -- scanning -----------------------------------------------------
 

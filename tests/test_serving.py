@@ -118,7 +118,9 @@ def test_spool_submit_claim_finish_accounting(tmp_path):
     spool = Spool(str(tmp_path / "sp"))
     assert spool.capacity == DEFAULT_CAPACITY
     r = spool.submit({"id": "a1", "tenant": "a", "cmd": ["-c", "pass"]})
-    assert r == {"job": "a1", "status": "queued"}
+    # the response carries the trace id minted at submit (PR 12)
+    assert r["job"] == "a1" and r["status"] == "queued"
+    assert r["trace"]
     (spec,) = spool.pending()
     assert spec.id == "a1" and spec.submitted_t is not None
     # atomic claim: exactly one winner for the rename race
@@ -600,7 +602,9 @@ def test_cli_submit_status_drain_round_trip(tmp_path):
     r = cli("submit", sp, "--id", "c1", "--tenant", "demo", "--",
             "-c", "pass")
     assert r.returncode == 0, r.stderr
-    assert json.loads(r.stdout) == {"job": "c1", "status": "queued"}
+    resp = json.loads(r.stdout)
+    assert resp["job"] == "c1" and resp["status"] == "queued"
+    assert resp["trace"]
     # duplicate id: explicit rejection, distinct exit code
     r = cli("submit", sp, "--id", "c1", "--", "-c", "pass")
     assert r.returncode == 3, (r.stdout, r.stderr)

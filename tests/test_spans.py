@@ -1,0 +1,838 @@
+"""Per-job distributed tracing + SLO attribution plane (PR 12).
+
+Covers the ISSUE-12 acceptance surface:
+
+- trace-context propagation: a trace id minted at ``spool.submit``
+  (additive ``m4t-job/1`` field) reaches every plane — span records,
+  audit records, done records, rank environments, and (armed-only)
+  the emission/exec/latency/flight-recorder telemetry records;
+- the unarmed telemetry record schema stays byte-identical to PR 11
+  (drift-pin test) and ``serving.jsonl`` stays backward-readable;
+- the span model: ``queued -> [verify] -> dispatch -> run -> result``
+  chains with attempt/spawn/warm_dispatch children, verified gapless
+  for every terminal job id (the span-chain completeness property);
+- the merged serving trace: (job, rank)-keyed process tracks (the
+  pid-collision fix), per-tenant sort-index grouping, and a golden
+  file pinning the exact export for a fixed input
+  (``tests/data/serve_trace_golden.json``; regen with
+  ``python -m tests.test_spans --regen``);
+- the SLO plane: config parsing, per-tenant percentile evaluation,
+  deduped breach verdicts in the PR 8 shape, comm-dominant breaches
+  emitting ``retune`` events with real plan keys, stage attribution
+  narration through the doctor;
+- e2e: a 2-rank warm-pool serve over 3 jobs whose emission records
+  carry the submitting job's id, every job with a complete span
+  chain in one merged Perfetto trace, and an injected slowdown
+  producing an SLO breach whose narration names the dominant stage.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mpi4jax_tpu.observability import events, spans, trace
+from mpi4jax_tpu.serving import export as sexport
+from mpi4jax_tpu.serving import slo as slo_mod
+from mpi4jax_tpu.serving.pool import WorkerPool
+from mpi4jax_tpu.serving.server import Server
+from mpi4jax_tpu.serving.spool import JobSpecError, Spool, parse_job
+
+pytestmark = [pytest.mark.tracing, pytest.mark.serving]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERVE_GOLDEN = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "data", "serve_trace_golden.json",
+)
+
+
+def _run_cli(module, *argv):
+    return subprocess.run(
+        [sys.executable, "-m", module, *argv],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+
+
+def _stub_server(spool, runner, **kw):
+    kw.setdefault("nproc", 1)
+    kw.setdefault("poll_s", 0.01)
+    kw.setdefault("log", lambda msg: None)
+    return Server(spool, runner=runner, **kw)
+
+
+# ---------------------------------------------------------------------
+# trace-context propagation
+# ---------------------------------------------------------------------
+
+
+def test_submit_mints_trace_id(tmp_path):
+    spool = Spool(str(tmp_path / "sp"))
+    r = spool.submit({"id": "j1", "cmd": ["-c", "pass"]})
+    assert r["status"] == "queued" and r["trace"].startswith("tr-")
+    (spec,) = spool.pending()
+    assert spec.trace == r["trace"]
+    # the submitted audit record carries it too
+    (sub,) = [x for x in spool.audit_records()
+              if x["event"] == "submitted"]
+    assert sub["trace"] == r["trace"]
+
+
+def test_explicit_trace_id_round_trips(tmp_path):
+    spool = Spool(str(tmp_path / "sp"))
+    r = spool.submit({
+        "id": "j1", "cmd": ["-c", "pass"], "trace": "upstream-7f3a",
+    })
+    assert r["trace"] == "upstream-7f3a"
+    (spec,) = spool.pending()
+    assert spec.trace == "upstream-7f3a"
+    assert spec.to_json()["trace"] == "upstream-7f3a"
+
+
+def test_invalid_trace_id_rejected():
+    with pytest.raises(JobSpecError, match="trace"):
+        parse_job({"cmd": ["x"], "trace": "no spaces"})
+    with pytest.raises(JobSpecError, match="trace"):
+        parse_job({"cmd": ["x"], "trace": 7})
+
+
+def test_trace_reaches_rank_env():
+    from mpi4jax_tpu import launch
+
+    env = launch.rank_env(
+        0, 2, shm_name="/x", shm_gen=1, trace_id="tr-abc",
+        job_id="j9",
+    )
+    assert env["M4T_TRACE_ID"] == "tr-abc"
+    assert env["M4T_JOB_ID"] == "j9"
+    bare = launch.rank_env(0, 2, shm_name="/x", shm_gen=1)
+    assert "M4T_TRACE_ID" not in bare and "M4T_JOB_ID" not in bare
+
+
+def test_done_record_and_runner_args_carry_trace(tmp_path):
+    spool = Spool(str(tmp_path / "sp"))
+    spool.submit({"id": "j1", "cmd": ["-c", "pass"]})
+    seen = {}
+
+    def runner(spec, world, events_dir, attempt, resume_step):
+        seen["spec"] = spec
+        return 0, []
+
+    server = _stub_server(spool, runner, max_jobs=1)
+    assert server.serve() == 0
+    (done,) = spool.done()
+    assert done["trace"] and done["trace"] == seen["spec"].trace
+    # and the launch-path args namespace would export it
+    args = server._world_args(seen["spec"], 1)
+    assert args.trace_id == done["trace"]
+    assert args.job_id == "j1"
+
+
+# ---------------------------------------------------------------------
+# armed-only telemetry stamping + unarmed drift pin
+# ---------------------------------------------------------------------
+
+#: the PR 11 unarmed record schemas, pinned literally: adding a field
+#: to the *unarmed* path is a breaking change for every downstream
+#: reader and must be an intentional, reviewed edit of these pins
+UNARMED_EMISSION_KEYS = {
+    "kind", "cid", "op", "bytes", "dtype", "axes", "world",
+    "annotation", "shape", "t", "seq", "op_seq",
+}
+UNARMED_RECORDER_KEYS = {
+    "kind", "seq", "op", "cid", "bytes", "dtype", "shape", "axes",
+    "world", "t",
+}
+
+
+@pytest.fixture
+def clean_telemetry(monkeypatch):
+    from mpi4jax_tpu import observability as obs
+    from mpi4jax_tpu.observability import metrics as metrics_mod
+
+    monkeypatch.delenv("M4T_TRACE_ID", raising=False)
+    monkeypatch.delenv("M4T_JOB_ID", raising=False)
+    prev_enabled = metrics_mod._enabled
+    prev_sink = events.get_sink()
+    obs.reset()
+    obs.enable()
+    yield obs
+    obs.reset()
+    metrics_mod._enabled = prev_enabled
+    events._sink = prev_sink
+
+
+def test_unarmed_emission_schema_drift_pin(clean_telemetry):
+    rec = clean_telemetry.registry.record_emission(
+        "AllReduce", nbytes=64, dtype="float32", axes=("ranks",),
+        world=2, cid="c1",
+    )
+    assert set(rec) == UNARMED_EMISSION_KEYS, sorted(rec)
+
+
+def test_armed_emission_carries_trace_and_job(clean_telemetry):
+    rec = clean_telemetry.registry.record_emission(
+        "AllReduce", nbytes=64, dtype="float32", axes=("ranks",),
+        world=2, cid="c1", trace="tr-1", job="j1",
+    )
+    assert set(rec) == UNARMED_EMISSION_KEYS | {"trace", "job"}
+    assert rec["trace"] == "tr-1" and rec["job"] == "j1"
+
+
+def test_unarmed_recorder_schema_drift_pin():
+    from mpi4jax_tpu.observability.recorder import FlightRecorder
+
+    fr = FlightRecorder(capacity=4)
+    fr.enable(True)
+    fr.record("AllReduce", cid="c1", nbytes=64, dtype="float32",
+              axes=("ranks",), world=2)
+    (entry,) = fr.snapshot()
+    assert set(entry) == UNARMED_RECORDER_KEYS, sorted(entry)
+    fr.reset()
+    fr.record("AllReduce", cid="c2", nbytes=64, trace="tr-1", job="j1")
+    (entry,) = fr.snapshot()
+    assert entry["trace"] == "tr-1" and entry["job"] == "j1"
+
+
+def test_emission_env_arming_through_real_op(
+    clean_telemetry, tmp_path, monkeypatch
+):
+    """The ops/_core.py prologue reads M4T_TRACE_ID/M4T_JOB_ID per
+    emission (the warm pool swaps them between in-process jobs), and
+    exec/latency events inherit the stamp from their emission."""
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as m4t
+    from mpi4jax_tpu.observability import metrics as metrics_mod
+
+    sink = str(tmp_path / "events.jsonl")
+    events.set_sink(sink)
+    m4t.allreduce(jnp.ones(4))
+    monkeypatch.setenv("M4T_TRACE_ID", "tr-armed")
+    monkeypatch.setenv("M4T_JOB_ID", "job-armed")
+    m4t.allreduce(jnp.ones(8))
+    monkeypatch.delenv("M4T_TRACE_ID")
+    monkeypatch.delenv("M4T_JOB_ID")
+    m4t.allreduce(jnp.ones(16))
+    recs = [r for r in events.read(sink) if r["kind"] == "emission"]
+    assert len(recs) == 3
+    assert "trace" not in recs[0] and "job" not in recs[0]
+    assert recs[1]["trace"] == "tr-armed"
+    assert recs[1]["job"] == "job-armed"
+    assert "trace" not in recs[2]
+    # exec/latency inherit from the emission record (armed only)
+    armed = recs[1]
+    metrics_mod.registry.mark_runtime_start(armed["cid"])
+    metrics_mod.registry.mark_runtime_end(armed["cid"], armed["op"])
+    bare = recs[2]
+    metrics_mod.registry.mark_runtime_start(bare["cid"])
+    metrics_mod.registry.mark_runtime_end(bare["cid"], bare["op"])
+    by_kind = {}
+    for r in events.read(sink):
+        by_kind.setdefault(r["kind"], []).append(r)
+    execs = {r.get("cid"): r for r in by_kind["exec"]}
+    lats = {r.get("cid"): r for r in by_kind["latency"]}
+    assert execs[armed["cid"]]["trace"] == "tr-armed"
+    assert lats[armed["cid"]]["job"] == "job-armed"
+    assert "trace" not in execs[bare["cid"]]
+    assert "trace" not in lats[bare["cid"]]
+    events.set_sink(None)
+
+
+# ---------------------------------------------------------------------
+# span chains
+# ---------------------------------------------------------------------
+
+
+def test_span_chain_completeness_property(tmp_path):
+    """Every terminal job id in serving.jsonl has a gapless
+    queued -> ... -> result chain — including failed and retried
+    jobs."""
+    spool = Spool(str(tmp_path / "sp"))
+    for obj in (
+        {"id": "ok", "tenant": "a", "cmd": ["-c", "pass"]},
+        {"id": "flaky", "tenant": "b", "cmd": ["-c", "pass"],
+         "retries": 2, "backoff_s": 0.0},
+        {"id": "bad", "tenant": "a", "cmd": ["-c", "pass"],
+         "retries": 1, "backoff_s": 0.0},
+    ):
+        assert spool.submit(obj)["status"] == "queued"
+
+    def runner(spec, world, events_dir, attempt, resume_step):
+        if spec.id == "bad":
+            return 1, []
+        if spec.id == "flaky" and attempt < 2:
+            return 1, []
+        return 0, []
+
+    server = _stub_server(spool, runner, max_jobs=3)
+    assert server.serve() == 0
+    terminals = spans.terminal_jobs(spool.audit_records())
+    assert sorted(terminals) == ["bad", "flaky", "ok"]
+    verdicts = spans.verify_chains(spool.span_records(), jobs=terminals)
+    for job, v in verdicts.items():
+        assert v["complete"], (job, v)
+        assert v["trace"], job
+    # retries surface as attempt children inside run
+    flaky = [s["span"] for s in spans.chains(spool.span_records())
+             ["flaky"] if s["span"].startswith("attempt")]
+    assert flaky == ["attempt0", "attempt1", "attempt2"]
+    # a job that never wrote spans is named, not silently passed
+    missing = spans.verify_chains(
+        spool.span_records(), jobs=["ghost"]
+    )["ghost"]
+    assert not missing["complete"]
+    assert missing["missing"] == list(spans.REQUIRED)
+
+
+def test_verify_gate_emits_verify_span(tmp_path):
+    spool = Spool(str(tmp_path / "sp"))
+    spool.submit({"id": "v1", "cmd": ["-c", "pass"], "verify": True})
+    server = _stub_server(
+        spool, lambda *a: (0, []), max_jobs=1,
+        verify_fn=lambda spec, world: True,
+    )
+    assert server.serve() == 0
+    chain = [s["span"] for s in spans.chains(spool.span_records())["v1"]
+             if s["span"] in spans.CHAIN]
+    assert chain == ["queued", "verify", "dispatch", "run", "result"]
+    v = spans.verify_chains(spool.span_records())["v1"]
+    assert v["complete"], v
+
+
+def test_rejected_job_keeps_queued_and_verify_spans(tmp_path):
+    spool = Spool(str(tmp_path / "sp"))
+    spool.submit({"id": "nope", "cmd": ["-c", "pass"], "verify": True})
+    server = _stub_server(
+        spool, lambda *a: (0, []), max_jobs=1,
+        verify_fn=lambda spec, world: False,
+    )
+    assert server.serve() == 0
+    (done,) = spool.done()
+    assert done["outcome"] == "rejected"
+    got = [s["span"] for s in spool.span_records()]
+    assert got == ["queued", "verify"]
+    # rejected jobs are not terminal-chain material
+    assert spans.terminal_jobs(spool.audit_records()) == []
+
+
+def test_serving_audit_stays_backward_readable(tmp_path):
+    """Span records ride in serving.jsonl without disturbing any
+    PR 10/11 reader: audit_records() filters them out and the doctor
+    timeline still narrates."""
+    from mpi4jax_tpu.observability import doctor
+
+    spool = Spool(str(tmp_path / "sp"))
+    spool.submit({"id": "j1", "cmd": ["-c", "pass"]})
+    server = _stub_server(spool, lambda *a: (0, []), max_jobs=1)
+    assert server.serve() == 0
+    assert spool.span_records()
+    for rec in spool.audit_records():
+        assert rec["kind"] == "serving"
+        assert rec.get("event") != "span"
+    timeline = doctor.format_serving_timeline(
+        doctor.load_serving_audit([spool.root])
+    )
+    assert "completed: job j1" in timeline
+
+
+# ---------------------------------------------------------------------
+# merged serving trace (trace --serve)
+# ---------------------------------------------------------------------
+
+
+def synthetic_serve_world():
+    """Fixed input for the golden/schema tests (all timestamps
+    pinned; regenerate the golden with
+    ``python -m tests.test_spans`` after intentional changes).
+    Two tenants, two jobs, both with a rank 0 — the pid-collision
+    surface."""
+    def emission(rank, seq, job, tr, t, nbytes=16):
+        return {
+            "kind": "emission", "rank": rank, "seq": seq,
+            "op": "AllReduce", "shape": [8], "dtype": "float32",
+            "axes": ["ranks"], "world": 2, "bytes": nbytes,
+            "cid": f"c{job}{rank}{seq}", "t": t, "trace": tr,
+            "job": job,
+        }
+
+    def chain(job, tr, tenant, t):
+        return [
+            spans.span_record("queued", job=job, t0=t, t1=t + 1.0,
+                              trace=tr, tenant=tenant),
+            spans.span_record("dispatch", job=job, t0=t + 1.0,
+                              t1=t + 1.5, trace=tr, tenant=tenant),
+            spans.span_record("run", job=job, t0=t + 1.5, t1=t + 4.0,
+                              trace=tr, tenant=tenant),
+            spans.span_record("attempt0", job=job, t0=t + 1.5,
+                              t1=t + 4.0, trace=tr, tenant=tenant,
+                              attempt=0, exit_code=0),
+            spans.span_record("result", job=job, t0=t + 4.0,
+                              t1=t + 4.1, trace=tr, tenant=tenant),
+        ]
+
+    return {
+        "jobs": [
+            {
+                "id": "jA", "tenant": "alpha", "trace": "tr-a",
+                "spans": chain("jA", "tr-a", "alpha", 100.0),
+                "by_rank": {
+                    0: [emission(0, 1, "jA", "tr-a", 102.0),
+                        {"kind": "latency", "rank": 0,
+                         "op": "AllReduce", "seconds": 0.5,
+                         "t": 102.6, "seq": 1, "cid": "cjA01",
+                         "trace": "tr-a", "job": "jA"}],
+                    1: [emission(1, 1, "jA", "tr-a", 102.1)],
+                },
+            },
+            {
+                "id": "jB", "tenant": "beta", "trace": "tr-b",
+                "spans": chain("jB", "tr-b", "beta", 101.0),
+                "by_rank": {
+                    0: [emission(0, 1, "jB", "tr-b", 103.0,
+                                 nbytes=32)],
+                },
+            },
+        ],
+    }
+
+
+def test_serve_trace_keys_tracks_by_job_and_rank():
+    obj = trace.build_serve_trace(synthetic_serve_world())
+    names = {
+        ev["pid"]: ev["args"]["name"]
+        for ev in obj["traceEvents"]
+        if ev["name"] == "process_name"
+    }
+    # the collision fix: jA rank 0 and jB rank 0 are distinct tracks
+    assert names[1] == "alpha/jA · rank 0"
+    assert names[101] == "beta/jB · rank 0"
+    assert names[0] == "alpha/jA · lifecycle"
+    # emission instants landed on their own job's track
+    pids = {}
+    for ev in obj["traceEvents"]:
+        if ev["ph"] == "i" and ev["name"] == "AllReduce" and (
+            ev["args"].get("trace")
+        ):
+            pids.setdefault(ev["args"]["job"], set()).add(ev["pid"])
+    assert pids == {"jA": {1, 2}, "jB": {101}}
+    # every track carries stable sort-index metadata
+    sort_pids = {
+        ev["pid"] for ev in obj["traceEvents"]
+        if ev["name"] == "process_sort_index"
+    }
+    assert sort_pids == set(names)
+    # lifecycle spans are duration slices on the job track
+    run_slices = [
+        ev for ev in obj["traceEvents"]
+        if ev["ph"] == "X" and ev["name"] == "run"
+    ]
+    assert {ev["pid"] for ev in run_slices} == {0, 100}
+    for ev in run_slices:
+        assert ev["dur"] == pytest.approx(2.5e6)
+    # collective instants fall inside their job's run span window
+    for ev in obj["traceEvents"]:
+        if ev["ph"] == "i" and ev["name"] == "AllReduce":
+            base = (ev["pid"] // trace.JOB_PID_STRIDE) * (
+                trace.JOB_PID_STRIDE
+            )
+            (run,) = [r for r in run_slices if r["pid"] == base]
+            assert run["ts"] <= ev["ts"] <= run["ts"] + run["dur"]
+
+
+def test_serve_trace_golden_file():
+    """The exact merged-serving export for the fixed input is pinned —
+    any schema drift must be an intentional, reviewed change."""
+    obj = trace.build_serve_trace(synthetic_serve_world())
+    normalized = json.loads(json.dumps(obj, sort_keys=True))
+    with open(SERVE_GOLDEN) as f:
+        golden = json.load(f)
+    assert normalized == golden
+
+
+def test_single_run_trace_keeps_rank_pids_with_sort_index():
+    by_rank = {
+        0: [{"kind": "emission", "rank": 0, "seq": 1,
+             "op": "AllReduce", "shape": [8], "dtype": "float32",
+             "axes": ["ranks"], "world": 2, "bytes": 16, "cid": "c1",
+             "t": 100.0}],
+        1: [{"kind": "emission", "rank": 1, "seq": 1,
+             "op": "AllReduce", "shape": [8], "dtype": "float32",
+             "axes": ["ranks"], "world": 2, "bytes": 16, "cid": "c2",
+             "t": 100.1}],
+    }
+    obj = trace.build_trace(by_rank)
+    names = {
+        (ev["pid"], ev["args"]["name"])
+        for ev in obj["traceEvents"] if ev["name"] == "process_name"
+    }
+    assert names == {(0, "rank 0"), (1, "rank 1")}
+    sorts = {
+        ev["pid"]: ev["args"]["sort_index"]
+        for ev in obj["traceEvents"]
+        if ev["name"] == "process_sort_index"
+    }
+    assert sorts == {0: 0, 1: 1}
+
+
+def test_trace_serve_cli_round_trip(tmp_path):
+    spool = Spool(str(tmp_path / "sp"))
+    for i in range(2):
+        spool.submit({"id": f"j{i}", "tenant": "t", "cmd": ["-c", "x"]})
+    server = _stub_server(spool, lambda *a: (0, []), max_jobs=2)
+    assert server.serve() == 0
+    out = str(tmp_path / "serve.json")
+    res = _run_cli(
+        "mpi4jax_tpu.observability.trace", "--serve", spool.root,
+        "-o", out,
+    )
+    assert res.returncode == 0, res.stderr
+    obj = json.load(open(out))
+    jobs = {m["job"] for m in obj["otherData"]["jobs"]}
+    assert jobs == {"j0", "j1"}
+    # an empty spool is exit 2, not a traceback
+    res = _run_cli(
+        "mpi4jax_tpu.observability.trace", "--serve",
+        str(tmp_path / "empty"), "-o", out,
+    )
+    assert res.returncode == 2
+
+
+# ---------------------------------------------------------------------
+# SLO plane
+# ---------------------------------------------------------------------
+
+
+def test_parse_slo_forms():
+    c = slo_mod.parse_slo("p99_latency_s=2.0, error_rate=0.05")
+    assert c["default"] == {"p99_latency_s": 2.0, "error_rate": 0.05}
+    c = slo_mod.parse_slo({"default": {"p50_latency_s": 1.0},
+                           "tenants": {"bulk": {"p50_latency_s": 9.0}}})
+    assert slo_mod.objectives_for(c, "bulk") == {"p50_latency_s": 9.0}
+    assert slo_mod.objectives_for(c, "other") == {"p50_latency_s": 1.0}
+    c = slo_mod.parse_slo('{"p90_queue_wait_s": 0.5}')
+    assert c["default"] == {"p90_queue_wait_s": 0.5}
+
+
+def test_parse_slo_file(tmp_path):
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps({"tenants": {"a": {"error_rate": 0.1}}}))
+    c = slo_mod.parse_slo(str(path))
+    assert slo_mod.objectives_for(c, "a") == {"error_rate": 0.1}
+
+
+@pytest.mark.parametrize("bad, needle", [
+    ("p99_latency_s", "objective=threshold"),
+    ("p99_latency_s=fast", "not a number"),
+    ("p99_sparkle_s=1", "unknown objective"),
+    ('{"default": {}, "oops": {}}', "unknown section"),
+    ("", "no objectives"),
+    ('{"p99_latency_s": -1}', "non-negative"),
+])
+def test_parse_slo_rejects(bad, needle):
+    with pytest.raises(slo_mod.SLOError, match=needle):
+        slo_mod.parse_slo(bad)
+
+
+def _served_spool(tmp_path, runner, jobs, **kw):
+    spool = Spool(str(tmp_path / "sp"))
+    for obj in jobs:
+        assert spool.submit(obj)["status"] == "queued"
+    server = _stub_server(spool, runner, max_jobs=len(jobs), **kw)
+    server.serve()
+    return spool
+
+
+def test_slo_breach_verdict_dedupe_and_narration(tmp_path):
+    import time as _time
+
+    def runner(spec, world, events_dir, attempt, resume):
+        if spec.id == "slow":
+            _time.sleep(0.25)
+        return 0, []
+
+    spool = _served_spool(tmp_path, runner, [
+        {"id": "fast", "tenant": "a", "cmd": ["-c", "x"]},
+        {"id": "slow", "tenant": "a", "cmd": ["-c", "x"]},
+    ])
+    config = slo_mod.parse_slo("p99_latency_s=0.1")
+    watch = slo_mod.SLOWatch(spool, config)
+    new = watch.check()
+    assert len(new) == 1
+    breach = new[0]
+    assert breach["tenant"] == "a" and breach["job"] == "slow"
+    assert breach["observed"] > 0.1
+    assert breach["dominant_stage"] == "compute"  # stub runner sleeps
+    assert breach["dominant_share"] > 0.5
+    # deduped: a second pass over the same evidence is silent
+    assert watch.check() == []
+    # the verdict event has the PR 8 shape and landed in slo.jsonl
+    (rec,) = slo_mod.load_slo_verdicts([spool.root])
+    assert rec["kind"] == "verdict" and rec["klass"] == "transient"
+    assert rec["finding"]["kind"] == "slo_breach"
+    # audited on serving.jsonl (backward-compatible extra event)
+    assert any(r["event"] == "slo_breach"
+               for r in spool.audit_records())
+    text = slo_mod.narrate(breach)
+    assert "job slow" in text and "compute-bound" in text
+
+
+def test_slo_error_rate_objective(tmp_path):
+    spool = _served_spool(
+        tmp_path, lambda *a: (1, []),
+        [{"id": "f1", "tenant": "x", "cmd": ["-c", "x"]}],
+    )
+    (breach,) = slo_mod.evaluate(
+        spool, slo_mod.parse_slo("error_rate=0.5")
+    )
+    assert breach["objective"] == "error_rate"
+    assert breach["observed"] == 1.0
+
+
+def test_slo_queue_wait_dominant_names_capacity(tmp_path):
+    """A breach dominated by queue-wait narrates 'capacity, not
+    compute' — the doctor's headline for an under-provisioned mesh."""
+    spool = Spool(str(tmp_path / "sp"))
+    spool.submit({"id": "jq", "tenant": "q", "cmd": ["-c", "x"]})
+    # age the queue entry so queue_wait dwarfs the (instant) run
+    (spec,) = spool.pending()
+    import time as _time
+
+    _time.sleep(0.3)
+    server = _stub_server(spool, lambda *a: (0, []), max_jobs=1)
+    assert server.serve() == 0
+    (breach,) = slo_mod.evaluate(
+        spool, slo_mod.parse_slo("p50_latency_s=0.05")
+    )
+    assert breach["dominant_stage"] == "queue_wait"
+    assert "capacity, not compute" in slo_mod.narrate(breach)
+
+
+def test_slo_comm_dominant_emits_retune_with_plan_keys(tmp_path):
+    """When the dominant stage is communication, the breach emits a
+    retune recommendation whose plan keys validate — the PR 8 loop's
+    input, now fed by SLOs."""
+    import time as _time
+
+    from mpi4jax_tpu.planner import autotune
+
+    def runner(spec, world, events_dir, attempt, resume):
+        _time.sleep(0.4)  # a run window the comm samples can fill
+        return 0, []
+
+    spool = _served_spool(tmp_path, runner, [
+        {"id": "commy", "tenant": "c", "cmd": ["-c", "x"]},
+    ])
+    (done,) = spool.done()
+    tr = done["trace"]
+    # fabricate the job's telemetry: emissions + latency samples that
+    # account for most of the (span-recorded) run window
+    run = [s for s in spool.span_records() if s["span"] == "run"][0]
+    d = os.path.join(spool.root, "jobs", "commy", "attempt00")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "events-rank0.jsonl"), "w") as f:
+        f.write(json.dumps({
+            "kind": "emission", "rank": 0, "seq": 1,
+            "op": "AllReduce", "shape": [1024], "dtype": "float32",
+            "axes": ["ranks"], "world": 2, "bytes": 4096, "cid": "cc1",
+            "t": run["t0"], "trace": tr, "job": "commy",
+        }) + "\n")
+        f.write(json.dumps({
+            "kind": "latency", "rank": 0, "op": "AllReduce",
+            "seconds": max(run["dur_s"] * 0.9, 1e-4), "seq": 1,
+            "cid": "cc1", "t": run["t1"], "trace": tr, "job": "commy",
+        }) + "\n")
+    (breach,) = slo_mod.evaluate(
+        spool, slo_mod.parse_slo("p99_latency_s=0.0")
+    )
+    assert breach["dominant_stage"] == "comm", breach
+    watch = slo_mod.SLOWatch(
+        spool, slo_mod.parse_slo("p99_latency_s=0.0")
+    )
+    assert watch.check()
+    keys = autotune.keys_from_verdicts([spool.root], platform="cpu")
+    assert keys and all("AllReduce|" in k for k in keys), keys
+
+
+def test_slo_exporter_histograms(tmp_path):
+    spool = _served_spool(tmp_path, lambda *a: (0, []), [
+        {"id": f"j{i}", "tenant": "h", "cmd": ["-c", "x"]}
+        for i in range(3)
+    ])
+    slo_mod.SLOWatch(
+        spool, slo_mod.parse_slo("p99_latency_s=0.0")
+    ).check()
+    text = sexport.render_serving_metrics(
+        sexport.serving_snapshot(spool)
+    )
+    assert text.endswith("# EOF\n")
+    assert 'm4t_serve_job_latency_seconds_bucket{le="+Inf",tenant="h"} 3' in text
+    assert 'm4t_serve_job_latency_seconds_count{tenant="h"} 3' in text
+    assert 'm4t_serve_stage_seconds{quantile="p99",stage="queue_wait",tenant="h"}' in text
+    assert 'm4t_serve_slo_breaches_total{objective="p99_latency_s",tenant="h"} 1' in text
+
+
+def test_doctor_narrates_slo_breach(tmp_path):
+    import time as _time
+
+    def runner(spec, world, events_dir, attempt, resume):
+        _time.sleep(0.2)
+        return 0, []
+
+    spool = _served_spool(tmp_path, runner, [
+        {"id": "jd", "tenant": "d", "cmd": ["-c", "x"]},
+    ])
+    slo_mod.SLOWatch(
+        spool, slo_mod.parse_slo("p99_latency_s=0.05")
+    ).check()
+    res = _run_cli("mpi4jax_tpu.observability.doctor", spool.root)
+    assert "SLO breaches" in res.stdout, res.stdout
+    assert "job jd" in res.stdout
+    assert "compute-bound" in res.stdout
+
+
+# ---------------------------------------------------------------------
+# CLI + selftest
+# ---------------------------------------------------------------------
+
+
+def test_spans_cli_verdicts(tmp_path):
+    spool = Spool(str(tmp_path / "sp"))
+    spool.submit({"id": "j1", "cmd": ["-c", "x"]})
+    server = _stub_server(spool, lambda *a: (0, []), max_jobs=1)
+    assert server.serve() == 0
+    res = _run_cli("mpi4jax_tpu.observability.spans", spool.root)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    assert "j1: complete" in res.stdout
+    res = _run_cli(
+        "mpi4jax_tpu.observability.spans", spool.root, "--json"
+    )
+    assert json.loads(res.stdout)["j1"]["complete"] is True
+    res = _run_cli(
+        "mpi4jax_tpu.observability.spans", str(tmp_path / "none")
+    )
+    assert res.returncode == 2
+
+
+def test_spans_selftest():
+    res = _run_cli("mpi4jax_tpu.observability.spans", "--selftest")
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    assert "spans selftest ok" in res.stdout
+
+
+# ---------------------------------------------------------------------
+# e2e: 2-rank warm pool, trace-id propagation, merged trace, SLO
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.pool
+def test_e2e_warm_pool_trace_and_slo(tmp_path):
+    """ISSUE-12 acceptance: a 2-rank ``serve --warm`` over 3 jobs
+    yields one merged Perfetto trace in which every submitted job has
+    a complete span chain and its per-rank collective slices (warm
+    workers' shared sinks attributed by trace id), and an injected
+    slowdown produces an SLO breach whose narration names the
+    dominant stage."""
+    import time as _time
+
+    spool = Spool(str(tmp_path / "sp"))
+    pool = WorkerPool(
+        os.path.join(spool.root, "pool"), 2, heartbeat_s=0.2,
+        audit=spool.audit, span=spool.span, log=lambda m: None,
+    )
+    pool.start()
+    try:
+        # pre-warm (the loadgen convention) so queue wait measures
+        # the queue, not the one-time worker import
+        deadline = _time.monotonic() + 120.0
+        while pool.idle_count() < 2:
+            assert _time.monotonic() < deadline, "pool never ready"
+            pool.check()
+            _time.sleep(0.05)
+        payload = ("import jax.numpy as jnp, mpi4jax_tpu as m4t; "
+                   "m4t.allreduce(jnp.ones(8))")
+        slow_payload = "import time; time.sleep(0.6); " + payload
+        for i in range(3):
+            body = slow_payload if i == 2 else payload
+            r = spool.submit({
+                "id": f"w{i}", "tenant": f"t{i % 2}",
+                "cmd": ["-c", body],
+            })
+            assert r["status"] == "queued", r
+        watch = slo_mod.SLOWatch(
+            spool, slo_mod.parse_slo("p99_latency_s=0.5")
+        )
+        server = Server(
+            spool, nproc=2, max_jobs=3, poll_s=0.02, pool=pool,
+            slo=watch, log=lambda m: None,
+        )
+        rc = server.serve()
+    finally:
+        pool.stop(grace_s=2.0)
+    assert rc == 0
+    outcomes = {r["id"]: r["outcome"] for r in spool.done()}
+    assert outcomes == {f"w{i}": "completed" for i in range(3)}
+
+    # every submitted job id has a complete, gapless span chain
+    terminals = spans.terminal_jobs(spool.audit_records())
+    assert sorted(terminals) == ["w0", "w1", "w2"]
+    verdicts = spans.verify_chains(spool.span_records(), jobs=terminals)
+    for job, v in verdicts.items():
+        assert v["complete"], (job, v)
+    # warm path: every chain has a warm_dispatch child
+    by_job = spans.chains(spool.span_records())
+    for job in terminals:
+        assert any(s["span"] == "warm_dispatch" for s in by_job[job])
+
+    # emission records in the shared pool sinks carry the submitting
+    # job's id + trace (the 2-rank warm propagation assertion)
+    traces = {r["id"]: r["trace"] for r in spool.done()}
+    for job in terminals:
+        by_rank = spans.collect_job_records(
+            spool.root, job, traces[job]
+        )
+        ems = [
+            r for recs in by_rank.values() for r in recs
+            if r.get("kind") == "emission"
+        ]
+        assert ems, job
+        assert all(e.get("job") == job for e in ems), (job, ems)
+        assert all(e.get("trace") == traces[job] for e in ems)
+
+    # one merged Perfetto trace holds every job, (job, rank)-keyed
+    out = str(tmp_path / "serve_trace.json")
+    assert trace.export_serve(spool.root, out) is not None
+    obj = json.load(open(out))
+    meta = {m["job"]: m for m in obj["otherData"]["jobs"]}
+    assert set(meta) == {"w0", "w1", "w2"}
+    for job, m in meta.items():
+        assert m["ranks"], (job, "no per-rank slices in the trace")
+        assert m["trace"] == traces[job]
+    # each job's collective instants sit on its own pid block
+    for ev in obj["traceEvents"]:
+        if ev["ph"] == "i" and ev["args"].get("job"):
+            base = meta[ev["args"]["job"]]["pid"]
+            assert base < ev["pid"] < base + trace.JOB_PID_STRIDE
+
+    # the injected slowdown breached the SLO with a named stage: the
+    # slowed job dominates its tenant's p99 and its 0.6s sleep makes
+    # the run stages (compute/comm) the story, not queue wait
+    recs = slo_mod.load_slo_verdicts([spool.root])
+    assert recs, "no SLO breach verdict"
+    findings = {r["finding"].get("job"): r["finding"] for r in recs}
+    assert "w2" in findings, findings
+    assert findings["w2"]["dominant_stage"] in ("compute", "comm")
+    res = _run_cli("mpi4jax_tpu.observability.doctor", spool.root)
+    assert "SLO breaches" in res.stdout
+    assert "job w2" in res.stdout
+
+
+if __name__ == "__main__":
+    # regenerate the golden serving trace after an intentional change
+    obj = trace.build_serve_trace(synthetic_serve_world())
+    with open(SERVE_GOLDEN, "w") as f:
+        json.dump(json.loads(json.dumps(obj, sort_keys=True)), f,
+                  indent=1, sort_keys=True)
+    print(f"golden rewritten: {SERVE_GOLDEN}")
