@@ -26,14 +26,23 @@ Three modes:
   record carries per-job latency for both paths and their ratio
   (``speedup`` — the acceptance bar is >= 10x).
 
+- ``--servers N``: federated drain (ISSUE-14). The *same* job mix is
+  drained twice — once by a single serve loop, once by N registered
+  serve loops sharing the spool (distinct ``server_id``s, leases,
+  federated claims) — and the record carries both walls plus the
+  throughput ``scaling`` ratio. The headline ``value`` is the
+  N-server drain wall clock; the run fails if any id is lost or
+  double-finished (the federation's whole point).
+
 Emits the benchmark JSON line on stdout (the BENCH ``parsed`` record)
-and, with ``--out BENCH_rNN_serve[_warm].json``, the full round
-wrapper — the ``serve`` / ``serve_warm`` variant trajectories ``perf
-gate`` covers::
+and, with ``--out BENCH_rNN_serve[_warm|_federated].json``, the full
+round wrapper — the ``serve`` / ``serve_warm`` / ``serve_federated``
+variant trajectories ``perf gate`` covers::
 
     python benchmarks/serve_loadgen.py --jobs 24 --out BENCH_r10_serve.json
     python benchmarks/serve_loadgen.py --warm --out BENCH_r11_serve_warm.json
-    python -m mpi4jax_tpu.observability.perf gate --variant serve_warm
+    python benchmarks/serve_loadgen.py --servers 2 --out BENCH_r14_serve_federated.json
+    python -m mpi4jax_tpu.observability.perf gate --variant serve_federated
 """
 
 from __future__ import annotations
@@ -51,6 +60,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 METRIC = "serve_loadgen_drain"
 METRIC_WARM = "serve_loadgen_warm_drain"
+METRIC_FED = "serve_loadgen_federated_drain"
 
 #: the --warm job payload: a job that pays what real serving jobs pay
 #: (python + jax + package import) cold, and nothing warm
@@ -167,6 +177,88 @@ def run_loadgen(jobs: int, tenants: int, nproc: int, *, stub: bool,
         }
 
 
+def run_loadgen_federated(jobs: int, tenants: int, nproc: int, *,
+                          stub: bool, queue_cap: int, servers: int):
+    """One drain of the full job mix by ``servers`` registered serve
+    loops sharing the spool. Returns the usual result dict plus the
+    per-server claim split and the lost/duplicate-id accounting that
+    makes the number honest."""
+    import threading
+
+    from mpi4jax_tpu.serving import Server, Spool
+
+    with tempfile.TemporaryDirectory() as tmp:
+        spool = Spool(os.path.join(tmp, "spool"))
+        spool.configure(queue_cap)
+        accepted = 0
+        shed = 0
+        for i in range(jobs):
+            r = spool.submit({
+                "id": f"load-{i:04d}",
+                "tenant": f"t{i % tenants}",
+                "cmd": ["-c", "pass"],
+                "nproc": 1,
+            })
+            if r["status"] == "queued":
+                accepted += 1
+            else:
+                shed += 1
+        # drain-to-empty is the termination condition for every loop
+        spool.request_drain("loadgen")
+        runner = None
+        if stub:
+            runner = lambda spec, world, d, attempt, resume: (0, [])  # noqa: E731
+        fleet = [
+            Server(
+                spool, nproc=nproc, poll_s=0.01, runner=runner,
+                server_id=f"lg-s{i:02d}", lease_s=5.0,
+                log=lambda msg: None,
+            )
+            for i in range(servers)
+        ]
+        rcs = [None] * servers
+        t0 = time.monotonic()
+        threads = [
+            threading.Thread(
+                target=lambda i=i: rcs.__setitem__(i, fleet[i].serve())
+            )
+            for i in range(servers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall_s = time.monotonic() - t0
+        done = spool.done()
+        ids = [rec.get("id") for rec in done]
+        done_ok = [r for r in done if r.get("outcome") == "completed"]
+        waits = sorted(
+            float(rec.get("queue_wait_s") or 0.0) for rec in done_ok
+        )
+        per_server = {}
+        for rec in spool.audit_records():
+            if rec["event"] == "claimed" and rec.get("server"):
+                srv = rec["server"]
+                per_server[srv] = per_server.get(srv, 0) + 1
+        completed = len(done_ok)
+        return {
+            "rc": max(r for r in rcs if r is not None),
+            "wall_s": wall_s,
+            "accepted": accepted,
+            "shed": shed,
+            "completed": completed,
+            "lost": accepted - completed,
+            "duplicate_ids": len(ids) - len(set(ids)),
+            "per_server": per_server,
+            "job_s": wall_s / completed if completed else None,
+            "jobs_per_hour": (
+                3600.0 * completed / wall_s if wall_s > 0 else None
+            ),
+            "queue_wait_p50_s": _pct(waits, 0.50),
+            "queue_wait_p99_s": _pct(waits, 0.99),
+        }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--jobs", type=int, default=24,
@@ -184,6 +276,12 @@ def main(argv=None) -> int:
                         help="cold-spawn vs warm-pool comparison over "
                         "an import-paying job mix (the serve_warm "
                         "BENCH variant)")
+    parser.add_argument("--servers", type=int, default=None,
+                        metavar="N",
+                        help="federated drain: the same job mix by 1 "
+                        "and then N registered serve loops sharing "
+                        "the spool (the serve_federated BENCH "
+                        "variant)")
     parser.add_argument("--out", default=None, metavar="BENCH.json",
                         help="also write the BENCH round wrapper here")
     parser.add_argument("--round", type=int, default=None,
@@ -192,7 +290,59 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     cap = args.queue_cap if args.queue_cap is not None else args.jobs
-    if args.warm:
+    if args.servers is not None:
+        n = max(1, args.servers)
+        solo = run_loadgen_federated(
+            args.jobs, args.tenants, args.nproc,
+            stub=args.stub, queue_cap=cap, servers=1,
+        )
+        fed = run_loadgen_federated(
+            args.jobs, args.tenants, args.nproc,
+            stub=args.stub, queue_cap=cap, servers=n,
+        )
+        scaling = (
+            fed["jobs_per_hour"] / solo["jobs_per_hour"]
+            if fed["jobs_per_hour"] and solo["jobs_per_hour"] else None
+        )
+        print(
+            f"# serve_loadgen [federated x{n}]: "
+            f"{fed['completed']}/{fed['accepted']} job(s): 1 server "
+            f"{solo['wall_s']:.2f}s vs {n} servers "
+            f"{fed['wall_s']:.2f}s — {scaling:.2f}x jobs/h; split "
+            f"{fed['per_server']}; lost={fed['lost']} "
+            f"dups={fed['duplicate_ids']}; rc solo={solo['rc']} "
+            f"fed={fed['rc']}",
+            file=sys.stderr,
+        )
+        record = {
+            "metric": METRIC_FED,
+            "value": round(fed["wall_s"], 3),
+            "unit": "s",
+            "vs_baseline": None,
+            "nproc": args.nproc,
+            "fused": None,
+            "jobs": args.jobs,
+            "mode": "stub" if args.stub else "spawn",
+            "servers": n,
+            "solo_wall_s": round(solo["wall_s"], 3),
+            "scaling": round(scaling, 2) if scaling else None,
+            "jobs_per_hour": round(fed["jobs_per_hour"], 1),
+            "per_server": fed["per_server"],
+            "lost": fed["lost"],
+            "duplicate_ids": fed["duplicate_ids"],
+            "queue_wait_p50_s": round(fed["queue_wait_p50_s"], 4),
+            "queue_wait_p99_s": round(fed["queue_wait_p99_s"], 4),
+        }
+        result = {
+            **fed,
+            "rc": max(solo["rc"], fed["rc"]),
+            "completed": min(solo["completed"], fed["completed"]),
+            "accepted": max(solo["accepted"], fed["accepted"]),
+        }
+        if (fed["lost"] or fed["duplicate_ids"]
+                or solo["lost"] or solo["duplicate_ids"]):
+            result["rc"] = max(result["rc"], 1)
+    elif args.warm:
         cold = run_loadgen(
             args.jobs, args.tenants, args.nproc,
             stub=False, queue_cap=cap, payload=WARM_PAYLOAD,
@@ -282,7 +432,9 @@ def main(argv=None) -> int:
                 "cmd": "python benchmarks/serve_loadgen.py "
                        f"--jobs {args.jobs} -n {args.nproc}"
                        + (" --stub" if args.stub else "")
-                       + (" --warm" if args.warm else ""),
+                       + (" --warm" if args.warm else "")
+                       + (f" --servers {args.servers}"
+                          if args.servers is not None else ""),
                 "rc": result["rc"],
                 "tail": line + "\n",
                 "parsed": record,

@@ -893,6 +893,8 @@ def format_serving_timeline(records: List[Dict[str, Any]]) -> str:
             line = (
                 f"  serve start: world {r.get('world')}, queue "
                 f"capacity {r.get('capacity')}"
+                + (f" (server {r['server']})" if r.get("server")
+                   else "")
                 + (", elastic" if r.get("elastic") else "")
                 + (", verify" if r.get("verify") else "")
             )
@@ -912,6 +914,64 @@ def format_serving_timeline(records: List[Dict[str, Any]]) -> str:
             line = (
                 f"  admitted:{tag} at world {r.get('world')} after "
                 f"{r.get('queue_wait_s', 0):.3g}s in queue"
+            )
+            if r.get("reclaims"):
+                line += (
+                    f" (reclaim #{r['reclaims']}"
+                    + (f", resumed from step {r['resume_step']}"
+                       if r.get("resume_step") is not None else "")
+                    + ")"
+                )
+        elif event == "claimed":
+            line = f"  claimed:{tag}"
+            if r.get("server"):
+                line += (
+                    f" by server {r['server']} "
+                    f"(epoch {r.get('epoch')})"
+                )
+        elif event == "server_register":
+            line = (
+                f"  server {r.get('server')} registered "
+                f"(lease {r.get('lease_s')}s"
+                + (f", world {r['world']}" if r.get("world") is not None
+                   else "")
+                + ")"
+            )
+        elif event == "server_stop":
+            line = (
+                f"  server {r.get('server')} stopped cleanly after "
+                f"{r.get('jobs')} job(s)"
+            )
+        elif event == "lease_expired":
+            line = (
+                f"  FAILOVER: server {r.get('server')} presumed dead "
+                f"— lease silent for "
+                f"{r.get('lease_age_s', 0):.3g}s"
+                + (f"; detected by {r['by']}" if r.get("by") else "")
+            )
+        elif event == "reclaim":
+            if r.get("action") == "exhausted":
+                line = (
+                    f"  FAILOVER:{tag} reclaim cap reached after "
+                    f"{r.get('reclaims')} reclaim(s) — terminal "
+                    "failed: reclaim_exhausted"
+                )
+            else:
+                line = (
+                    f"  FAILOVER:{tag} reclaimed from server "
+                    f"{r.get('from_server')} (claim epoch "
+                    f"{r.get('epoch')}, {r.get('reason')})"
+                    + (f" by {r['by']}" if r.get("by") else "")
+                    + " — requeued with provenance"
+                )
+        elif event == "fenced":
+            holder = r.get("holder") or {}
+            line = (
+                f"  FENCED:{tag} — zombie server {r.get('server')} "
+                f"(stale claim epoch {r.get('epoch')}) tried to "
+                f"write '{r.get('outcome_rejected')}'; rejected"
+                + (f" (job now held by {holder.get('server')})"
+                   if holder.get("server") else "")
             )
         elif event == "world":
             line = (

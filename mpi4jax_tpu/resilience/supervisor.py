@@ -368,8 +368,17 @@ class Supervisor:
         except Exception:
             pass  # span recording must never mask the run's outcome
 
-    def run(self) -> int:
-        resume: Optional[int] = resume_step()  # inherit if nested
+    def run(self, resume0: Optional[int] = None) -> int:
+        """Drive attempts until success or budget exhaustion.
+
+        ``resume0`` seeds the first attempt's resume step — the
+        serving plane passes the newest checkpoint step when it
+        re-runs a job reclaimed from a dead server, so attempt 0
+        already starts warm instead of from step 0."""
+        resume: Optional[int] = (
+            resume0 if resume0 is not None
+            else resume_step()  # inherit if nested
+        )
         exit_code = 0
         for attempt in range(self.policy.retries + 1):
             attempt_t0 = time.time()

@@ -10,10 +10,17 @@ Subcommands:
 - ``submit SPOOL [--spec JOB.json | flags + argv]`` — validate and
   enqueue one job; prints the JSON response. Exit 0 = queued, 3 =
   rejected (queue_full / draining / duplicate_id — the explicit
-  backpressure contract), 2 = invalid spec.
-- ``status SPOOL`` — queue depth, running and finished jobs.
+  backpressure contract), 2 = invalid spec. With ``--wait`` the exit
+  code mirrors the job's *outcome* instead: 0 completed, 1 failed,
+  3 rejected (2 when ``--wait-timeout`` expires first).
+- ``status SPOOL`` — queue depth, running and finished jobs, plus the
+  federation server table (who holds a lease, how fresh it is).
 - ``drain SPOOL [--wait]`` — stop admission (new submits are
   rejected) and, with ``--wait``, block until the queue is empty.
+  The sentinel is spool-global: every federated server sees it.
+- ``reclaim SPOOL`` — one offline scavenger pass: requeue running
+  entries whose owner's lease expired (the same pass every federated
+  server runs in its loop; this is the no-server-left recovery tool).
 - ``--selftest`` — device-free exercise of the whole control plane
   (spool protocol, scheduler fairness, server loop under a stub
   runner including elastic shrink over a real resharded checkpoint,
@@ -31,7 +38,13 @@ import time
 
 from .scheduler import FairScheduler
 from .server import Server
-from .spool import JobSpecError, Spool, parse_job
+from .spool import (
+    DEFAULT_LEASE_S,
+    DEFAULT_MAX_RECLAIMS,
+    JobSpecError,
+    Spool,
+    parse_job,
+)
 
 
 def _cmd_serve(args) -> int:
@@ -76,6 +89,9 @@ def _cmd_serve(args) -> int:
             metrics_port=args.metrics_port,
             pool=pool,
             slo=slo,
+            server_id=args.server_id,
+            lease_s=args.lease,
+            max_reclaims=args.max_reclaims,
         )
     except ValueError as e:
         print(f"serve: {e}", file=sys.stderr)
@@ -129,7 +145,35 @@ def _cmd_submit(args) -> int:
         print(f"submit: {e}", file=sys.stderr)
         return 2
     print(json.dumps(response))
-    return 0 if response.get("status") == "queued" else 3
+    if response.get("status") != "queued":
+        return 3
+    if not args.wait:
+        return 0
+    # block until the id is terminal; the exit code mirrors the
+    # outcome, so e2e scripts need no hand-rolled poll loop
+    job_id = response["job"]
+    deadline = (
+        None if args.wait_timeout is None
+        else time.monotonic() + args.wait_timeout
+    )
+    while True:
+        for rec in spool.done():
+            if rec.get("id") == job_id:
+                outcome = str(rec.get("outcome"))
+                print(json.dumps({
+                    "job": job_id, "outcome": outcome,
+                    "reason": rec.get("reason"),
+                }))
+                return {
+                    "completed": 0, "failed": 1, "rejected": 3,
+                }.get(outcome, 1)
+        if deadline is not None and time.monotonic() > deadline:
+            print(
+                f"submit: job {job_id} not terminal after "
+                f"{args.wait_timeout:g}s", file=sys.stderr,
+            )
+            return 2
+        time.sleep(0.2)
 
 
 def _cmd_status(args) -> int:
@@ -148,11 +192,30 @@ def _cmd_status(args) -> int:
         f"{status['capacity']}"
         + (" [draining]" if status["draining"] else "")
     )
+    servers = status.get("servers") or []
+    if servers:
+        alive = sum(1 for s in servers if s.get("alive"))
+        print(f"  servers: {alive}/{len(servers)} alive")
+        for s in servers:
+            age = s.get("lease_age_s")
+            print(
+                f"    {s.get('id')}: "
+                + ("lease ok" if s.get("alive") else "lease EXPIRED")
+                + (f", renewed {age:.1f}s ago"
+                   if age is not None else "")
+                + f" (lease {s.get('lease_s'):g}s, "
+                f"pid {s.get('pid')})"
+            )
     for state in ("pending", "running"):
         for job in status[state]:
+            owner = ""
+            if state == "running" and job.get("server"):
+                owner = (
+                    f" server={job['server']} epoch={job.get('epoch')}"
+                )
             print(
                 f"  {state:>7}  {job['job']}  tenant={job['tenant']} "
-                f"nproc={job['nproc']}"
+                f"nproc={job['nproc']}" + owner
             )
     for job in status["done"]:
         print(
@@ -183,6 +246,29 @@ def _cmd_status(args) -> int:
                 + (f"beat {age:.1f}s ago" if age is not None
                    else "no heartbeat")
             )
+    return 0
+
+
+def _cmd_reclaim(args) -> int:
+    spool = Spool(args.spool)
+    actions = spool.reclaim(
+        by=args.by, max_reclaims=args.max_reclaims,
+        grace_s=args.grace,
+    )
+    if args.json:
+        print(json.dumps(actions, indent=1))
+    else:
+        for act in actions:
+            print(
+                f"reclaim: job {act.get('job')} "
+                f"{act.get('action')} (owner "
+                f"{act.get('from_server')}, {act.get('reason')})",
+                file=sys.stderr,
+            )
+        print(
+            f"reclaim: {len(actions)} action(s) on {spool.root}",
+            file=sys.stderr,
+        )
     return 0
 
 
@@ -616,6 +702,82 @@ def selftest() -> int:  # noqa: C901 — one linear smoke script
             assert needle in text4, (needle, text4)
         pool.stop(grace_s=0.2)
 
+        # ======== federation: leases, reclaim, zombie fencing =========
+        spool5 = Spool(os.path.join(tmp, "spool5"))
+        spool5.configure(8)
+        ck5 = os.path.join(tmp, "ck5")
+        mgr5 = _ckpt.CheckpointManager(ck5, keep=2, world=1)
+        mgr5.save_sharded(
+            7, {"w": np.arange(4.0, dtype=np.float64)},
+            {"w": LeafSpec(shape=(4,), dtype="float64")},
+        )
+        assert spool5.submit({
+            "id": "orph", "cmd": ["-c", "pass"], "nproc": 1,
+            "resume_dir": ck5,
+        })["status"] == "queued"
+        # server A registers, claims, then "dies" (no more renewals)
+        spool5.register_server("sA", lease_s=1.0, now=100.0)
+        (specA,) = spool5.pending()
+        claimed = spool5.claim(specA, server="sA")
+        assert claimed is not None and claimed.epoch == 1
+        assert spool5.claim(specA, server="sB") is None  # one winner
+        # before expiry the scavenger must not touch the claim
+        assert spool5.reclaim(now=100.5, by="sB") == []
+        acts = spool5.reclaim(now=102.0, by="sB")
+        assert [a["action"] for a in acts] == ["requeued"], acts
+        (req,) = spool5.pending()
+        assert req.reclaims == 1
+        assert req.reclaimed_from[0]["server"] == "sA"
+        # server B drains the orphan; it resumes from the checkpoint
+        # the dead server left behind
+        resumes = []
+
+        def runner5(spec, world, events_dir, attempt, resume_step):
+            resumes.append(resume_step)
+            return 0, []
+
+        serverB = Server(
+            spool5, nproc=1, max_jobs=1, poll_s=0.01, runner=runner5,
+            server_id="sB", lease_s=30.0, log=lambda msg: None,
+        )
+        assert serverB.serve() == 0
+        assert resumes == [7], resumes  # reclaimed job started warm
+        (rec5,) = spool5.done()
+        assert rec5["outcome"] == "completed"
+        assert rec5["reclaims"] == 1, rec5
+        # the zombie revives and writes its stale outcome: fenced
+        assert spool5.finish(
+            claimed, "completed", server="sA", epoch=1
+        ) is False
+        assert [r["id"] for r in spool5.done()] == ["orph"]
+        by5 = {}
+        for r in spool5.audit_records():
+            by5.setdefault(r["event"], []).append(r)
+        for needle in ("server_register", "lease_expired", "reclaim",
+                       "fenced", "server_stop"):
+            assert by5.get(needle), (needle, sorted(by5))
+        terminal5 = [
+            r for e in ("completed", "failed", "rejected")
+            for r in by5.get(e, []) if r.get("job") == "orph"
+        ]
+        assert len(terminal5) == 1, terminal5  # exactly-once, audited
+        # exporter: the federation metric families
+        text5 = sexport.render_serving_metrics(
+            sexport.serving_snapshot(spool5)
+        )
+        for needle in (
+            "m4t_serve_servers_alive",
+            'm4t_serve_reclaims_total{reason="lease_expired"} 1',
+            "m4t_serve_fenced_total 1",
+            'm4t_serve_server_lease_age{server="sA"}',
+        ):
+            assert needle in text5, (needle, text5)
+        # persistent poison verdicts accumulate across servers
+        spool5.record_strike("tox", reason="wedged", server="sA")
+        assert not spool5.poisoned("tox")
+        spool5.record_strike("tox", reason="wedged", server="sB")
+        assert spool5.poisoned("tox") and spool5.strikes("tox") == 2
+
     print("serving selftest ok")
     return 0
 
@@ -700,6 +862,20 @@ def main(argv=None) -> int:
                    help="finished jobs a tenant needs before its "
                    "percentile objectives are judged (default "
                    "%(default)s)")
+    p.add_argument("--server-id", default=None, metavar="ID",
+                   help="federation identity for this serving loop "
+                   "(registry file, claim owner suffix, fence key); "
+                   "default: a unique minted id")
+    p.add_argument("--lease", type=float, default=DEFAULT_LEASE_S,
+                   metavar="S",
+                   help="heartbeat lease: peers presume this server "
+                   "dead and reclaim its running jobs after S "
+                   "seconds without a renewal (default %(default)s)")
+    p.add_argument("--max-reclaims", type=int,
+                   default=DEFAULT_MAX_RECLAIMS, metavar="K",
+                   help="per-job reclaim cap: a job orphaned more "
+                   "than K times ends failed: reclaim_exhausted "
+                   "(default %(default)s)")
     p.set_defaults(fn=_cmd_serve)
 
     p = sub.add_parser("submit", help="enqueue one job")
@@ -720,6 +896,14 @@ def main(argv=None) -> int:
                    "JSON)")
     p.add_argument("--verify", action="store_true",
                    help="gate this job through the static verifier")
+    p.add_argument("--wait", action="store_true",
+                   help="block until the job is terminal; exit code "
+                   "mirrors the outcome (0 completed / 1 failed / "
+                   "3 rejected)")
+    p.add_argument("--wait-timeout", type=float, default=None,
+                   metavar="S",
+                   help="with --wait: give up (exit 2) after S "
+                   "seconds (default: wait forever)")
     p.add_argument("-m", dest="module", default=None,
                    help="run a module instead of a script")
     p.add_argument("cmd", nargs="*",
@@ -732,6 +916,20 @@ def main(argv=None) -> int:
     p.add_argument("spool")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=_cmd_status)
+
+    p = sub.add_parser("reclaim", help="offline scavenger pass: "
+                       "requeue orphans of dead servers")
+    p.add_argument("spool")
+    p.add_argument("--by", default=None, metavar="ID",
+                   help="attribute the pass to this server id "
+                   "(skips its own claims)")
+    p.add_argument("--max-reclaims", type=int,
+                   default=DEFAULT_MAX_RECLAIMS, metavar="K")
+    p.add_argument("--grace", type=float, default=0.0, metavar="S",
+                   help="extra slack on top of each owner's lease "
+                   "before it counts as expired")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=_cmd_reclaim)
 
     p = sub.add_parser("drain", help="stop admission; optionally wait "
                        "for the queue to empty")

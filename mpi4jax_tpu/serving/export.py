@@ -25,6 +25,17 @@ Families (all prefixed ``m4t_serve_``)::
     m4t_serve_job_run_seconds{job=,tenant=}   gauge   per finished job
     m4t_serve_job_attempts{job=,tenant=}      gauge   per finished job
 
+Federation layer (multi-server spool — PR 14)::
+
+    m4t_serve_servers_alive                   gauge   registered servers
+                                                      with a fresh lease
+    m4t_serve_server_lease_age{server=}       gauge   seconds since each
+                                                      server's renewal
+    m4t_serve_reclaims_total{reason=}         counter orphans requeued /
+                                                      exhausted by reason
+    m4t_serve_fenced_total                    counter zombie terminal
+                                                      writes rejected
+
 SLO attribution layer (``serving/slo.py`` — PR 12)::
 
     m4t_serve_job_latency_seconds{tenant=}    histogram completed-job
@@ -142,6 +153,8 @@ def serving_snapshot(
         spool = Spool(spool)
     counts: Dict[str, int] = {}
     rejected: Dict[str, int] = {}
+    reclaims: Dict[str, int] = {}
+    fenced = 0
     world = None
     for rec in spool.audit_records():
         event = rec.get("event")
@@ -150,6 +163,11 @@ def serving_snapshot(
         elif event == "rejected":
             reason = str(rec.get("reason", "?"))
             rejected[reason] = rejected.get(reason, 0) + 1
+        elif event == "reclaim":
+            reason = str(rec.get("reason", "?"))
+            reclaims[reason] = reclaims.get(reason, 0) + 1
+        elif event == "fenced":
+            fenced += 1
         elif event == "serve_start":
             world = rec.get("world", world)
         elif event == "world":
@@ -185,6 +203,9 @@ def serving_snapshot(
         "draining": spool.draining(),
         "counts": counts,
         "rejected": rejected,
+        "reclaims": reclaims,
+        "fenced": fenced,
+        "servers": spool.servers(),
         "jobs": jobs,
         "slo_breaches": slo_breaches,
         "pool": pool_snapshot(spool),
@@ -222,6 +243,28 @@ def render_serving_metrics(snap: Dict[str, Any]) -> str:
                         "Load-shed and admission rejections by reason.")
     for reason, n in sorted(snap.get("rejected", {}).items()):
         c.sample(n, reason=reason)
+
+    # -- federation layer (multi-server spool) -------------------------
+    servers = snap.get("servers") or []
+    g = _export._Family(out, "m4t_serve_servers_alive", "gauge",
+                        "Registered servers whose heartbeat lease is "
+                        "still fresh.")
+    g.sample(sum(1 for s in servers if s.get("alive")))
+    g = _export._Family(out, "m4t_serve_server_lease_age", "gauge",
+                        "Seconds since each registered server renewed "
+                        "its lease (an operator sees a dead server "
+                        "here before the scavenger acts).")
+    for s in servers:
+        g.sample(s.get("lease_age_s"), server=str(s.get("id")))
+    c = _export._Family(out, "m4t_serve_reclaims_total", "counter",
+                        "Orphaned running entries reclaimed from dead "
+                        "servers, by detection reason.")
+    for reason, n in sorted((snap.get("reclaims") or {}).items()):
+        c.sample(n, reason=reason)
+    c = _export._Family(out, "m4t_serve_fenced_total", "counter",
+                        "Late terminal writes from superseded claim "
+                        "epochs (zombie servers) that were rejected.")
+    c.sample(snap.get("fenced", 0))
 
     w = _export._Family(out, "m4t_serve_job_queue_wait_seconds",
                         "gauge",

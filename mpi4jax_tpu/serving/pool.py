@@ -584,6 +584,14 @@ class WorkerPool:
         #: lifecycle span per attempt (mailbox hand-off latency, the
         #: warm analog of the cold path's ``spawn`` span)
         self._span_fn = span
+        #: ``strike_fn(job, reason) -> cumulative strikes`` — the
+        #: Spool.record_strike seam (wired by the federated Server):
+        #: strikes persist on the spool, so a job that wedged server
+        #: A's workers carries its record to server B
+        self._strike_fn: Optional[Callable[[str, str], int]] = None
+        #: ``poisoned_fn(job) -> bool`` — the Spool.poisoned seam:
+        #: consult the spool-wide verdict alongside local state
+        self._poisoned_fn: Optional[Callable[[str], bool]] = None
         self._log = log or (lambda msg: sys.stderr.write(
             f"m4t.pool: {msg}\n"
         ))
@@ -809,7 +817,14 @@ class WorkerPool:
 
     def poisoned(self, job_id: str) -> bool:
         with self._lock:
-            return job_id in self._poisoned
+            if job_id in self._poisoned:
+                return True
+        if self._poisoned_fn is not None:
+            try:
+                return bool(self._poisoned_fn(job_id))
+            except Exception:
+                return False
+        return False
 
     def strikes(self, job_id: str) -> int:
         with self._lock:
@@ -947,6 +962,13 @@ class WorkerPool:
             # one strike per attempt, however many workers it wedged
             d.struck = True
             n = self._strikes.get(job, 0) + 1
+            if self._strike_fn is not None:
+                # the spool's persistent count wins when higher: a
+                # peer server may already have struck this job
+                try:
+                    n = max(n, int(self._strike_fn(job, reason)))
+                except Exception:
+                    pass
             self._strikes[job] = n
             self._audit(
                 "pool_strike", job=job, strikes=n,

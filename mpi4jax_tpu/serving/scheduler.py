@@ -28,6 +28,8 @@ class FairScheduler:
         #: tenant -> logical time of its last pick (-1 = never served)
         self._last_pick: Dict[str, int] = {}
         self._clock = 0
+        #: undo state for :meth:`revert` (one level deep)
+        self._prev: Optional[tuple] = None
 
     def pick(self, pending: List[JobSpec]) -> Optional[JobSpec]:
         """The next job to claim, or None when the queue is empty.
@@ -50,6 +52,22 @@ class FairScheduler:
             first,
             key=lambda t: (self._last_pick.get(t, -1), order[t]),
         )
+        self._prev = (tenant, self._last_pick.get(tenant), self._clock)
         self._clock += 1
         self._last_pick[tenant] = self._clock
         return first[tenant]
+
+    def revert(self) -> None:
+        """Undo the most recent :meth:`pick`. A federated server that
+        loses the claim race to a peer must not burn the tenant's
+        turn — the pick never dispatched, so fairness state rolls
+        back as if it never happened."""
+        if self._prev is None:
+            return
+        tenant, last, clock = self._prev
+        self._prev = None
+        self._clock = clock
+        if last is None:
+            self._last_pick.pop(tenant, None)
+        else:
+            self._last_pick[tenant] = last

@@ -53,7 +53,12 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..resilience.supervisor import RetryPolicy, Supervisor
 from .scheduler import FairScheduler
-from .spool import JobSpec, Spool
+from .spool import (
+    DEFAULT_LEASE_S,
+    DEFAULT_MAX_RECLAIMS,
+    JobSpec,
+    Spool,
+)
 
 #: a runner maps (spec, world, events_dir, attempt, resume_step) to
 #: ``(exit_code, preempted_ranks)`` — the ``launch.spawn_world``
@@ -88,6 +93,10 @@ class Server:
         metrics_port: Optional[int] = None,
         pool: Optional[Any] = None,
         slo: Optional[Any] = None,
+        server_id: Optional[str] = None,
+        lease_s: float = DEFAULT_LEASE_S,
+        max_reclaims: int = DEFAULT_MAX_RECLAIMS,
+        clock: Callable[[], float] = time.time,
         log: Callable[[str], None] = _default_log,
     ):
         if nproc < 1:
@@ -95,6 +104,16 @@ class Server:
         if min_ranks < 1 or min_ranks > nproc:
             raise ValueError("min_ranks must be in [1, nproc]")
         self.spool = spool
+        #: this serving loop's federation identity: its lease file,
+        #: its claims' owner suffix, and the id the fence checks
+        self.server_id = server_id or (
+            f"s-{os.getpid():x}-{os.urandom(3).hex()}"
+        )
+        self.lease_s = float(lease_s)
+        self.max_reclaims = int(max_reclaims)
+        self._clock = clock
+        self._last_renew = 0.0
+        self._last_scavenge = 0.0
         self.capacity = int(nproc)
         self.elastic = bool(elastic)
         self.min_ranks = int(min_ranks)
@@ -115,6 +134,20 @@ class Server:
             # the server's chain spans land on — wire its span seam to
             # this spool unless a harness already did
             pool._span_fn = spool.span
+        if pool is not None and getattr(pool, "_strike_fn", None) is None:
+            # write dispatch-failure strikes through to the spool's
+            # persistent verdicts: a job that wedges this server's
+            # workers is refused by every peer, not just this pool
+            pool._strike_fn = (
+                lambda job, reason: spool.record_strike(
+                    job, reason=reason, server=self.server_id,
+                    max_strikes=getattr(pool, "max_strikes", 2),
+                )
+            )
+        if pool is not None and getattr(
+            pool, "_poisoned_fn", None
+        ) is None:
+            pool._poisoned_fn = spool.poisoned
         self._runner = runner or self._launch_runner
         self._verify_fn = verify_fn or self._launch_verify
         self.metrics_port = metrics_port
@@ -254,6 +287,60 @@ class Server:
             f"mesh capacity {old} -> {self.capacity} rank(s)"
         )
 
+    def _ckpt_resume(
+        self, spec: JobSpec, world: int
+    ) -> Tuple[Optional[int], Optional[Dict[str, Any]]]:
+        """The newest step ``spec`` can resume from at ``world``, and
+        the reshard source (``{"step", "world"}``) when the candidate
+        had to go through the bounded-memory planner first. Shared by
+        the elastic shrink path and reclaimed-job resume — both are
+        "pick up mid-flight work at whatever world I have now"."""
+        resume = None
+        reshard_src = None
+        if not spec.resume_dir:
+            return resume, reshard_src
+        try:
+            from ..resilience import reshard as _reshard
+            from ..resilience.ckpt import CheckpointManager
+
+            mgr = CheckpointManager(spec.resume_dir, world=world)
+            info = mgr.latest_valid(world=world, allow_reshard=True)
+            if info is None:
+                self._log(
+                    f"job {spec.id}: no valid checkpoint to carry "
+                    "over; resuming from step 0"
+                )
+            elif not info.world_mismatch:
+                resume = info.step
+            elif not info.sharded:
+                self._log(
+                    f"job {spec.id}: checkpoint step {info.step} "
+                    f"predates m4t-ckpt/2 and cannot be resharded; "
+                    "resuming from step 0"
+                )
+            else:
+                reshard_t0 = time.time()
+                new_info = _reshard.reshard_checkpoint(
+                    mgr, info, world,
+                    log=lambda m: self._log(f"job {spec.id}: {m}"),
+                )
+                resume = new_info.step
+                reshard_src = {
+                    "step": info.step, "world": info.world,
+                }
+                self._job_span(
+                    spec, "reshard", reshard_t0, time.time(),
+                    from_world=info.world, to_world=world,
+                    step=info.step,
+                )
+        except Exception as exc:
+            self._log(
+                f"job {spec.id}: reshard failed ({exc!r}); "
+                "resuming from step 0"
+            )
+            resume = None
+        return resume, reshard_src
+
     def _shrink_for(self, spec: JobSpec, state: Dict[str, Any]):
         """Preemption mid-job under ``--elastic``: shrink capacity to
         the survivors, reshard the job's newest checkpoint to the new
@@ -289,51 +376,7 @@ class Server:
             self.capacity_lost = True
             self._log(f"job {spec.id}: {state['blocked']}; giving up")
             return None
-        resume = None
-        reshard_src = None
-        if spec.resume_dir:
-            try:
-                from ..resilience import reshard as _reshard
-                from ..resilience.ckpt import CheckpointManager
-
-                mgr = CheckpointManager(spec.resume_dir, world=new_world)
-                info = mgr.latest_valid(
-                    world=new_world, allow_reshard=True
-                )
-                if info is None:
-                    self._log(
-                        f"job {spec.id}: no valid checkpoint to carry "
-                        "over; resuming from step 0"
-                    )
-                elif not info.world_mismatch:
-                    resume = info.step
-                elif not info.sharded:
-                    self._log(
-                        f"job {spec.id}: checkpoint step {info.step} "
-                        f"predates m4t-ckpt/2 and cannot be resharded; "
-                        "resuming from step 0"
-                    )
-                else:
-                    reshard_t0 = time.time()
-                    new_info = _reshard.reshard_checkpoint(
-                        mgr, info, new_world,
-                        log=lambda m: self._log(f"job {spec.id}: {m}"),
-                    )
-                    resume = new_info.step
-                    reshard_src = {
-                        "step": info.step, "world": info.world,
-                    }
-                    self._job_span(
-                        spec, "reshard", reshard_t0, time.time(),
-                        from_world=info.world, to_world=new_world,
-                        step=info.step,
-                    )
-            except Exception as exc:
-                self._log(
-                    f"job {spec.id}: reshard failed ({exc!r}); "
-                    "resuming from step 0"
-                )
-                resume = None
+        resume, reshard_src = self._ckpt_resume(spec, new_world)
         if (self.verify or spec.verify) and not self._verify_fn(
             spec, new_world
         ):
@@ -357,6 +400,77 @@ class Server:
         self._set_capacity(new_cap, **audit)
         return resume
 
+    # -- federation: lease, scavenge, fence ----------------------------
+
+    def _register(self) -> None:
+        now = self._clock()
+        try:
+            self.spool.register_server(
+                self.server_id, lease_s=self.lease_s, now=now,
+                world=self.capacity,
+            )
+        except Exception as exc:
+            self._log(f"server registration failed: {exc!r}")
+        self._last_renew = now
+        self._last_scavenge = now
+
+    def _deregister(self) -> None:
+        try:
+            self.spool.deregister_server(
+                self.server_id, jobs=self.jobs_served,
+            )
+        except Exception:
+            pass
+
+    def _federation_tick(self) -> None:
+        """Once per loop turn: renew this server's lease (at a third
+        of the lease period, so two missed renewals still beat
+        expiry) and scavenge peers' orphans (at a quarter — failover
+        latency is bounded by lease + scavenge cadence)."""
+        now = self._clock()
+        if now - self._last_renew >= self.lease_s / 3.0:
+            self._last_renew = now
+            try:
+                self.spool.renew_lease(self.server_id, now=now)
+            except Exception:
+                pass
+        if now - self._last_scavenge >= self.lease_s / 4.0:
+            self._last_scavenge = now
+            try:
+                for act in self.spool.reclaim(
+                    now=now, by=self.server_id,
+                    max_reclaims=self.max_reclaims,
+                ):
+                    self._log(
+                        f"job {act.get('job')}: {act.get('action')} "
+                        f"(owner {act.get('from_server')}, "
+                        f"{act.get('reason')})"
+                    )
+            except Exception as exc:
+                self._log(f"scavenger pass failed: {exc!r}")
+
+    def _finish(self, spec: JobSpec, outcome: str, **extra: Any) -> bool:
+        """Write ``spec``'s terminal record under this server's claim
+        epoch. False means this server was fenced — the job was
+        reclaimed while we ran it, its story belongs to the claimant
+        now, and *nothing* more may be written for it. A spec claimed
+        without an owner (single-server harnesses driving
+        :meth:`run_job` directly) takes the unfenced legacy path."""
+        if spec.owner is None:
+            self.spool.finish(spec, outcome, **extra)
+            return True
+        ok = self.spool.finish(
+            spec, outcome, server=spec.owner, epoch=spec.epoch,
+            **extra,
+        )
+        if not ok:
+            self._log(
+                f"job {spec.id}: fenced — claim epoch "
+                f"{spec.epoch} was superseded; dropping late "
+                f"'{outcome}' record"
+            )
+        return ok
+
     # -- one job -------------------------------------------------------
 
     def run_job(self, spec: JobSpec) -> str:
@@ -368,14 +482,14 @@ class Server:
         except Exception as exc:
             self._log(f"job {spec.id}: internal error: {exc!r}")
             try:
-                self.spool.finish(
+                if self._finish(
                     spec, "failed", reason="internal_error",
                     error=repr(exc),
-                )
-                self.spool.audit(
-                    "failed", job=spec.id, tenant=spec.tenant,
-                    reason="internal_error", error=repr(exc),
-                )
+                ):
+                    self.spool.audit(
+                        "failed", job=spec.id, tenant=spec.tenant,
+                        reason="internal_error", error=repr(exc),
+                    )
             except Exception:
                 pass
             outcome = "failed"
@@ -398,10 +512,37 @@ class Server:
         t0 = time.time()
         wait_s = max(0.0, t0 - (spec.submitted_t or t0))
         world = min(spec.nproc, self.capacity)
+        if self.spool.poisoned(spec.id):
+            # the spool-wide verdict (written when this job wedged
+            # *some* server's workers) outranks local state: refuse
+            # dispatch even if this server never saw it misbehave
+            self._log(
+                f"job {spec.id}: refused — poisoned verdict on the "
+                "spool"
+            )
+            if self._finish(
+                spec, "failed", reason="poisoned", refused=True,
+                queue_wait_s=round(wait_s, 6),
+            ):
+                self.spool.audit(
+                    "failed", job=spec.id, tenant=spec.tenant,
+                    reason="poisoned", refused=True,
+                )
+            return "failed"
+        resume0: Optional[int] = None
+        admit_extra: Dict[str, Any] = {}
+        if spec.reclaims > 0:
+            # reclaimed from a dead server: pick up its mid-flight
+            # work at whatever world this server has (resharding
+            # through the planner when the worlds differ)
+            resume0, _ = self._ckpt_resume(spec, world)
+            admit_extra["reclaims"] = spec.reclaims
+            if resume0 is not None:
+                admit_extra["resume_step"] = resume0
         self.spool.audit(
             "admitted", job=spec.id, tenant=spec.tenant, world=world,
             requested_nproc=spec.nproc, queue_wait_s=round(wait_s, 6),
-            trace=spec.trace,
+            trace=spec.trace, **admit_extra,
         )
         # the chain spans share boundary clock reads on purpose:
         # queued.t1 == verify.t0 == ... — gaplessness by construction,
@@ -420,14 +561,14 @@ class Server:
             )
             if not verified:
                 # the unprovable program never touches the shared mesh
-                self.spool.finish(
+                if self._finish(
                     spec, "rejected", reason="verify_failed",
                     world=world, queue_wait_s=wait_s,
-                )
-                self.spool.audit(
-                    "rejected", job=spec.id, tenant=spec.tenant,
-                    reason="verify_failed", world=world,
-                )
+                ):
+                    self.spool.audit(
+                        "rejected", job=spec.id, tenant=spec.tenant,
+                        reason="verify_failed", world=world,
+                    )
                 return "rejected"
 
         jobdir = self.spool.job_dir(spec.id)
@@ -513,8 +654,12 @@ class Server:
         def abort_fn(attempt: int) -> Optional[str]:
             # the pool's two-strikes rule: a job that keeps wedging
             # workers is poisoned — retrying it would degrade the
-            # pool, so the remaining budget is vetoed
+            # pool, so the remaining budget is vetoed. The spool-wide
+            # verdict counts too: a peer server's strikes and ours
+            # accumulate against the same job.
             if self._pool is not None and self._pool.poisoned(spec.id):
+                return "poisoned"
+            if self.spool.poisoned(spec.id):
                 return "poisoned"
             return None
 
@@ -535,7 +680,7 @@ class Server:
         )
         t_run = time.time()
         self._job_span(spec, "dispatch", t_gate, t_run, world=world)
-        rc = sup.run()
+        rc = sup.run(resume0)
         t_run_end = time.time()
         self._job_span(
             spec, "run", t_run, t_run_end,
@@ -551,7 +696,8 @@ class Server:
             run_s=round(run_s, 6),
         )
         if rc == 0:
-            self.spool.finish(spec, "completed", **common)
+            if not self._finish(spec, "completed", **common):
+                return "fenced"
             self.spool.audit(
                 "completed", job=spec.id, tenant=spec.tenant, **common
             )
@@ -560,7 +706,9 @@ class Server:
                 outcome="completed",
             )
             return "completed"
-        if self._pool is not None and self._pool.poisoned(spec.id):
+        if (
+            self._pool is not None and self._pool.poisoned(spec.id)
+        ) or self.spool.poisoned(spec.id):
             # however the last attempt's exit classified, the final
             # word on a poisoned job is "poisoned"
             reason = "poisoned"
@@ -568,10 +716,11 @@ class Server:
             reason = state["blocked"] or last.get(
                 "reason", "exit_nonzero"
             )
-        self.spool.finish(
+        if not self._finish(
             spec, "failed", exit_code=rc, klass=last.get("klass"),
             reason=reason, **common,
-        )
+        ):
+            return "fenced"
         self.spool.audit(
             "failed", job=spec.id, tenant=spec.tenant, exit_code=rc,
             klass=last.get("klass"), reason=reason, **common,
@@ -593,12 +742,14 @@ class Server:
             "serve_start", world=self.capacity,
             capacity=self.spool.capacity, pid=os.getpid(),
             elastic=self.elastic, verify=self.verify,
+            server=self.server_id,
             warm_pool=(self._pool.size if self._pool is not None
                        else None),
         )
         self._log(
-            f"serving from {self.spool.root} at world "
-            f"{self.capacity} (queue capacity {self.spool.capacity}"
+            f"serving from {self.spool.root} as {self.server_id} at "
+            f"world {self.capacity} (queue capacity "
+            f"{self.spool.capacity}"
             + (", elastic" if self.elastic else "")
             + (", verify" if self.verify else "")
             + (f", warm pool of {self._pool.size}"
@@ -606,15 +757,18 @@ class Server:
             + ")"
         )
         self._start_metrics()
+        self._register()
         if self._pool is not None:
             try:
                 return self._serve_concurrent()
             finally:
+                self._deregister()
                 self._stop_metrics()
         idle_since = time.monotonic()
         rc = 0
         try:
             while True:
+                self._federation_tick()
                 if (
                     self.max_jobs is not None
                     and self.jobs_served >= self.max_jobs
@@ -645,9 +799,12 @@ class Server:
                     time.sleep(self.poll_s)
                     continue
                 idle_since = time.monotonic()
-                claimed = self.spool.claim(spec)
+                claimed = self.spool.claim(spec, server=self.server_id)
                 if claimed is None:
-                    continue  # a peer server won the rename
+                    # a peer server won the rename: put the tenant's
+                    # turn back so losing a race costs no fairness
+                    self.scheduler.revert()
+                    continue
                 self.run_job(claimed)
                 self.jobs_served += 1
                 self._write_metrics()
@@ -662,6 +819,7 @@ class Server:
             self._log("interrupted; exiting")
             rc = 130
         finally:
+            self._deregister()
             self._write_metrics()
             self._stop_metrics()
         return rc
@@ -682,6 +840,7 @@ class Server:
         rc = 0
         try:
             while True:
+                self._federation_tick()
                 # one pool-doctor pass per loop turn: reap worker
                 # exits, enforce heartbeat deadlines, flip started
                 # workers idle (the doctor thread does this too when
@@ -743,8 +902,9 @@ class Server:
                     # sub-mesh, don't leapfrog it
                     time.sleep(self.poll_s)
                     continue
-                claimed = self.spool.claim(spec)
+                claimed = self.spool.claim(spec, server=self.server_id)
                 if claimed is None:
+                    self.scheduler.revert()
                     continue  # a peer server won the rename
                 t = threading.Thread(
                     target=self.run_job, args=(claimed,),

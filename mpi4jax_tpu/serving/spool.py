@@ -33,6 +33,27 @@ submitted: each id ends ``completed``, ``failed``, or ``rejected``.
 Entry filenames are ``<20-digit submit time_ns>-<job id>.json``: the
 lexicographic directory order *is* FIFO submit order, which is what
 the fair scheduler's per-tenant queues are built from.
+
+**Federation** (several servers draining one spool) adds three pieces
+on top of the same primitives, all optional — a spool never touched by
+a federated server is byte-identical to the single-server layout:
+
+- **Server registry + leases**: each serving loop registers under a
+  unique ``server_id`` (``servers/<id>.json``, tmp+fsync+rename) and
+  renews a heartbeat lease. A claim made on behalf of a server renames
+  the entry to ``running/<entry>@<server_id>@<epoch>`` so every
+  running entry names its owner and claim epoch (``@`` cannot appear
+  in an id, so the suffix is unambiguous).
+- **Orphan reclamation**: :meth:`Spool.reclaim` detects running
+  entries whose owner lease expired (or whose owner vanished), and
+  requeues them with ``reclaims``/``reclaimed_from`` provenance under
+  a per-job cap — past the cap the job ends terminal
+  ``failed: reclaim_exhausted`` instead of cycling forever.
+- **Zombie fencing**: a federated :meth:`Spool.finish` must first win
+  an atomic rename of *its own* claim instance
+  (``@<server>@<epoch>``). A revived server whose job was reclaimed
+  finds its claim gone, gets a ``fenced`` audit record, and writes no
+  terminal record — every id still ends terminal exactly once.
 """
 
 from __future__ import annotations
@@ -52,24 +73,41 @@ PENDING_DIR = "pending"
 RUNNING_DIR = "running"
 DONE_DIR = "done"
 JOBS_DIR = "jobs"
+SERVERS_DIR = "servers"
+VERDICTS_DIR = "verdicts"
 AUDIT_NAME = "serving.jsonl"
 CONFIG_NAME = "spool.json"
 DRAIN_SENTINEL = "DRAIN"
+
+SERVER_SCHEMA = "m4t-server/1"
+VERDICT_SCHEMA = "m4t-verdict/1"
 
 #: default bounded-queue capacity (pending jobs) when the spool was
 #: never configured; ``serve --queue-cap`` / ``Spool.configure`` pin it
 DEFAULT_CAPACITY = 16
 
+#: default heartbeat lease: a server silent this long is presumed dead
+DEFAULT_LEASE_S = 15.0
+
+#: default per-job reclaim cap: a job orphaned more times than this is
+#: terminal ``failed: reclaim_exhausted``, never a hot potato
+DEFAULT_MAX_RECLAIMS = 3
+
 _ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
 _TRACE_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,127}$")
 _ENTRY_RE = re.compile(r"^(\d{20})-(.+)\.json$")
+#: running-dir entry: the pending name, optionally suffixed with the
+#: claiming ``@<server_id>@<epoch>`` (ids may contain dots, never @)
+_RUN_RE = re.compile(
+    r"^(\d{20})-(.+)\.json(?:@([A-Za-z0-9][A-Za-z0-9._-]{0,63})@(\d+))?$"
+)
 
 #: job-spec fields accepted by :func:`parse_job`; anything else is a
 #: typo caught at submit time, not a knob that silently does nothing
 _JOB_FIELDS = frozenset({
     "schema", "id", "tenant", "cmd", "module", "nproc", "timeout_s",
     "retries", "backoff_s", "verify", "resume_dir", "fault_plan", "env",
-    "submitted_t", "trace",
+    "submitted_t", "trace", "reclaims", "reclaimed_from",
 })
 
 
@@ -100,8 +138,19 @@ class JobSpec:
     #: ``M4T_TRACE_ID``, stamped on every span and audit record — the
     #: one key all of this job's telemetry joins on
     trace: Optional[str] = None
+    #: times this job was reclaimed from a dead server (additive
+    #: ``m4t-job/1`` field: serialized only when non-zero, so a spool
+    #: never touched by federation stays byte-identical)
+    reclaims: int = 0
+    #: reclaim provenance: one ``{"server", "epoch", "reason", ...}``
+    #: dict per reclaim, oldest first
+    reclaimed_from: Optional[List[Dict[str, Any]]] = None
     #: spool entry filename (set by the spool, never serialized)
     entry: str = field(default="", compare=False)
+    #: claiming server id / claim epoch (set by a federated claim or
+    #: a running-dir scan, never serialized)
+    owner: Optional[str] = field(default=None, compare=False)
+    epoch: Optional[int] = field(default=None, compare=False)
 
     @property
     def target(self) -> str:
@@ -135,6 +184,10 @@ class JobSpec:
             out["submitted_t"] = self.submitted_t
         if self.trace is not None:
             out["trace"] = self.trace
+        if self.reclaims:
+            out["reclaims"] = self.reclaims
+        if self.reclaimed_from:
+            out["reclaimed_from"] = [dict(r) for r in self.reclaimed_from]
         return out
 
 
@@ -267,6 +320,22 @@ def parse_job(obj: Any, *, job_id: Optional[str] = None) -> JobSpec:
             f"job spec: trace must match {_TRACE_RE.pattern} "
             f"(got {trace!r})"
         )
+    reclaims = _want(obj, "reclaims", 0)
+    if not isinstance(reclaims, int) or isinstance(reclaims, bool) or (
+        reclaims < 0
+    ):
+        raise JobSpecError(
+            f"job spec: reclaims must be a non-negative integer "
+            f"(got {reclaims!r})"
+        )
+    reclaimed_from = obj.get("reclaimed_from")
+    if reclaimed_from is not None and (
+        not isinstance(reclaimed_from, list)
+        or not all(isinstance(r, dict) for r in reclaimed_from)
+    ):
+        raise JobSpecError(
+            "job spec: reclaimed_from must be a list of objects"
+        )
     return JobSpec(
         id=jid or "",
         tenant=tenant,
@@ -282,6 +351,11 @@ def parse_job(obj: Any, *, job_id: Optional[str] = None) -> JobSpec:
         env=None if env is None else dict(env),
         submitted_t=None if submitted_t is None else float(submitted_t),
         trace=trace,
+        reclaims=reclaims,
+        reclaimed_from=(
+            None if reclaimed_from is None
+            else [dict(r) for r in reclaimed_from]
+        ),
     )
 
 
@@ -291,7 +365,8 @@ class Spool:
 
     def __init__(self, root: str):
         self.root = os.path.abspath(root)
-        for sub in (PENDING_DIR, RUNNING_DIR, DONE_DIR, JOBS_DIR):
+        for sub in (PENDING_DIR, RUNNING_DIR, DONE_DIR, JOBS_DIR,
+                    SERVERS_DIR, VERDICTS_DIR):
             os.makedirs(os.path.join(self.root, sub), exist_ok=True)
         self.audit_path = os.path.join(self.root, AUDIT_NAME)
         # the warm pool's serve loop audits from concurrent job
@@ -419,13 +494,17 @@ class Spool:
             names = os.listdir(self._dir(sub))
         except OSError:
             return []
-        return sorted(n for n in names if _ENTRY_RE.match(n))
+        # running entries may carry an @server@epoch owner suffix;
+        # pending/done entries never do
+        regex = _RUN_RE if sub == RUNNING_DIR else _ENTRY_RE
+        return sorted(n for n in names if regex.match(n))
 
     def _known_ids(self) -> set:
         ids = set()
         for sub in (PENDING_DIR, RUNNING_DIR, DONE_DIR):
+            regex = _RUN_RE if sub == RUNNING_DIR else _ENTRY_RE
             for name in self._entries(sub):
-                m = _ENTRY_RE.match(name)
+                m = regex.match(name)
                 if m:
                     ids.add(m.group(2))
         return ids
@@ -524,6 +603,11 @@ class Spool:
         except (OSError, json.JSONDecodeError, JobSpecError):
             return None  # claimed by a peer mid-read, or torn by hand
         spec.entry = name
+        if sub == RUNNING_DIR:
+            m = _RUN_RE.match(name)
+            if m and m.group(3):
+                spec.owner = m.group(3)
+                spec.epoch = int(m.group(4))
         return spec
 
     def pending(self) -> List[JobSpec]:
@@ -560,34 +644,519 @@ class Spool:
 
     # -- claim / finish -----------------------------------------------
 
-    def claim(self, spec: JobSpec) -> Optional[JobSpec]:
+    def claim(
+        self, spec: JobSpec, *, server: Optional[str] = None
+    ) -> Optional[JobSpec]:
         """Atomically move ``spec`` from pending to running; None if a
-        peer won the race (its rename already consumed the entry)."""
+        peer won the race (its rename already consumed the entry).
+
+        With ``server=`` the running entry is named
+        ``<entry>@<server>@<epoch>`` (epoch = reclaims so far + 1) so
+        the owner is on disk for the scavenger and the fence; without
+        it, the single-server layout is unchanged."""
         src = os.path.join(self._dir(PENDING_DIR), spec.entry)
-        dst = os.path.join(self._dir(RUNNING_DIR), spec.entry)
+        dst_name = spec.entry
+        epoch: Optional[int] = None
+        if server is not None:
+            if not _ID_RE.match(server):
+                raise ValueError(
+                    f"server id must match {_ID_RE.pattern} "
+                    f"(got {server!r})"
+                )
+            epoch = int(spec.reclaims) + 1
+            dst_name = f"{spec.entry}@{server}@{epoch}"
+        dst = os.path.join(self._dir(RUNNING_DIR), dst_name)
         try:
             os.replace(src, dst)
         except OSError:
             return None
-        self.audit("claimed", job=spec.id, tenant=spec.tenant)
+        spec.entry = dst_name
+        spec.owner = server
+        spec.epoch = epoch
+        if server is None:
+            self.audit("claimed", job=spec.id, tenant=spec.tenant)
+        else:
+            self.audit(
+                "claimed", job=spec.id, tenant=spec.tenant,
+                server=server, epoch=epoch,
+            )
         return spec
 
-    def finish(self, spec: JobSpec, outcome: str, **extra: Any) -> None:
+    @staticmethod
+    def _entry_base(entry: str) -> str:
+        """The pending/done filename for a (possibly owned) entry."""
+        return entry.split("@", 1)[0]
+
+    def _running_holder(self, job_id: str) -> Optional[Dict[str, Any]]:
+        """Who currently holds ``job_id``'s running entry, if anyone."""
+        for name in self._entries(RUNNING_DIR):
+            m = _RUN_RE.match(name)
+            if m and m.group(2) == job_id:
+                return {
+                    "server": m.group(3),
+                    "epoch": int(m.group(4)) if m.group(4) else None,
+                }
+        return None
+
+    def finish(
+        self,
+        spec: JobSpec,
+        outcome: str,
+        *,
+        server: Optional[str] = None,
+        epoch: Optional[int] = None,
+        **extra: Any,
+    ) -> bool:
         """Record the final outcome (``completed`` / ``failed`` /
-        ``rejected``) in ``done/`` and clear the running entry."""
+        ``rejected``) in ``done/`` and clear the running entry.
+
+        A federated finish (``server=``) must first *take* its own
+        claim instance — an atomic rename of
+        ``running/<base>@<server>@<epoch>`` to a private tombstone.
+        If that rename fails the claim was superseded (the job was
+        reclaimed while this server was wedged): the late terminal
+        record is rejected, a ``fenced`` audit record names the zombie
+        and the current holder, and the method returns False without
+        writing anything. Returns True when the record landed."""
+        base = self._entry_base(spec.entry) if spec.entry else spec.entry
+        token: Optional[str] = None
+        if server is not None:
+            if epoch is None:
+                epoch = (
+                    spec.epoch if spec.epoch is not None
+                    else int(spec.reclaims) + 1
+                )
+            running = os.path.join(
+                self._dir(RUNNING_DIR), f"{base}@{server}@{epoch}"
+            )
+            token = os.path.join(
+                self.job_dir(spec.id), f".terminal@{server}@{epoch}"
+            )
+            try:
+                os.replace(running, token)
+            except OSError:
+                # this claim instance no longer exists: reclaimed out
+                # from under a zombie, or already finished — either
+                # way the terminal story belongs to someone else now
+                self.audit(
+                    "fenced", job=spec.id, tenant=spec.tenant,
+                    server=server, epoch=int(epoch),
+                    outcome_rejected=outcome,
+                    holder=self._running_holder(spec.id),
+                )
+                return False
         record = dict(spec.to_json())
         record.update(outcome=outcome, finished_t=time.time(), **extra)
-        final = os.path.join(self._dir(DONE_DIR), spec.entry)
-        tmp = os.path.join(self._dir(DONE_DIR), f".tmp-{spec.entry}")
+        final = os.path.join(self._dir(DONE_DIR), base)
+        tmp = os.path.join(self._dir(DONE_DIR), f".tmp-{base}")
         with open(tmp, "w") as f:
             json.dump(record, f, indent=1, default=str)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, final)
+        if token is not None:
+            try:
+                os.unlink(token)
+            except OSError:
+                pass
+        else:
+            try:
+                os.unlink(
+                    os.path.join(self._dir(RUNNING_DIR), spec.entry)
+                )
+            except OSError:
+                pass
+        return True
+
+    # -- server registry / leases -------------------------------------
+
+    def _server_path(self, server_id: str) -> str:
+        return os.path.join(self.root, SERVERS_DIR, f"{server_id}.json")
+
+    def _write_json_atomic(self, path: str, obj: Dict[str, Any]) -> None:
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(obj, f, indent=1, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def register_server(
+        self,
+        server_id: str,
+        *,
+        lease_s: float = DEFAULT_LEASE_S,
+        now: Optional[float] = None,
+        **meta: Any,
+    ) -> Dict[str, Any]:
+        """Register a serving loop (``servers/<id>.json``) and audit
+        ``server_register``. ``now`` is injectable for tests."""
+        if not _ID_RE.match(server_id):
+            raise ValueError(
+                f"server id must match {_ID_RE.pattern} "
+                f"(got {server_id!r})"
+            )
+        t = time.time() if now is None else float(now)
+        rec: Dict[str, Any] = {
+            "schema": SERVER_SCHEMA, "id": server_id,
+            "lease_s": float(lease_s), "started_t": t, "renewed_t": t,
+            "pid": os.getpid(),
+        }
+        rec.update(meta)
+        self._write_json_atomic(self._server_path(server_id), rec)
+        self.audit(
+            "server_register", server=server_id, lease_s=float(lease_s),
+            **meta,
+        )
+        return rec
+
+    def renew_lease(
+        self, server_id: str, *, now: Optional[float] = None
+    ) -> None:
+        """Refresh the heartbeat. A server whose registry file was
+        removed (scavenged as dead, operator cleanup) re-registers —
+        its old claims are already forfeit, but its next ones count."""
+        t = time.time() if now is None else float(now)
+        path = self._server_path(server_id)
         try:
-            os.unlink(os.path.join(self._dir(RUNNING_DIR), spec.entry))
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            self.register_server(server_id, now=t)
+            return
+        rec["renewed_t"] = t
+        self._write_json_atomic(path, rec)
+
+    def deregister_server(
+        self, server_id: str, **fields: Any
+    ) -> None:
+        """Clean shutdown: drop the lease file, audit ``server_stop``."""
+        try:
+            os.unlink(self._server_path(server_id))
         except OSError:
             pass
+        self.audit("server_stop", server=server_id, **fields)
+
+    def servers(self, *, now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Registered servers, each with ``lease_age_s`` and ``alive``
+        (lease not yet expired) computed against ``now``."""
+        t = time.time() if now is None else float(now)
+        out: List[Dict[str, Any]] = []
+        d = os.path.join(self.root, SERVERS_DIR)
+        try:
+            names = sorted(os.listdir(d))
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(".json") or name.startswith("."):
+                continue
+            try:
+                with open(os.path.join(d, name)) as f:
+                    rec = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            if not isinstance(rec, dict) or "id" not in rec:
+                continue
+            age = t - float(rec.get("renewed_t", 0.0))
+            rec["lease_age_s"] = age
+            rec["alive"] = age <= float(rec.get("lease_s", DEFAULT_LEASE_S))
+            out.append(rec)
+        return out
+
+    # -- orphan reclamation -------------------------------------------
+
+    def _requeue_or_exhaust(
+        self,
+        token: str,
+        base: str,
+        *,
+        owner: Optional[str],
+        epoch: Optional[int],
+        reason: str,
+        by: Optional[str],
+        max_reclaims: int,
+        now: float,
+    ) -> Optional[Dict[str, Any]]:
+        """Finish a reclaim transition: the claim instance has already
+        been renamed to ``token`` (the atomic take), so this path owns
+        the job. Requeue it with provenance, or — past the cap —
+        write its terminal ``failed: reclaim_exhausted`` record."""
+        try:
+            with open(token) as f:
+                spec = parse_job(json.load(f))
+        except (OSError, json.JSONDecodeError, JobSpecError):
+            # a torn spec cannot be requeued; leave the token for an
+            # operator, but never crash the scavenger
+            return None
+        action: Dict[str, Any] = {
+            "job": spec.id, "tenant": spec.tenant,
+            "from_server": owner, "epoch": epoch, "reason": reason,
+        }
+        if spec.reclaims >= max_reclaims:
+            rec = dict(spec.to_json())
+            rec.update(
+                outcome="failed", reason="reclaim_exhausted",
+                finished_t=now,
+            )
+            self._write_json_atomic(
+                os.path.join(self._dir(DONE_DIR), base), rec
+            )
+            try:
+                os.unlink(token)
+            except OSError:
+                pass
+            action["action"] = "exhausted"
+            self.audit(
+                "reclaim", job=spec.id, tenant=spec.tenant,
+                from_server=owner, epoch=epoch, reason=reason,
+                action="exhausted", by=by, reclaims=spec.reclaims,
+            )
+            self.audit(
+                "failed", job=spec.id, tenant=spec.tenant,
+                reason="reclaim_exhausted", reclaims=spec.reclaims,
+            )
+            return action
+        spec.reclaims += 1
+        prov = list(spec.reclaimed_from or [])
+        prov.append({
+            "server": owner, "epoch": epoch, "reason": reason,
+            "by": by, "t": now,
+        })
+        spec.reclaimed_from = prov
+        # requeue under the original entry name: the job keeps its
+        # FIFO position (it already waited once)
+        self._write_json_atomic(
+            os.path.join(self._dir(PENDING_DIR), base), spec.to_json()
+        )
+        try:
+            os.unlink(token)
+        except OSError:
+            pass
+        action["action"] = "requeued"
+        action["reclaims"] = spec.reclaims
+        self.audit(
+            "reclaim", job=spec.id, tenant=spec.tenant,
+            from_server=owner, epoch=epoch, reason=reason,
+            action="requeued", by=by, reclaims=spec.reclaims,
+        )
+        return action
+
+    def reclaim(
+        self,
+        *,
+        now: Optional[float] = None,
+        by: Optional[str] = None,
+        max_reclaims: int = DEFAULT_MAX_RECLAIMS,
+        grace_s: float = 0.0,
+    ) -> List[Dict[str, Any]]:
+        """One scavenger pass: requeue running entries whose owner is
+        dead (lease expired, or registry file gone), and sweep
+        transition tokens left by a finisher/scavenger that crashed
+        mid-transition. Returns a list of action dicts
+        (``action: requeued | exhausted | swept``).
+
+        The atomic take (rename of the claim instance to a private
+        token) is the race arbiter: a zombie's own :meth:`finish` and
+        a scavenger reclaiming the same claim cannot both win.
+        Unowned (single-server era) running entries are never touched.
+        ``by`` names the scavenging server so it skips its own claims.
+        """
+        t = time.time() if now is None else float(now)
+        servers = {rec["id"]: rec for rec in self.servers(now=t)}
+        actions: List[Dict[str, Any]] = []
+        expired_audited: set = set()
+
+        def owner_dead(owner: str) -> Optional[str]:
+            rec = servers.get(owner)
+            if rec is None:
+                return "server_gone"
+            age = float(rec["lease_age_s"])
+            if age <= float(rec.get("lease_s", DEFAULT_LEASE_S)) + grace_s:
+                return None
+            if owner not in expired_audited:
+                expired_audited.add(owner)
+                self.audit(
+                    "lease_expired", server=owner,
+                    lease_age_s=round(age, 3), by=by,
+                )
+            return "lease_expired"
+
+        for name in self._entries(RUNNING_DIR):
+            m = _RUN_RE.match(name)
+            if not m or not m.group(3):
+                continue  # unowned: a single-server claim, not ours
+            owner, epoch = m.group(3), int(m.group(4))
+            if by is not None and owner == by:
+                continue
+            reason = owner_dead(owner)
+            if reason is None:
+                continue
+            base = self._entry_base(name)
+            job_id = m.group(2)
+            token = os.path.join(
+                self.job_dir(job_id), f".reclaim@{owner}@{epoch}"
+            )
+            try:
+                os.replace(os.path.join(self._dir(RUNNING_DIR), name),
+                           token)
+            except OSError:
+                continue  # lost the race (zombie finished, peer took it)
+            act = self._requeue_or_exhaust(
+                token, base, owner=owner, epoch=epoch, reason=reason,
+                by=by, max_reclaims=max_reclaims, now=t,
+            )
+            if act:
+                actions.append(act)
+
+        # interrupted transitions: a finisher or scavenger that died
+        # after the atomic take but before its done/pending write left
+        # a token behind; resolve it once its creator's lease is gone
+        jobs_root = os.path.join(self.root, JOBS_DIR)
+        try:
+            job_ids = sorted(os.listdir(jobs_root))
+        except OSError:
+            job_ids = []
+        done_ids = {
+            _ENTRY_RE.match(n).group(2)
+            for n in self._entries(DONE_DIR)
+        }
+        pending_ids = {
+            _ENTRY_RE.match(n).group(2)
+            for n in self._entries(PENDING_DIR)
+        }
+        for job_id in job_ids:
+            jdir = os.path.join(jobs_root, job_id)
+            try:
+                names = os.listdir(jdir)
+            except OSError:
+                continue
+            for name in names:
+                kind = None
+                if name.startswith(".terminal@"):
+                    kind = "terminal"
+                elif name.startswith(".reclaim@"):
+                    kind = "reclaim"
+                if kind is None:
+                    continue
+                parts = name.split("@")
+                owner = parts[1] if len(parts) == 3 else ""
+                if owner and owner_dead(owner) is None:
+                    continue  # creator is alive: transition in flight
+                token = os.path.join(jdir, name)
+                if job_id in done_ids or job_id in pending_ids:
+                    # the transition completed (or the job moved on);
+                    # the token is litter
+                    try:
+                        os.unlink(token)
+                    except OSError:
+                        pass
+                    actions.append({
+                        "job": job_id, "action": "swept", "token": name,
+                    })
+                    continue
+                # the taker died holding the job: neither terminal nor
+                # pending. We cannot know a dead finisher's intended
+                # outcome, so the job goes back to the queue.
+                epoch = None
+                if len(parts) == 3 and parts[2].isdigit():
+                    epoch = int(parts[2])
+                # atomic take of the token itself: two scavengers
+                # sweeping the same leftover cannot both resolve it
+                take = f"{token}.take"
+                try:
+                    os.replace(token, take)
+                except OSError:
+                    continue
+                try:
+                    with open(take) as f:
+                        obj = json.load(f)
+                except (OSError, json.JSONDecodeError):
+                    continue
+                if not isinstance(obj, dict):
+                    continue
+                # the original entry name is gone with the rename; a
+                # fresh one from submitted_t keeps FIFO order close
+                sub_t = obj.get("submitted_t") or t
+                base = f"{int(float(sub_t) * 1e9):020d}-{job_id}.json"
+                act = self._requeue_or_exhaust(
+                    take, base, owner=owner or None, epoch=epoch,
+                    reason="interrupted_transition", by=by,
+                    max_reclaims=max_reclaims, now=t,
+                )
+                if act:
+                    actions.append(act)
+        return actions
+
+    # -- poisoned-job verdicts ----------------------------------------
+
+    def _verdict_path(self, job_id: str) -> str:
+        return os.path.join(self.root, VERDICTS_DIR, f"{job_id}.json")
+
+    def record_strike(
+        self,
+        job_id: str,
+        *,
+        reason: str = "",
+        server: Optional[str] = None,
+        max_strikes: int = 2,
+    ) -> int:
+        """Persist one dispatch-failure strike against ``job_id``;
+        at ``max_strikes`` the verdict flips to poisoned, so *every*
+        server — including ones that never saw the job — refuses it.
+        Returns the cumulative strike count."""
+        path = self._verdict_path(job_id)
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            rec = {}
+        n = int(rec.get("strikes", 0)) + 1
+        out = {
+            "schema": VERDICT_SCHEMA, "job": job_id, "strikes": n,
+            "poisoned": bool(rec.get("poisoned")) or n >= max_strikes,
+            "t": time.time(),
+        }
+        if reason:
+            out["reason"] = reason
+        if server:
+            out["server"] = server
+        self._write_json_atomic(path, out)
+        return n
+
+    def poisoned(self, job_id: str) -> bool:
+        """True when the spool-wide verdict says ``job_id`` wedges
+        workers — server-independent, survives restarts."""
+        try:
+            with open(self._verdict_path(job_id)) as f:
+                return bool(json.load(f).get("poisoned"))
+        except (OSError, json.JSONDecodeError):
+            return False
+
+    def strikes(self, job_id: str) -> int:
+        try:
+            with open(self._verdict_path(job_id)) as f:
+                return int(json.load(f).get("strikes", 0))
+        except (OSError, json.JSONDecodeError, TypeError, ValueError):
+            return 0
+
+    def verdicts(self) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        d = os.path.join(self.root, VERDICTS_DIR)
+        try:
+            names = sorted(os.listdir(d))
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(".json") or name.startswith("."):
+                continue
+            try:
+                with open(os.path.join(d, name)) as f:
+                    rec = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+        return out
 
     # -- status -------------------------------------------------------
 
@@ -607,8 +1176,23 @@ class Spool:
                 for s in self.pending()
             ],
             "running": [
-                {"job": s.id, "tenant": s.tenant, "nproc": s.nproc}
+                {
+                    "job": s.id, "tenant": s.tenant, "nproc": s.nproc,
+                    "server": s.owner, "epoch": s.epoch,
+                }
                 for s in self.running()
+            ],
+            "servers": [
+                {
+                    "id": rec.get("id"),
+                    "alive": rec.get("alive"),
+                    "lease_s": rec.get("lease_s"),
+                    "lease_age_s": round(
+                        float(rec.get("lease_age_s", 0.0)), 3
+                    ),
+                    "pid": rec.get("pid"),
+                }
+                for rec in self.servers()
             ],
             "done": [
                 {

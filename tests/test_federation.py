@@ -1,0 +1,732 @@
+"""Federated serving (ISSUE-14): server registry + leases, orphan
+reclamation, zombie fencing, cross-server coordination.
+
+Covers the ISSUE-14 acceptance surface:
+
+- registry/leases: serve loops register under a unique ``server_id``,
+  renew a heartbeat lease, deregister cleanly; ``servers()`` computes
+  lease age and liveness against an injectable clock;
+- claim ownership: a federated claim names its owner and claim epoch
+  *in the running entry itself* (``<entry>@<server>@<epoch>``) so the
+  scavenger and the fence work from disk alone;
+- the claim race: N threads racing ``Spool.claim`` — every job is
+  claimed exactly once, none lost, none duplicated, and the status
+  totals are conserved;
+- orphan reclamation: expired-lease and gone-server owners have their
+  running entries requeued atomically with ``reclaims`` /
+  ``reclaimed_from`` provenance; the per-job reclaim cap turns the
+  job terminal (``failed: reclaim_exhausted``) instead of cycling
+  forever; interrupted transitions (a finisher that died holding the
+  atomic take) are swept;
+- zombie fencing: a reclaimed-out server's late terminal record is
+  rejected with a ``fenced`` audit naming the zombie and the current
+  holder — no job goes terminal twice;
+- reclaimed checkpointed jobs resume from their newest checkpoint on
+  the surviving server (attempt 0 starts warm);
+- cross-server coordination: a poisoned verdict recorded by server A
+  is refused by server B (the verdict lives in the spool, not the
+  pool);
+- single-server byte-compat: legacy (unowned) claims are never
+  touched by the scavenger, legacy ``finish`` still returns True, and
+  a no-peer serve emits the PR 12 audit/terminal records plus only
+  the additive registry events;
+- ``submit --wait`` CLI exit codes (0 completed / 1 failed /
+  3 rejected / 2 wait timeout);
+- the doctor's failover narration and the federation OpenMetrics
+  families;
+- chaos e2e (slow, ``-m 'federation and chaos'``): two ``serve``
+  processes, one SIGKILLed mid-job — the survivor reclaims the orphan
+  and completes it *from its checkpoint*; every id ends terminal
+  exactly once and an injected zombie write is fenced.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mpi4jax_tpu.observability import doctor
+from mpi4jax_tpu.resilience import ckpt as _ckpt
+from mpi4jax_tpu.resilience.reshard import LeafSpec
+from mpi4jax_tpu.serving import Server, Spool, parse_job
+from mpi4jax_tpu.serving import export as sexport
+
+pytestmark = [pytest.mark.serving, pytest.mark.federation]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _events(spool, *names):
+    return [r for r in spool.audit_records() if r["event"] in names]
+
+
+def _terminal(spool, job_id):
+    return [r for r in spool.audit_records()
+            if r["event"] in ("completed", "failed", "rejected")
+            and r.get("job") == job_id]
+
+
+# ---------------------------------------------------------------------
+# registry + leases
+# ---------------------------------------------------------------------
+
+
+def test_registry_lease_lifecycle(tmp_path):
+    spool = Spool(str(tmp_path / "sp"))
+    rec = spool.register_server("sA", lease_s=10.0, now=100.0, world=2)
+    assert rec["id"] == "sA" and rec["lease_s"] == 10.0
+    (srv,) = spool.servers(now=104.0)
+    assert srv["id"] == "sA" and srv["alive"]
+    assert srv["lease_age_s"] == pytest.approx(4.0)
+    # a renew resets the age; the lease stays alive past the original
+    # expiry
+    spool.renew_lease("sA", now=106.0)
+    (srv,) = spool.servers(now=112.0)
+    assert srv["alive"] and srv["lease_age_s"] == pytest.approx(6.0)
+    # silence past the lease: still listed, no longer alive
+    (srv,) = spool.servers(now=117.0)
+    assert not srv["alive"]
+    # a renew after the registry file was removed re-registers
+    os.unlink(os.path.join(spool.root, "servers", "sA.json"))
+    spool.renew_lease("sA", now=120.0)
+    (srv,) = spool.servers(now=121.0)
+    assert srv["alive"]
+    spool.deregister_server("sA", jobs=0)
+    assert spool.servers() == []
+    assert _events(spool, "server_register")
+    assert _events(spool, "server_stop")
+
+
+def test_claim_records_owner_and_epoch(tmp_path):
+    spool = Spool(str(tmp_path / "sp"))
+    assert spool.submit({"id": "own", "cmd": ["-c", "pass"]})[
+        "status"] == "queued"
+    (spec,) = spool.pending()
+    got = spool.claim(spec, server="sA")
+    assert got is not None
+    assert got.owner == "sA" and got.epoch == 1
+    assert got.entry.endswith(".json@sA@1")
+    # the race still has exactly one winner across servers
+    assert spool.claim(spec, server="sB") is None
+    (run,) = spool.running()
+    assert run.owner == "sA" and run.epoch == 1
+    (row,) = spool.status()["running"]
+    assert row["server"] == "sA" and row["epoch"] == 1
+    (rec,) = _events(spool, "claimed")
+    assert rec["server"] == "sA" and rec["epoch"] == 1
+    with pytest.raises(ValueError):
+        spool.claim(spec, server="bad id!")
+
+
+def test_claim_race_exactly_one_winner_per_job(tmp_path):
+    """Property: N servers racing ``claim`` over M jobs — every job is
+    claimed exactly once, none lost, none duplicated, and every winner
+    can finish its own claim."""
+    spool = Spool(str(tmp_path / "sp"))
+    spool.configure(64)
+    jobs = [f"j{i:02d}" for i in range(12)]
+    for j in jobs:
+        assert spool.submit({"id": j, "cmd": ["-c", "pass"]})[
+            "status"] == "queued"
+    n = 6
+    barrier = threading.Barrier(n)
+    wins = [[] for _ in range(n)]
+    errors = []
+
+    def racer(i):
+        try:
+            specs = spool.pending()  # private spec objects per thread
+            barrier.wait()
+            for spec in specs:
+                got = spool.claim(spec, server=f"s{i}")
+                if got is not None:
+                    wins[i].append(got)
+        except Exception as exc:  # pragma: no cover - fail loudly
+            errors.append(exc)
+
+    threads = [threading.Thread(target=racer, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errors
+    claimed = [s.id for w in wins for s in w]
+    assert sorted(claimed) == jobs  # exactly once each, none lost
+    assert spool.pending() == []
+    running = spool.running()
+    assert sorted(s.id for s in running) == jobs
+    assert all(s.owner is not None and s.epoch == 1 for s in running)
+    assert spool.status()["depth"] == 0
+    # every winner finishes its own claims — nothing is fenced
+    for i, w in enumerate(wins):
+        for spec in w:
+            assert spool.finish(spec, "completed", server=f"s{i}",
+                                epoch=spec.epoch)
+    assert sorted(r["id"] for r in spool.done()) == jobs
+    assert spool.running() == []
+
+
+# ---------------------------------------------------------------------
+# orphan reclamation
+# ---------------------------------------------------------------------
+
+
+def test_reclaim_requeues_expired_lease_with_provenance(tmp_path):
+    spool = Spool(str(tmp_path / "sp"))
+    spool.register_server("sA", lease_s=1.0, now=100.0)
+    assert spool.submit({"id": "orph", "tenant": "t",
+                         "cmd": ["-c", "pass"]})["status"] == "queued"
+    (spec,) = spool.pending()
+    assert spool.claim(spec, server="sA") is not None
+    # fresh lease: the scavenger does not touch it
+    assert spool.reclaim(now=100.5, by="sB") == []
+    # grace extends the lease
+    assert spool.reclaim(now=102.0, by="sB", grace_s=10.0) == []
+    # a server never reclaims its own claims
+    assert spool.reclaim(now=102.0, by="sA") == []
+    (act,) = spool.reclaim(now=102.0, by="sB")
+    assert act["action"] == "requeued" and act["job"] == "orph"
+    assert act["from_server"] == "sA" and act["epoch"] == 1
+    assert act["reason"] == "lease_expired"
+    assert spool.running() == []
+    (back,) = spool.pending()
+    assert back.reclaims == 1
+    (prov,) = back.reclaimed_from
+    assert prov["server"] == "sA" and prov["epoch"] == 1
+    assert prov["reason"] == "lease_expired" and prov["by"] == "sB"
+    (exp,) = _events(spool, "lease_expired")
+    assert exp["server"] == "sA" and exp["by"] == "sB"
+    (rec,) = _events(spool, "reclaim")
+    assert rec["action"] == "requeued" and rec["reclaims"] == 1
+    # the next claim runs at epoch 2: provenance feeds the fence
+    assert spool.claim(back, server="sB").epoch == 2
+
+
+def test_reclaim_cap_turns_terminal_not_cyclic(tmp_path):
+    """A job whose every claimer dies must not cycle forever: past the
+    cap it goes terminal ``failed: reclaim_exhausted`` exactly once."""
+    spool = Spool(str(tmp_path / "sp"))
+    assert spool.submit({"id": "cyc", "cmd": ["-c", "pass"]})[
+        "status"] == "queued"
+    actions = []
+    for _ in range(3):
+        (spec,) = spool.pending()
+        # "ghost" never registered: the owner is simply gone
+        assert spool.claim(spec, server="ghost") is not None
+        (act,) = spool.reclaim(now=200.0, by="sB", max_reclaims=2)
+        actions.append(act["action"])
+        assert act["reason"] == "server_gone"
+    assert actions == ["requeued", "requeued", "exhausted"]
+    assert spool.pending() == [] and spool.running() == []
+    (rec,) = spool.done()
+    assert rec["id"] == "cyc" and rec["outcome"] == "failed"
+    assert rec["reason"] == "reclaim_exhausted"
+    assert rec["reclaims"] == 2 and len(rec["reclaimed_from"]) == 2
+    # terminal exactly once, and the audit says why
+    (term,) = _terminal(spool, "cyc")
+    assert term["event"] == "failed"
+    assert term["reason"] == "reclaim_exhausted"
+    # ghost was never registered: no lease_expired record for it
+    assert _events(spool, "lease_expired") == []
+
+
+def test_reclaim_sweeps_interrupted_transitions(tmp_path):
+    """A finisher/scavenger that died *after* the atomic take but
+    before its done/pending write leaves a token behind; once its
+    creator's lease is gone the job is requeued, and tokens whose
+    transition did complete are swept as litter."""
+    spool = Spool(str(tmp_path / "sp"))
+    assert spool.submit({"id": "tok", "cmd": ["-c", "pass"]})[
+        "status"] == "queued"
+    (spec,) = spool.pending()
+    assert spool.claim(spec, server="sA") is not None
+    # simulate the crash: the take landed, the done record never did
+    os.replace(
+        os.path.join(spool.root, "running", spec.entry),
+        os.path.join(spool.job_dir("tok"), ".terminal@sA@1"),
+    )
+    assert spool.running() == [] and spool.pending() == []
+    (act,) = spool.reclaim(now=300.0, by="sB")
+    assert act["action"] == "requeued"
+    assert act["reason"] == "interrupted_transition"
+    (back,) = spool.pending()
+    assert back.id == "tok" and back.reclaims == 1
+    # finish it properly, then sweep a stale leftover token as litter
+    assert spool.claim(back, server="sB") is not None
+    assert spool.finish(back, "completed", server="sB",
+                        epoch=back.epoch)
+    with open(os.path.join(spool.job_dir("tok"),
+                           ".reclaim@sA@1"), "w") as f:
+        json.dump(back.to_json(), f)
+    (act,) = spool.reclaim(now=301.0, by="sB")
+    assert act["action"] == "swept"
+    assert not os.path.exists(
+        os.path.join(spool.job_dir("tok"), ".reclaim@sA@1"))
+    (rec,) = spool.done()
+    assert rec["outcome"] == "completed"  # terminal exactly once
+
+
+# ---------------------------------------------------------------------
+# zombie fencing
+# ---------------------------------------------------------------------
+
+
+def test_zombie_finish_is_fenced(tmp_path):
+    spool = Spool(str(tmp_path / "sp"))
+    spool.register_server("sA", lease_s=1.0, now=100.0)
+    assert spool.submit({"id": "f0", "cmd": ["-c", "pass"]})[
+        "status"] == "queued"
+    (spec,) = spool.pending()
+    zombie = spool.claim(spec, server="sA")
+    assert zombie is not None and zombie.epoch == 1
+    # sA wedges; sB reclaims and re-claims at epoch 2
+    (act,) = spool.reclaim(now=102.0, by="sB")
+    assert act["action"] == "requeued"
+    (back,) = spool.pending()
+    winner = spool.claim(back, server="sB")
+    assert winner.epoch == 2
+    # the zombie wakes up and tries to write its stale terminal record
+    assert spool.finish(zombie, "completed", server="sA",
+                        epoch=1) is False
+    (fen,) = _events(spool, "fenced")
+    assert fen["job"] == "f0" and fen["server"] == "sA"
+    assert fen["epoch"] == 1 and fen["outcome_rejected"] == "completed"
+    assert fen["holder"] == {"server": "sB", "epoch": 2}
+    assert spool.done() == []  # the zombie wrote nothing
+    # the live claim finishes normally — exactly one terminal record
+    assert spool.finish(winner, "failed", server="sB", epoch=2,
+                        reason="oom") is True
+    (rec,) = spool.done()
+    assert rec["outcome"] == "failed" and rec["reclaims"] == 1
+    # an even later zombie retry is fenced again, not double-written
+    assert spool.finish(zombie, "completed", server="sA",
+                        epoch=1) is False
+    assert len(spool.done()) == 1
+
+
+# ---------------------------------------------------------------------
+# reclaimed jobs resume from their checkpoint
+# ---------------------------------------------------------------------
+
+
+def test_reclaimed_job_resumes_from_checkpoint(tmp_path):
+    """The surviving server's in-loop scavenger reclaims the orphan
+    and its attempt 0 starts from the newest checkpoint step."""
+    spool = Spool(str(tmp_path / "sp"))
+    ckroot = str(tmp_path / "ck")
+    mgr = _ckpt.CheckpointManager(ckroot, keep=2, world=1)
+    mgr.save_sharded(
+        7, {"w": np.arange(4.0, dtype=np.float32)},
+        {"w": LeafSpec(shape=(4,), dtype="float32")},
+    )
+    assert spool.submit({
+        "id": "orph", "cmd": ["-c", "pass"], "resume_dir": ckroot,
+    })["status"] == "queued"
+    # sA claimed it and died long ago
+    spool.register_server("sA", lease_s=1.0, now=time.time() - 60.0)
+    (spec,) = spool.pending()
+    assert spool.claim(spec, server="sA") is not None
+    resumes = []
+
+    def runner(spec, world, events_dir, attempt, resume_step):
+        resumes.append(resume_step)
+        return 0, []
+
+    server = Server(
+        spool, nproc=1, max_jobs=1, poll_s=0.01, runner=runner,
+        server_id="sB", lease_s=0.2, log=lambda msg: None,
+    )
+    rc = server.serve()
+    assert rc == 0
+    assert resumes == [7]  # attempt 0 started warm
+    (rec,) = spool.done()
+    assert rec["id"] == "orph" and rec["outcome"] == "completed"
+    assert rec["reclaims"] == 1
+    assert rec["reclaimed_from"][0]["server"] == "sA"
+    (adm,) = _events(spool, "admitted")
+    assert adm["reclaims"] == 1 and adm["resume_step"] == 7
+    (term,) = _terminal(spool, "orph")
+    assert term["event"] == "completed"
+
+
+def test_poison_verdict_is_spool_global(tmp_path):
+    """Server A's strikes persist in the spool; server B refuses the
+    job without ever dispatching it."""
+    spool = Spool(str(tmp_path / "sp"))
+    assert spool.record_strike("tox", reason="pool_wedged",
+                               server="sA") == 1
+    assert not spool.poisoned("tox")
+    assert spool.record_strike("tox", reason="pool_wedged",
+                               server="sA") == 2
+    assert spool.poisoned("tox") and spool.strikes("tox") == 2
+    (v,) = spool.verdicts()
+    assert v["job"] == "tox" and v["poisoned"]
+    assert spool.submit({"id": "tox", "cmd": ["-c", "pass"]})[
+        "status"] == "queued"
+    ran = []
+
+    def runner(spec, world, events_dir, attempt, resume_step):
+        ran.append(spec.id)
+        return 0, []
+
+    server = Server(spool, nproc=1, max_jobs=1, poll_s=0.01,
+                    runner=runner, server_id="sB",
+                    log=lambda msg: None)
+    assert server.serve() == 0
+    assert ran == []  # never dispatched
+    (rec,) = spool.done()
+    assert rec["outcome"] == "failed" and rec["reason"] == "poisoned"
+    (term,) = _terminal(spool, "tox")
+    assert term["reason"] == "poisoned" and term["refused"] is True
+
+
+# ---------------------------------------------------------------------
+# single-server byte-compat (PR 12 pin)
+# ---------------------------------------------------------------------
+
+
+def test_legacy_unowned_claims_are_untouched(tmp_path):
+    """Old spools stay readable and old call sites stay correct: an
+    unowned claim is invisible to the scavenger and legacy ``finish``
+    still returns True with the PR 12 record shape."""
+    spool = Spool(str(tmp_path / "sp"))
+    assert spool.submit({"id": "old", "cmd": ["-c", "pass"]})[
+        "status"] == "queued"
+    (spec,) = spool.pending()
+    assert spool.claim(spec) is not None  # no server=: legacy
+    assert "@" not in spec.entry
+    assert spec.owner is None and spec.epoch is None
+    # the scavenger never touches unowned entries, however old
+    assert spool.reclaim(now=time.time() + 9999.0, by="sX") == []
+    (run,) = spool.running()
+    assert run.id == "old"
+    assert spool.finish(spec, "completed", world=1) is True
+    (rec,) = spool.done()
+    assert rec["outcome"] == "completed"
+    for k in ("reclaims", "reclaimed_from", "owner", "epoch"):
+        assert k not in rec, k
+
+
+def test_single_server_serve_matches_pr12_records(tmp_path):
+    """A no-peer serve writes the same audit event sequence and
+    terminal records as PR 12; the only additions are the registry
+    events, and no failover event ever fires."""
+    spool = Spool(str(tmp_path / "sp"))
+    assert spool.submit({"id": "ok", "cmd": ["-c", "pass"]})[
+        "status"] == "queued"
+    assert spool.submit({"id": "bad", "cmd": ["-c", "pass"]})[
+        "status"] == "queued"
+    spool.request_drain()
+
+    def runner(spec, world, events_dir, attempt, resume_step):
+        return (1, []) if spec.id == "bad" else (0, [])
+
+    server = Server(spool, nproc=1, max_jobs=2, poll_s=0.01,
+                    runner=runner, server_id="solo",
+                    log=lambda msg: None)
+    assert server.serve() == 0
+    recs = spool.audit_records()
+    events = [r["event"] for r in recs]
+    # federation never fires without a dead peer
+    for absent in ("reclaim", "fenced", "lease_expired"):
+        assert absent not in events, absent
+    # the additions are exactly the registry bookends
+    added = [e for e in events if e in ("server_register",
+                                       "server_stop")]
+    assert added == ["server_register", "server_stop"]
+    # everything else is the PR 12 sequence, in the PR 12 order
+    assert [e for e in events if e not in ("server_register",
+                                           "server_stop")] == [
+        "submitted", "submitted", "drain_requested", "serve_start",
+        "claimed", "admitted", "completed",
+        "claimed", "admitted", "failed",
+    ]
+    # terminal records keep the PR 12 shape: no federation keys at all
+    for rec in spool.done():
+        for k in ("reclaims", "reclaimed_from", "owner", "epoch"):
+            assert k not in rec, (rec["id"], k)
+    assert {r["id"]: r["outcome"] for r in spool.done()} == {
+        "ok": "completed", "bad": "failed",
+    }
+
+
+# ---------------------------------------------------------------------
+# doctor narration + metrics export
+# ---------------------------------------------------------------------
+
+
+def _failover_flow(tmp_path):
+    """register sA -> claim -> lease expires -> sB reclaims and
+    completes at epoch 2 -> the sA zombie is fenced."""
+    spool = Spool(str(tmp_path / "sp"))
+    spool.register_server("sA", lease_s=1.0, now=100.0)
+    assert spool.submit({"id": "f0", "cmd": ["-c", "pass"]})[
+        "status"] == "queued"
+    (spec,) = spool.pending()
+    zombie = spool.claim(spec, server="sA")
+    spool.reclaim(now=102.0, by="sB")
+    (back,) = spool.pending()
+    winner = spool.claim(back, server="sB")
+    assert spool.finish(zombie, "completed", server="sA",
+                        epoch=1) is False
+    assert spool.finish(winner, "completed", server="sB", epoch=2,
+                        world=1, attempts=1)
+    return spool
+
+
+def test_doctor_narrates_failover(tmp_path):
+    spool = _failover_flow(tmp_path)
+    recs = doctor.load_serving_audit([spool.root])
+    text = doctor.format_serving_timeline(recs)
+    assert "server sA registered (lease 1.0s)" in text
+    assert "claimed: job f0 by server sA (epoch 1)" in text
+    assert "FAILOVER: server sA presumed dead" in text
+    assert "detected by sB" in text
+    assert ("FAILOVER: job f0 reclaimed from server sA (claim epoch "
+            "1, lease_expired) by sB — requeued with provenance"
+            ) in text
+    assert ("FENCED: job f0 — zombie server sA (stale claim epoch 1) "
+            "tried to write 'completed'; rejected "
+            "(job now held by sB)") in text
+
+
+def test_export_federation_metric_families(tmp_path):
+    spool = _failover_flow(tmp_path)
+    snap = sexport.serving_snapshot(spool)
+    assert snap["reclaims"] == {"lease_expired": 1}
+    assert snap["fenced"] == 1
+    assert [s["id"] for s in snap["servers"]] == ["sA"]
+    text = sexport.render_serving_metrics(snap)
+    assert "m4t_serve_servers_alive 0" in text  # sA's lease is cold
+    assert 'm4t_serve_server_lease_age{server="sA"}' in text
+    assert 'm4t_serve_reclaims_total{reason="lease_expired"} 1' in text
+    assert "m4t_serve_fenced_total 1" in text
+    assert text.endswith("# EOF\n")
+
+
+# ---------------------------------------------------------------------
+# submit --wait CLI
+# ---------------------------------------------------------------------
+
+
+def _cli_env():
+    env = dict(os.environ)
+    env.setdefault("MPI4JAX_TPU_SKIP_VERSION_CHECK", "1")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return env
+
+
+def _cli(*argv, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.serving", *argv],
+        capture_output=True, text=True, cwd=REPO, timeout=timeout,
+        env=_cli_env(),
+    )
+
+
+def test_cli_submit_wait_timeout_without_server(tmp_path):
+    sp = str(tmp_path / "sp")
+    r = _cli("submit", sp, "--id", "w0", "--wait",
+             "--wait-timeout", "0.4", "--", "-c", "pass")
+    assert r.returncode == 2, (r.stdout, r.stderr)
+    assert "not terminal after" in r.stderr
+
+
+def test_cli_submit_wait_follows_the_outcome(tmp_path):
+    sp = str(tmp_path / "sp")
+    serve = subprocess.Popen(
+        [sys.executable, "-m", "mpi4jax_tpu.serving", "serve", sp,
+         "-n", "1", "--poll", "0.05"],
+        cwd=REPO, env=_cli_env(),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        r = _cli("submit", sp, "--id", "good", "--wait", "--",
+                 "-c", "pass", timeout=240)
+        assert r.returncode == 0, (r.stdout, r.stderr)
+        # two JSON lines: the queued response, then the outcome
+        queued, out = map(json.loads, r.stdout.splitlines())
+        assert queued["status"] == "queued"
+        assert out["job"] == "good" and out["outcome"] == "completed"
+        r = _cli("submit", sp, "--id", "sad", "--wait", "--",
+                 "-c", "import sys; sys.exit(3)", timeout=240)
+        assert r.returncode == 1, (r.stdout, r.stderr)
+        assert json.loads(r.stdout.splitlines()[-1])[
+            "outcome"] == "failed"
+        # a rejected submit exits 3 immediately, wait or not
+        r = _cli("submit", sp, "--id", "good", "--wait", "--",
+                 "-c", "pass")
+        assert r.returncode == 3
+        assert json.loads(r.stdout)["reason"] == "duplicate_id"
+    finally:
+        _cli("drain", sp)
+        try:
+            serve.wait(120)
+        except subprocess.TimeoutExpired:
+            serve.kill()
+            raise
+
+
+def test_cli_offline_reclaim(tmp_path):
+    sp = str(tmp_path / "sp")
+    spool = Spool(sp)
+    spool.register_server("sA", lease_s=0.1,
+                          now=time.time() - 60.0)
+    assert spool.submit({"id": "r0", "cmd": ["-c", "pass"]})[
+        "status"] == "queued"
+    (spec,) = spool.pending()
+    assert spool.claim(spec, server="sA") is not None
+    r = _cli("reclaim", sp, "--by", "operator", "--json")
+    assert r.returncode == 0, r.stderr
+    (act,) = json.loads(r.stdout)
+    assert act["job"] == "r0" and act["action"] == "requeued"
+    (back,) = spool.pending()
+    assert back.reclaims == 1
+    # idempotent: a second pass finds nothing to do
+    r = _cli("reclaim", sp, "--by", "operator", "--json")
+    assert json.loads(r.stdout) == []
+
+
+# ---------------------------------------------------------------------
+# chaos e2e: SIGKILL one of two servers mid-job
+# ---------------------------------------------------------------------
+
+# device-free single-rank job: checkpoints every step, proves a warm
+# resume by writing the step it came back from
+_CKPT_JOB = """
+import sys
+import time
+import numpy as np
+from mpi4jax_tpu.resilience import ckpt, reshard, resume_step
+
+ckroot, proof = sys.argv[1], sys.argv[2]
+STEPS = 24
+specs = {"w": reshard.LeafSpec(shape=(4,), dtype="float32")}
+mgr = ckpt.CheckpointManager(ckroot, keep=3, world=1)
+start = 0
+r = resume_step()
+if r is not None:
+    with open(proof, "w") as f:
+        f.write(f"resumed@{r}")
+    start = r + 1
+w = np.zeros(4, np.float32)
+for step in range(start, STEPS):
+    mgr.save_sharded(step, {"w": w + step}, specs)
+    time.sleep(0.25)
+"""
+
+
+def _wait_for(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_sigkill_failover_loses_no_job(tmp_path):
+    """ISSUE-14 acceptance: two ``serve`` processes over one spool;
+    the one holding the job is SIGKILLed mid-run. The survivor's
+    scavenger reclaims the orphan after the lease expires and
+    completes it *from its checkpoint* (the proof file names the
+    resumed step). Every submitted id ends terminal exactly once, and
+    an injected zombie write from the dead server's identity is
+    fenced, not recorded."""
+    script = str(tmp_path / "ckpt_job.py")
+    with open(script, "w") as f:
+        f.write(f"import sys; sys.path.insert(0, {REPO!r})\n")
+        f.write(textwrap.dedent(_CKPT_JOB))
+    sp = str(tmp_path / "sp")
+    ckroot = str(tmp_path / "ck")
+    proof = str(tmp_path / "proof.txt")
+    spool = Spool(sp)
+    assert spool.submit({
+        "id": "orph", "cmd": [script, ckroot, proof],
+        "resume_dir": ckroot, "timeout_s": 120.0,
+    })["status"] == "queued"
+
+    def serve(server_id, log_path):
+        return subprocess.Popen(
+            [sys.executable, "-m", "mpi4jax_tpu.serving", "serve", sp,
+             "-n", "1", "--poll", "0.05", "--server-id", server_id,
+             "--lease", "0.5"],
+            cwd=REPO, env=_cli_env(), start_new_session=True,
+            stdout=subprocess.DEVNULL, stderr=open(log_path, "w"),
+        )
+
+    p1 = serve("chaos-s1", str(tmp_path / "s1.log"))
+    p2 = None
+    try:
+        # wait until s1 owns the job AND a checkpoint step committed
+        _wait_for(
+            lambda: any(r["event"] == "claimed"
+                        and r.get("server") == "chaos-s1"
+                        for r in spool.audit_records()),
+            60, "chaos-s1 to claim the job",
+        )
+        _wait_for(
+            lambda: _ckpt.CheckpointManager(
+                ckroot, world=1).latest_valid(world=1) is not None,
+            60, "the first committed checkpoint",
+        )
+        # SIGKILL the whole process group: server AND its spawned job
+        os.killpg(os.getpgid(p1.pid), signal.SIGKILL)
+        p1.wait(30)
+        p2 = serve("chaos-s2", str(tmp_path / "s2.log"))
+        _wait_for(lambda: len(spool.done()) == 1, 120,
+                  "the survivor to reclaim and complete the job")
+    finally:
+        for p in (p1, p2):
+            if p is not None and p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+                except OSError:
+                    pass
+    _cli("drain", sp)
+    if p2 is not None:
+        p2.wait(120)
+
+    # the survivor completed the orphan from its checkpoint
+    (rec,) = spool.done()
+    assert rec["id"] == "orph" and rec["outcome"] == "completed"
+    assert rec["reclaims"] == 1
+    assert rec["reclaimed_from"][0]["server"] == "chaos-s1"
+    with open(proof) as f:
+        body = f.read()
+    assert body.startswith("resumed@"), body
+    assert int(body.split("@")[1]) >= 0
+    # the failover is fully audited…
+    events = [r["event"] for r in spool.audit_records()]
+    assert "lease_expired" in events
+    (rcl,) = _events(spool, "reclaim")
+    assert rcl["action"] == "requeued"
+    assert rcl["from_server"] == "chaos-s1" and rcl["by"] == "chaos-s2"
+    claims = _events(spool, "claimed")
+    assert [(c["server"], c["epoch"]) for c in claims] == [
+        ("chaos-s1", 1), ("chaos-s2", 2),
+    ]
+    # …and every id is terminal exactly once
+    (term,) = _terminal(spool, "orph")
+    assert term["event"] == "completed"
+
+    # the dead server's identity comes back as a zombie: its late
+    # terminal write must be fenced, never double-recorded
+    zombie = parse_job({"id": "orph", "cmd": [script, ckroot, proof]})
+    zombie.entry = f"{0:020d}-orph.json"
+    assert spool.finish(zombie, "completed", server="chaos-s1",
+                        epoch=1) is False
+    (fen,) = _events(spool, "fenced")
+    assert fen["job"] == "orph" and fen["server"] == "chaos-s1"
+    assert len(spool.done()) == 1
+    assert len(_terminal(spool, "orph")) == 1
