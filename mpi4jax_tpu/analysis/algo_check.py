@@ -1,0 +1,427 @@
+"""Admission checker for ``m4t-algo/1`` collective algorithms.
+
+An algorithm file becomes a planner impl only after this module proves
+it, per declared world:
+
+- **M4T201 / M4T202** (from :mod:`.simulate`) — the emitted per-rank
+  schedule events run to completion under blocking rendezvous
+  semantics; a stuck state yields the usual rank-cycle / order-
+  mismatch witnesses pointing at the offending phase/step.
+- **M4T204 — chunk coverage** — a symbolic chunk interpreter replays
+  the completed rounds tracking, per rank and buffer slot, the
+  multiset of ``(source_rank, chunk_id)`` contributions. At the end,
+  every payload slot of every rank must hold *exactly* the declared
+  collective's result (AllReduce: every rank's contribution to that
+  chunk exactly once; AllToAll: exactly the block rank ``j`` sent to
+  this rank). Deadlock-free-but-wrong algorithms are rejected with the
+  missing / over-reduced / misplaced chunk named.
+- **M4T205 — step-cost admission** — the completed simulation is
+  lowered to fused per-round transfers; the measured step structure
+  (synchronization rounds = the alpha term, per-rank wire chunk-units
+  = the beta term) becomes the algorithm's first-class ``costmodel``
+  entry. Admission fails if the rounds are not fusable to one global
+  step order, or if the file's declared ``expect`` bounds are
+  exceeded — the bound is a contract, so ``lint --cost``,
+  ``launch --verify`` and the autotuner's analytic seed stay truthful.
+
+Reports reuse :class:`~.simulate.SimReport` so ``--json`` / ``--sarif``
+output, golden pins and CI annotation all work like linter findings.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .simulate import SimFinding, SimReport, SimRule, simulate_rounds
+
+#: semantic rules this checker adds on top of the M4T201–203 verdicts
+ALGO_RULES: Dict[str, SimRule] = {
+    "M4T204": SimRule(
+        "M4T204",
+        "algorithm chunk-coverage violation (a rank ends without "
+        "every chunk exactly-once reduced / delivered)",
+        "error",
+    ),
+    "M4T205": SimRule(
+        "M4T205",
+        "algorithm step-cost admission failure (rounds not fusable "
+        "to one global step order, or declared cost bounds exceeded)",
+        "error",
+    ),
+}
+
+
+def algo_rule_catalog() -> str:
+    return "\n".join(
+        f"{r.code} [{r.severity}] {r.title}" for r in ALGO_RULES.values()
+    )
+
+
+# ---------------------------------------------------------------------
+# M4T204: the symbolic chunk interpreter
+# ---------------------------------------------------------------------
+
+
+def _expected(collective: str, world: int, rank: int,
+              chunk: int) -> Counter:
+    if collective == "AllReduce":
+        return Counter({(s, chunk): 1 for s in range(world)})
+    # AllToAll: slot j must hold exactly the block rank j addressed to
+    # this rank (initial layout: rank s's slot d holds (s, d))
+    return Counter({(chunk, rank): 1})
+
+
+def interpret_coverage(
+    program, advances: List[List[Tuple[int, int]]]
+) -> List[SimFinding]:
+    """Replay the completed simulation over symbolic chunk contents
+    and diff every rank's final payload slots against the declared
+    collective semantics. Pure python, device-free; agreement with a
+    brute-force interpreter is property-tested."""
+    from ..planner import algo as _algo
+
+    n, C, S = program.world, program.chunks, program.slots
+    coll = program.spec.collective
+    state: Dict[int, List[Counter]] = {
+        r: [Counter() for _ in range(S)] for r in range(n)
+    }
+    for r in range(n):
+        for c in range(C):
+            state[r][c][(r, c)] = 1
+    comm = {r: program.comm_items(r) for r in range(n)}
+    attached = _algo.attached_copies(program)
+
+    def run_copies(r: int, key: int) -> None:
+        for cp in attached[r].get(key, []):
+            state[r][cp.dst] = Counter(state[r][cp.src])
+
+    for r in range(n):
+        run_copies(r, -1)
+
+    # pair each recv event with its sender's event in program order
+    # per directed pair — the rendezvous pairing the simulator used
+    send_events: Dict[Tuple[int, int], List[int]] = {}
+    for r in range(n):
+        for pc, item in enumerate(comm[r]):
+            if item.to != _algo.PROC_NULL:
+                send_events.setdefault((r, item.to), []).append(pc)
+    recv_pair: Dict[Tuple[int, int], Tuple[int, int]] = {}
+    taken: Dict[Tuple[int, int], int] = {}
+    for r in range(n):
+        for pc, item in enumerate(comm[r]):
+            if item.frm == _algo.PROC_NULL:
+                continue
+            key = (item.frm, r)
+            k = taken.get(key, 0)
+            taken[key] = k + 1
+            sends = send_events.get(key, [])
+            if k < len(sends):
+                recv_pair[(r, pc)] = (item.frm, sends[k])
+
+    #: payload snapshots taken at the *sender's* completion, keyed by
+    #: the sender event — a sender may run ahead of a slow receiver
+    stash: Dict[Tuple[int, int], List[Counter]] = {}
+    pcs = {r: 0 for r in range(n)}
+    for adv in advances:
+        deliveries = []
+        for r, pc in adv:
+            item = comm[r][pc]
+            if item.to != _algo.PROC_NULL:
+                stash[(r, pc)] = [
+                    Counter(state[r][s]) for s in item.send_slots
+                ]
+        for r, pc in adv:
+            item = comm[r][pc]
+            if item.frm == _algo.PROC_NULL:
+                continue
+            pair = recv_pair.get((r, pc))
+            if pair is not None and pair in stash:
+                vals = stash[pair]
+            else:
+                # sender still parked at its matching send: its state
+                # is frozen until it completes — read it live
+                s = item.frm
+                sender = comm[s][pcs[s]]
+                vals = [Counter(state[s][x]) for x in sender.send_slots]
+            deliveries.append((r, item.recv_slots, vals, item.action))
+        for r, slots_, vals, action in deliveries:
+            for slot, val in zip(slots_, vals):
+                if action == "reduce":
+                    state[r][slot] = state[r][slot] + val
+                else:
+                    state[r][slot] = val
+        for r, pc in adv:
+            run_copies(r, pc)
+        for r, pc in adv:
+            pcs[r] = pc + 1
+
+    findings: List[SimFinding] = []
+    for r in range(n):
+        for c in range(C):
+            want = _expected(coll, n, r, c)
+            have = state[r][c]
+            if have == want:
+                continue
+            missing = sorted((want - have).elements())
+            surplus = sorted((have - want).elements())
+            parts = []
+            if missing:
+                srcs = sorted({s for s, _ in missing})
+                parts.append(
+                    "missing contribution(s) from rank(s) "
+                    f"{srcs}" if coll == "AllReduce"
+                    else f"missing the block from rank {c}"
+                )
+            if surplus:
+                dups = [
+                    (k, have[k] - want[k])
+                    for k in sorted(set(surplus))
+                    if have[k] > want[k] and want[k] > 0
+                ]
+                if dups:
+                    parts.append(
+                        "over-reduced: " + ", ".join(
+                            f"contribution {k} applied {want[k] + d}x"
+                            for k, d in dups
+                        )
+                    )
+                foreign = [k for k in sorted(set(surplus))
+                           if want[k] == 0]
+                if foreign:
+                    parts.append(f"holds foreign chunk(s) {foreign}")
+            findings.append(SimFinding(
+                code="M4T204",
+                severity="error",
+                message=(
+                    f"chunk coverage violation: rank {r} chunk {c} "
+                    f"({coll}, world {n}): " + "; ".join(parts)
+                ),
+                witness={
+                    "rank": r,
+                    "chunk": c,
+                    "missing": [list(k) for k in missing],
+                    "surplus": [list(k) for k in surplus],
+                    "held": sorted(
+                        [list(k), v] for k, v in have.items()
+                    ),
+                },
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------
+# M4T205: step-cost admission
+# ---------------------------------------------------------------------
+
+
+def admit_cost(
+    spec, program
+) -> Tuple[List[SimFinding], Optional[Dict[str, int]]]:
+    """Derive the algorithm's cost entry from its verified step
+    structure; emit M4T205 findings when it cannot be derived or
+    breaks the file's declared ``expect`` bounds."""
+    from ..planner import algo as _algo
+
+    n = program.world
+    try:
+        low = _algo.lower(program)
+    except _algo.AlgoNotFusable as e:
+        return [SimFinding(
+            code="M4T205",
+            severity="error",
+            message=f"step-cost admission failed at world {n}: {e}",
+            witness={"world": n, "fusable": False},
+        )], None
+    actual = {
+        "rounds": len(low.rounds),
+        "wire_chunks": low.wire_chunks,
+        "chunks": low.chunks,
+        "slots": low.slots,
+    }
+    findings: List[SimFinding] = []
+    env = spec.env(n)
+    for key in ("rounds", "wire_chunks"):
+        if key not in spec.expect:
+            continue
+        try:
+            bound = _algo.evaluate(
+                spec.expect[key], env, what=f"expect.{key}"
+            )
+        except _algo.AlgoError as e:
+            findings.append(SimFinding(
+                code="M4T205", severity="error",
+                message=f"step-cost admission failed at world {n}: "
+                        f"{e}",
+                witness={"world": n, "expect": key},
+            ))
+            continue
+        if actual[key] > bound:
+            findings.append(SimFinding(
+                code="M4T205",
+                severity="error",
+                message=(
+                    f"step-cost admission failed at world {n}: "
+                    f"measured {key} {actual[key]} exceeds the "
+                    f"declared bound {bound} "
+                    f"({spec.expect[key]!r}) — the costmodel entry "
+                    "would be untruthful"
+                ),
+                witness={
+                    "world": n, "key": key,
+                    "actual": actual[key], "declared": bound,
+                },
+            ))
+    return findings, actual
+
+
+# ---------------------------------------------------------------------
+# the checker
+# ---------------------------------------------------------------------
+
+
+def check_spec(
+    spec, worlds: Optional[Sequence[int]] = None
+) -> List[SimReport]:
+    """Prove one parsed algorithm at each world (default: its declared
+    worlds). One :class:`SimReport` per world; ``deadlock-free``
+    verdicts mean *fully admitted* (simulate + M4T204 + M4T205)."""
+    from ..planner import algo as _algo
+
+    target = f"{spec.path or '<inline>'}::{spec.name}"
+    reports: List[SimReport] = []
+    for n in worlds if worlds is not None else spec.worlds:
+        n = int(n)
+        axis_env = {"ranks": n}
+        try:
+            program = _algo.expand(spec, n)
+        except _algo.AlgoError as e:
+            reports.append(SimReport(
+                target=target, axis_env=axis_env, world=n,
+                verdict="error", reason=str(e),
+            ))
+            continue
+        events = _algo.events_for(program)
+        ok, advances, findings = simulate_rounds(events)
+        n_events = {r: len(evs) for r, evs in events.items()}
+        if not ok:
+            reports.append(SimReport(
+                target=target, axis_env=axis_env, world=n,
+                verdict="findings", findings=list(findings),
+                n_events=n_events, rounds=len(advances),
+            ))
+            continue
+        coverage = interpret_coverage(program, advances)
+        costf, entry = admit_cost(spec, program)
+        all_findings = coverage + costf
+        reports.append(SimReport(
+            target=target, axis_env=axis_env, world=n,
+            verdict="deadlock-free" if not all_findings else "findings",
+            findings=all_findings,
+            n_events=n_events,
+            rounds=len(advances),
+            cost={"algo": entry} if entry is not None else None,
+        ))
+    return reports
+
+
+def check_file(
+    path: str, worlds: Optional[Sequence[int]] = None
+) -> List[SimReport]:
+    """Load + prove one algorithm file; parse errors come back as a
+    single ``error`` report instead of raising."""
+    from ..planner import algo as _algo
+
+    try:
+        spec = _algo.load(path)
+    except _algo.AlgoError as e:
+        return [SimReport(
+            target=f"{path}::<unparsed>", axis_env={}, world=0,
+            verdict="error", reason=str(e),
+        )]
+    return check_spec(spec, worlds)
+
+
+def reports_clean(reports: Sequence[SimReport]) -> bool:
+    return bool(reports) and all(r.deadlock_free for r in reports)
+
+
+# ---------------------------------------------------------------------
+# proof artifacts (``<algo>.proof.json``, schema m4t-algo-proof/1)
+# ---------------------------------------------------------------------
+
+
+def build_proof(spec, reports: Sequence[SimReport]) -> Dict[str, Any]:
+    """The committed proof artifact: fingerprint-bound verdicts per
+    world. Registration re-verifies anyway (truth over trust) — the
+    artifact exists so review, CI and `launch --verify` can detect a
+    stale or never-proven file without re-running anything."""
+    from ..planner.algo import PROOF_SCHEMA
+
+    if not reports_clean(reports):
+        bad = [r.world for r in reports if not r.deadlock_free]
+        raise ValueError(
+            f"refusing to write a proof for a failing algorithm "
+            f"(world(s) {bad} not clean)"
+        )
+    return {
+        "schema": PROOF_SCHEMA,
+        "name": spec.name,
+        "fingerprint": spec.fingerprint,
+        "rules": ["M4T201", "M4T202", "M4T204", "M4T205"],
+        "worlds": {
+            str(r.world): {
+                "verdict": r.verdict,
+                "rounds": r.rounds,
+                **(r.cost["algo"] if r.cost else {}),
+            }
+            for r in reports
+        },
+    }
+
+
+def write_proof(spec, reports: Sequence[SimReport],
+                path: Optional[str] = None) -> str:
+    from ..planner import algo as _algo
+
+    out = path or _algo.proof_path(spec.path or spec.name + ".json")
+    body = json.dumps(build_proof(spec, reports), indent=2,
+                      sort_keys=True) + "\n"
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(body)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, out)
+    return out
+
+
+def proof_mismatch(spec, proof: Dict[str, Any]) -> Optional[str]:
+    """Why this proof does NOT admit this spec (None = it does)."""
+    from ..planner.algo import PROOF_SCHEMA
+
+    if not isinstance(proof, dict):
+        return "proof is not an object"
+    if proof.get("schema") != PROOF_SCHEMA:
+        return (f"proof schema mismatch: want {PROOF_SCHEMA!r}, "
+                f"got {proof.get('schema')!r}")
+    if proof.get("name") != spec.name:
+        return (f"proof names {proof.get('name')!r}, file is "
+                f"{spec.name!r}")
+    if proof.get("fingerprint") != spec.fingerprint:
+        return (
+            "stale proof: algorithm content fingerprint "
+            f"{spec.fingerprint} != proven {proof.get('fingerprint')} "
+            "— re-run `planner algo check --write-proof`"
+        )
+    worlds = proof.get("worlds") or {}
+    for n in spec.worlds:
+        entry = worlds.get(str(n))
+        if not entry:
+            return f"proof does not cover declared world {n}"
+        if entry.get("verdict") != "deadlock-free":
+            return (f"proof records verdict {entry.get('verdict')!r} "
+                    f"at world {n}")
+    return None

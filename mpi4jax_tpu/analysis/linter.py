@@ -292,10 +292,12 @@ def reports_to_json(reports: List[Report]) -> Dict[str, Any]:
 
 def rule_catalog() -> str:
     """One line per registered rule (the ``--rules`` CLI listing):
-    the M4T1xx lint rules plus the M4T2xx simulation verdicts."""
+    the M4T1xx lint rules, the M4T2xx simulation verdicts, and the
+    algorithm admission rules (M4T204/M4T205)."""
+    from .algo_check import algo_rule_catalog
     from .simulate import sim_rule_catalog
 
     lint_lines = "\n".join(
         f"{r.code} [{r.severity}] {r.title}" for r in RULES.values()
     )
-    return lint_lines + "\n" + sim_rule_catalog()
+    return lint_lines + "\n" + sim_rule_catalog() + "\n" + algo_rule_catalog()

@@ -55,7 +55,9 @@ def _rules_meta() -> List[Dict[str, Any]]:
                 ),
             }
         )
-    for r in SIM_RULES.values():
+    from .algo_check import ALGO_RULES
+
+    for r in list(SIM_RULES.values()) + list(ALGO_RULES.values()):
         rules.append(
             {
                 "id": r.code,

@@ -159,9 +159,19 @@ class SimReport:
             for note in self.schedule.notes:
                 lines.append(f"  note: {note}")
         if self.cost is not None:
-            from .schedule import format_cost_report
+            if "algo" in self.cost:
+                # algorithm reports (analysis/algo_check.py) carry the
+                # verified step structure, not a schedule cost report
+                a = self.cost["algo"]
+                lines.append(
+                    f"  cost: {a.get('rounds')} round(s), "
+                    f"{a.get('wire_chunks')} wire chunk(s) of "
+                    f"{a.get('chunks')} (slots {a.get('slots')})"
+                )
+            else:
+                from .schedule import format_cost_report
 
-            lines.append(format_cost_report(self.cost))
+                lines.append(format_cost_report(self.cost))
         return "\n".join(lines)
 
 
@@ -392,16 +402,18 @@ def _classify_stuck(
     return findings
 
 
-def simulate_events(
+def simulate_rounds(
     events: Dict[int, List[ScheduleEvent]],
-) -> Tuple[bool, int, List[SimFinding]]:
-    """Run the blocking-semantics simulation over raw per-rank event
-    lists. Returns ``(deadlock_free, rounds, findings)``. Exposed
-    separately from :func:`simulate` so synthetic schedules (the
-    property-based tests) can drive it directly."""
+) -> Tuple[bool, List[List[Tuple[int, int]]], List[SimFinding]]:
+    """Like :func:`simulate_events`, but additionally records *which*
+    events completed in each synchronization round: returns
+    ``(deadlock_free, advances, findings)`` where ``advances[t]`` is
+    the list of ``(rank, position)`` pairs that completed in round
+    ``t``. The round structure is what the algorithm compiler
+    (``planner/algo.py``) lowers to its fused global step order."""
     pcs = {r: 0 for r in events}
     total = sum(len(ev) for ev in events.values())
-    rounds = 0
+    advances: List[List[Tuple[int, int]]] = []
     while any(pcs[r] < len(events[r]) for r in events):
         advance = []
         for r in sorted(events):
@@ -416,13 +428,24 @@ def simulate_events(
             if ready:
                 advance.append(r)
         if not advance:
-            return False, rounds, _classify_stuck(pcs, events)
+            return False, advances, _classify_stuck(pcs, events)
+        advances.append([(r, pcs[r]) for r in advance])
         for r in advance:
             pcs[r] += 1
-        rounds += 1
-        if rounds > total + 1:  # pragma: no cover — safety backstop
-            return False, rounds, _classify_stuck(pcs, events)
-    return True, rounds, []
+        if len(advances) > total + 1:  # pragma: no cover — backstop
+            return False, advances, _classify_stuck(pcs, events)
+    return True, advances, []
+
+
+def simulate_events(
+    events: Dict[int, List[ScheduleEvent]],
+) -> Tuple[bool, int, List[SimFinding]]:
+    """Run the blocking-semantics simulation over raw per-rank event
+    lists. Returns ``(deadlock_free, rounds, findings)``. Exposed
+    separately from :func:`simulate` so synthetic schedules (the
+    property-based tests) can drive it directly."""
+    ok, advances, findings = simulate_rounds(events)
+    return ok, len(advances), findings
 
 
 def simulate(schedule: ProgramSchedule) -> Tuple[str, int, List[SimFinding]]:

@@ -43,7 +43,11 @@ collective schedule is enumerated and simulated at ``-n`` ranks
 (``analysis/{schedule,simulate}.py``); a deadlock (M4T201, with a
 rank-cycle witness) or cross-rank order mismatch (M4T202) blocks the
 launch — the bug the doctor would name post-mortem is named pre-spawn
-instead, for free.
+instead, for free. ``--algo FILE`` sideloads declarative collective
+algorithms (``m4t-algo/1``, ``planner/algo.py``) into every rank's
+registry; under ``--verify`` each file is proven at ``-n`` ranks
+(simulate + M4T204 chunk coverage + M4T205 cost admission) first, and
+an armed plan naming an unproven ``algo:*`` impl blocks the same way.
 
 Adaptive planning (``planner/``): ``--plan PLAN.json`` arms a tuned
 collective plan cache in every rank (``M4T_PLAN_CACHE``) so plannable
@@ -219,6 +223,70 @@ def _verify_prelaunch(args, world=None) -> int:
     deadlock-free at 4 ranks is not automatically deadlock-free at 2).
     """
     world = args.nproc if world is None else int(world)
+
+    # --algo files gate first: a sideloaded collective algorithm that
+    # deadlocks (M4T201), drops a chunk (M4T204), or breaks its cost
+    # contract (M4T205) at *this* world must never reach a rank's
+    # registry. Same verdict surface as `planner algo check`.
+    algo_files = list(getattr(args, "algo", None) or ())
+    if algo_files:
+        from .analysis import algo_check
+
+        blocked_algos = False
+        for path in algo_files:
+            sys.stderr.write(
+                f"mpi4jax_tpu.launch: --verify: proving algorithm "
+                f"{path!r} at n={world} before spawning\n"
+            )
+            reports = algo_check.check_file(path, [world])
+            for rep in reports:
+                sys.stderr.write(rep.to_text() + "\n")
+            if not algo_check.reports_clean(reports):
+                blocked_algos = True
+        if blocked_algos:
+            sys.stderr.write(
+                "mpi4jax_tpu.launch: --verify BLOCKED the launch: an "
+                "--algo file failed verification at this world — no "
+                "rank was spawned. Fix the findings above or drop the "
+                "algorithm.\n"
+            )
+            return 1
+
+    # an armed plan routing through an unregistered (unproven or
+    # proof-stale) algorithm impl is the same class of failure:
+    # refuse pre-spawn with the registry's reason, not at step 1.
+    plan_path = getattr(args, "plan", None)
+    if plan_path and os.path.exists(plan_path):
+        from .planner import algo as _algomod
+        from .planner import plan as _planmod
+
+        try:
+            armed = _planmod.load(plan_path)
+        except Exception:
+            armed = None  # main's own --plan validation reports this
+        if armed is not None:
+            bad = []
+            for key, ent in sorted(armed.entries.items()):
+                impl = getattr(ent, "impl", "")
+                if impl.startswith("algo:") and _algomod.get(impl) is None:
+                    bad.append((key, impl))
+            if bad:
+                for key, impl in bad:
+                    sys.stderr.write(
+                        f"mpi4jax_tpu.launch: --verify: plan entry "
+                        f"{key!r} routes through {impl!r}, which is "
+                        f"not a registered (proof-verified) "
+                        f"algorithm\n"
+                    )
+                sys.stderr.write(
+                    "mpi4jax_tpu.launch: --verify BLOCKED the launch: "
+                    "the armed plan names unproven algorithm impl(s) "
+                    "— no rank was spawned. Re-prove them (`python -m "
+                    "mpi4jax_tpu.planner algo check --write-proof`) "
+                    "or re-tune the plan.\n"
+                )
+                return 1
+
     target = args.module if args.module else args.cmd[0]
     sys.stderr.write(
         f"mpi4jax_tpu.launch: --verify: proving {target!r} "
@@ -298,7 +366,7 @@ def make_world_args(**overrides):
         events_dir=None, hang_timeout=0.0, heartbeat=5.0,
         doctor=False, live=False, live_grace=None, dashboard=False,
         metrics_port=None, perf=False, plan=None, tune=False,
-        verify=False, static_check="off", fault_plan=None,
+        verify=False, algo=None, static_check="off", fault_plan=None,
         retries=0, backoff=1.0, resume_dir=None,
         elastic=False, min_ranks=1,
         plan_cache_env=None, _live_report=None,
@@ -778,6 +846,15 @@ def main(argv=None):
         "witness)",
     )
     parser.add_argument(
+        "--algo", action="append", default=None, metavar="ALGO.json",
+        help="sideload a collective algorithm file (m4t-algo/1, "
+        "planner/algo.py) into every rank's registry via "
+        "M4T_ALGO_PATH; may repeat. With --verify each file is "
+        "proven at -n ranks (simulate + chunk coverage + cost "
+        "admission) before any rank spawns — an unproven algorithm "
+        "blocks the launch",
+    )
+    parser.add_argument(
         "--static-check", choices=("off", "warn", "error"), default="off",
         help="set M4T_STATIC_CHECK for every rank: screen each op "
         "emission at trace time with the site-local static-analysis "
@@ -854,6 +931,26 @@ def main(argv=None):
         parser.error("--elastic requires --retries >= 1 (the restart "
                      "loop) and --resume-dir (the checkpoint to "
                      "reshard)")
+
+    if args.algo:
+        args.algo = [os.path.abspath(p) for p in args.algo]
+        for path in args.algo:
+            if not os.path.exists(path):
+                parser.error(f"--algo: {path} does not exist")
+        # rank_env copies os.environ, so extending M4T_ALGO_PATH here
+        # sideloads the files into every rank's registry (and into
+        # this process's own registry, which --verify's armed-plan
+        # check consults) across every spawn path, including the
+        # supervisor's restarts
+        dirs = []
+        for path in args.algo:
+            d = os.path.dirname(path)
+            if d not in dirs:
+                dirs.append(d)
+        prior = os.environ.get("M4T_ALGO_PATH")
+        if prior:
+            dirs += [d for d in prior.split(os.pathsep) if d]
+        os.environ["M4T_ALGO_PATH"] = os.pathsep.join(dirs)
 
     if args.verify:
         rc = _verify_prelaunch(args)

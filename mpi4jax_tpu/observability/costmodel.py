@@ -131,6 +131,49 @@ def itemsize(dtype: Optional[str]) -> int:
     return _ITEMSIZE.get(str(dtype or ""), 4)
 
 
+#: first-class cost entries for planner impls whose step structure was
+#: verified elsewhere: ``algo:<name>@<fingerprint>`` tags registered by
+#: ``planner/algo.registry`` from each algorithm's admission pass
+#: (M4T205), so ``lint --cost``, ``launch --verify`` and the
+#: autotuner's analytic seed all price it from the *proven* round
+#: structure rather than a guess
+_IMPL_COSTS: Dict[str, Dict[str, Any]] = {}
+
+
+def register_impl_cost(
+    impl: str,
+    *,
+    op: str,
+    label: str,
+    per_world: Dict[int, Dict[str, int]],
+) -> None:
+    """Register an impl's verified step structure: per world,
+    ``{"chunks", "wire_chunks", "rounds"}`` — wire bytes scale as
+    ``wire_chunks * ceil(payload / chunks)``, steps are the proven
+    synchronization rounds."""
+    _IMPL_COSTS[impl] = {
+        "op": op,
+        "label": label,
+        "per_world": {int(w): dict(v) for w, v in per_world.items()},
+    }
+
+
+def registered_impl_cost(impl: str) -> Optional[Dict[str, Any]]:
+    """The registered entry for one impl tag; ``algo:*`` tags trigger
+    a lazy registry scan so offline consumers (lint/doctor reading a
+    record stream) price them without arming anything first."""
+    entry = _IMPL_COSTS.get(impl)
+    if entry is None and impl.startswith("algo:"):
+        try:
+            from ..planner import algo as _algo
+
+            _algo.registry()
+        except Exception:
+            return None
+        entry = _IMPL_COSTS.get(impl)
+    return entry
+
+
 def _quant_wire_format_bytes(n_elems: int) -> int:
     if n_elems <= 0:
         return 0
@@ -234,6 +277,20 @@ def _impl_cost(
     the caller then falls through to the plain op model, so a plan
     from a newer schema degrades to a conservative estimate instead
     of crashing an offline report."""
+    reg = registered_impl_cost(impl)
+    if reg is not None:
+        if op != reg["op"]:
+            return None
+        ent = reg["per_world"].get(n)
+        if ent is None:
+            return None
+        chunk_b = -(-b // max(1, int(ent["chunks"])))
+        return {
+            "op": op,
+            "wire_bytes": int(ent["wire_chunks"]) * chunk_b,
+            "steps": int(ent["rounds"]),
+            "algorithm": reg["label"],
+        }
     if impl == "pallas_ring" and op in (
         "AllReduce", "ReduceScatter", "AllGather"
     ):

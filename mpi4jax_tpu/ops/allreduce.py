@@ -169,6 +169,10 @@ def _allreduce_spmd(x, *, op, comm: BoundComm, transpose):
     # reduces to the legacy opt-in ring heuristic (the policy that
     # used to live here as _use_pallas_ring) and the HLO path below.
     d = _dispatch.select("AllReduce", x, op, comm)
+    if d.impl.startswith("algo:"):
+        from ..planner import algo as _algo
+
+        return _algo.execute_spmd(x, op, comm, d.impl)
     if d.impl == "pallas_ring":
         return _ring_reduce(x, comm, d.params)
     if d.impl == "quantized":

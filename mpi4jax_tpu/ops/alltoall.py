@@ -16,6 +16,7 @@ from jax import lax
 from jax.interpreters import ad
 
 from ..comm import BoundComm, Comm, resolve_comm
+from ..planner import dispatch as _dispatch
 from ..token import NOTSET, raise_if_token_is_set
 from ..validation import enforce_types
 from ._core import define_primitive, emit
@@ -36,6 +37,14 @@ def _alltoall_spmd(x, *, comm: BoundComm):
         return _shm.alltoall(x)
     if not comm.axes or comm.size == 1:
         return x
+    # Planner dispatch seam: unarmed the only AllToAll impl is the
+    # HLO collective below (byte-identical to the pre-seam lowering);
+    # armed, a verified m4t-algo/1 algorithm may be routed instead.
+    d = _dispatch.select("AllToAll", x, None, comm)
+    if d.impl.startswith("algo:"):
+        from ..planner import algo as _algo
+
+        return _algo.execute_spmd(x, None, comm, d.impl)
     axis = comm.axis_target()
     _, kw = comm.collective_kwargs()
     return lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=False, **kw)
@@ -86,6 +95,14 @@ def alltoall(x, *, comm=None, token=NOTSET):
             f"(the communicator size), got shape {x.shape}; reference "
             "parity: alltoall.py:65-67"
         )
+    # Planner stamp (armed only — one falsy check otherwise), the
+    # allreduce.py pattern: the same pure decision the lowering will
+    # make, recorded into telemetry for perf attribution.
+    decision = None
+    if (_dispatch.active is not None or _dispatch.pins) and (
+        bound.backend == "xla" and bound.size > 1
+    ):
+        decision = _dispatch.select("AllToAll", x, None, bound)
     (out,) = emit(
         mpi_alltoall_p,
         (x,),
@@ -94,5 +111,6 @@ def alltoall(x, *, comm=None, token=NOTSET):
         details=f"[{x.size} items, n={bound.size}]",
         bound_comm=bound,
         annotation="m4t.alltoall",
+        decision=decision,
     )
     return out

@@ -120,12 +120,18 @@ def _cmd_tune(args: argparse.Namespace) -> int:
     else:
         keys = []
     if not keys:
+        grid = {}
+        if args.ops:
+            grid["ops"] = tuple(
+                o.strip() for o in args.ops.split(",") if o.strip()
+            )
         keys = autotune.default_keys(
             platform=platform,
             world=args.world,
             axes=tuple(args.axes.split(",")),
             dtypes=tuple(args.dtypes.split(",")),
             buckets=_parse_buckets(args.buckets),
+            **grid,
         )
     planobj, report = autotune.sweep(
         keys,
@@ -187,6 +193,181 @@ def _cmd_show(args: argparse.Namespace) -> int:
             f"# plan {planobj.plan_id} ({planobj.source}, platform "
             f"{planobj.platform}, {len(planobj.entries)} keys)"
         )
+    return 0
+
+
+# ---------------------------------------------------------------------
+# algo: check / show / lower (device-free; the m4t-algo/1 toolchain)
+# ---------------------------------------------------------------------
+
+
+def _parse_ranks(spec: Optional[str]) -> Optional[List[int]]:
+    if not spec:
+        return None
+    return [int(p) for p in spec.split(",") if p.strip()]
+
+
+def _print_algo_reports(reports, *, verbose: bool = True) -> None:
+    for r in reports:
+        mark = "ok" if r.deadlock_free else "FAIL"
+        codes = sorted({f.code for f in r.findings})
+        extra = f" [{','.join(codes)}]" if codes else ""
+        if r.verdict == "error":
+            extra = f" ({r.reason})"
+        cost = ""
+        if r.cost and r.cost.get("algo"):
+            a = r.cost["algo"]
+            cost = (f" rounds={a['rounds']} "
+                    f"wire_chunks={a['wire_chunks']}")
+        print(f"{mark:4} {r.target} world={r.world} "
+              f"{r.verdict}{cost}{extra}")
+        if verbose:
+            for f in r.findings:
+                print(f"     {f.code}: {f.message}")
+
+
+def _cmd_algo_check(args: argparse.Namespace) -> int:
+    from ..analysis import algo_check
+    from . import algo as _algo
+
+    worlds = _parse_ranks(args.ranks)
+    all_reports = []
+    rc = 0
+    for path in args.files:
+        if path.endswith(".proof.json"):
+            # proof artifacts sit next to the algorithm files, so a
+            # directory glob picks them up too — they are outputs of
+            # this command, not inputs
+            continue
+        reports = algo_check.check_file(path, worlds)
+        all_reports.extend(reports)
+        clean = algo_check.reports_clean(reports)
+        if not clean:
+            rc = 1
+        if args.write_proof is not None:
+            if not clean:
+                print(f"# {path}: not clean — refusing to write a "
+                      "proof", file=sys.stderr)
+            elif worlds is not None:
+                print(f"# {path}: --write-proof needs the declared "
+                      "worlds (drop --ranks)", file=sys.stderr)
+                rc = max(rc, 2)
+            else:
+                spec = _algo.load(path)
+                out = algo_check.write_proof(
+                    spec, reports, args.write_proof or None
+                )
+                print(f"# proof written to {out} "
+                      f"(fingerprint {spec.fingerprint})",
+                      file=sys.stderr)
+    if args.sarif:
+        from ..analysis.sarif import to_sarif
+
+        sarif_log = to_sarif([], all_reports, root=os.getcwd())
+        if args.sarif == "-":
+            print(json.dumps(sarif_log, indent=1))
+        else:
+            with open(args.sarif, "w") as f:
+                json.dump(sarif_log, f, indent=1)
+            print(f"# SARIF written to {args.sarif}", file=sys.stderr)
+    if args.json and args.sarif != "-":
+        from ..analysis.simulate import sim_reports_to_json
+
+        print(json.dumps(sim_reports_to_json(all_reports), indent=1))
+    elif args.sarif != "-":
+        _print_algo_reports(all_reports)
+    return rc
+
+
+def _cmd_algo_show(args: argparse.Namespace) -> int:
+    from . import algo as _algo
+
+    if args.file:
+        try:
+            spec = _algo.load(args.file)
+        except _algo.AlgoError as exc:
+            print(f"show: {args.file}: {exc}", file=sys.stderr)
+            return 1
+        info = {
+            "name": spec.name,
+            "collective": spec.collective,
+            "reduce": spec.reduce,
+            "worlds": list(spec.worlds),
+            "fingerprint": spec.fingerprint,
+            "impl_tag": spec.tag,
+            "expect": spec.expect,
+            "phases": len(spec.phases),
+            "proof": _algo.proof_path(args.file),
+            "proven": os.path.exists(_algo.proof_path(args.file)),
+        }
+        if args.json:
+            print(json.dumps(info, indent=1))
+        else:
+            for k, v in info.items():
+                print(f"{k}: {v}")
+        return 0
+    reg = _algo.registry(refresh=True)
+    rejects = _algo.registry_rejects()
+    if args.json:
+        print(json.dumps({
+            "registered": {
+                tag: {
+                    "path": impl.path,
+                    "collective": impl.op,
+                    "worlds": sorted(impl.per_world),
+                    "per_world": {
+                        str(w): st
+                        for w, st in sorted(impl.per_world.items())
+                    },
+                }
+                for tag, impl in sorted(reg.items())
+            },
+            "rejected": [
+                {"path": p, "reason": why} for p, why in rejects
+            ],
+        }, indent=1))
+        return 0
+    for tag, impl in sorted(reg.items()):
+        worlds = ",".join(str(w) for w in sorted(impl.per_world))
+        print(f"{tag} [{impl.op}] worlds={{{worlds}}} {impl.path}")
+    for p, why in rejects:
+        print(f"REJECTED {p}: {why}")
+    if not reg and not rejects:
+        print("# no algorithm files found (planner/algos/ + "
+              "M4T_ALGO_PATH)")
+    return 0
+
+
+def _cmd_algo_lower(args: argparse.Namespace) -> int:
+    from . import algo as _algo
+
+    try:
+        spec = _algo.load(args.file)
+    except _algo.AlgoError as exc:
+        print(f"lower: {args.file}: {exc}", file=sys.stderr)
+        return 1
+    worlds = _parse_ranks(args.ranks) or list(spec.worlds)
+    out = {}
+    for n in worlds:
+        try:
+            low = _algo.lower(_algo.expand(spec, n))
+        except _algo.AlgoError as exc:
+            print(f"lower: {args.file} at world {n}: {exc}",
+                  file=sys.stderr)
+            return 1
+        out[str(n)] = low.to_json()
+        if not args.json:
+            print(f"{spec.tag} world={n}: {len(low.rounds)} rounds, "
+                  f"wire_chunks={low.wire_chunks}, "
+                  f"chunks={low.chunks}, slots={low.slots}")
+            for t, groups in enumerate(low.rounds):
+                for g in groups:
+                    edges = " ".join(
+                        f"{a}->{b}" for a, b in g.edges
+                    )
+                    print(f"  round {t} (x{g.count}): {edges}")
+    if args.json:
+        print(json.dumps(out, indent=1))
     return 0
 
 
@@ -334,6 +515,79 @@ def selftest() -> int:
         dispatch.pins = saved_pins
         dispatch.active = saved_active
 
+    # -- algo: the m4t-algo/1 compiler, admission and registry ---------
+    from ..analysis import algo_check
+    from ..observability import costmodel
+    from . import algo as _algo
+
+    ring_raw = {
+        "schema": _algo.SCHEMA, "name": "selftest-ring",
+        "collective": "AllReduce", "reduce": "SUM",
+        "worlds": [2, 4], "chunks": "n",
+        "expect": {"rounds": "2 * (n - 1)",
+                   "wire_chunks": "2 * (n - 1)"},
+        "phases": [
+            {"repeat": "n - 1", "steps": [
+                {"to": "(r + 1) % n", "from": "(r - 1) % n",
+                 "send": "(r - i) % n", "recv": "(r - i - 1) % n",
+                 "action": "reduce"}]},
+            {"repeat": "n - 1", "steps": [
+                {"to": "(r + 1) % n", "from": "(r - 1) % n",
+                 "send": "(r - i + 1) % n", "recv": "(r - i) % n",
+                 "action": "copy"}]},
+        ],
+    }
+    ring_spec = _algo.parse(ring_raw)
+    ring_reports = algo_check.check_spec(ring_spec)
+    assert algo_check.reports_clean(ring_reports), [
+        (r.world, r.verdict, [f.code for f in r.findings])
+        for r in ring_reports
+    ]
+    proof = algo_check.build_proof(ring_spec, ring_reports)
+    assert algo_check.proof_mismatch(ring_spec, proof) is None
+    # a hand-edited body must invalidate the proof (fingerprint drift)
+    edited = _algo.parse(dict(ring_raw, worlds=[2, 4, 8]))
+    drift = algo_check.proof_mismatch(edited, proof)
+    assert drift and "stale proof" in drift, drift
+
+    dl_spec = _algo.parse({
+        "schema": _algo.SCHEMA, "name": "selftest-deadlock",
+        "collective": "AllReduce", "reduce": "SUM",
+        "worlds": [4], "chunks": 1,
+        "phases": [
+            {"steps": [{"to": "(r + 1) % n", "send": 0}]},
+            {"steps": [{"from": "(r - 1) % n", "recv": 0,
+                        "action": "reduce"}]},
+        ],
+    })
+    (dl_report,) = algo_check.check_spec(dl_spec)
+    assert not dl_report.deadlock_free
+    assert any(f.code == "M4T201" for f in dl_report.findings)
+
+    bad_spec = _algo.parse({
+        "schema": _algo.SCHEMA, "name": "selftest-badcov",
+        "collective": "AllReduce", "reduce": "SUM",
+        "worlds": [4], "chunks": "n",
+        "phases": [ring_raw["phases"][0]],  # reduce-scatter only
+    })
+    (bad_report,) = algo_check.check_spec(bad_spec)
+    codes = {f.code for f in bad_report.findings}
+    assert codes == {"M4T204"}, codes
+
+    # every shipped algorithm must be registered (proof fresh + clean)
+    n_shipped = _algo.assert_all_registered()
+    assert n_shipped >= 3, (
+        f"expected >= 3 shipped algorithms, found {n_shipped}"
+    )
+    for tag, impl in _algo.registry().items():
+        c = costmodel.cost(
+            impl.op, nbytes=1 << 20,
+            world=sorted(impl.per_world)[0], dtype="float32",
+            impl=tag,
+        )
+        assert c.get("impl") == tag and c["steps"] > 0, c
+        assert tag in _plan.impls_for(impl.op)
+
     print("planner selftest ok")
     return 0
 
@@ -376,6 +630,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_tune.add_argument(
         "--dtypes", default="float32,bfloat16",
         help="dtypes of the grid (default %(default)s)",
+    )
+    p_tune.add_argument(
+        "--ops", default=None, metavar="AllReduce,AllToAll",
+        help="ops of the grid (default: every op with a built-in "
+        "alternative impl; name AllToAll explicitly to sweep "
+        "registered algorithm impls for it)",
     )
     p_tune.add_argument(
         "--buckets", default="12:27:2", metavar="LO:HI[:STEP]|LIST",
@@ -435,6 +695,54 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_show.add_argument("--cache", default=None, metavar="PLAN.json")
     p_show.add_argument("--json", action="store_true")
     p_show.set_defaults(func=_cmd_show)
+
+    p_algo = sub.add_parser(
+        "algo",
+        help="check / show / lower m4t-algo/1 collective algorithms "
+        "(device-free)",
+    )
+    algo_sub = p_algo.add_subparsers(dest="algo_command", required=True)
+    a_check = algo_sub.add_parser(
+        "check",
+        help="prove algorithm file(s): simulate (M4T201/202), chunk "
+        "coverage (M4T204), step-cost admission (M4T205)",
+    )
+    a_check.add_argument("files", nargs="+", metavar="FILE")
+    a_check.add_argument(
+        "--ranks", default=None, metavar="2,4,8",
+        help="world sizes to prove at (default: the file's declared "
+        "worlds)",
+    )
+    a_check.add_argument("--json", action="store_true")
+    a_check.add_argument(
+        "--sarif", default=None, metavar="FILE|-",
+        help="write the findings as a SARIF log (- for stdout)",
+    )
+    a_check.add_argument(
+        "--write-proof", nargs="?", const="", default=None,
+        metavar="PATH",
+        help="on a clean check at the declared worlds, write the "
+        "proof artifact (default: <file>.proof.json next to the "
+        "algorithm)",
+    )
+    a_check.set_defaults(func=_cmd_algo_check)
+    a_show = algo_sub.add_parser(
+        "show",
+        help="summarize one algorithm file, or (no FILE) list the "
+        "registry: registered impls + rejected files with reasons",
+    )
+    a_show.add_argument("file", nargs="?", metavar="FILE")
+    a_show.add_argument("--json", action="store_true")
+    a_show.set_defaults(func=_cmd_algo_show)
+    a_lower = algo_sub.add_parser(
+        "lower",
+        help="compile an algorithm through the simulator and print "
+        "the fused per-round global step order",
+    )
+    a_lower.add_argument("file", metavar="FILE")
+    a_lower.add_argument("--ranks", default=None, metavar="N[,M...]")
+    a_lower.add_argument("--json", action="store_true")
+    a_lower.set_defaults(func=_cmd_algo_lower)
 
     args = parser.parse_args(argv)
     return args.func(args)

@@ -1,0 +1,1101 @@
+"""Programmable collective algorithms: the ``m4t-algo/1`` schedule DSL.
+
+GC3 (PAPERS.md) compiles user-written collective algorithms into
+verified execution plans. This module is that compiler for the m4t
+stack: a declarative JSON file describes a collective as per-rank
+send/recv/reduce/copy steps over chunk ids, parameterized by world
+size, and the compiler
+
+1. expands it to concrete per-rank programs at a given world,
+2. emits the per-rank :class:`~..analysis.schedule.ScheduleEvent`
+   lists directly (the algorithm *is* the schedule), so
+   ``analysis/simulate.py`` can prove it deadlock-free (M4T201/M4T202
+   with witnesses) and ``analysis/algo_check.py`` can prove it
+   *correct* (M4T204 chunk coverage) and *costable* (M4T205 step-cost
+   admission),
+3. lowers the proof's synchronization rounds to one fused
+   CollectivePermute per round (the ``reshard.execute_plan_on_mesh``
+   idiom: every rank walks one global step order), executed on-mesh
+   via ``lax.ppermute`` over the communicator's axes — deadlock-free
+   by construction *because* the rounds came out of the simulator,
+4. registers proven algorithms as planner impls
+   ``algo:<name>@<fingerprint>`` behind ``planner/dispatch.select``,
+   content-fingerprinted like ``m4t-plan/1`` so a stale or edited file
+   can never silently re-route, with a first-class
+   ``observability/costmodel.py`` entry derived from the verified
+   step structure so ``lint --cost``, ``launch --verify`` and the
+   autotuner's analytic seed stay truthful.
+
+File format (see ``docs/static-analysis.md`` for the walkthrough)::
+
+    {"schema": "m4t-algo/1", "name": "ring",
+     "collective": "AllReduce", "reduce": "SUM",
+     "worlds": [2, 4, 8], "chunks": "n",
+     "phases": [
+       {"repeat": "n - 1", "steps": [
+         {"to": "(r + 1) % n", "from": "(r - 1) % n",
+          "send": "(r - i) % n", "recv": "(r - i - 1) % n",
+          "action": "reduce"}]},
+       {"repeat": "n - 1", "steps": [
+         {"to": "(r + 1) % n", "from": "(r - 1) % n",
+          "send": "(r - i + 1) % n", "recv": "(r - i) % n",
+          "action": "copy"}]}]}
+
+Expressions are integer arithmetic over ``n`` (world), ``r`` (rank),
+``i`` (phase loop index), ``j`` (bundle index), the file's ``let``
+bindings, and ``log2`` — parsed through an AST whitelist, never
+``eval`` over raw input. ``to``/``from`` evaluating to -1 (PROC_NULL)
+mean "no partner at this step for this rank", which is exactly what
+lets a *mis-written* algorithm deadlock — and the simulator catch it.
+
+Everything here is device-free except :func:`execute_spmd`, which is
+only imported from inside the op lowerings.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..analysis.schedule import ScheduleEvent
+from ..observability import costmodel as _costmodel
+from ..observability.recorder import fingerprint as _fingerprint
+
+#: schema tag of the algorithm file format
+SCHEMA = "m4t-algo/1"
+#: schema tag of the committed proof artifact
+PROOF_SCHEMA = "m4t-algo-proof/1"
+#: collectives an algorithm may declare (the executor's vocabulary)
+COLLECTIVES = ("AllReduce", "AllToAll")
+#: reduce ops an AllReduce algorithm may declare
+REDUCE_OPS = ("SUM", "MAX", "MIN")
+#: canonical op name stamped on every emitted p2p schedule event; one
+#: shared name so fingerprints of matching send/recv pairs are
+#: byte-identical (the simulator's p2p match criterion)
+EVENT_OP = "Sendrecv"
+#: proof-time payload model: one f32 element per chunk over the
+#: canonical single mesh axis (drift-pinned by tests)
+PROOF_DTYPE = "float32"
+PROOF_AXES = ("ranks",)
+
+PROC_NULL = -1
+
+
+class AlgoError(ValueError):
+    """Malformed or invalid m4t-algo file (parse/validation errors)."""
+
+
+class AlgoNotFusable(AlgoError):
+    """The algorithm completes, but some rendezvous spans simulator
+    rounds (asymmetric completion) — it cannot be lowered to one fused
+    permute per round, so it has no truthful step cost (M4T205)."""
+
+
+# ---------------------------------------------------------------------
+# expression language: integer arithmetic through an AST whitelist
+# ---------------------------------------------------------------------
+
+_ALLOWED_BINOPS = {
+    ast.Add: lambda a, b: a + b,
+    ast.Sub: lambda a, b: a - b,
+    ast.Mult: lambda a, b: a * b,
+    ast.FloorDiv: lambda a, b: a // b,
+    ast.Mod: lambda a, b: a % b,
+    ast.BitXor: lambda a, b: a ^ b,
+    ast.Pow: lambda a, b: a ** b,
+}
+
+
+def _exact_log2(v) -> int:
+    v = int(v)
+    if v < 1 or v & (v - 1):
+        raise AlgoError(f"log2({v}) is not an integer")
+    return v.bit_length() - 1
+
+
+_ALLOWED_FUNCS = {"log2": _exact_log2, "min": min, "max": max, "abs": abs}
+
+
+def _eval_node(node: ast.AST, env: Dict[str, int]) -> int:
+    if isinstance(node, ast.Expression):
+        return _eval_node(node.body, env)
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, bool) or not isinstance(node.value, int):
+            raise AlgoError(f"non-integer literal {node.value!r}")
+        return node.value
+    if isinstance(node, ast.Name):
+        if node.id not in env:
+            raise AlgoError(
+                f"unknown name {node.id!r} (have {sorted(env)})"
+            )
+        return env[node.id]
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return -_eval_node(node.operand, env)
+    if isinstance(node, ast.BinOp) and type(node.op) in _ALLOWED_BINOPS:
+        try:
+            return _ALLOWED_BINOPS[type(node.op)](
+                _eval_node(node.left, env), _eval_node(node.right, env)
+            )
+        except ZeroDivisionError:
+            raise AlgoError("division by zero in expression")
+    if isinstance(node, ast.Call):
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in _ALLOWED_FUNCS
+            and not node.keywords
+        ):
+            args = [_eval_node(a, env) for a in node.args]
+            return int(_ALLOWED_FUNCS[node.func.id](*args))
+        raise AlgoError("only log2/min/max/abs calls are allowed")
+    raise AlgoError(
+        f"disallowed syntax {type(node).__name__} in expression "
+        "(integer + - * // % ^ ** and log2/min/max/abs only)"
+    )
+
+
+def evaluate(expr: Any, env: Dict[str, int], *, what: str = "expr") -> int:
+    """Evaluate one DSL expression (int literal or string) under
+    ``env``. Raises :class:`AlgoError` on anything but whitelisted
+    integer arithmetic."""
+    if expr is None:
+        return PROC_NULL
+    if isinstance(expr, bool):
+        raise AlgoError(f"{what}: booleans are not integers")
+    if isinstance(expr, int):
+        return expr
+    if not isinstance(expr, str):
+        raise AlgoError(f"{what}: expected int or expression string, "
+                        f"got {type(expr).__name__}")
+    try:
+        tree = ast.parse(expr, mode="eval")
+    except SyntaxError as e:
+        raise AlgoError(f"{what}: cannot parse {expr!r}: {e}")
+    try:
+        return int(_eval_node(tree, env))
+    except AlgoError as e:
+        raise AlgoError(f"{what}: {expr!r}: {e}")
+
+
+# ---------------------------------------------------------------------
+# spec parsing
+# ---------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class StepSpec:
+    """One per-rank step template (unevaluated expressions)."""
+
+    to: Any = None
+    frm: Any = None
+    send: Any = None          # slot expr or {"var","count","slot"}
+    recv: Any = None
+    action: str = "copy"      # reduce | copy — applies to the recv side
+    copy: Any = None          # local step: {"from_slot","to_slot"}
+
+
+@dataclasses.dataclass(frozen=True)
+class PhaseSpec:
+    repeat: Any
+    steps: Tuple[StepSpec, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class AlgoSpec:
+    """Parsed (but not yet world-expanded) algorithm file."""
+
+    name: str
+    collective: str
+    reduce: Optional[str]
+    worlds: Tuple[int, ...]
+    chunks: Any
+    slots: Any
+    let: Tuple[Tuple[str, Any], ...]
+    expect: Dict[str, Any]
+    phases: Tuple[PhaseSpec, ...]
+    raw: Dict[str, Any]
+    path: Optional[str] = None
+
+    @property
+    def fingerprint(self) -> str:
+        return spec_fingerprint(self.raw)
+
+    @property
+    def tag(self) -> str:
+        return f"algo:{self.name}@{self.fingerprint}"
+
+    def env(self, world: int) -> Dict[str, int]:
+        """Base expression environment at one world (``n`` + lets)."""
+        env = {"n": int(world)}
+        for name, expr in self.let:
+            env[name] = evaluate(expr, env, what=f"let {name}")
+        return env
+
+
+def spec_fingerprint(raw: Dict[str, Any]) -> str:
+    """Content fingerprint of the algorithm body — same recipe as
+    ``plan.Plan.plan_id`` (sha256/16 over canonical JSON), so a stale
+    or hand-edited file can never silently keep its impl tag."""
+    body = json.dumps(raw, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(body.encode()).hexdigest()[:16]
+
+
+def _parse_step(obj: Dict[str, Any], where: str) -> StepSpec:
+    if not isinstance(obj, dict):
+        raise AlgoError(f"{where}: step must be an object")
+    if "copy" in obj:
+        extra = set(obj) - {"copy"}
+        if extra:
+            raise AlgoError(f"{where}: local copy step takes no other "
+                            f"keys (got {sorted(extra)})")
+        c = obj["copy"]
+        if not isinstance(c, dict) or set(c) != {"from_slot", "to_slot"}:
+            raise AlgoError(f"{where}: local copy needs exactly "
+                            "{'from_slot', 'to_slot'}")
+        return StepSpec(copy=c)
+    known = {"to", "from", "send", "recv", "action"}
+    extra = set(obj) - known
+    if extra:
+        raise AlgoError(f"{where}: unknown step keys {sorted(extra)}")
+    action = obj.get("action", "copy")
+    if action not in ("reduce", "copy"):
+        raise AlgoError(f"{where}: action must be reduce|copy, "
+                        f"got {action!r}")
+    to, frm = obj.get("to"), obj.get("from")
+    if to is None and frm is None:
+        raise AlgoError(f"{where}: communication step needs 'to' "
+                        "and/or 'from' (or use a local 'copy' step)")
+    if (to is None) != (obj.get("send") is None):
+        raise AlgoError(f"{where}: 'to' and 'send' go together")
+    if (frm is None) != (obj.get("recv") is None):
+        raise AlgoError(f"{where}: 'from' and 'recv' go together")
+    return StepSpec(to=to, frm=frm, send=obj.get("send"),
+                    recv=obj.get("recv"), action=action)
+
+
+def parse(raw: Dict[str, Any], *, path: Optional[str] = None) -> AlgoSpec:
+    """Parse + shallow-validate an ``m4t-algo/1`` document."""
+    if not isinstance(raw, dict):
+        raise AlgoError("algorithm file must be a JSON object")
+    if raw.get("schema") != SCHEMA:
+        raise AlgoError(
+            f"schema mismatch: want {SCHEMA!r}, got {raw.get('schema')!r}"
+        )
+    name = raw.get("name")
+    if (
+        not isinstance(name, str)
+        or not name
+        or not all(c.isalnum() or c in "_-" for c in name)
+    ):
+        raise AlgoError(f"invalid algorithm name {name!r} "
+                        "(alphanumeric/_/- only)")
+    coll = raw.get("collective")
+    if coll not in COLLECTIVES:
+        raise AlgoError(f"collective must be one of {COLLECTIVES}, "
+                        f"got {coll!r}")
+    reduce_op = raw.get("reduce")
+    if coll == "AllReduce":
+        if reduce_op not in REDUCE_OPS:
+            raise AlgoError(f"AllReduce algorithm needs reduce in "
+                            f"{REDUCE_OPS}, got {reduce_op!r}")
+    elif reduce_op is not None:
+        raise AlgoError(f"{coll} algorithm must not declare 'reduce'")
+    worlds = raw.get("worlds")
+    if (
+        not isinstance(worlds, list)
+        or not worlds
+        or not all(isinstance(w, int) and w >= 2 for w in worlds)
+    ):
+        raise AlgoError("worlds must be a non-empty list of ints >= 2")
+    let_raw = raw.get("let", {})
+    if not isinstance(let_raw, dict):
+        raise AlgoError("'let' must be an object")
+    expect = raw.get("expect", {})
+    if not isinstance(expect, dict) or not set(expect) <= {
+        "rounds", "wire_chunks"
+    }:
+        raise AlgoError("'expect' takes only {'rounds', 'wire_chunks'}")
+    phases_raw = raw.get("phases")
+    if not isinstance(phases_raw, list) or not phases_raw:
+        raise AlgoError("phases must be a non-empty list")
+    phases = []
+    for pi, ph in enumerate(phases_raw):
+        if not isinstance(ph, dict) or "steps" not in ph:
+            raise AlgoError(f"phase {pi}: needs a 'steps' list")
+        steps = tuple(
+            _parse_step(s, f"phase {pi} step {si}")
+            for si, s in enumerate(ph["steps"])
+        )
+        if not steps:
+            raise AlgoError(f"phase {pi}: empty steps")
+        phases.append(PhaseSpec(repeat=ph.get("repeat", 1), steps=steps))
+    spec = AlgoSpec(
+        name=name,
+        collective=coll,
+        reduce=reduce_op,
+        worlds=tuple(sorted(set(worlds))),
+        chunks=raw.get("chunks", "n"),
+        slots=raw.get("slots"),
+        let=tuple(sorted(let_raw.items())),
+        expect=dict(expect),
+        phases=tuple(phases),
+        raw=raw,
+        path=path,
+    )
+    return spec
+
+
+def load(path: str) -> AlgoSpec:
+    with open(path) as f:
+        try:
+            raw = json.load(f)
+        except json.JSONDecodeError as e:
+            raise AlgoError(f"{path}: not valid JSON: {e}")
+    return parse(raw, path=path)
+
+
+# ---------------------------------------------------------------------
+# world expansion: spec -> concrete per-rank programs
+# ---------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CommItem:
+    """One concrete communication step of one rank."""
+
+    to: int                      # peer rank or PROC_NULL
+    frm: int
+    send_slots: Tuple[int, ...]
+    recv_slots: Tuple[int, ...]
+    action: str
+    label: str
+
+    @property
+    def count(self) -> int:
+        return len(self.send_slots) or len(self.recv_slots)
+
+
+@dataclasses.dataclass(frozen=True)
+class CopyItem:
+    src: int
+    dst: int
+    label: str
+
+
+@dataclasses.dataclass
+class Program:
+    """Concrete per-rank programs of one algorithm at one world."""
+
+    spec: AlgoSpec
+    world: int
+    chunks: int
+    slots: int
+    #: rank -> ordered mix of CommItem / CopyItem
+    items: Dict[int, List[Any]]
+
+    def comm_items(self, rank: int) -> List[CommItem]:
+        return [x for x in self.items[rank] if isinstance(x, CommItem)]
+
+
+def _eval_slots(spec_slot: Any, env: Dict[str, int], nslots: int,
+                what: str) -> Tuple[int, ...]:
+    """Evaluate a slot expression (scalar or bundle generator) to a
+    concrete tuple of distinct slot ids."""
+    if isinstance(spec_slot, dict):
+        keys = set(spec_slot)
+        if not {"count", "slot"} <= keys or not keys <= {
+            "count", "slot", "var"
+        }:
+            raise AlgoError(
+                f"{what}: bundle needs {{'count', 'slot'[, 'var']}}"
+            )
+        var = spec_slot.get("var", "j")
+        if not isinstance(var, str) or not var.isidentifier():
+            raise AlgoError(f"{what}: bad bundle var {var!r}")
+        count = evaluate(spec_slot["count"], env, what=f"{what}.count")
+        if count < 1:
+            raise AlgoError(f"{what}: bundle count {count} < 1")
+        out = []
+        for j in range(count):
+            jenv = dict(env)
+            jenv[var] = j
+            out.append(evaluate(spec_slot["slot"], jenv,
+                                what=f"{what}.slot"))
+        slots = tuple(out)
+    else:
+        slots = (evaluate(spec_slot, env, what=what),)
+    for s in slots:
+        if not (0 <= s < nslots):
+            raise AlgoError(f"{what}: slot {s} outside [0, {nslots})")
+    if len(set(slots)) != len(slots):
+        raise AlgoError(f"{what}: duplicate slots {slots}")
+    return slots
+
+
+def expand(spec: AlgoSpec, world: int) -> Program:
+    """Expand the spec to concrete per-rank programs at ``world``."""
+    n = int(world)
+    base = spec.env(n)
+    chunks = evaluate(spec.chunks, base, what="chunks")
+    if chunks < 1:
+        raise AlgoError(f"chunks {chunks} < 1 at world {n}")
+    if spec.collective == "AllToAll" and chunks != n:
+        raise AlgoError(
+            f"AllToAll algorithm must use chunks == n "
+            f"(one block per destination), got {chunks} at world {n}"
+        )
+    slots = (
+        evaluate(spec.slots, base, what="slots")
+        if spec.slots is not None
+        else chunks
+    )
+    if slots < chunks:
+        raise AlgoError(f"slots {slots} < chunks {chunks} at world {n}")
+    items: Dict[int, List[Any]] = {r: [] for r in range(n)}
+    for pi, phase in enumerate(spec.phases):
+        repeat = evaluate(phase.repeat, base, what=f"phase {pi}.repeat")
+        if repeat < 0:
+            raise AlgoError(f"phase {pi}: repeat {repeat} < 0")
+        for i in range(repeat):
+            for si, st in enumerate(phase.steps):
+                for r in range(n):
+                    env = dict(base)
+                    env["r"] = r
+                    env["i"] = i
+                    label = (f"{spec.name}:phase{pi}.step{si}"
+                             f"[i={i}]")
+                    if st.copy is not None:
+                        src = evaluate(st.copy["from_slot"], env,
+                                       what=f"{label}.copy.from_slot")
+                        dst = evaluate(st.copy["to_slot"], env,
+                                       what=f"{label}.copy.to_slot")
+                        for s in (src, dst):
+                            if not (0 <= s < slots):
+                                raise AlgoError(
+                                    f"{label}: copy slot {s} outside "
+                                    f"[0, {slots})"
+                                )
+                        items[r].append(CopyItem(src, dst, label))
+                        continue
+                    to = evaluate(st.to, env, what=f"{label}.to")
+                    frm = evaluate(st.frm, env, what=f"{label}.from")
+                    for peer, what in ((to, "to"), (frm, "from")):
+                        if peer != PROC_NULL and not (0 <= peer < n):
+                            raise AlgoError(
+                                f"{label}: {what} {peer} outside "
+                                f"[0, {n}) (use -1 for PROC_NULL)"
+                            )
+                        if peer == r:
+                            raise AlgoError(
+                                f"{label}: rank {r} {what} itself — "
+                                "self-transfers are local copies"
+                            )
+                    send_slots: Tuple[int, ...] = ()
+                    recv_slots: Tuple[int, ...] = ()
+                    if to != PROC_NULL:
+                        send_slots = _eval_slots(
+                            st.send, env, slots, f"{label}.send"
+                        )
+                    if frm != PROC_NULL:
+                        recv_slots = _eval_slots(
+                            st.recv, env, slots, f"{label}.recv"
+                        )
+                    if to == PROC_NULL and frm == PROC_NULL:
+                        continue  # this rank sits the step out
+                    if (
+                        send_slots
+                        and recv_slots
+                        and len(send_slots) != len(recv_slots)
+                    ):
+                        raise AlgoError(
+                            f"{label}: send bundle {len(send_slots)} != "
+                            f"recv bundle {len(recv_slots)}"
+                        )
+                    if (st.action != "reduce"
+                            and set(send_slots) & set(recv_slots)):
+                        # Overlap is safe under "reduce" because sends
+                        # read the pre-round snapshot (recursive
+                        # doubling sends and accumulates slot 0); a
+                        # plain "copy" into a slot also being sent is
+                        # almost always an authoring bug.
+                        raise AlgoError(
+                            f"{label}: send and recv slots overlap "
+                            f"{sorted(set(send_slots) & set(recv_slots))}"
+                            " — rendezvous buffers must be disjoint"
+                            " unless the step reduces"
+                        )
+                    items[r].append(CommItem(
+                        to=to, frm=frm, send_slots=send_slots,
+                        recv_slots=recv_slots, action=st.action,
+                        label=label,
+                    ))
+    return Program(spec=spec, world=n, chunks=chunks, slots=slots,
+                   items=items)
+
+
+# ---------------------------------------------------------------------
+# schedule-event emission (the algorithm *is* the schedule)
+# ---------------------------------------------------------------------
+
+
+def event_fingerprint(count: int, *, chunk_elems: int = 1,
+                      dtype: str = PROOF_DTYPE,
+                      axes: Sequence[str] = PROOF_AXES) -> str:
+    """The exact ``recorder.fingerprint`` string stamped on emitted
+    events — byte-identical to a CollectiveSite record of the same
+    transfer (drift-pinned by tests/test_planner_algo.py)."""
+    return _fingerprint({
+        "op": EVENT_OP,
+        "shape": (count, chunk_elems),
+        "dtype": dtype,
+        "axes": tuple(axes),
+    })
+
+
+def events_for(
+    program: Program,
+    *,
+    chunk_elems: int = 1,
+    dtype: str = PROOF_DTYPE,
+    axes: Sequence[str] = PROOF_AXES,
+    itemsize: int = 4,
+) -> Dict[int, List[ScheduleEvent]]:
+    """Emit per-rank ``schedule.py`` events for the simulator. The
+    default unit payload (one f32 element per chunk) is the proof
+    configuration; the executor's real payloads only rescale shapes."""
+    n = program.world
+    out: Dict[int, List[ScheduleEvent]] = {r: [] for r in range(n)}
+    src = program.spec.path or f"<{program.spec.name}>"
+    for r in range(n):
+        for item in program.comm_items(r):
+            edges = []
+            sends: Tuple[int, ...] = ()
+            recvs: Tuple[int, ...] = ()
+            if item.to != PROC_NULL:
+                edges.append((r, item.to))
+                sends = (item.to,)
+            if item.frm != PROC_NULL:
+                edges.append((item.frm, r))
+                recvs = (item.frm,)
+            group = tuple(sorted({r} | set(sends) | set(recvs)))
+            out[r].append(ScheduleEvent(
+                op=EVENT_OP,
+                fingerprint=event_fingerprint(
+                    item.count, chunk_elems=chunk_elems, dtype=dtype,
+                    axes=axes,
+                ),
+                kind="p2p",
+                group=group,
+                edges=tuple(edges),
+                sends=sends,
+                recvs=recvs,
+                nbytes=item.count * chunk_elems * itemsize,
+                dtype=dtype,
+                world=n,
+                reduce_op=(
+                    program.spec.reduce
+                    if item.action == "reduce" else None
+                ),
+                source=f"{src}:1 ({item.label})",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------
+# lowering: simulator rounds -> fused global permute schedule
+# ---------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RoundGroup:
+    """All transfers of one simulator round with one bundle size —
+    one fused CollectivePermute at execution time."""
+
+    count: int
+    edges: Tuple[Tuple[int, int], ...]
+    send_slots: Dict[int, Tuple[int, ...]]
+    recv_slots: Dict[int, Tuple[int, ...]]
+    reduce_ranks: frozenset
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "count": self.count,
+            "edges": [list(e) for e in self.edges],
+            "send_slots": {
+                str(r): list(s)
+                for r, s in sorted(self.send_slots.items())
+            },
+            "recv_slots": {
+                str(r): list(s)
+                for r, s in sorted(self.recv_slots.items())
+            },
+            "reduce_ranks": sorted(self.reduce_ranks),
+        }
+
+
+@dataclasses.dataclass
+class Lowered:
+    """The compiled algorithm at one world: a single global step
+    order every rank walks (permute rounds + local copy tables)."""
+
+    world: int
+    chunks: int
+    slots: int
+    rounds: List[List[RoundGroup]]
+    #: copies[t] applies after round t-1 (copies[0] before round 0);
+    #: rank -> ordered (src, dst) slot pairs
+    copies: List[Dict[int, List[Tuple[int, int]]]]
+    #: max over ranks of total chunk-units sent (the beta term)
+    wire_chunks: int
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "world": self.world,
+            "chunks": self.chunks,
+            "slots": self.slots,
+            "rounds": [
+                [g.to_json() for g in groups] for groups in self.rounds
+            ],
+            "copies": [
+                {str(r): [list(c) for c in cs]
+                 for r, cs in sorted(cp.items())}
+                for cp in self.copies
+            ],
+            "wire_chunks": self.wire_chunks,
+        }
+
+
+def attached_copies(
+    program: Program,
+) -> Dict[int, Dict[int, List[CopyItem]]]:
+    """Local copy items of each rank keyed by the comm-item index they
+    follow (``-1`` = before any communication). Shared between the
+    lowering and the M4T204 coverage interpreter so both replay the
+    same ordering."""
+    attached: Dict[int, Dict[int, List[CopyItem]]] = {
+        r: {-1: []} for r in range(program.world)
+    }
+    for r in range(program.world):
+        k = -1
+        for item in program.items[r]:
+            if isinstance(item, CommItem):
+                k += 1
+                attached[r][k] = []
+            else:
+                attached[r].setdefault(k, []).append(item)
+    return attached
+
+
+def lower(program: Program) -> Lowered:
+    """Compile the per-rank programs through the simulator into a
+    fused round schedule. Raises :class:`AlgoError` if the simulation
+    does not complete, :class:`AlgoNotFusable` if any rendezvous
+    completes asymmetrically across rounds."""
+    from ..analysis.simulate import simulate_rounds
+
+    events = events_for(program)
+    ok, advances, findings = simulate_rounds(events)
+    if not ok:
+        codes = ",".join(sorted({f.code for f in findings})) or "stuck"
+        raise AlgoError(
+            f"algorithm does not complete at world {program.world} "
+            f"({codes}) — run `planner algo check` for the witness"
+        )
+    n = program.world
+    comm = {r: program.comm_items(r) for r in range(n)}
+    # local items attached after comm item k (k = -1 for the prelude)
+    attached = attached_copies(program)
+    copies: List[Dict[int, List[Tuple[int, int]]]] = [
+        {} for _ in range(len(advances) + 1)
+    ]
+    for r in range(n):
+        pre = [(c.src, c.dst) for c in attached[r].get(-1, [])]
+        if pre:
+            copies[0][r] = pre
+    rounds: List[List[RoundGroup]] = []
+    for t, adv in enumerate(advances):
+        adv_ranks = {r for r, _ in adv}
+        groups: Dict[int, Dict[str, Any]] = {}
+        for r, pc in adv:
+            item = comm[r][pc]
+            for peer in (item.to, item.frm):
+                if peer != PROC_NULL and peer not in adv_ranks:
+                    raise AlgoNotFusable(
+                        f"round {t}: rank {r} completes {item.label} "
+                        f"but peer {peer} does not complete in the "
+                        "same round — not fusable to a global step "
+                        "order (M4T205)"
+                    )
+            g = groups.setdefault(item.count, {
+                "edges": [], "send": {}, "recv": {}, "reduce": set(),
+            })
+            if item.to != PROC_NULL:
+                g["edges"].append((r, item.to))
+                g["send"][r] = item.send_slots
+            if item.frm != PROC_NULL:
+                g["recv"][r] = item.recv_slots
+                if item.action == "reduce":
+                    g["reduce"].add(r)
+            post = [(c.src, c.dst) for c in attached[r].get(pc, [])]
+            if post:
+                copies[t + 1].setdefault(r, []).extend(post)
+        rounds.append([
+            RoundGroup(
+                count=k,
+                edges=tuple(sorted(g["edges"])),
+                send_slots=dict(g["send"]),
+                recv_slots=dict(g["recv"]),
+                reduce_ranks=frozenset(g["reduce"]),
+            )
+            for k, g in sorted(groups.items())
+        ])
+    wire = max(
+        (
+            sum(len(it.send_slots) for it in comm[r])
+            for r in range(n)
+        ),
+        default=0,
+    )
+    return Lowered(world=n, chunks=program.chunks, slots=program.slots,
+                   rounds=rounds, copies=copies, wire_chunks=wire)
+
+
+# ---------------------------------------------------------------------
+# registry: proven algorithms as planner impls
+# ---------------------------------------------------------------------
+
+
+def algos_dir() -> str:
+    return os.path.join(os.path.dirname(__file__), "algos")
+
+
+def _search_paths() -> List[str]:
+    """Algorithm files: the shipped package dir + ``M4T_ALGO_PATH``
+    (colon-separated files or directories)."""
+    paths: List[str] = []
+    d = algos_dir()
+    if os.path.isdir(d):
+        for fn in sorted(os.listdir(d)):
+            if fn.endswith(".json") and not fn.endswith(".proof.json"):
+                paths.append(os.path.join(d, fn))
+    extra = os.environ.get("M4T_ALGO_PATH", "")
+    for p in extra.split(":"):
+        p = p.strip()
+        if not p:
+            continue
+        if os.path.isdir(p):
+            for fn in sorted(os.listdir(p)):
+                if fn.endswith(".json") and not fn.endswith(
+                    ".proof.json"
+                ):
+                    paths.append(os.path.join(p, fn))
+        else:
+            paths.append(p)
+    return paths
+
+
+def proof_path(algo_file: str) -> str:
+    base = algo_file[:-5] if algo_file.endswith(".json") else algo_file
+    return base + ".proof.json"
+
+
+@dataclasses.dataclass
+class AlgoImpl:
+    """A proven, registered algorithm: a planner impl."""
+
+    spec: AlgoSpec
+    path: str
+    #: world -> {"rounds", "wire_chunks", "chunks", "slots"} from the
+    #: admission re-check (not the committed file — truth, not trust)
+    per_world: Dict[int, Dict[str, int]]
+    _lowered: Dict[int, Lowered] = dataclasses.field(
+        default_factory=dict
+    )
+
+    @property
+    def tag(self) -> str:
+        return self.spec.tag
+
+    @property
+    def op(self) -> str:
+        return self.spec.collective
+
+    def lowered(self, world: int) -> Lowered:
+        if world not in self._lowered:
+            self._lowered[world] = lower(expand(self.spec, world))
+        return self._lowered[world]
+
+    def feasible(self, op: str, x, reduce_op, comm) -> bool:
+        if op != self.spec.collective:
+            return False
+        if getattr(comm, "backend", None) == "shm":
+            return False  # the executor lowers to mesh ppermute
+        if comm.size not in self.per_world:
+            return False
+        if self.spec.collective == "AllReduce":
+            name = getattr(reduce_op, "name", str(reduce_op))
+            if name != self.spec.reduce:
+                return False
+        return True
+
+    def static_feasible(self, op: str, *, world: int) -> bool:
+        return op == self.spec.collective and world in self.per_world
+
+
+# cache keyed on (M4T_ALGO_PATH, file set + mtimes) so launch's env
+# export and test tmp dirs both take effect without explicit resets
+_cache_key: Optional[Tuple] = None
+_cache_registry: Dict[str, AlgoImpl] = {}
+_cache_rejects: List[Tuple[str, str]] = []
+
+
+def _current_key() -> Tuple:
+    paths = _search_paths()
+    stamp = []
+    for p in paths:
+        try:
+            stamp.append((p, os.stat(p).st_mtime_ns))
+        except OSError:
+            stamp.append((p, None))
+    return tuple(stamp)
+
+
+def registry(*, refresh: bool = False) -> Dict[str, AlgoImpl]:
+    """Scan, verify and register algorithm files. Only files whose
+    committed proof artifact matches the current content fingerprint
+    *and* whose declared worlds re-verify clean (simulate + coverage +
+    cost admission) become impls; everything else lands in
+    :func:`registry_rejects` with a reason."""
+    global _cache_key, _cache_registry, _cache_rejects
+    key = _current_key()
+    if not refresh and key == _cache_key:
+        return dict(_cache_registry)
+    from ..analysis import algo_check
+
+    reg: Dict[str, AlgoImpl] = {}
+    rejects: List[Tuple[str, str]] = []
+    for path in _search_paths():
+        try:
+            spec = load(path)
+        except AlgoError as e:
+            rejects.append((path, f"parse error: {e}"))
+            continue
+        pp = proof_path(path)
+        if not os.path.exists(pp):
+            rejects.append((path, "unproven: no committed proof "
+                            f"artifact ({os.path.basename(pp)})"))
+            continue
+        try:
+            with open(pp) as f:
+                proof = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            rejects.append((path, f"unreadable proof: {e}"))
+            continue
+        err = algo_check.proof_mismatch(spec, proof)
+        if err:
+            rejects.append((path, err))
+            continue
+        reports = algo_check.check_spec(spec)
+        bad = [r for r in reports if not r.deadlock_free]
+        if bad:
+            codes = sorted({
+                f.code for r in bad for f in r.findings
+            }) or [bad[0].verdict]
+            rejects.append((
+                path,
+                f"re-verification failed at world(s) "
+                f"{[r.world for r in bad]}: {','.join(codes)}",
+            ))
+            continue
+        per_world = {
+            r.world: dict(r.cost["algo"]) for r in reports
+        }
+        impl = AlgoImpl(spec=spec, path=path, per_world=per_world)
+        if impl.tag in reg:
+            rejects.append((path, f"duplicate impl tag {impl.tag}"))
+            continue
+        reg[impl.tag] = impl
+        _register_cost(impl)
+    _cache_key, _cache_registry, _cache_rejects = key, reg, rejects
+    return dict(reg)
+
+
+def registry_rejects() -> List[Tuple[str, str]]:
+    registry()
+    return list(_cache_rejects)
+
+
+def invalidate_cache() -> None:
+    global _cache_key
+    _cache_key = None
+
+
+def get(tag: str) -> Optional[AlgoImpl]:
+    return registry().get(tag)
+
+
+def impl_tags_for(op: str) -> Tuple[str, ...]:
+    """Registered algorithm impl tags for one op (consumed by
+    ``plan.impls_for`` so pins/plans/tuning treat algorithms exactly
+    like built-ins)."""
+    try:
+        reg = registry()
+    except Exception:  # registry must never break dispatch
+        return ()
+    return tuple(sorted(
+        tag for tag, impl in reg.items() if impl.op == op
+    ))
+
+
+def assert_all_registered() -> int:
+    """CI gate: every algorithm file under ``planner/algos/`` must be
+    registered (proof present, fingerprint-fresh, re-verified clean).
+    Returns the number of registered shipped algorithms."""
+    registry(refresh=True)
+    shipped = os.path.abspath(algos_dir())
+    bad = [
+        (p, why) for p, why in registry_rejects()
+        if os.path.abspath(p).startswith(shipped)
+    ]
+    if bad:
+        lines = "\n".join(f"  {p}: {why}" for p, why in bad)
+        raise SystemExit(
+            f"unproven algorithm file(s) in planner/algos/:\n{lines}"
+        )
+    return sum(
+        1 for impl in _cache_registry.values()
+        if os.path.abspath(impl.path).startswith(shipped)
+    )
+
+
+def _register_cost(impl: AlgoImpl) -> None:
+    _costmodel.register_impl_cost(
+        impl.tag,
+        op=impl.op,
+        label=f"verified algo {impl.spec.name} "
+              f"({impl.spec.fingerprint})",
+        per_world={
+            w: {
+                "chunks": st["chunks"],
+                "wire_chunks": st["wire_chunks"],
+                "rounds": st["rounds"],
+            }
+            for w, st in impl.per_world.items()
+        },
+    )
+
+
+# ---------------------------------------------------------------------
+# execution: the fused rounds on a live mesh (jax only from here down)
+# ---------------------------------------------------------------------
+
+
+def _combine_fn(reduce_name: Optional[str]):
+    import jax.numpy as jnp
+
+    return {
+        "SUM": jnp.add, "MAX": jnp.maximum, "MIN": jnp.minimum,
+    }[reduce_name]
+
+
+def _apply_group(state, grp: RoundGroup, rank, comm, combine):
+    import numpy as np
+    import jax.numpy as jnp
+    from jax import lax
+
+    k = grp.count
+    world = comm.size
+    send_tab = np.zeros((world, k), np.int32)
+    recv_tab = np.zeros((world, k), np.int32)
+    recv_mask = np.zeros((world,), bool)
+    red_mask = np.zeros((world,), bool)
+    for r, slots_ in grp.send_slots.items():
+        send_tab[r] = slots_
+    for r, slots_ in grp.recv_slots.items():
+        recv_tab[r] = slots_
+        recv_mask[r] = True
+        red_mask[r] = r in grp.reduce_ranks
+    payload = jnp.take(
+        state, jnp.take(jnp.asarray(send_tab), rank, axis=0), axis=0
+    )
+    moved = lax.ppermute(
+        payload, comm.axis_target(),
+        list(comm.to_global_edges(grp.edges)),
+    )
+    idx = jnp.take(jnp.asarray(recv_tab), rank, axis=0)
+    cur = jnp.take(state, idx, axis=0)
+    if grp.reduce_ranks and combine is not None:
+        is_red = jnp.take(jnp.asarray(red_mask), rank)
+        new = jnp.where(is_red, combine(cur, moved), moved)
+    else:
+        new = moved
+    rm = jnp.take(jnp.asarray(recv_mask), rank)
+    new = jnp.where(rm, new, cur)
+    return state.at[idx].set(new)
+
+
+def _apply_copies(state, per_rank, rank, world: int):
+    import numpy as np
+    import jax.numpy as jnp
+
+    if not per_rank:
+        return state
+    depth = max(len(cs) for cs in per_rank.values())
+    src_tab = np.zeros((world, depth), np.int32)
+    dst_tab = np.zeros((world, depth), np.int32)
+    for r, cs in per_rank.items():
+        for j, (s, d) in enumerate(cs):
+            src_tab[r, j] = s
+            dst_tab[r, j] = d
+        # identity-pad the tail: slot0 -> slot0 is a no-op
+    for j in range(depth):
+        src = jnp.take(jnp.asarray(src_tab[:, j]), rank)
+        dst = jnp.take(jnp.asarray(dst_tab[:, j]), rank)
+        state = state.at[dst].set(state[src])
+    return state
+
+
+def execute_spmd(x, reduce_op, comm, tag: str):
+    """Run a registered algorithm's fused round schedule over the live
+    mesh — called from inside the op lowerings when ``dispatch.select``
+    routed to an ``algo:*`` impl."""
+    import jax.numpy as jnp
+
+    impl = get(tag)
+    if impl is None:
+        raise AlgoError(
+            f"{tag}: not a registered (proven) algorithm — run "
+            "`python -m mpi4jax_tpu.planner algo check` and commit "
+            "the proof artifact"
+        )
+    low = impl.lowered(comm.size)
+    rank = comm.global_rank()
+    world = comm.size
+    if impl.op == "AllReduce":
+        combine = _combine_fn(impl.spec.reduce)
+        flat = x.reshape(-1)
+        ce = max(1, -(-flat.size // low.chunks))
+        pad = low.chunks * ce - flat.size
+        buf = jnp.pad(flat, (0, pad)) if pad else flat
+        state = jnp.zeros((low.slots, ce), x.dtype)
+        state = state.at[: low.chunks].set(buf.reshape(low.chunks, ce))
+    else:  # AllToAll: leading axis == world == chunks
+        combine = None
+        block = x.reshape(world, -1)
+        ce = block.shape[1]
+        state = jnp.zeros((low.slots, ce), x.dtype)
+        state = state.at[: low.chunks].set(block)
+    state = _apply_copies(state, low.copies[0], rank, world)
+    for t, groups in enumerate(low.rounds):
+        for grp in groups:
+            state = _apply_group(state, grp, rank, comm, combine)
+        state = _apply_copies(state, low.copies[t + 1], rank, world)
+    if impl.op == "AllReduce":
+        out = state[: low.chunks].reshape(-1)
+        if pad:
+            out = out[: x.size]
+        return out.reshape(x.shape)
+    return state[: low.chunks].reshape(x.shape)

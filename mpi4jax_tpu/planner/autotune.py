@@ -105,6 +105,16 @@ def candidates(
         fast = (mesh or {}).get(axes[-1])
         if fast and world % fast == 0 and 1 < fast < world:
             out.append(("hierarchical", {"fast": int(fast)}))
+    for tag in avail:
+        # verified m4t-algo/1 algorithms ride the sweep on equal
+        # footing: statically feasible iff proven at this world
+        if not tag.startswith("algo:"):
+            continue
+        from . import algo as _algo
+
+        ai = _algo.get(tag)
+        if ai is not None and ai.static_feasible(op, world=world):
+            out.append((tag, {}))
     return out
 
 
@@ -350,11 +360,17 @@ def default_keys(
     axes: Sequence[str] = ("ranks",),
     dtypes: Sequence[str] = ("float32", "bfloat16"),
     buckets: Sequence[int] = tuple(range(12, 27, 2)),
-    ops: Sequence[str] = tuple(_plan.AVAILABLE),
+    ops: Sequence[str] = tuple(
+        op for op, impls in _plan.AVAILABLE.items() if len(impls) > 1
+    ),
 ) -> List[str]:
     """The standalone tune grid: op x size-class x dtype at one world
     size (4 KiB..64 MiB by default — below that every impl is
-    latency-bound and the HLO collective always wins the seed)."""
+    latency-bound and the HLO collective always wins the seed). The
+    default op set is the ops with a *built-in* alternative route;
+    ops whose only alternatives are registered algorithms (AllToAll)
+    join via ``--ops``/``--events`` so the standalone grid stays
+    stable when no algorithm files are installed."""
     keys = []
     for op in ops:
         for dtype in dtypes:

@@ -276,6 +276,14 @@ def _feasible(impl: str, op: str, x, reduce_op, comm) -> bool:
         return True
     if comm.backend != "xla" or comm.size <= 1:
         return False
+    if impl.startswith("algo:"):
+        # a verified m4t-algo/1 algorithm: feasible only when it is
+        # *currently registered* (proof fresh) and proven at this
+        # exact world/op/reduce — a stale file degrades to default
+        from . import algo as _algo
+
+        ai = _algo.get(impl)
+        return ai is not None and ai.feasible(op, x, reduce_op, comm)
     from ..comm import SUM
 
     if impl == "pallas_ring":
@@ -388,6 +396,15 @@ def static_impl(
         impl = entry.impl
     if impl not in _plan.impls_for(op):
         return None
+    if impl.startswith("algo:"):
+        from . import algo as _algo
+
+        ai = _algo.get(impl)
+        if ai is None or not ai.static_feasible(
+            op, world=int(world or 0)
+        ):
+            return None
+        return impl
     n_axes = len(tuple(axes or ()))
     if impl == "pallas_ring" and (
         n_axes != 1 or str(dtype) not in ("float32", "bfloat16")

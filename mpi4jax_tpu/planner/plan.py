@@ -61,6 +61,10 @@ AVAILABLE: Dict[str, Tuple[str, ...]] = {
     "AllReduce": ("hlo", "pallas_ring", "quantized", "hierarchical"),
     "ReduceScatter": ("hlo", "pallas_ring"),
     "AllGather": ("hlo", "pallas_ring"),
+    # AllToAll has no built-in alternative route; verified m4t-algo/1
+    # algorithms (planner/algo.py) extend its vocabulary at runtime
+    # via impls_for()
+    "AllToAll": ("hlo",),
 }
 
 #: impls that change numerics beyond reordering (int8 wire format):
@@ -319,8 +323,17 @@ def load(path: str, *, platform: Optional[str] = None) -> Plan:
 
 def impls_for(op: str) -> Tuple[str, ...]:
     """The implementation vocabulary of one op (``("hlo",)`` for ops
-    with no alternative route)."""
-    return AVAILABLE.get(op, ("hlo",))
+    with no alternative route), extended with every *registered*
+    verified algorithm impl (``algo:<name>@<fingerprint>`` tags from
+    ``planner/algo.registry``) so pins, plan entries and the tune
+    sweep treat algorithms exactly like built-ins."""
+    base = AVAILABLE.get(op, ("hlo",))
+    try:
+        from . import algo as _algo
+
+        return base + _algo.impl_tags_for(op)
+    except Exception:  # the registry must never break plan parsing
+        return base
 
 
 def merge(base: Optional[Plan], update: Plan) -> Plan:

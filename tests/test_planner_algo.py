@@ -1,0 +1,771 @@
+"""Programmable collective algorithms (planner/algo.py +
+analysis/algo_check.py): the m4t-algo/1 DSL, the simulator-backed
+admission pipeline (M4T201/202 via simulate.py, M4T204 chunk coverage,
+M4T205 cost admission), proof artifacts, the registry, fingerprint
+drift pins against the recorder/plan schemas, property-based agreement
+with brute-force reference implementations, the committed negative
+fixtures, the CLI, and ``launch --verify --algo`` as a pre-spawn gate.
+
+All device-free. Regenerate the golden after an intentional change to
+a shipped algorithm or the lowering::
+
+    python tests/test_planner_algo.py --regen
+"""
+
+import copy
+import json
+import os
+import random
+import shutil
+import subprocess
+import sys
+from collections import Counter
+
+import pytest
+
+from mpi4jax_tpu.analysis import algo_check
+from mpi4jax_tpu.analysis.schedule import ScheduleEvent
+from mpi4jax_tpu.analysis.simulate import simulate_events, simulate_rounds
+from mpi4jax_tpu.observability import costmodel, recorder
+from mpi4jax_tpu.planner import algo as algomod
+from mpi4jax_tpu.planner import autotune, plan as planmod
+
+pytestmark = [pytest.mark.tuning, pytest.mark.algo]
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+GOLDEN = os.path.join(HERE, "data", "algo_golden.json")
+DEADLOCK_FIXTURE = os.path.join(HERE, "data", "algo_deadlock.json")
+BADCOV_FIXTURE = os.path.join(HERE, "data", "algo_badcoverage.json")
+
+SHIPPED = ("ring", "recursive_double", "alltoall_twophase")
+WORLDS = (2, 4, 8)
+
+
+def shipped_path(stem):
+    return os.path.join(algomod.algos_dir(), stem + ".json")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    """The registry caches on (path, mtime); tests that sideload via
+    M4T_ALGO_PATH must not leak entries into each other."""
+    algomod.invalidate_cache()
+    yield
+    algomod.invalidate_cache()
+
+
+# ---------------------------------------------------------------------
+# fingerprint drift pins: the compiler's event identity is the
+# recorder's, byte for byte
+# ---------------------------------------------------------------------
+
+
+def test_event_fingerprint_literal_pin():
+    """Drift pin: the exact strings the simulator matches on. If this
+    breaks, every committed proof artifact is stale — regenerate them
+    (`planner algo check --write-proof`) and say why in the commit."""
+    assert algomod.event_fingerprint(1) == "Sendrecv[1x1:float32]@ranks"
+    assert algomod.event_fingerprint(2) == "Sendrecv[2x1:float32]@ranks"
+
+
+def test_event_fingerprint_matches_recorder_schema():
+    for count in (1, 2, 7):
+        assert algomod.event_fingerprint(count) == recorder.fingerprint({
+            "op": "Sendrecv",
+            "shape": (count, 1),
+            "dtype": "float32",
+            "axes": ("ranks",),
+        })
+
+
+def test_events_carry_recorder_fingerprints():
+    spec = algomod.load(shipped_path("ring"))
+    program = algomod.expand(spec, 4)
+    for r, evs in algomod.events_for(program).items():
+        for e in evs:
+            assert e.fingerprint == recorder.fingerprint({
+                "op": e.op, "shape": (e.nbytes // 4, 1),
+                "dtype": e.dtype, "axes": ("ranks",),
+            })
+
+
+def test_algo_impl_tag_roundtrips_through_plan_cache(tmp_path):
+    """An ``algo:<name>@<fp>`` impl tag survives plan save/load and is
+    addressed by the same ``key_from_record`` key the telemetry join
+    uses — the end-to-end contract `planner tune` relies on."""
+    tag = algomod.load(shipped_path("ring")).tag
+    key = planmod.plan_key(
+        "AllReduce", nbytes=4096, dtype="float32", world=8,
+        axes=("ranks",), platform="cpu",
+    )
+    p = planmod.Plan(platform="cpu")
+    p.entries[key] = planmod.PlanEntry(impl=tag, source="analytic")
+    path = str(tmp_path / "plan.json")
+    planmod.save(p, path)
+    loaded = planmod.load(path)
+    assert loaded.entries[key].impl == tag
+    record = {"op": "AllReduce", "bytes": 4096, "dtype": "float32",
+              "world": 8, "axes": ["ranks"]}
+    assert planmod.key_from_record(record, "cpu") == key
+
+
+# ---------------------------------------------------------------------
+# shipped algorithms: proven, proof-fresh, registered
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("stem", SHIPPED)
+def test_shipped_algorithm_proves_clean_at_all_worlds(stem):
+    reports = algo_check.check_file(shipped_path(stem), WORLDS)
+    assert len(reports) == len(WORLDS)
+    for rep in reports:
+        assert rep.verdict == "deadlock-free", rep.to_text()
+        assert rep.cost is not None and "algo" in rep.cost
+    assert algo_check.reports_clean(reports)
+
+
+@pytest.mark.parametrize("stem", SHIPPED)
+def test_shipped_proof_artifact_is_fresh(stem):
+    path = shipped_path(stem)
+    spec = algomod.load(path)
+    with open(algomod.proof_path(path)) as f:
+        proof = json.load(f)
+    assert algo_check.proof_mismatch(spec, proof) is None
+    assert proof["fingerprint"] == spec.fingerprint
+    assert sorted(int(w) for w in proof["worlds"]) == list(WORLDS)
+
+
+def test_registry_contains_all_shipped_algorithms():
+    assert algomod.assert_all_registered() >= 3
+    reg = algomod.registry()
+    tags = {impl.spec.name: tag for tag, impl in reg.items()}
+    assert {"ring", "recursive-double", "alltoall-twophase"} <= set(tags)
+    ar = algomod.impl_tags_for("AllReduce")
+    assert tags["ring"] in ar and tags["recursive-double"] in ar
+    assert tags["alltoall-twophase"] in algomod.impl_tags_for("AllToAll")
+    for tag, impl in reg.items():
+        assert tag == f"algo:{impl.spec.name}@{impl.spec.fingerprint}"
+        assert tag in planmod.impls_for(impl.op)
+
+
+def test_unproven_file_is_rejected_not_registered(tmp_path, monkeypatch):
+    """No proof artifact -> the file cannot register (and
+    assert_all_registered, the CI gate, raises)."""
+    shutil.copy(shipped_path("ring"), tmp_path / "ring.json")
+    monkeypatch.setenv("M4T_ALGO_PATH", str(tmp_path))
+    algomod.invalidate_cache()
+    reg = algomod.registry(refresh=True)
+    assert all(i.path != str(tmp_path / "ring.json") for i in reg.values())
+    rejects = dict(algomod.registry_rejects())
+    assert str(tmp_path / "ring.json") in rejects
+    assert "proof" in rejects[str(tmp_path / "ring.json")]
+    # a sideloaded reject does not break the shipped-file CI gate...
+    assert algomod.assert_all_registered() >= 3
+    # ...but an unproven file in the shipped directory does
+    monkeypatch.setattr(algomod, "algos_dir", lambda: str(tmp_path))
+    algomod.invalidate_cache()
+    with pytest.raises(SystemExit):
+        algomod.assert_all_registered()
+
+
+def test_stale_proof_is_rejected_after_edit(tmp_path, monkeypatch):
+    """Fingerprint drift pin: editing the algorithm body invalidates
+    the committed proof — the registry must refuse, not trust."""
+    src = shipped_path("ring")
+    dst = str(tmp_path / "ring_copy.json")
+    with open(src) as f:
+        raw = json.load(f)
+    raw["name"] = "ring-copy"  # distinct tag from the shipped ring
+    with open(dst, "w") as f:
+        json.dump(raw, f)
+    spec = algomod.load(dst)
+    reports = algo_check.check_spec(spec, WORLDS)
+    algo_check.write_proof(spec, reports)
+    monkeypatch.setenv("M4T_ALGO_PATH", str(tmp_path))
+    algomod.invalidate_cache()
+    assert any(i.path == dst for i in algomod.registry(refresh=True).values())
+    with open(dst) as f:
+        raw = json.load(f)
+    raw["worlds"] = [2, 4]  # any body edit moves the fingerprint
+    with open(dst, "w") as f:
+        json.dump(raw, f)
+    algomod.invalidate_cache()
+    assert all(i.path != dst for i in algomod.registry(refresh=True).values())
+    rejects = dict(algomod.registry_rejects())
+    assert "stale proof" in rejects[dst]
+
+
+# ---------------------------------------------------------------------
+# negative fixtures: the committed counterexamples
+# ---------------------------------------------------------------------
+
+
+def test_deadlock_fixture_yields_rank_cycle_witness():
+    reports = algo_check.check_file(DEADLOCK_FIXTURE, [4])
+    (rep,) = reports
+    assert not rep.deadlock_free
+    finding = next(f for f in rep.findings if f.code == "M4T201")
+    assert "rank cycle" in finding.message
+    assert "0 -> 1 -> 2 -> 3 -> 0" in finding.message
+
+
+def test_badcoverage_fixture_names_missing_chunk():
+    reports = algo_check.check_file(BADCOV_FIXTURE, [4])
+    (rep,) = reports
+    assert not rep.deadlock_free
+    codes = {f.code for f in rep.findings}
+    assert codes == {"M4T204"}
+    msgs = " ".join(f.message for f in rep.findings)
+    assert "chunk coverage violation" in msgs
+    assert "missing contribution" in msgs
+    witness = rep.findings[0].witness
+    assert {"rank", "chunk", "missing"} <= set(witness)
+
+
+def test_cost_admission_rejects_broken_expect_bounds():
+    with open(shipped_path("ring")) as f:
+        raw = json.load(f)
+    raw["expect"] = {"rounds": "n - 1", "wire_chunks": "2 * (n - 1)"}
+    spec = algomod.parse(raw)
+    (rep,) = algo_check.check_spec(spec, [4])
+    assert not rep.deadlock_free
+    assert {f.code for f in rep.findings} == {"M4T205"}
+    assert "rounds" in rep.findings[0].message
+
+
+def test_over_reduction_is_named():
+    """Reducing the same contribution twice is an M4T204, not silent
+    numerical corruption: an exchange-and-reduce run for one round too
+    many applies every contribution 2x."""
+    spec = algomod.parse({
+        "schema": algomod.SCHEMA, "name": "double-reduce",
+        "collective": "AllReduce", "reduce": "SUM",
+        "worlds": [2], "chunks": 1,
+        "phases": [{"repeat": 2, "steps": [
+            {"to": "r ^ 1", "from": "r ^ 1",
+             "send": 0, "recv": 0, "action": "reduce"},
+        ]}],
+    })
+    (rep,) = algo_check.check_spec(spec, [2])
+    msgs = " ".join(
+        f.message for f in rep.findings if f.code == "M4T204"
+    )
+    assert "over-reduced" in msgs and "applied 2x" in msgs
+
+
+# ---------------------------------------------------------------------
+# property tests: the admission pipeline vs brute force
+# ---------------------------------------------------------------------
+
+
+def _p2p_event(rank, to, frm, world):
+    edges = []
+    sends = recvs = ()
+    if to >= 0:
+        edges.append((rank, to))
+        sends = (to,)
+    if frm >= 0:
+        edges.append((frm, rank))
+        recvs = (frm,)
+    return ScheduleEvent(
+        op="Sendrecv", fingerprint=algomod.event_fingerprint(1),
+        kind="p2p", group=tuple(sorted({rank} | set(sends) | set(recvs))),
+        edges=tuple(edges), sends=sends, recvs=recvs,
+        nbytes=4, dtype="float32", world=world,
+    )
+
+
+def _brute_force_blocking(events):
+    """Reference rendezvous matcher, written independently of
+    simulate.py: every round, an event completes iff each of its send
+    peers currently receives from this rank and each recv peer
+    currently sends to it; no progress with work left is a deadlock."""
+    world = len(events)
+    pcs = [0] * world
+    while True:
+        if all(pcs[r] >= len(events[r]) for r in range(world)):
+            return True
+
+        def matched(r):
+            if pcs[r] >= len(events[r]):
+                return False
+            e = events[r][pcs[r]]
+            for d in e.sends:
+                if pcs[d] >= len(events[d]):
+                    return False
+                if r not in events[d][pcs[d]].recvs:
+                    return False
+            for s in e.recvs:
+                if pcs[s] >= len(events[s]):
+                    return False
+                if r not in events[s][pcs[s]].sends:
+                    return False
+            return True
+
+        done = [r for r in range(world) if matched(r)]
+        if not done:
+            return False
+        for r in done:
+            pcs[r] += 1
+
+
+def test_simulator_agrees_with_brute_force_blocking_matcher():
+    """1000 random synthetic p2p schedules: the production simulator
+    and the independent reference matcher must agree on every verdict
+    (and on completability — a clean verdict really drains every pc)."""
+    rng = random.Random(0xA160)
+    agree_clean = agree_deadlock = 0
+    for seed in range(1000):
+        rng.seed(seed)
+        world = rng.choice((2, 3, 4))
+        events = {r: [] for r in range(world)}
+        for _step in range(rng.randint(1, 3)):
+            if rng.random() < 0.55:
+                # symmetric shifted exchange: always completable, so
+                # the family exercises clean verdicts too
+                k = rng.randrange(1, world)
+                for r in range(world):
+                    events[r].append(_p2p_event(
+                        r, (r + k) % world, (r - k) % world, world,
+                    ))
+            else:
+                for r in range(world):
+                    peers = [p for p in range(world) if p != r]
+                    to = rng.choice([-1] + peers)
+                    frm = rng.choice([-1] + peers)
+                    if to == -1 and frm == -1:
+                        to = rng.choice(peers)
+                    events[r].append(_p2p_event(r, to, frm, world))
+        ok_sim, _rounds, findings = simulate_events(events)
+        ok_ref = _brute_force_blocking(events)
+        assert ok_sim == ok_ref, (
+            f"seed {seed}: simulator={ok_sim} brute-force={ok_ref}"
+        )
+        if ok_sim:
+            agree_clean += 1
+        else:
+            agree_deadlock += 1
+            assert any(f.code == "M4T201" for f in findings)
+    # the family must actually exercise both verdicts
+    assert agree_clean > 100 and agree_deadlock > 100
+
+
+def _brute_force_values(program, reduce_name="SUM"):
+    """Independent concrete-value interpreter: run the program over
+    numpy-free python ints where rank r's chunk c starts as the basis
+    value (r, c), with snapshot-at-send semantics, driven by the same
+    matched-round order as _brute_force_blocking."""
+    n, S = program.world, program.slots
+    state = {r: [None] * S for r in range(n)}
+    for r in range(n):
+        for c in range(program.chunks):
+            state[r][c] = Counter({(r, c): 1})
+    for r in range(n):
+        state[r] = [v if v is not None else Counter() for v in state[r]]
+    items = {r: list(program.items[r]) for r in range(n)}
+    pcs = [0] * n
+
+    def cur_comm(r):
+        """Advance over local copies (they never block), apply them."""
+        while pcs[r] < len(items[r]):
+            it = items[r][pcs[r]]
+            if isinstance(it, algomod.CopyItem):
+                state[r][it.dst] = Counter(state[r][it.src])
+                pcs[r] += 1
+            else:
+                return it
+        return None
+
+    while True:
+        cur = {r: cur_comm(r) for r in range(n)}
+        if all(c is None for c in cur.values()):
+            return state
+
+        def matched(r):
+            e = cur[r]
+            if e is None:
+                return False
+            if e.to >= 0 and (cur[e.to] is None or cur[e.to].frm != r):
+                return False
+            if e.frm >= 0 and (cur[e.frm] is None or cur[e.frm].to != r):
+                return False
+            return True
+
+        done = [r for r in range(n) if matched(r)]
+        if not done:
+            return None  # deadlock
+        payload = {
+            r: [Counter(state[r][s]) for s in cur[r].send_slots]
+            for r in done if cur[r].to >= 0
+        }
+        for r in done:
+            e = cur[r]
+            if e.frm < 0:
+                continue
+            for slot, val in zip(e.recv_slots, payload[e.frm]):
+                if e.action == "reduce":
+                    state[r][slot] = state[r][slot] + val
+                else:
+                    state[r][slot] = val
+        for r in done:
+            pcs[r] += 1
+
+
+def _values_correct(program, state):
+    if state is None:
+        return False
+    n = program.world
+    for r in range(n):
+        for c in range(program.chunks):
+            want = algo_check._expected(
+                program.spec.collective, n, r, c
+            )
+            if state[r][c] != want:
+                return False
+    return True
+
+
+@pytest.mark.parametrize("stem", SHIPPED)
+@pytest.mark.parametrize("world", WORLDS)
+def test_coverage_interpreter_agrees_on_shipped(stem, world):
+    program = algomod.expand(algomod.load(shipped_path(stem)), world)
+    ok, advances, _ = simulate_rounds(algomod.events_for(program))
+    assert ok
+    assert algo_check.interpret_coverage(program, advances) == []
+    assert _values_correct(program, _brute_force_values(program))
+
+
+def test_coverage_agrees_with_brute_force_on_truncated_rings():
+    """Property family: a ring whose reduce-scatter runs j laps and
+    allgather m laps is correct iff j == m == n-1. The symbolic M4T204
+    interpreter and the independent concrete-value interpreter must
+    agree on all of them (1000 seeded draws)."""
+    with open(shipped_path("ring")) as f:
+        base = json.load(f)
+    base.pop("expect", None)
+    rng = random.Random(0xC0FE)
+    outcomes = Counter()
+    for seed in range(1000):
+        rng.seed(seed)
+        n = rng.choice((2, 3, 4, 5))
+        j = rng.randint(1, n - 1) if n > 1 else 1
+        m = rng.randint(0, n - 1)
+        raw = copy.deepcopy(base)
+        raw["worlds"] = [n]
+        raw["phases"][0]["repeat"] = str(j)
+        raw["phases"][1]["repeat"] = str(m)
+        if m == 0:
+            raw["phases"] = raw["phases"][:1]
+        program = algomod.expand(algomod.parse(raw), n)
+        ok, advances, _ = simulate_rounds(algomod.events_for(program))
+        assert ok  # symmetric sendrecv rings never deadlock
+        m204 = algo_check.interpret_coverage(program, advances)
+        correct = _values_correct(program, _brute_force_values(program))
+        assert (not m204) == correct, (
+            f"seed {seed} (n={n} j={j} m={m}): symbolic interpreter "
+            f"says {'clean' if not m204 else 'violation'}, brute force "
+            f"says values {'correct' if correct else 'wrong'}"
+        )
+        assert (not m204) == (j == n - 1 and m == n - 1)
+        outcomes[bool(m204)] += 1
+    assert outcomes[True] > 100 and outcomes[False] > 100
+
+
+# ---------------------------------------------------------------------
+# costmodel + autotune integration (device-free half)
+# ---------------------------------------------------------------------
+
+
+def test_costmodel_serves_verified_step_structure():
+    tag = algomod.load(shipped_path("ring")).tag
+    c = costmodel.cost(
+        "AllReduce", nbytes=1 << 20, dtype="float32", world=8, impl=tag,
+    )
+    assert c.get("impl") == tag
+    assert c["steps"] == 14  # 2*(n-1) at n=8
+    assert c["wire_bytes"] == 14 * -(-(1 << 20) // 8)  # ceil(b/chunks)
+    assert "verified algo" in c["algorithm"]
+
+
+def test_costmodel_ignores_algo_outside_its_proof():
+    """Wrong op or an unproven world: the registry entry does not
+    apply, and the model falls back to the default op cost (no impl
+    stamp) instead of inventing numbers for an unverified config."""
+    tag = algomod.load(shipped_path("ring")).tag
+    c = costmodel.cost(
+        "AllGather", nbytes=1 << 20, dtype="float32", world=8, impl=tag,
+    )
+    assert c.get("impl") != tag
+    c = costmodel.cost(
+        "AllReduce", nbytes=1 << 20, dtype="float32", world=16, impl=tag,
+    )
+    assert c.get("impl") != tag and c["steps"] == 30  # default ring
+
+
+def test_autotune_candidates_include_registered_algos():
+    tag = algomod.load(shipped_path("alltoall_twophase")).tag
+    assert tag in planmod.impls_for("AllToAll")
+    key = planmod.plan_key(
+        "AllToAll", nbytes=1 << 16, dtype="float32", world=8,
+        axes=("ranks",), platform="cpu",
+    )
+    cands = autotune.candidates(planmod.parse_key(key))
+    assert any(impl == tag for impl, _params in cands)
+
+
+def test_autotune_default_grid_unchanged_by_algo_registration():
+    """Regression pin: registering algorithms must not silently grow
+    the default tune grid (plan goldens + selftest determinism) —
+    AllToAll keys join only via --ops or observed events."""
+    keys = autotune.default_keys(platform="cpu", world=8)
+    ops = {k.split("|")[0] for k in keys}
+    assert ops == {"AllReduce", "ReduceScatter", "AllGather"}
+
+
+def test_tune_sweep_over_alltoall_picks_verified_algo(tmp_path):
+    """`planner tune --ops AllToAll` sweeps registered algorithms on
+    equal footing and pins the winner with a costmodel-seeded entry."""
+    out = str(tmp_path / "plan.json")
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.planner", "tune",
+         "--ops", "AllToAll", "--world", "8", "--dtypes", "float32",
+         "--cache", out],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+        env=_clean_env(),
+    )
+    assert res.returncode == 0, res.stderr
+    loaded = planmod.load(out)
+    tag = algomod.load(shipped_path("alltoall_twophase")).tag
+    a2a = {k: e for k, e in loaded.entries.items()
+           if k.startswith("AllToAll|")}
+    assert a2a, sorted(loaded.entries)
+    assert any(e.impl == tag for e in a2a.values()), {
+        k: e.impl for k, e in a2a.items()
+    }
+
+
+# ---------------------------------------------------------------------
+# golden pin: shipped algorithm identity + compiled structure
+# ---------------------------------------------------------------------
+
+
+def _golden_payload():
+    out = {}
+    for stem in sorted(SHIPPED):
+        spec = algomod.load(shipped_path(stem))
+        per_world = {}
+        for n in WORLDS:
+            program = algomod.expand(spec, n)
+            lowered = algomod.lower(program)
+            per_world[str(n)] = {
+                "rounds": len(lowered.rounds),
+                "wire_chunks": lowered.wire_chunks,
+                "chunks": program.chunks,
+                "slots": program.slots,
+                "event_fingerprints": sorted({
+                    e.fingerprint
+                    for evs in algomod.events_for(program).values()
+                    for e in evs
+                }),
+            }
+        out[stem] = {
+            "name": spec.name,
+            "collective": spec.collective,
+            "fingerprint": spec.fingerprint,
+            "tag": spec.tag,
+            "per_world": per_world,
+        }
+    return out
+
+
+def test_golden_pin():
+    """Shipped algorithm identity (fingerprints -> registry tags ->
+    plan entries) and compiled structure are frozen; an intentional
+    change regenerates with `python tests/test_planner_algo.py --regen`
+    plus fresh proofs."""
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    assert golden == _golden_payload()
+
+
+# ---------------------------------------------------------------------
+# CLI: planner algo {check,show,lower}
+# ---------------------------------------------------------------------
+
+
+def _clean_env():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("M4T_ALGO_PATH", None)
+    return env
+
+
+def _planner(*argv, timeout=240):
+    return subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.planner", *argv],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO,
+        env=_clean_env(),
+    )
+
+
+def test_cli_check_clean_file_exits_zero():
+    res = _planner(
+        "algo", "check", shipped_path("ring"), "--ranks", "2,4,8",
+    )
+    assert res.returncode == 0, res.stderr
+    assert res.stdout.count("deadlock-free") == 3  # one per world
+
+
+def test_cli_check_skips_proof_artifacts():
+    # CI runs `algo check planner/algos/*.json`, which also globs the
+    # committed .proof.json artifacts — they are outputs, not inputs
+    res = _planner(
+        "algo", "check",
+        shipped_path("ring"),
+        algomod.proof_path(shipped_path("ring")),
+        "--ranks", "2,4,8",
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert res.stdout.count("deadlock-free") == 3
+    assert "schema mismatch" not in res.stdout
+
+
+def test_cli_check_deadlock_exits_one_with_witness():
+    res = _planner("algo", "check", DEADLOCK_FIXTURE)
+    assert res.returncode == 1
+    assert "M4T201" in res.stdout and "rank cycle" in res.stdout
+
+
+def test_cli_check_json_schema():
+    res = _planner("algo", "check", BADCOV_FIXTURE, "--json")
+    assert res.returncode == 1
+    payload = json.loads(res.stdout)
+    reports = payload if isinstance(payload, list) else payload["reports"]
+    codes = {
+        f["code"] for rep in reports for f in rep.get("findings", ())
+    }
+    assert "M4T204" in codes
+
+
+def test_cli_check_sarif_names_rules():
+    res = _planner("algo", "check", DEADLOCK_FIXTURE, "--sarif", "-")
+    assert res.returncode == 1
+    sarif = json.loads(res.stdout)
+    run = sarif["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"M4T201", "M4T204", "M4T205"} <= rule_ids
+    assert any(
+        x["ruleId"] == "M4T201" for x in run["results"]
+    )
+
+
+def test_cli_show_lists_registry():
+    res = _planner("algo", "show")
+    assert res.returncode == 0, res.stderr
+    for name in ("ring", "recursive-double", "alltoall-twophase"):
+        assert name in res.stdout
+
+
+def test_cli_lower_json_roundtrips():
+    res = _planner(
+        "algo", "lower", shipped_path("recursive_double"),
+        "--ranks", "8", "--json",
+    )
+    assert res.returncode == 0, res.stderr
+    payload = json.loads(res.stdout)
+    lowered = payload["8"] if "8" in payload else payload
+    assert lowered["wire_chunks"] == 3
+    assert len(lowered["rounds"]) == 3
+
+
+def test_rule_catalog_lists_all_simulation_rules():
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.analysis", "--rules"],
+        capture_output=True, text=True, timeout=240, cwd=REPO,
+        env=_clean_env(),
+    )
+    assert res.returncode == 0
+    for code in ("M4T201", "M4T202", "M4T203", "M4T204", "M4T205"):
+        assert code in res.stdout, code
+
+
+# ---------------------------------------------------------------------
+# launch --verify --algo: the pre-spawn gate, end to end
+# ---------------------------------------------------------------------
+
+
+def _launch_verify(tmp_path, algo_file):
+    target = str(tmp_path / "target.py")
+    with open(target, "w") as f:
+        f.write("print('RANK_RAN')\n")
+    return subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.launch", "-n", "2",
+         "--verify", "--algo", algo_file, target],
+        capture_output=True, text=True, timeout=240, cwd=REPO,
+        env=_clean_env(),
+    )
+
+
+def test_launch_verify_blocks_deadlocking_algo_before_spawn(tmp_path):
+    """Acceptance: the committed deadlock fixture is rejected by
+    ``launch --verify`` with the M4T201 rank-cycle witness, exit 1,
+    and no rank ever spawns."""
+    res = _launch_verify(tmp_path, DEADLOCK_FIXTURE)
+    assert res.returncode == 1
+    assert "M4T201" in res.stderr and "rank cycle" in res.stderr
+    assert "BLOCKED" in res.stderr
+    assert "RANK_RAN" not in res.stdout
+
+
+def test_launch_verify_blocks_coverage_violation(tmp_path):
+    res = _launch_verify(tmp_path, BADCOV_FIXTURE)
+    assert res.returncode == 1
+    assert "M4T204" in res.stderr
+    assert "missing contribution" in res.stderr
+    assert "RANK_RAN" not in res.stdout
+
+
+def test_launch_verify_admits_proven_algo_and_spawns(tmp_path):
+    res = _launch_verify(tmp_path, shipped_path("ring"))
+    assert res.returncode == 0, res.stderr
+    # both ranks really ran (the --verify import itself prints once)
+    assert res.stdout.count("RANK_RAN") >= 2
+
+
+def test_launch_verify_blocks_plan_with_unproven_algo_impl(tmp_path):
+    """An armed plan naming an algo impl with no registry backing is
+    refused pre-spawn, not at the first collective."""
+    key = planmod.plan_key(
+        "AllReduce", nbytes=4096, dtype="float32", world=2,
+        axes=("ranks",), platform="cpu",
+    )
+    p = planmod.Plan(platform="cpu")
+    p.entries[key] = planmod.PlanEntry(
+        impl="algo:phantom@0123456789abcdef", source="analytic",
+    )
+    plan_path = str(tmp_path / "plan.json")
+    planmod.save(p, plan_path)
+    target = str(tmp_path / "target.py")
+    with open(target, "w") as f:
+        f.write("print('RANK_RAN')\n")
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.launch", "-n", "2",
+         "--verify", "--plan", plan_path, target],
+        capture_output=True, text=True, timeout=240, cwd=REPO,
+        env=_clean_env(),
+    )
+    assert res.returncode == 1
+    assert "not a registered" in res.stderr
+    assert "BLOCKED" in res.stderr
+    assert "RANK_RAN" not in res.stdout
+
+
+if __name__ == "__main__":
+    if "--regen" in sys.argv:
+        with open(GOLDEN, "w") as f:
+            json.dump(_golden_payload(), f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"regenerated {GOLDEN}")
+    else:
+        print(__doc__)
