@@ -371,6 +371,7 @@ def make_world_args(**overrides):
         elastic=False, min_ranks=1,
         plan_cache_env=None, _live_report=None,
         trace_id=None, job_id=None,
+        probe_topology=False,
     )
     for key, value in overrides.items():
         if not hasattr(args, key):
@@ -751,6 +752,62 @@ def _spawn_world(
 spawn_world = _spawn_world
 
 
+#: wall-clock budget for one probe world: the sweep is O(world *
+#: payloads * repeats) short sendrecvs, so a probe that outlives this
+#: is wedged, not slow
+_PROBE_TIMEOUT_S = 120.0
+
+
+def _run_probe_world(args, out_dir, *, world=None):
+    """Spawn a short probe world (``mpi4jax_tpu.observability.topology
+    probe``) before the workload: every rank sweeps ``sendrecv`` over
+    the CartComm edges and rank 0 merges the fitted ``m4t-topo/1``
+    map into ``out_dir/topology.json`` — the link truth the doctor's
+    link-bound classifier, the per-link exporters, and ``planner tune
+    --topo`` all consume. Probe telemetry deliberately does not ride
+    the run's ``--events-dir`` sinks (a sweep's thousands of sendrecvs
+    would drown the workload's record stream). A failed probe is a
+    warning, never a launch blocker: the run proceeds with the
+    uniform-peak model, exactly as before. Returns the map path or
+    None."""
+    from .observability import topology as _topology
+
+    world = args.nproc if world is None else int(world)
+    if world < 2:
+        sys.stderr.write(
+            "mpi4jax_tpu.launch: --probe-topology skipped: a world of "
+            f"{world} rank(s) has no links to measure\n"
+        )
+        return None
+    probe_args = make_world_args(
+        nproc=world,
+        module="mpi4jax_tpu.observability.topology",
+        cmd=["probe", "--out", out_dir],
+        hang_timeout=_PROBE_TIMEOUT_S,
+    )
+    exit_code, _preempted = _spawn_world(probe_args, None, world=world)
+    path = os.path.join(out_dir, _topology.MAP_BASENAME)
+    if exit_code == 0 and os.path.isfile(path):
+        try:
+            topo = _topology.load(path)
+        except (OSError, ValueError) as exc:
+            sys.stderr.write(
+                "mpi4jax_tpu.launch: topology probe produced an "
+                f"unusable map ({exc}); continuing without one\n"
+            )
+            return None
+        sys.stderr.write(
+            f"mpi4jax_tpu.launch: topology probe: {topo['world']} "
+            f"ranks, {len(topo['edges'])} measured edge(s) -> {path}\n"
+        )
+        return path
+    sys.stderr.write(
+        f"mpi4jax_tpu.launch: topology probe failed (exit {exit_code}); "
+        "continuing without a link map\n"
+    )
+    return None
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="python -m mpi4jax_tpu.launch", description=__doc__
@@ -900,6 +957,18 @@ def main(argv=None):
         "default policy by construction)",
     )
     parser.add_argument(
+        "--probe-topology", action="store_true",
+        help="active topology probe (requires --events-dir, -n >= 2): "
+        "before the workload spawns, a short probe world sweeps "
+        "sendrecv over every CartComm edge at a few payload sizes and "
+        "persists the fitted per-link alpha/beta map as "
+        "EVENTS_DIR/topology.json (m4t-topo/1, "
+        "observability/topology.py) — the doctor then classifies "
+        "stragglers link-bound vs rank-bound against it and `planner "
+        "tune --topo` prices impls per edge; with --elastic the "
+        "shrunk world is re-probed before its first attempt",
+    )
+    parser.add_argument(
         "--min-ranks", type=int, default=1, metavar="K",
         help="elastic floor: never shrink below K ranks — fewer "
         "survivors than K is a give-up, not a smaller world "
@@ -969,6 +1038,9 @@ def main(argv=None):
     if args.tune and not (events_dir and args.plan):
         parser.error("--tune requires --events-dir (the measurements) "
                      "and --plan (where the tuned plan is written)")
+    if args.probe_topology and not events_dir:
+        parser.error("--probe-topology requires --events-dir (where "
+                     "topology.json is persisted)")
     if events_dir:
         events_dir = os.path.abspath(events_dir)
         os.makedirs(events_dir, exist_ok=True)
@@ -1016,6 +1088,8 @@ def main(argv=None):
     if args.retries == 0:
         # the pre-supervisor contract, preserved exactly: one attempt,
         # flat artifact layout, same exit codes
+        if args.probe_topology:
+            _run_probe_world(args, events_dir)
         exit_code, _preempted = _spawn_world(
             args, events_dir, fault_plan_env=fault_plan_env
         )
@@ -1038,6 +1112,7 @@ def main(argv=None):
         "transition": None,       # elastic shrink decided for next
         "blocked": None,          # elastic give-up reason, if any
         "last_exit": 0,
+        "probed_world": None,     # world size the topology map covers
     }
 
     def attempt_dir(attempt):
@@ -1061,6 +1136,15 @@ def main(argv=None):
         state["dir"] = d
         world = state["world"]
         state["world_ran"] = world
+        if args.probe_topology and events_dir and (
+            state["probed_world"] != world
+        ):
+            # first attempt, or the elastic supervisor shrank the
+            # world: the old map's edges name ranks that no longer
+            # exist, so the surviving links are re-measured before the
+            # workload spawns at the new size
+            _run_probe_world(args, events_dir, world=world)
+            state["probed_world"] = world
         sys.stderr.write(
             f"mpi4jax_tpu.launch: attempt {attempt} (world {world})"
             + (f" (resuming from step {resume_step})"

@@ -51,7 +51,8 @@ from __future__ import annotations
 
 import math
 import os
-from typing import Any, Dict, Optional
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
 
 from .. import config
 
@@ -81,20 +82,38 @@ DEFAULT_PEAK_GBPS = 25.0
 _QUANT_BLOCK = 256
 
 
+#: M4T_PEAK_GBPS values already warned about (one warning per distinct
+#: bad value, not one per cost-model call)
+_WARNED_PEAK: set = set()
+
+
 def peak_gbps(device_kind: Optional[str] = None) -> float:
     """The peak link bandwidth the attribution divides by:
     ``M4T_PEAK_GBPS`` when set, else the generation table keyed by
-    ``device_kind``, else :data:`DEFAULT_PEAK_GBPS`."""
+    ``device_kind``, else :data:`DEFAULT_PEAK_GBPS`.
+
+    An unparseable or non-positive ``M4T_PEAK_GBPS`` warns once and
+    falls back to the table — a typo'd override must not silently
+    poison every achieved-bandwidth figure downstream."""
     # read the env dynamically (not the import-time snapshot) so the
     # CLI and tests can retarget without reloading the module
     raw = os.environ.get("M4T_PEAK_GBPS", "")
     if raw:
+        value = None
         try:
             value = float(raw)
-            if value > 0:
-                return value
         except ValueError:
             pass
+        if value is not None and value > 0:
+            return value
+        if raw not in _WARNED_PEAK:
+            _WARNED_PEAK.add(raw)
+            warnings.warn(
+                f"M4T_PEAK_GBPS={raw!r} is not a positive number; "
+                "falling back to the generation table",
+                RuntimeWarning,
+                stacklevel=2,
+            )
     elif config.PEAK_GBPS > 0:
         return config.PEAK_GBPS
     if device_kind:
@@ -324,6 +343,197 @@ def _impl_cost(
             ),
         }
     return None
+
+
+def _ring_edges(n: int) -> List[Tuple[int, int]]:
+    return [(r, (r + 1) % n) for r in range(n)]
+
+
+def edge_phases(
+    op: str,
+    *,
+    nbytes: int,
+    world: Optional[int],
+    dtype: Optional[str] = None,
+    impl: Optional[str] = None,
+    params: Optional[Dict[str, Any]] = None,
+) -> List[Dict[str, Any]]:
+    """Directed-edge decomposition of one emission: which physical
+    links the algorithm's bytes actually ride. Returns a list of
+    *phases* — ``{"edges": [(src, dst), ...], "per_edge_bytes",
+    "steps"}`` — where ``per_edge_bytes`` is the total bytes each
+    listed edge carries across the phase and ``steps`` the
+    synchronization rounds the phase contributes (phases are
+    sequential; edges within a phase move concurrently).
+
+    The built-in models mirror :func:`cost`: ring AllReduce/RS/AG use
+    the ring edges ``r -> (r+1) % n``, AllToAll one rotation per
+    displacement, hierarchical AllReduce a per-group fast ring plus a
+    stride-``fast`` slow ring, quantized the int8 ring, and verified
+    ``algo:*`` impls their proven per-round ``RoundGroup`` edges from
+    the m4t-algo/1 lowering. Ops with no meaningful link decomposition
+    (trees whose edge set depends on the root, point-to-point with
+    unrecorded peers) return ``[]`` — consumers (per-link attribution,
+    :func:`expected_time_topo`) skip those records rather than guess."""
+    n = int(world) if world else 1
+    b = max(0, int(nbytes))
+    if n <= 1 or b <= 0:
+        return []
+    if impl and impl != "hlo":
+        phases = _impl_edge_phases(op, impl, b, n, dtype, params or {})
+        if phases is not None:
+            return phases
+    ring = _ring_edges(n)
+    if op == "AllReduce":
+        return [{"edges": ring,
+                 "per_edge_bytes": int(round(2 * (n - 1) * b / n)),
+                 "steps": 2 * (n - 1)}]
+    if op == "ReduceScatter":
+        return [{"edges": ring,
+                 "per_edge_bytes": int(round((n - 1) * b / n)),
+                 "steps": n - 1}]
+    if op == "AllGather":
+        return [{"edges": ring, "per_edge_bytes": (n - 1) * b,
+                 "steps": n - 1}]
+    if op == "AllToAll":
+        # pairwise exchange: rotation d moves every rank's block for
+        # destination (r+d) % n — one phase per displacement
+        return [
+            {"edges": [(r, (r + d) % n) for r in range(n)],
+             "per_edge_bytes": int(round(b / n)), "steps": 1}
+            for d in range(1, n)
+        ]
+    return []
+
+
+def _impl_edge_phases(
+    op: str,
+    impl: str,
+    b: int,
+    n: int,
+    dtype: Optional[str],
+    params: Dict[str, Any],
+) -> Optional[List[Dict[str, Any]]]:
+    """Planner-impl edge decompositions; None falls through to the
+    plain op model (same degradation contract as :func:`_impl_cost`)."""
+    if impl.startswith("algo:"):
+        reg = registered_impl_cost(impl)
+        if reg is None or op != reg["op"] or n not in reg["per_world"]:
+            return None
+        try:
+            from ..planner import algo as _algo
+
+            ai = _algo.get(impl)
+            low = ai.lowered(n) if ai is not None else None
+        except Exception:
+            return None
+        if low is None:
+            return None
+        chunk_b = -(-b // max(1, int(low.chunks)))
+        phases: List[Dict[str, Any]] = []
+        for groups in low.rounds:
+            first = True
+            for g in groups:
+                if not g.edges:
+                    continue
+                phases.append({
+                    "edges": [(int(s), int(d)) for s, d in g.edges],
+                    "per_edge_bytes": int(g.count) * chunk_b,
+                    # one synchronization round per simulator round,
+                    # however many fused bundles it carries
+                    "steps": 1 if first else 0,
+                })
+                first = False
+        return phases
+    if impl == "pallas_ring" and op in (
+        "AllReduce", "ReduceScatter", "AllGather"
+    ):
+        # the Pallas kernels run the same ring schedule over the same
+        # edges — only the engine differs
+        return edge_phases(op, nbytes=b, world=n, dtype=dtype)
+    if impl == "quantized" and op == "AllReduce":
+        elems = b // itemsize(dtype)
+        hop = _quant_wire_format_bytes(_quant_ring_chunk_elems(elems, n))
+        return [{"edges": _ring_edges(n),
+                 "per_edge_bytes": 2 * (n - 1) * hop,
+                 "steps": 2 * (n - 1)}]
+    if impl == "hierarchical" and op == "AllReduce":
+        fast = int(params.get("fast") or 0)
+        if not (1 < fast < n and n % fast == 0):
+            return None
+        slow = n // fast
+        # fast groups are contiguous rank blocks (the innermost mesh
+        # axis is minor in the rank order); the slow ring strides by
+        # ``fast`` and is the phase that crosses between groups
+        fast_edges: List[Tuple[int, int]] = []
+        for g0 in range(0, n, fast):
+            fast_edges.extend(
+                (g0 + i, g0 + (i + 1) % fast) for i in range(fast)
+            )
+        slow_edges = [(r, (r + fast) % n) for r in range(n)]
+        return [
+            {"edges": fast_edges,
+             "per_edge_bytes": int(round(2 * (fast - 1) * b / fast)),
+             "steps": 2 * (fast - 1)},
+            {"edges": slow_edges,
+             "per_edge_bytes": int(round(2 * (slow - 1) * (b / fast) / slow)),
+             "steps": 2 * (slow - 1)},
+        ]
+    return None
+
+
+def record_edge_phases(record: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Edge decomposition of one emission/recorder record (the shared
+    JSONL schema), impl-aware like :func:`record_cost`."""
+    return edge_phases(
+        record.get("op", "?"),
+        nbytes=record.get("bytes") or 0,
+        world=record.get("world"),
+        dtype=record.get("dtype"),
+        impl=record.get("impl"),
+        params=record.get("impl_params"),
+    )
+
+
+def expected_time_topo(
+    op: str,
+    *,
+    nbytes: int,
+    world: Optional[int],
+    betas: Dict[Tuple[int, int], float],
+    dtype: Optional[str] = None,
+    impl: Optional[str] = None,
+    params: Optional[Dict[str, Any]] = None,
+    gbps: Optional[float] = None,
+    alpha: Optional[float] = None,
+) -> Optional[float]:
+    """Edge-aware alpha-beta expected time: per phase, ``steps *
+    alpha`` plus the drain time of the phase's *slowest* link (edges
+    in a phase move concurrently, so the phase completes when its
+    worst edge does). ``betas`` is the measured per-link bandwidth map
+    (``topology.edge_betas``); unmeasured edges price at the uniform
+    ``gbps``. Returns None when the op/impl has no edge decomposition
+    — callers fall back to :func:`expected_time_s`."""
+    phases = edge_phases(
+        op, nbytes=nbytes, world=world, dtype=dtype, impl=impl,
+        params=params,
+    )
+    if not phases:
+        return None
+    gbps = peak_gbps() if gbps is None else float(gbps)
+    alpha = alpha_s() if alpha is None else float(alpha)
+    t = 0.0
+    for phase in phases:
+        t += int(phase["steps"]) * alpha
+        worst = 0.0
+        for src, dst in phase["edges"]:
+            beta = betas.get((int(src), int(dst)), gbps)
+            if beta and beta > 0:
+                worst = max(
+                    worst, int(phase["per_edge_bytes"]) / (beta * 1e9)
+                )
+        t += worst
+    return t
 
 
 def record_cost(record: Dict[str, Any]) -> Dict[str, Any]:

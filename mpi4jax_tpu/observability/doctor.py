@@ -73,7 +73,12 @@ Finding kinds and their stable fields:
   expects, even when no peer log reached that seq);
 - ``missing_rank`` — ``rank``, ``world``, ``note``;
 - ``straggler`` — ``op``, ``rank``, ``mean_s``, ``peer_median_s``,
-  ``ratio``, ``samples``, ``min_samples``, ``peer_samples``.
+  ``ratio``, ``samples``, ``min_samples``, ``peer_samples``, optional
+  ``link_diagnosis`` (with a measured ``m4t-topo/1`` map — ``--topo``
+  or an auto-detected ``topology.json`` beside the inputs:
+  ``topology.classify_rank``'s link-bound vs rank-bound verdict,
+  naming the slowest incident edge and its measured-vs-fleet-median
+  beta).
 
 New fields may be added within a schema version; existing ones are
 renamed or removed only with a version bump. Exit codes are part of
@@ -674,6 +679,31 @@ def attach_static_sites(report: Dict[str, Any], sites) -> int:
     return joined
 
 
+def attach_link_classification(
+    report: Dict[str, Any], topo: Dict[str, Any]
+) -> int:
+    """Join straggler verdicts to a measured topology map
+    (``m4t-topo/1``, ``observability/topology.py``): is the straggling
+    rank slow, or is one of its *links*? Each straggler finding gains
+    a ``link_diagnosis`` — ``topology.classify_rank``'s verdict:
+    ``link-bound`` (naming the slowest incident directed edge and its
+    measured-vs-fleet-median beta) or ``rank-bound`` (its links look
+    like everyone else's). Mutates findings in place; returns how many
+    joins landed."""
+    from . import topology
+
+    joined = 0
+    for f in report.get("findings", []):
+        if f.get("kind") != "straggler" or f.get("rank") is None:
+            continue
+        diag = topology.classify_rank(topo, int(f["rank"]))
+        if diag is None:
+            continue
+        f["link_diagnosis"] = diag
+        joined += 1
+    return joined
+
+
 # ---------------------------------------------------------------------
 # report formatting
 # ---------------------------------------------------------------------
@@ -743,12 +773,31 @@ def _fmt_finding(f: Dict[str, Any]) -> str:
             f"produced no log at all"
         )
     if kind == "straggler":
-        return (
+        txt = (
             f"STRAGGLER: rank {f['rank']} {f['op']} mean "
             f"{f['mean_s'] * 1e3:.2f}ms vs peer median "
             f"{f['peer_median_s'] * 1e3:.2f}ms "
             f"({f['ratio']:.1f}x, {f['samples']} samples)"
         )
+        diag = f.get("link_diagnosis")
+        if diag:
+            if diag["klass"] == "link-bound":
+                txt += (
+                    f"\n  link-bound: edge {diag['slowest_edge']} "
+                    f"measured {diag['slowest_edge_gbps']:.3g} GB/s vs "
+                    f"fleet median {diag['fleet_median_gbps']:.3g} GB/s "
+                    f"({diag['ratio']:.2f}x) — suspect the link, not "
+                    "the rank"
+                )
+            else:
+                txt += (
+                    f"\n  rank-bound: slowest incident edge "
+                    f"{diag['slowest_edge']} is healthy "
+                    f"({diag['slowest_edge_gbps']:.3g} GB/s, "
+                    f"{diag['ratio']:.2f}x fleet median) — suspect the "
+                    "rank itself"
+                )
+        return txt
     return json.dumps(f)
 
 
@@ -1163,6 +1212,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="additionally export the merged logs as Chrome "
         "trace-event JSON (load in Perfetto / chrome://tracing)",
     )
+    parser.add_argument(
+        "--topo",
+        metavar="TOPO.json",
+        default=None,
+        help="measured topology map (m4t-topo/1; launch "
+        "--probe-topology) to classify stragglers as link-bound vs "
+        "rank-bound; auto-detected from a topology.json beside the "
+        "inputs when omitted",
+    )
     args = parser.parse_args(argv)
 
     report = diagnose(
@@ -1222,6 +1280,25 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(
                 f"# static: {len(schedules)} simulated schedule(s), "
                 f"{pos_joins} hang position join(s)",
+                file=sys.stderr,
+            )
+    from . import topology
+
+    topo = None
+    if args.topo:
+        try:
+            topo = topology.load(args.topo)
+        except (OSError, ValueError) as e:
+            print(f"doctor: --topo failed: {e}", file=sys.stderr)
+            return 2
+    else:
+        topo = topology.find(args.inputs)
+    if topo is not None:
+        link_joins = attach_link_classification(report, topo)
+        if link_joins:
+            print(
+                f"# topology: {len(topo.get('edges') or {})} measured "
+                f"edge(s), {link_joins} straggler link join(s)",
                 file=sys.stderr,
             )
     if args.trace:

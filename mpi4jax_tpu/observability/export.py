@@ -29,6 +29,8 @@ exposition-format rules)::
     m4t_pct_of_peak{op=,impl=,axes=}    gauge   achieved vs cost model
     m4t_plan_key_emissions_total{key=}  counter per plan-key traffic
     m4t_anomalies_total                 counter perf-watch anomalies
+    m4t_topo_link_gbps{src=,dst=}       gauge   per-link achieved GB/s
+    m4t_topo_link_probe_gbps{src=,dst=} gauge   per-link probed beta
     m4t_verdicts_total{kind=,klass=}    counter confirmed verdicts
 
 Import-light (stdlib only) like the rest of the offline stack.
@@ -92,9 +94,12 @@ def render_openmetrics(
     snap: Dict[str, Any],
     *,
     verdicts: Optional[List[Dict[str, Any]]] = None,
+    topo_links: Optional[Dict[str, Dict[str, Any]]] = None,
 ) -> str:
     """One OpenMetrics exposition of a live snapshot (plus confirmed
-    streaming-doctor verdicts, when given)."""
+    streaming-doctor verdicts and per-link topology attribution, when
+    given — ``topo_links`` is the ``topology.attribute_links`` /
+    ``topology.edge_betas`` link table keyed ``"src->dst"``)."""
     out: List[str] = []
 
     g = _Family(out, "m4t_live_ranks", "gauge",
@@ -165,6 +170,21 @@ def render_openmetrics(
     c = _Family(out, "m4t_anomalies_total", "counter",
                 "Perf-watch anomaly events observed.")
     c.sample(snap.get("anomalies", 0))
+
+    if topo_links:
+        g = _Family(out, "m4t_topo_link_gbps", "gauge",
+                    "Achieved (or probed) bandwidth per directed link "
+                    "(topology observatory).")
+        p = _Family(out, "m4t_topo_link_probe_gbps", "gauge",
+                    "Probe-fitted beta per directed link "
+                    "(m4t-topo/1 map).")
+        for key in sorted(topo_links):
+            row = topo_links[key]
+            src, _, dst = str(key).partition("->")
+            src = row.get("src", src)
+            dst = row.get("dst", dst)
+            g.sample(row.get("gbps_p50"), src=src, dst=dst)
+            p.sample(row.get("beta_gbps"), src=src, dst=dst)
 
     c = _Family(out, "m4t_verdicts_total", "counter",
                 "Confirmed streaming-doctor verdicts.")

@@ -1,0 +1,1106 @@
+"""Topology observatory: measured link maps, per-link attribution,
+and link-localized straggler diagnosis.
+
+Every other layer of the perf stack prices every link at one uniform
+``M4T_PEAK_GBPS`` (:mod:`.costmodel`), but real meshes are
+heterogeneous — the Cloud Collectives observation (arXiv:2105.14088)
+is that rank-reordering and algorithm-selection wins come precisely
+from *measuring* which links are slow. This module is the data plane
+that measurement rides on:
+
+1. **Active probe harness** — pairwise and ring ``sendrecv`` sweeps
+   at a few payload sizes over :class:`..comm.CartComm` edges, run
+   inside a launched world (``launch --probe-topology`` spawns a
+   short probe world before the workload; the elastic supervisor
+   re-probes after a shrink). Each rank times its own sweeps, writes
+   a partial ``topo-rank{k}.json``, and rank 0 merges the partials
+   into ``DIR/topology.json``: a versioned ``m4t-topo/1`` map with
+   per-rank host/device_kind and directed edges carrying a fitted
+   per-link alpha/beta (least squares over ``t = alpha +
+   nbytes / (beta * 1e9)``) plus sweep provenance.
+
+2. **Per-link attribution** — :func:`attribute_links` joins cid-keyed
+   runtime latency records with the cost model's directed-edge
+   decomposition (:func:`..costmodel.edge_phases` — ring/tree/
+   pairwise built-ins plus PR 15's proven ``algo:*`` round schedules)
+   to compute achieved GB/s *per link*. The doctor consumes the map
+   to classify a confirmed straggler as ``rank-bound`` vs
+   ``link-bound`` (:func:`classify_rank`, joined in
+   ``doctor.attach_link_classification``), the exporter publishes
+   ``m4t_topo_link_gbps{src=,dst=}`` gauges, and the Perfetto export
+   grows a per-link counter track.
+
+3. **Planner consumption** — ``planner tune --topo TOPO.json``
+   replaces the uniform-peak analytic seed with the map's per-edge
+   betas (``costmodel.expected_time_topo``), so a skewed topology can
+   flip impl choices (e.g. flat ring -> hierarchical when a flat
+   ring's wrap link is slow); pinned by ``tests/test_topology.py``.
+
+A collective synchronizes its ranks, so attributed per-link GB/s from
+collective latency is a *lower bound* shaped by the slowest
+participant — the probe map is the authoritative per-link truth, and
+attribution is the "what did this run actually see" overlay.
+
+Map schema (``m4t-topo/1``)::
+
+    {"schema": "m4t-topo/1",
+     "world": 4,
+     "platform": "cpu",
+     "ranks": {"0": {"host": "node-a", "device_kind": "cpu"}},
+     "edges": {"0->1": {"alpha_s": 2.1e-06, "beta_gbps": 18.7,
+                        "samples": 9, "payloads": [4096, 65536, 1048576],
+                        "provenance": "probe:ring+pairwise"}},
+     "provenance": {"method": "sendrecv-sweep", "source": "probe",
+                    "payloads": [...], "repeats": 3}}
+
+Import-light on purpose (stdlib + costmodel): the report/diff/selftest
+CLI and every offline consumer run without jax. The probe entry —
+and only the probe entry — imports the op layer lazily.
+
+CLI::
+
+    python -m mpi4jax_tpu.observability.topology probe --out DIR
+        [--payloads 4096,65536,1048576] [--repeats 3]
+        [--synthetic SPEC --world N]       # device-free map synthesis
+    python -m mpi4jax_tpu.observability.topology report TOPO.json
+        [RUNDIR] [--prom OUT.prom]
+    python -m mpi4jax_tpu.observability.topology diff A.json B.json
+    python -m mpi4jax_tpu.observability.topology --selftest
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from . import costmodel
+
+#: topology-map schema tag; bump on any incompatible layout change
+SCHEMA = "m4t-topo/1"
+
+#: payload sizes the sweep times, bytes (small / medium / large: the
+#: small size anchors alpha, the large one anchors beta)
+DEFAULT_PAYLOADS = (1 << 12, 1 << 16, 1 << 20)
+
+#: timed repetitions per (edge, payload) after one untimed warmup
+DEFAULT_REPEATS = 3
+
+#: a link is "slow" when its fitted beta is below this fraction of
+#: the fleet-median beta (the doctor's link-bound threshold)
+SLOW_LINK_FACTOR = 0.5
+
+Edge = Tuple[int, int]
+
+
+def edge_key(src: int, dst: int) -> str:
+    """The JSON key of one directed edge: ``"src->dst"``."""
+    return f"{int(src)}->{int(dst)}"
+
+
+def parse_edge(key: str) -> Edge:
+    src, _, dst = str(key).partition("->")
+    return (int(src), int(dst))
+
+
+# ---------------------------------------------------------------------
+# alpha/beta fitting
+# ---------------------------------------------------------------------
+
+
+def fit_alpha_beta(
+    samples: Sequence[Tuple[int, float]],
+) -> Tuple[float, float]:
+    """Least-squares fit of ``t = alpha + nbytes / (beta * 1e9)`` over
+    ``(nbytes, seconds)`` samples; returns ``(alpha_s, beta_gbps)``.
+
+    Degenerate inputs degrade instead of crashing: with a single
+    payload size (or a non-physical negative slope from timing noise)
+    alpha is pinned at 0 and beta falls back to the mean measured
+    throughput — finite and positive whenever any sample moved bytes
+    in nonzero time."""
+    pts = [
+        (float(n), float(t))
+        for n, t in samples
+        if t > 0 and n >= 0
+    ]
+    if not pts:
+        raise ValueError("fit_alpha_beta: no usable samples")
+    n = len(pts)
+    mean_x = sum(p[0] for p in pts) / n
+    mean_y = sum(p[1] for p in pts) / n
+    sxx = sum((p[0] - mean_x) ** 2 for p in pts)
+    sxy = sum((p[0] - mean_x) * (p[1] - mean_y) for p in pts)
+    slope = sxy / sxx if sxx > 0 else 0.0
+    alpha = mean_y - slope * mean_x
+    if slope > 0:
+        return (max(0.0, alpha), 1.0 / (slope * 1e9))
+    # single payload size / noise-dominated: mean throughput, no alpha
+    thru = [p[0] / p[1] for p in pts if p[0] > 0]
+    if not thru:
+        # pure-latency samples (zero-byte payloads): all alpha
+        return (mean_y, costmodel.DEFAULT_PEAK_GBPS)
+    return (0.0, (sum(thru) / len(thru)) / 1e9)
+
+
+# ---------------------------------------------------------------------
+# synthetic link models (device-free probe backend)
+# ---------------------------------------------------------------------
+
+
+class SyntheticLinkModel:
+    """An injectable per-edge alpha/beta model: the device-free probe
+    backend the selftest and the test matrix sweep against (and the
+    seam a simulator could implement). ``links`` overrides the default
+    per directed edge: ``{(src, dst): {"alpha_s": ..,
+    "beta_gbps": ..}}`` (either field optional)."""
+
+    def __init__(
+        self,
+        world: int,
+        *,
+        alpha_s: float = 2e-6,
+        beta_gbps: float = 20.0,
+        links: Optional[Dict[Edge, Dict[str, float]]] = None,
+    ):
+        if int(world) < 2:
+            raise ValueError("SyntheticLinkModel needs world >= 2")
+        self.world = int(world)
+        self.alpha_s = float(alpha_s)
+        self.beta_gbps = float(beta_gbps)
+        self.links = {
+            (int(s), int(d)): dict(v) for (s, d), v in (links or {}).items()
+        }
+
+    def params(self, src: int, dst: int) -> Tuple[float, float]:
+        over = self.links.get((int(src), int(dst)), {})
+        return (
+            float(over.get("alpha_s", self.alpha_s)),
+            float(over.get("beta_gbps", self.beta_gbps)),
+        )
+
+    def time_s(self, src: int, dst: int, nbytes: int) -> float:
+        alpha, beta = self.params(src, dst)
+        return alpha + max(0, int(nbytes)) / (beta * 1e9)
+
+    def samples(
+        self,
+        *,
+        payloads: Sequence[int] = DEFAULT_PAYLOADS,
+        repeats: int = DEFAULT_REPEATS,
+    ) -> Dict[Edge, List[Tuple[int, float]]]:
+        """Deterministic sweep transcript over every directed edge
+        (what the real probe would have measured under this model)."""
+        out: Dict[Edge, List[Tuple[int, float]]] = {}
+        for src in range(self.world):
+            for dst in range(self.world):
+                if src == dst:
+                    continue
+                rows = []
+                for nbytes in payloads:
+                    for _ in range(max(1, int(repeats))):
+                        rows.append((int(nbytes), self.time_s(src, dst, nbytes)))
+                out[(src, dst)] = rows
+        return out
+
+
+def parse_synthetic_spec(spec: str, *, world: int) -> SyntheticLinkModel:
+    """Build a :class:`SyntheticLinkModel` from a compact CLI spec:
+    ``"beta=20,alpha_us=2,2->3=1.5,3->2=1.5"`` — a default beta
+    (GB/s), a default alpha (us), and per-edge beta overrides."""
+    alpha_s, beta, links = 2e-6, 20.0, {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, value = part.partition("=")
+        if not value:
+            raise ValueError(f"--synthetic: malformed entry {part!r}")
+        if key == "beta":
+            beta = float(value)
+        elif key == "alpha_us":
+            alpha_s = float(value) * 1e-6
+        elif "->" in key:
+            links[parse_edge(key)] = {"beta_gbps": float(value)}
+        else:
+            raise ValueError(f"--synthetic: unknown field {key!r}")
+    return SyntheticLinkModel(world, alpha_s=alpha_s, beta_gbps=beta, links=links)
+
+
+# ---------------------------------------------------------------------
+# map construction / persistence
+# ---------------------------------------------------------------------
+
+
+def build_map(
+    world: int,
+    samples_by_edge: Dict[Edge, List[Tuple[int, float]]],
+    *,
+    ranks: Optional[Dict[int, Dict[str, Any]]] = None,
+    platform: str = "cpu",
+    provenance: Optional[Dict[str, Any]] = None,
+    edge_provenance: str = "probe:ring+pairwise",
+) -> Dict[str, Any]:
+    """Fit every edge's sweep transcript and assemble the versioned
+    ``m4t-topo/1`` document."""
+    edges: Dict[str, Any] = {}
+    for (src, dst), samples in sorted(samples_by_edge.items()):
+        if not samples:
+            continue
+        alpha, beta = fit_alpha_beta(samples)
+        edges[edge_key(src, dst)] = {
+            "alpha_s": alpha,
+            "beta_gbps": beta,
+            "samples": len(samples),
+            "payloads": sorted({int(n) for n, _ in samples}),
+            "provenance": edge_provenance,
+        }
+    rank_meta = {
+        str(r): {
+            "host": str((ranks or {}).get(r, {}).get("host", "")),
+            "device_kind": str(
+                (ranks or {}).get(r, {}).get("device_kind", platform)
+            ),
+        }
+        for r in range(int(world))
+    }
+    return {
+        "schema": SCHEMA,
+        "world": int(world),
+        "platform": platform,
+        "ranks": rank_meta,
+        "edges": edges,
+        "provenance": dict(provenance or {"method": "sendrecv-sweep",
+                                          "source": "probe"}),
+    }
+
+
+def synthetic_map(
+    model: SyntheticLinkModel,
+    *,
+    payloads: Sequence[int] = DEFAULT_PAYLOADS,
+    repeats: int = DEFAULT_REPEATS,
+    platform: str = "cpu",
+) -> Dict[str, Any]:
+    """Probe a synthetic link model device-free into a full map."""
+    return build_map(
+        model.world,
+        model.samples(payloads=payloads, repeats=repeats),
+        platform=platform,
+        provenance={
+            "method": "sendrecv-sweep",
+            "source": "synthetic",
+            "payloads": [int(p) for p in payloads],
+            "repeats": int(repeats),
+        },
+        edge_provenance="synthetic",
+    )
+
+
+def validate(topo: Any) -> Dict[str, Any]:
+    """Schema-check one loaded document; raises ``ValueError`` on
+    anything that must not be trusted as a topology map."""
+    if not isinstance(topo, dict) or topo.get("schema") != SCHEMA:
+        got = topo.get("schema") if isinstance(topo, dict) else type(topo).__name__
+        raise ValueError(f"expected a {SCHEMA!r} map (got {got!r})")
+    world = topo.get("world")
+    if not isinstance(world, int) or world < 1:
+        raise ValueError(f"{SCHEMA}: bad world {world!r}")
+    for key, edge in (topo.get("edges") or {}).items():
+        src, dst = parse_edge(key)  # raises on malformed keys
+        if not (0 <= src < world and 0 <= dst < world and src != dst):
+            raise ValueError(f"{SCHEMA}: edge {key!r} outside world {world}")
+        beta = edge.get("beta_gbps")
+        if not isinstance(beta, (int, float)) or beta <= 0:
+            raise ValueError(f"{SCHEMA}: edge {key!r} has no positive beta")
+    return topo
+
+
+def save(path: str, topo: Dict[str, Any]) -> str:
+    """Atomic write (tmp + rename, the repo's commit idiom)."""
+    validate(topo)
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(prefix=".topo-", dir=d)
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(topo, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def load(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return validate(json.load(f))
+
+
+#: the per-run map filename ``launch --probe-topology`` persists and
+#: the doctor auto-detects beside its inputs
+MAP_BASENAME = "topology.json"
+
+
+def find(inputs: Iterable[str]) -> Optional[Dict[str, Any]]:
+    """Auto-detect a persisted map beside run artifacts: the first
+    readable ``topology.json`` in (or next to) the given inputs. The
+    parent directory is consulted too — a supervised run probes into
+    the run root while the doctor reads per-attempt subdirectories."""
+    for item in inputs:
+        base = item if os.path.isdir(item) else (
+            os.path.dirname(item) or "."
+        )
+        for d in (base, os.path.dirname(os.path.abspath(base))):
+            candidate = os.path.join(d, MAP_BASENAME)
+            if os.path.isfile(candidate):
+                try:
+                    return load(candidate)
+                except (OSError, ValueError, json.JSONDecodeError):
+                    continue
+    return None
+
+
+# ---------------------------------------------------------------------
+# map queries
+# ---------------------------------------------------------------------
+
+
+def edge_betas(topo: Dict[str, Any]) -> Dict[Edge, float]:
+    """``{(src, dst): beta_gbps}`` — the shape
+    ``costmodel.expected_time_topo`` and the autotune sweep consume."""
+    return {
+        parse_edge(k): float(v["beta_gbps"])
+        for k, v in (topo.get("edges") or {}).items()
+    }
+
+
+def fleet_median_beta(topo: Dict[str, Any]) -> Optional[float]:
+    betas = sorted(edge_betas(topo).values())
+    return statistics.median(betas) if betas else None
+
+
+def slow_links(
+    topo: Dict[str, Any], *, factor: float = SLOW_LINK_FACTOR
+) -> List[Dict[str, Any]]:
+    """Directed edges whose fitted beta is below ``factor`` x the
+    fleet median, slowest first."""
+    median = fleet_median_beta(topo)
+    if not median:
+        return []
+    out = []
+    for (src, dst), beta in sorted(edge_betas(topo).items()):
+        if beta < factor * median:
+            out.append({
+                "edge": edge_key(src, dst),
+                "src": src,
+                "dst": dst,
+                "beta_gbps": beta,
+                "fleet_median_gbps": median,
+                "ratio": beta / median,
+            })
+    out.sort(key=lambda r: r["beta_gbps"])
+    return out
+
+
+def classify_rank(
+    topo: Dict[str, Any], rank: int, *, factor: float = SLOW_LINK_FACTOR
+) -> Optional[Dict[str, Any]]:
+    """Is a straggling rank's slowness explained by one of its links?
+
+    Looks at every measured edge incident to ``rank`` (both
+    directions): if the slowest one sits below ``factor`` x the
+    fleet-median beta the verdict is ``link-bound`` (naming the
+    directed edge and its measured-vs-fleet-median beta), else
+    ``rank-bound`` (its links look like everyone else's — the rank
+    itself is slow). ``None`` when the map has no edges at this
+    rank."""
+    median = fleet_median_beta(topo)
+    if not median:
+        return None
+    rank = int(rank)
+    incident = [
+        (beta, (src, dst))
+        for (src, dst), beta in sorted(edge_betas(topo).items())
+        if rank in (src, dst)
+    ]
+    if not incident:
+        return None
+    beta, (src, dst) = min(incident)
+    result = {
+        "fleet_median_gbps": median,
+        "slowest_edge": edge_key(src, dst),
+        "slowest_edge_gbps": beta,
+        "ratio": beta / median,
+        "factor": float(factor),
+    }
+    result["klass"] = "link-bound" if beta < factor * median else "rank-bound"
+    return result
+
+
+# ---------------------------------------------------------------------
+# per-link attribution (measured achieved GB/s per directed edge)
+# ---------------------------------------------------------------------
+
+
+def attribute_links(
+    by_rank: Dict[int, List[Dict[str, Any]]],
+    *,
+    topo: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Join cid-keyed runtime latency samples against the cost model's
+    directed-edge decomposition: for each latency sample a rank
+    recorded, the bytes its *outgoing* edges carried during that
+    collective (``costmodel.edge_phases`` — ring/tree/pairwise
+    built-ins and proven ``algo:*`` round schedules) divided by the
+    measured seconds give that link's achieved GB/s for the sample.
+
+    Returns ``{"links": {"src->dst": {"src", "dst", "samples",
+    "gbps_p50", "bytes"}}}``, with ``"beta_gbps"``/``"vs_probe"``
+    joined in when a probe map is given. ``by_rank`` is the
+    ``doctor.load`` shape."""
+    from . import doctor
+
+    per_edge: Dict[Edge, List[float]] = {}
+    bytes_edge: Dict[Edge, int] = {}
+    for rank in sorted(by_rank):
+        emissions: Dict[str, Dict[str, Any]] = {}
+        for rec in doctor.collective_stream(by_rank[rank]):
+            if rec.get("cid"):
+                emissions.setdefault(rec["cid"], rec)
+        for rec in by_rank[rank]:
+            if rec.get("kind") != "latency":
+                continue
+            seconds = rec.get("seconds")
+            if not isinstance(seconds, (int, float)) or seconds <= 0:
+                continue
+            emission = emissions.get(rec.get("cid") or "")
+            if emission is None:
+                continue
+            phases = costmodel.record_edge_phases(emission)
+            if not phases:
+                continue
+            outgoing: Dict[Edge, int] = {}
+            for phase in phases:
+                for (src, dst) in phase["edges"]:
+                    if src == rank:
+                        e = (src, dst)
+                        outgoing[e] = outgoing.get(e, 0) + int(
+                            phase["per_edge_bytes"]
+                        )
+            for e, nbytes in outgoing.items():
+                if nbytes <= 0:
+                    continue
+                per_edge.setdefault(e, []).append(nbytes / seconds / 1e9)
+                bytes_edge[e] = bytes_edge.get(e, 0) + nbytes
+    betas = edge_betas(topo) if topo else {}
+    links: Dict[str, Any] = {}
+    for e in sorted(per_edge):
+        src, dst = e
+        p50 = statistics.median(per_edge[e])
+        row = {
+            "src": src,
+            "dst": dst,
+            "samples": len(per_edge[e]),
+            "gbps_p50": p50,
+            "bytes": bytes_edge[e],
+        }
+        beta = betas.get(e)
+        if beta:
+            row["beta_gbps"] = beta
+            row["vs_probe"] = p50 / beta
+        links[edge_key(src, dst)] = row
+    return {"links": links}
+
+
+# ---------------------------------------------------------------------
+# rendering: heatmap, report, diff
+# ---------------------------------------------------------------------
+
+_HEAT_CHARS = " .:-=+*#%@"
+
+
+def render_heatmap(topo: Dict[str, Any]) -> List[str]:
+    """ASCII link heatmap: rows are source ranks, columns destination
+    ranks, each cell the edge's beta scaled onto ``' '..'@'`` against
+    the fastest measured link (``.`` is slowest, ``@`` fastest,
+    ``-`` on the diagonal, ``?`` for unmeasured edges)."""
+    world = int(topo.get("world") or 0)
+    betas = edge_betas(topo)
+    top = max(betas.values(), default=0.0)
+    lines = ["link beta heatmap (GB/s; rows=src, cols=dst; "
+             f"@ = {top:.3g} GB/s)"]
+    header = "     " + " ".join(f"{d:>2}" for d in range(world))
+    lines.append(header)
+    for src in range(world):
+        cells = []
+        for dst in range(world):
+            if src == dst:
+                cells.append(" -")
+                continue
+            beta = betas.get((src, dst))
+            if beta is None:
+                cells.append(" ?")
+            elif top <= 0:
+                cells.append(" ?")
+            else:
+                idx = min(
+                    len(_HEAT_CHARS) - 1,
+                    max(1, int(round(beta / top * (len(_HEAT_CHARS) - 1)))),
+                )
+                cells.append(" " + _HEAT_CHARS[idx])
+        lines.append(f"  {src:>2} " + " ".join(cells))
+    return lines
+
+
+def format_report(
+    topo: Dict[str, Any],
+    *,
+    links: Optional[Dict[str, Any]] = None,
+    factor: float = SLOW_LINK_FACTOR,
+) -> str:
+    """The human report: provenance line, heatmap, slow-link table,
+    and (when run artifacts joined) the measured per-link overlay."""
+    prov = topo.get("provenance") or {}
+    median = fleet_median_beta(topo)
+    out = [
+        f"topology: {SCHEMA} world={topo['world']} "
+        f"platform={topo.get('platform', '?')} "
+        f"edges={len(topo.get('edges') or {})} "
+        f"source={prov.get('source', '?')}"
+        + (f" fleet-median={median:.3g}GB/s" if median else ""),
+    ]
+    out.extend(render_heatmap(topo))
+    slow = slow_links(topo, factor=factor)
+    if slow:
+        out.append(f"slow links (< {factor:g}x fleet median):")
+        for row in slow:
+            out.append(
+                f"  {row['edge']:<8} {row['beta_gbps']:.3g} GB/s "
+                f"({row['ratio']:.2f}x median)"
+            )
+    else:
+        out.append(f"no slow links (every edge >= {factor:g}x fleet median)")
+    if links:
+        out.append(f"{'link':<8} {'probe':>9} {'run p50':>9} "
+                   f"{'vs':>6} {'samples':>8}")
+        for key in sorted(links, key=parse_edge):
+            row = links[key]
+            beta = row.get("beta_gbps")
+            vs = row.get("vs_probe")
+            out.append(
+                f"{key:<8} "
+                + (f"{beta:>7.3g}GB" if beta else f"{'-':>9}")
+                + f" {row['gbps_p50']:>7.3g}GB"
+                + (f" {vs:>5.2f}x" if vs else f" {'-':>6}")
+                + f" {row['samples']:>8}"
+            )
+    return "\n".join(out)
+
+
+def diff_maps(
+    a: Dict[str, Any],
+    b: Dict[str, Any],
+    *,
+    threshold: float = 0.2,
+) -> List[Dict[str, Any]]:
+    """Per-edge beta drift between two maps: edges whose beta moved by
+    more than ``threshold`` (relative), plus edges only one map has.
+    Sorted worst-regression first."""
+    ea, eb = edge_betas(a), edge_betas(b)
+    rows: List[Dict[str, Any]] = []
+    for e in sorted(set(ea) | set(eb)):
+        beta_a, beta_b = ea.get(e), eb.get(e)
+        if beta_a is None or beta_b is None:
+            rows.append({
+                "edge": edge_key(*e), "a_gbps": beta_a, "b_gbps": beta_b,
+                "change": "added" if beta_a is None else "removed",
+            })
+            continue
+        rel = (beta_b - beta_a) / beta_a
+        if abs(rel) >= threshold:
+            rows.append({
+                "edge": edge_key(*e), "a_gbps": beta_a, "b_gbps": beta_b,
+                "change": f"{rel:+.0%}",
+            })
+    def _sortkey(r):
+        if r["change"] in ("added", "removed"):
+            return (1, 0.0)
+        return (0, (r["b_gbps"] - r["a_gbps"]) / r["a_gbps"])
+    rows.sort(key=_sortkey)
+    return rows
+
+
+def format_diff(rows: List[Dict[str, Any]]) -> str:
+    if not rows:
+        return "topology diff: no edge drifted beyond the threshold"
+    out = ["topology diff (worst regression first):"]
+    for r in rows:
+        a = f"{r['a_gbps']:.3g}" if r.get("a_gbps") else "-"
+        b = f"{r['b_gbps']:.3g}" if r.get("b_gbps") else "-"
+        out.append(f"  {r['edge']:<8} {a:>8} -> {b:<8} GB/s  [{r['change']}]")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------
+# the in-world probe (imports the op layer lazily; jax required)
+# ---------------------------------------------------------------------
+
+
+def _sweep_edges(world: int) -> List[int]:
+    """The CartComm shift displacements the sweep times: 1 (the ring)
+    plus every other displacement (pairwise — rotation d covers every
+    directed edge ``r -> (r+d) % world``)."""
+    return list(range(1, world))
+
+
+def probe_rank(
+    out_dir: str,
+    *,
+    payloads: Sequence[int] = DEFAULT_PAYLOADS,
+    repeats: int = DEFAULT_REPEATS,
+    merge_timeout_s: float = 60.0,
+) -> Optional[str]:
+    """Run this rank's share of the sweep inside a launched world.
+
+    Every displacement ``d`` is a periodic :class:`..comm.CartComm`
+    shift: rank ``r`` sendrecv's with destination ``(r+d) % n`` and
+    source ``(r-d) % n`` — ``d=1`` is the ring sweep, ``d>1`` the
+    pairwise rotations, together covering every directed edge. Each
+    rank times its own calls (one untimed warmup per payload, then
+    ``repeats`` timed ones; the measured wall time is attributed to
+    the rank's *outgoing* edge), writes ``topo-rank{k}.json``, and
+    rank 0 merges every partial into ``DIR/topology.json`` (returned
+    on rank 0; the partial path elsewhere)."""
+    import platform as _platform
+
+    import numpy as np
+
+    import mpi4jax_tpu as m4t
+    from .. import config
+    from ..runtime import shm
+
+    rank, world = shm.rank(), shm.size()
+    if world < 2:
+        raise RuntimeError("topology probe needs a world of >= 2 ranks")
+    cart = m4t.CartComm([world], periods=True)
+    samples: Dict[Edge, List[Tuple[int, float]]] = {}
+    for disp in _sweep_edges(world):
+        source_table, dest_table = cart.shift(0, disp)
+        source, dest = source_table[rank], dest_table[rank]
+        for nbytes in payloads:
+            buf = np.zeros(max(1, int(nbytes)), dtype=np.uint8)
+            recv = np.empty_like(buf)
+            for i in range(max(1, int(repeats)) + 1):
+                t_start = time.perf_counter()
+                out = m4t.sendrecv(buf, recv, source, dest,
+                                   sendtag=disp, recvtag=disp)
+                np.asarray(out)  # force completion before stopping the clock
+                elapsed = time.perf_counter() - t_start
+                if i == 0:
+                    continue  # warmup
+                samples.setdefault((rank, dest), []).append(
+                    (int(nbytes), elapsed)
+                )
+    partial = {
+        "schema": f"{SCHEMA}-partial",
+        "rank": rank,
+        "world": world,
+        "host": _platform.node(),
+        "device_kind": config.PLATFORM_CLASS or "cpu",
+        "samples": {
+            edge_key(*e): [[n, t] for n, t in rows]
+            for e, rows in sorted(samples.items())
+        },
+    }
+    partial_path = os.path.join(out_dir, f"topo-rank{rank}.json")
+    fd, tmp = tempfile.mkstemp(prefix=".topo-", dir=out_dir)
+    with os.fdopen(fd, "w") as f:
+        json.dump(partial, f)
+    os.replace(tmp, partial_path)
+    # every rank reaches the same collective count above, so a barrier
+    # here means "all partials are durably renamed"
+    m4t.barrier()
+    if rank != 0:
+        return partial_path
+    return merge_partials(
+        out_dir, world, payloads=payloads, repeats=repeats,
+        timeout_s=merge_timeout_s,
+    )
+
+
+def merge_partials(
+    out_dir: str,
+    world: int,
+    *,
+    payloads: Sequence[int] = DEFAULT_PAYLOADS,
+    repeats: int = DEFAULT_REPEATS,
+    timeout_s: float = 60.0,
+) -> str:
+    """Merge per-rank ``topo-rank{k}.json`` partials into the fitted
+    ``DIR/topology.json`` map (polls briefly for stragglers so the
+    merge also works launcher-side, without a barrier)."""
+    deadline = time.monotonic() + max(0.0, float(timeout_s))
+    paths = {
+        r: os.path.join(out_dir, f"topo-rank{r}.json") for r in range(world)
+    }
+    while (
+        any(not os.path.exists(p) for p in paths.values())
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.05)
+    samples: Dict[Edge, List[Tuple[int, float]]] = {}
+    ranks: Dict[int, Dict[str, Any]] = {}
+    platform = "cpu"
+    for r, path in sorted(paths.items()):
+        if not os.path.exists(path):
+            raise RuntimeError(
+                f"topology probe: rank {r} partial never appeared in "
+                f"{out_dir}"
+            )
+        with open(path) as f:
+            partial = json.load(f)
+        ranks[r] = {
+            "host": partial.get("host", ""),
+            "device_kind": partial.get("device_kind", "cpu"),
+        }
+        platform = partial.get("device_kind", platform)
+        for key, rows in (partial.get("samples") or {}).items():
+            samples.setdefault(parse_edge(key), []).extend(
+                (int(n), float(t)) for n, t in rows
+            )
+    topo = build_map(
+        world, samples,
+        ranks=ranks,
+        platform=platform,
+        provenance={
+            "method": "sendrecv-sweep",
+            "source": "probe",
+            "payloads": [int(p) for p in payloads],
+            "repeats": int(repeats),
+        },
+    )
+    return save(os.path.join(out_dir, MAP_BASENAME), topo)
+
+
+# ---------------------------------------------------------------------
+# selftest (device-free)
+# ---------------------------------------------------------------------
+
+
+def selftest() -> int:
+    """Device-free proof over injectable synthetic link models: the
+    fit recovers planted alpha/beta, a planted slow link is detected
+    and localized to the correct directed edge, the doctor-facing
+    classifier splits link-bound from rank-bound, and a skewed map
+    flips the autotuner's impl choice vs the uniform-peak seed."""
+    # 1. the fit recovers a planted alpha/beta from a clean sweep
+    model = SyntheticLinkModel(4, alpha_s=3e-6, beta_gbps=18.0)
+    alpha, beta = fit_alpha_beta(model.samples()[(0, 1)])
+    assert abs(alpha - 3e-6) < 1e-9, alpha
+    assert abs(beta - 18.0) < 1e-6, beta
+
+    # degenerate sweeps degrade, not crash
+    alpha1, beta1 = fit_alpha_beta([(1 << 20, 1e-3)] * 3)
+    assert alpha1 == 0.0 and beta1 > 0, (alpha1, beta1)
+
+    # 2. a planted slow link is detected and localized
+    slow_edge = (2, 3)
+    skewed = SyntheticLinkModel(
+        4, beta_gbps=20.0, links={slow_edge: {"beta_gbps": 1.0}}
+    )
+    topo = synthetic_map(skewed)
+    validate(topo)
+    found = slow_links(topo)
+    assert len(found) == 1, found
+    assert (found[0]["src"], found[0]["dst"]) == slow_edge, found
+    assert found[0]["beta_gbps"] < 0.1 * found[0]["fleet_median_gbps"]
+
+    # round-trips through save/load unchanged
+    import tempfile as _tempfile
+
+    with _tempfile.TemporaryDirectory() as d:
+        path = save(os.path.join(d, MAP_BASENAME), topo)
+        assert load(path) == topo
+        assert find([d]) == topo
+
+    # 3. the classifier: the slow edge's ranks read link-bound, a
+    #    rank with healthy links reads rank-bound
+    verdict = classify_rank(topo, 2)
+    assert verdict is not None and verdict["klass"] == "link-bound", verdict
+    assert verdict["slowest_edge"] == edge_key(*slow_edge), verdict
+    verdict0 = classify_rank(topo, 0)
+    assert verdict0 is not None and verdict0["klass"] == "rank-bound", verdict0
+
+    # 4. the doctor join mutates straggler findings in place
+    from . import doctor
+
+    report = {"findings": [
+        {"kind": "straggler", "op": "AllReduce", "rank": 2,
+         "mean_s": 0.01, "peer_median_s": 0.002, "ratio": 5.0,
+         "samples": 8, "min_samples": 5, "peer_samples": {}},
+        {"kind": "hang", "rank": 1, "last_seq": 3},
+    ]}
+    joined = doctor.attach_link_classification(report, topo)
+    assert joined == 1, joined
+    diag = report["findings"][0]["link_diagnosis"]
+    assert diag["klass"] == "link-bound"
+    assert diag["slowest_edge"] == edge_key(*slow_edge)
+    txt = doctor._fmt_finding(report["findings"][0])
+    assert "link-bound" in txt and edge_key(*slow_edge) in txt, txt
+
+    # 5. per-link attribution joins latency x edge decomposition
+    by_rank = {}
+    world = 4
+    for r in range(world):
+        by_rank[r] = [
+            {"kind": "emission", "op": "AllReduce", "bytes": 1 << 20,
+             "dtype": "float32", "world": world, "axes": ["ranks"],
+             "seq": 1, "cid": f"c{r}", "t": 1.0},
+            {"kind": "latency", "op": "AllReduce", "cid": f"c{r}",
+             "seconds": 2e-3, "t": 1.1},
+        ]
+    attributed = attribute_links(by_rank, topo=topo)
+    # a ring AllReduce uses exactly the ring edges, one outgoing per rank
+    assert set(attributed["links"]) == {
+        edge_key(r, (r + 1) % world) for r in range(world)
+    }, attributed
+    row = attributed["links"][edge_key(0, 1)]
+    expected_gbps = (2 * (world - 1) * (1 << 20) / world) / 2e-3 / 1e9
+    assert abs(row["gbps_p50"] - expected_gbps) < 1e-9, row
+    assert row["vs_probe"] > 0
+
+    # 6. rendering is total: heatmap marks the slow edge colder than
+    #    its healthy mirror, report and diff never crash
+    heat = render_heatmap(topo)
+    assert len(heat) == 2 + world
+    row2 = heat[2 + slow_edge[0]]
+    cells = row2.split()[1:]
+    assert _HEAT_CHARS.index(cells[slow_edge[1]]) < _HEAT_CHARS.index(
+        cells[(slow_edge[1] + 1) % world]
+    ), heat
+    assert "slow links" in format_report(topo, links=attributed["links"])
+    uniform = synthetic_map(SyntheticLinkModel(4, beta_gbps=20.0))
+    drift = diff_maps(uniform, topo)
+    assert [r["edge"] for r in drift] == [edge_key(*slow_edge)], drift
+    assert format_diff(drift)
+
+    # 7. planner consumption: the skewed map flips an impl choice the
+    #    uniform-peak seed would have made (the acceptance flip —
+    #    tests/test_topology.py pins the same scenario end to end)
+    from ..planner import autotune, plan as _plan
+
+    key = _plan.plan_key(
+        "AllReduce", nbytes=12 << 20, dtype="float32", world=8,
+        axes=("a", "b"), platform="cpu",
+    )
+    mesh = {"a": 2, "b": 4}
+    plan_uniform, _ = autotune.sweep([key], mesh=mesh, gbps=20.0)
+    crossing = SyntheticLinkModel(
+        8, beta_gbps=20.0,
+        links={(0, 4): {"beta_gbps": 0.5}, (4, 0): {"beta_gbps": 0.5}},
+    )
+    plan_topo, _ = autotune.sweep(
+        [key], mesh=mesh, gbps=20.0, topo=synthetic_map(crossing)
+    )
+    assert plan_uniform.entries[key].impl != plan_topo.entries[key].impl, (
+        plan_uniform.entries[key], plan_topo.entries[key],
+    )
+    assert plan_topo.entries[key].beta_source == "topo-probe"
+
+    # 8. the OpenMetrics gauge family renders per-link samples
+    from . import export
+
+    text = export.render_openmetrics(
+        {"ranks": [0, 1], "records": 0},
+        topo_links=attributed["links"],
+    )
+    assert "m4t_topo_link_gbps" in text
+    assert 'src="0"' in text and 'dst="1"' in text
+
+    # 9. the CLI spec parser round-trips the planted skew
+    parsed = parse_synthetic_spec("beta=20,alpha_us=2,2->3=1", world=4)
+    assert parsed.params(2, 3) == (2e-6, 1.0)
+    assert parsed.params(3, 2) == (2e-6, 20.0)
+
+    print("topology selftest ok")
+    return 0
+
+
+# ---------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------
+
+
+def _parse_payloads(text: str) -> List[int]:
+    out = [int(p) for p in str(text).split(",") if p.strip()]
+    if not out or any(p <= 0 for p in out):
+        raise argparse.ArgumentTypeError(
+            f"--payloads must be positive byte counts (got {text!r})"
+        )
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi4jax_tpu.observability.topology",
+        description="Measured link maps: probe, report, diff.",
+    )
+    parser.add_argument(
+        "--selftest", action="store_true",
+        help="run the device-free synthetic-link selftest and exit",
+    )
+    sub = parser.add_subparsers(dest="cmd")
+
+    p_probe = sub.add_parser(
+        "probe",
+        help="sweep sendrecv over CartComm edges (inside a launched "
+        "world) or synthesize a map from a link model (device-free)",
+    )
+    p_probe.add_argument(
+        "--out", required=True, metavar="DIR_OR_FILE",
+        help="run directory the map is merged into (in-world probe) "
+        "or the output file (--synthetic)",
+    )
+    p_probe.add_argument(
+        "--payloads", type=_parse_payloads,
+        default=list(DEFAULT_PAYLOADS), metavar="N,N,...",
+        help="payload sizes to sweep, bytes (default "
+        f"{','.join(str(p) for p in DEFAULT_PAYLOADS)})",
+    )
+    p_probe.add_argument(
+        "--repeats", type=int, default=DEFAULT_REPEATS, metavar="K",
+        help="timed repetitions per (edge, payload) after one warmup "
+        "(default %(default)s)",
+    )
+    p_probe.add_argument(
+        "--synthetic", default=None, metavar="SPEC",
+        help="device-free: synthesize the map from a link model spec "
+        "('beta=20,alpha_us=2,2->3=1.5' — default beta GB/s, default "
+        "alpha us, per-edge beta overrides); requires --world",
+    )
+    p_probe.add_argument(
+        "--world", type=int, default=None, metavar="N",
+        help="world size for --synthetic",
+    )
+
+    p_report = sub.add_parser(
+        "report",
+        help="render a map: heatmap + slow links, optionally joined "
+        "with a run's measured per-link attribution",
+    )
+    p_report.add_argument("topo", help="topology.json (m4t-topo/1)")
+    p_report.add_argument(
+        "rundir", nargs="?", default=None,
+        help="run artifacts to overlay measured per-link GB/s from "
+        "(launch --events-dir layout)",
+    )
+    p_report.add_argument(
+        "--prom", default=None, metavar="OUT.prom",
+        help="additionally write the m4t_topo_link_gbps gauges as an "
+        "OpenMetrics exposition",
+    )
+    p_report.add_argument(
+        "--factor", type=float, default=SLOW_LINK_FACTOR, metavar="F",
+        help="slow-link threshold as a fraction of the fleet-median "
+        "beta (default %(default)s)",
+    )
+
+    p_diff = sub.add_parser(
+        "diff", help="per-edge beta drift between two maps",
+    )
+    p_diff.add_argument("a", help="older topology.json")
+    p_diff.add_argument("b", help="newer topology.json")
+    p_diff.add_argument(
+        "--threshold", type=float, default=0.2, metavar="F",
+        help="relative beta change worth reporting (default "
+        "%(default)s)",
+    )
+    p_diff.add_argument(
+        "--fail-on-drift", action="store_true",
+        help="exit 1 when any edge drifted (a CI tripwire)",
+    )
+
+    args = parser.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    if args.cmd is None:
+        parser.error("missing command (probe/report/diff/--selftest)")
+
+    if args.cmd == "probe":
+        if args.synthetic is not None:
+            if not args.world or args.world < 2:
+                parser.error("--synthetic requires --world >= 2")
+            model = parse_synthetic_spec(args.synthetic, world=args.world)
+            topo = synthetic_map(
+                model, payloads=args.payloads, repeats=args.repeats
+            )
+            out = args.out
+            if os.path.isdir(out):
+                out = os.path.join(out, MAP_BASENAME)
+            save(out, topo)
+            print(f"topology: synthetic map ({args.world} ranks, "
+                  f"{len(topo['edges'])} edges) written to {out}")
+            return 0
+        if os.environ.get("M4T_RANK") is None:
+            parser.error(
+                "probe must run inside a launched world (launch "
+                "--probe-topology / launch -n N -m "
+                "mpi4jax_tpu.observability.topology probe --out DIR) — "
+                "or pass --synthetic for a device-free map"
+            )
+        os.makedirs(args.out, exist_ok=True)
+        path = probe_rank(
+            args.out, payloads=args.payloads, repeats=args.repeats
+        )
+        if path and os.path.basename(path) == MAP_BASENAME:
+            topo = load(path)
+            print(f"topology: probed {topo['world']} ranks, "
+                  f"{len(topo['edges'])} edges -> {path}")
+            print(format_report(topo))
+        return 0
+
+    if args.cmd == "report":
+        topo = load(args.topo)
+        links = None
+        if args.rundir:
+            from . import doctor
+
+            by_rank = doctor.load([args.rundir])
+            if by_rank:
+                links = attribute_links(by_rank, topo=topo).get("links")
+        print(format_report(topo, links=links, factor=args.factor))
+        if args.prom:
+            from . import export
+
+            gauges = links if links is not None else {
+                edge_key(*e): {"gbps_p50": beta}
+                for e, beta in edge_betas(topo).items()
+            }
+            export.write_prom(
+                args.prom,
+                export.render_openmetrics(
+                    {"ranks": [], "records": 0}, topo_links=gauges
+                ),
+            )
+            print(f"# m4t_topo_link_gbps exposition written to {args.prom}")
+        return 0
+
+    if args.cmd == "diff":
+        rows = diff_maps(load(args.a), load(args.b), threshold=args.threshold)
+        print(format_diff(rows))
+        return 1 if rows and args.fail_on_drift else 0
+
+    return 2  # pragma: no cover — argparse exhausts the commands
+
+
+if __name__ == "__main__":
+    sys.exit(main())

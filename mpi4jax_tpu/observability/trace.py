@@ -17,7 +17,13 @@ process track per rank (``pid`` = rank, labeled ``rank N``), with
   sample that joins its emission (by cid, else seq) is divided into
   the cost model's expected wire bytes
   (``observability/costmodel.py``), so a degrading link shows up as
-  a falling "achieved GB/s" curve right in the timeline.
+  a falling "achieved GB/s" curve right in the timeline,
+- a **per-link counter track** (a dedicated "links" process): the
+  same cid joins decomposed onto the directed edges the collective's
+  algorithm rides (``costmodel.record_edge_phases`` — the topology
+  observatory's attribution math), one "link src->dst GB/s" counter
+  per measured edge, so *which link* degraded is visible without
+  leaving the timeline.
 
 **Merged serving trace** (``--serve SPOOL``): one Perfetto file for a
 whole spool of jobs. Every job gets its *own* process group — a
@@ -227,6 +233,65 @@ def _rank_events(
             )
 
 
+def _link_counter_events(
+    trace_events: List[Dict[str, Any]],
+    by_rank: Dict[int, List[Dict[str, Any]]],
+    *,
+    pid: int,
+    t0: float,
+) -> bool:
+    """Per-link achieved-GB/s counters: each latency sample that joins
+    its emission by cid is decomposed onto the directed edges the
+    collective's algorithm rides (``costmodel.record_edge_phases``);
+    the recording rank's outgoing-edge bytes over the measured seconds
+    is that link's achieved GB/s at that instant. One counter series
+    per edge on a dedicated "links" process, so the per-rank tracks
+    stay clean. Returns whether anything was emitted (the caller only
+    then labels the process)."""
+    emitted = False
+    for rank in sorted(by_rank):
+        by_cid: Dict[str, Dict[str, Any]] = {}
+        for rec in by_rank[rank]:
+            if rec.get("kind") in ("emission", "recorder") and rec.get("cid"):
+                by_cid.setdefault(rec["cid"], rec)
+        for rec in by_rank[rank]:
+            if rec.get("kind") != "latency":
+                continue
+            seconds = rec.get("seconds")
+            t = rec.get("t")
+            if not isinstance(seconds, (int, float)) or seconds <= 0:
+                continue
+            if not isinstance(t, (int, float)):
+                continue
+            emission = by_cid.get(rec.get("cid") or "")
+            if emission is None:
+                continue
+            outgoing: Dict[Any, int] = {}
+            for phase in costmodel.record_edge_phases(emission):
+                for src, dst in phase["edges"]:
+                    if src == rank:
+                        outgoing[(src, dst)] = (
+                            outgoing.get((src, dst), 0)
+                            + int(phase["per_edge_bytes"])
+                        )
+            for (src, dst), nbytes in sorted(outgoing.items()):
+                if nbytes <= 0:
+                    continue
+                trace_events.append(
+                    {
+                        "name": f"link {src}->{dst} GB/s",
+                        "ph": "C",
+                        "pid": pid,
+                        "ts": _micros(t, t0),
+                        "args": {
+                            "gbps": round(nbytes / seconds / 1e9, 6)
+                        },
+                    }
+                )
+                emitted = True
+    return emitted
+
+
 def build_trace(by_rank: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
     """Build the single-run Chrome trace-event object from
     rank-grouped records (the
@@ -245,6 +310,11 @@ def build_trace(by_rank: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
             trace_events, rank, f"rank {rank}", rank, _THREAD_NAMES
         )
         _rank_events(trace_events, by_rank[rank], pid=rank, t0=t0)
+    links_pid = (max(by_rank) + 1) if by_rank else 0
+    link_events: List[Dict[str, Any]] = []
+    if _link_counter_events(link_events, by_rank, pid=links_pid, t0=t0):
+        _process_meta(trace_events, links_pid, "links", links_pid, {})
+        trace_events.extend(link_events)
     return {
         "traceEvents": trace_events,
         "displayTimeUnit": "ms",

@@ -82,6 +82,16 @@ def _cmd_tune(args: argparse.Namespace) -> int:
                 "schema": autotune.TABLE_SCHEMA,
                 "gbps": {**table.get("gbps", {}), **measured.get("gbps", {})},
                 "keys": {**table.get("keys", {}), **measured.get("keys", {})},
+                "sources": {
+                    "gbps": {
+                        **(table.get("sources") or {}).get("gbps", {}),
+                        **(measured.get("sources") or {}).get("gbps", {}),
+                    },
+                    "keys": {
+                        **(table.get("sources") or {}).get("keys", {}),
+                        **(measured.get("sources") or {}).get("keys", {}),
+                    },
+                },
             }
             measured = merged
     if args.from_verdicts:
@@ -133,6 +143,20 @@ def _cmd_tune(args: argparse.Namespace) -> int:
             buckets=_parse_buckets(args.buckets),
             **grid,
         )
+    topo = None
+    if args.topo:
+        from ..observability import topology as _topology
+
+        try:
+            topo = _topology.load(args.topo)
+        except (OSError, ValueError) as exc:
+            print(f"tune: --topo {args.topo}: {exc}", file=sys.stderr)
+            return 2
+        print(
+            f"tune: pricing candidates over {len(topo.get('edges') or {})} "
+            f"measured link(s) from {args.topo}",
+            file=sys.stderr,
+        )
     planobj, report = autotune.sweep(
         keys,
         measured=measured,
@@ -141,6 +165,7 @@ def _cmd_tune(args: argparse.Namespace) -> int:
         gbps=args.peak_gbps,
         alpha=(args.alpha_us * 1e-6 if args.alpha_us is not None else None),
         prune=args.prune,
+        topo=topo,
     )
     cache = _cache_path(args)
     if cache and not args.dry_run:
@@ -651,6 +676,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--events", nargs="*", default=None, metavar="RUNDIR",
         help="run artifact dirs (launch --events-dir --perf): derive "
         "the measured table and the key set from real emissions",
+    )
+    p_tune.add_argument(
+        "--topo", default=None, metavar="TOPO.json",
+        help="measured m4t-topo/1 topology map (launch --probe-topology "
+        "or `topology probe`): candidates are priced over its per-edge "
+        "betas instead of the uniform peak, so a slow link can flip "
+        "the winning impl",
     )
     p_tune.add_argument(
         "--from-verdicts", nargs="*", default=None, metavar="RUNDIR",

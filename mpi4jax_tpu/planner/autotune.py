@@ -36,7 +36,26 @@ Measured-bandwidth table schema (``m4t-bwtable/1``)::
 
     {"schema": "m4t-bwtable/1",
      "gbps": {"hlo": 18.2, "pallas_ring": 31.0},          # per impl
-     "keys": {"<plan key>": {"hlo": 12.9, ...}}}          # overrides
+     "keys": {"<plan key>": {"hlo": 12.9, ...}},          # overrides
+     "sources": {"gbps": {"hlo": "attribution"},          # provenance
+                 "keys": {"<plan key>": {"hlo": "attribution"}}}}
+
+Rows additionally carry *provenance* (the optional ``sources``
+mirror): ``"attribution"`` for betas measured out of run artifacts,
+``"topo-probe"`` for betas derived from a measured topology map — so
+``planner show`` can say where a pinned decision's beta came from
+(:attr:`..plan.PlanEntry.beta_source`).
+
+When a ``m4t-topo/1`` map is supplied (``sweep(..., topo=...)`` /
+``planner tune --topo``), the analytic seed's uniform-peak beta term
+is replaced by an edge-aware path
+(``costmodel.expected_time_topo``): each candidate is priced over the
+*measured* per-link betas of the edges its algorithm actually rides,
+so a skewed topology can flip impl choices the uniform model would
+never flip (a flat ring beats hierarchical when the hierarchy's slow
+ring crosses a bad link, and vice versa). Measured attribution rows
+still override topo pricing where both exist — a real end-to-end
+measurement beats a model even an edge-aware one.
 """
 
 from __future__ import annotations
@@ -132,6 +151,22 @@ def _lookup_gbps(
     return None
 
 
+def _lookup_source(
+    table: Optional[Dict[str, Any]], key: str, impl: str
+) -> Optional[str]:
+    """Provenance of the table row :func:`_lookup_gbps` would return.
+    ``None`` for tables predating the ``sources`` mirror (hand-written
+    or legacy tables carry no provenance), so their pinned entries —
+    and plan fingerprints — are byte-identical to before the mirror
+    existed."""
+    sources = (table or {}).get("sources") or {}
+    per_key = (sources.get("keys") or {}).get(key) or {}
+    value = per_key.get(impl)
+    if value is None:
+        value = (sources.get("gbps") or {}).get(impl)
+    return str(value) if value else None
+
+
 def sweep(
     keys: Sequence[str],
     *,
@@ -141,12 +176,24 @@ def sweep(
     gbps: Optional[float] = None,
     alpha: Optional[float] = None,
     prune: float = DEFAULT_PRUNE,
+    topo: Optional[Dict[str, Any]] = None,
 ) -> Tuple[_plan.Plan, List[Dict[str, Any]]]:
     """Seed + refine + pin over ``keys``; returns ``(plan, report)``
     where ``report`` holds one row per key with every candidate's
-    analytic/measured time (the tune CLI's transcript)."""
+    analytic/measured time (the tune CLI's transcript).
+
+    ``topo`` is an optional ``m4t-topo/1`` map: candidates with an
+    edge decomposition are then priced over its per-edge betas
+    (``costmodel.expected_time_topo``) instead of the uniform peak,
+    and a winner the topo pricing decided carries
+    ``beta_source="topo-probe"``."""
     gbps = costmodel.peak_gbps() if gbps is None else float(gbps)
     alpha = costmodel.alpha_s() if alpha is None else float(alpha)
+    betas = None
+    if topo is not None:
+        from ..observability import topology as _topology
+
+        betas = _topology.edge_betas(_topology.validate(topo))
     platform = None
     entries: Dict[str, _plan.PlanEntry] = {}
     report: List[Dict[str, Any]] = []
@@ -164,14 +211,22 @@ def sweep(
                 info["op"], nbytes=nbytes, world=info["world"],
                 dtype=info["dtype"], impl=impl, params=params,
             )
-            rows.append({
+            row = {
                 "impl": impl,
                 "params": params,
                 "cost": c,
                 "analytic_s": costmodel.expected_time_s(
                     c, gbps=gbps, alpha=alpha
                 ),
-            })
+                "topo_s": None,
+            }
+            if betas is not None:
+                row["topo_s"] = costmodel.expected_time_topo(
+                    info["op"], nbytes=nbytes, world=info["world"],
+                    dtype=info["dtype"], impl=impl, params=params,
+                    betas=betas, gbps=gbps, alpha=alpha,
+                )
+            rows.append(row)
         best_analytic = min(r["analytic_s"] for r in rows)
         for r in rows:
             # the analytic best itself is never pruned (a prune factor
@@ -184,6 +239,10 @@ def sweep(
             r["time_s"] = r["analytic_s"]
             if r["pruned"]:
                 continue
+            if r["topo_s"] is not None:
+                # edge-aware pricing replaces the uniform-peak beta
+                # term for candidates the map can decompose
+                r["time_s"] = r["topo_s"]
             m = _lookup_gbps(measured, key, r["impl"])
             if m is not None:
                 r["measured_gbps"] = m
@@ -195,12 +254,26 @@ def sweep(
         source = "measured" if winner["measured_gbps"] is not None else "analytic"
         any_measured |= source == "measured"
         used_gbps = winner["measured_gbps"] if source == "measured" else gbps
+        beta_source = None
+        if source == "measured":
+            beta_source = _lookup_source(measured, key, winner["impl"])
+        elif winner["topo_s"] is not None:
+            beta_source = "topo-probe"
+            # the effective end-to-end bandwidth the per-edge betas
+            # imply for the pinned schedule (diagnostics)
+            span = winner["time_s"] - winner["cost"]["steps"] * alpha
+            used_gbps = (
+                winner["cost"]["wire_bytes"] / (span * 1e9)
+                if span > 0 and winner["cost"]["wire_bytes"] > 0
+                else None
+            )
         entries[key] = _plan.PlanEntry(
             impl=winner["impl"],
             params=dict(winner["params"]),
             source=source,
             expected_gbps=used_gbps,
             expected_s=winner["time_s"],
+            beta_source=beta_source,
         )
         report.append({
             "key": key,
@@ -208,7 +281,8 @@ def sweep(
             "source": source,
             "candidates": [
                 {k: r[k] for k in
-                 ("impl", "analytic_s", "measured_gbps", "time_s", "pruned")}
+                 ("impl", "analytic_s", "topo_s", "measured_gbps",
+                  "time_s", "pruned")}
                 for r in rows
             ],
         })
@@ -272,7 +346,10 @@ def measured_table_from_events(
     """Build a measured-bandwidth table from run artifacts (``launch
     --events-dir --perf`` layouts) through the PR 4 attribution join:
     per (plan key, impl) the median achieved GB/s, plus per-impl
-    medians as the cross-key fallback."""
+    medians as the cross-key fallback. Every row is stamped
+    ``"attribution"`` in the table's ``sources`` mirror (vs
+    ``"topo-probe"`` betas a topology map supplies), so ``planner
+    show`` can say where a pinned beta came from."""
     from ..observability import doctor, perf
 
     by_rank = doctor.load(list(inputs))
@@ -301,6 +378,13 @@ def measured_table_from_events(
                 for impl, v in sorted(impls.items())
             }
             for key, impls in sorted(per_key.items())
+        },
+        "sources": {
+            "gbps": {impl: "attribution" for impl in sorted(per_impl)},
+            "keys": {
+                key: {impl: "attribution" for impl in sorted(impls)}
+                for key, impls in sorted(per_key.items())
+            },
         },
     }
 

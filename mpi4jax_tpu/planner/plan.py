@@ -177,6 +177,11 @@ class PlanEntry:
     #: predicted bandwidth/time backing the decision (diagnostics)
     expected_gbps: Optional[float] = None
     expected_s: Optional[float] = None
+    #: where the beta term that priced the winner came from: None for
+    #: the uniform-peak analytic seed, ``"topo-probe"`` when a measured
+    #: topology map's per-edge betas did the pricing (``tune --topo``),
+    #: ``"attribution"`` when a measured-bandwidth table row did
+    beta_source: Optional[str] = None
 
     def to_json(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"impl": self.impl, "source": self.source}
@@ -186,6 +191,8 @@ class PlanEntry:
             out["expected_gbps"] = self.expected_gbps
         if self.expected_s is not None:
             out["expected_s"] = self.expected_s
+        if self.beta_source is not None:
+            out["beta_source"] = self.beta_source
         return out
 
     @classmethod
@@ -198,6 +205,7 @@ class PlanEntry:
             source=str(data.get("source", "analytic")),
             expected_gbps=data.get("expected_gbps"),
             expected_s=data.get("expected_s"),
+            beta_source=data.get("beta_source"),
         )
 
 
@@ -362,6 +370,8 @@ def summarize(planobj: Plan) -> List[str]:
             extra += " " + ",".join(f"{k}={v}" for k, v in sorted(e.params.items()))
         if e.expected_gbps is not None:
             extra += f" ~{e.expected_gbps:.3g}GB/s"
+        if e.beta_source is not None:
+            extra += f" beta:{e.beta_source}"
         lossy = " (lossy)" if e.impl in LOSSY_IMPLS else ""
         lines.append(f"{key} -> {e.impl}{lossy} [{e.source}]{extra}")
     return lines

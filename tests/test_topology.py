@@ -1,0 +1,599 @@
+"""Topology observatory (``mpi4jax_tpu/observability/topology.py``):
+measured link maps, per-link attribution, link-localized straggler
+diagnosis, and topo-aware planner tuning.
+
+Covers the acceptance surface:
+
+- alpha/beta fit recovery over injectable synthetic link models;
+- slow-link detection/localization matrix (which directed edge, how
+  slow vs the fleet median) and the link-bound vs rank-bound
+  classifier the doctor joins onto confirmed stragglers;
+- golden ``m4t-topo/1`` map pin (``tests/data/topo_golden.json``);
+- per-link attribution: cid-keyed latency x the cost model's
+  directed-edge decomposition -> achieved GB/s per link, exported as
+  OpenMetrics gauges and a Perfetto counter track;
+- planner consumption: ``tune --topo`` prices candidates over
+  per-edge betas and a planted slow link flips the winning impl vs
+  the uniform-peak seed (pinned, including ``beta_source``);
+- the ``m4t-bwtable/1`` ``sources`` provenance mirror;
+- the ``peak_gbps`` bad-``M4T_PEAK_GBPS`` warn-once fallback;
+- end-to-end: a real 2-rank ``launch --probe-topology`` run persists
+  a validated map with finite fitted betas.
+
+Regen the golden map pin after an intentional schema change::
+
+    python tests/test_topology.py --regen
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import textwrap
+import warnings
+
+import pytest
+
+from mpi4jax_tpu.observability import costmodel, doctor, export, topology
+from mpi4jax_tpu.planner import autotune, plan as planmod
+
+pytestmark = pytest.mark.topology
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "data", "topo_golden.json")
+
+#: the fixed synthetic probe the golden file pins: a 4-rank world at
+#: 20 GB/s with one 0.5 GB/s directed pair planted across 0<->2
+GOLDEN_SPEC = "beta=20,alpha_us=2,0->2=0.5,2->0=0.5"
+GOLDEN_WORLD = 4
+
+
+def golden_topo():
+    model = topology.parse_synthetic_spec(GOLDEN_SPEC, world=GOLDEN_WORLD)
+    return topology.synthetic_map(model)
+
+
+def skewed_topo(world=4, slow=((2, 3),), beta=20.0, slow_beta=1.0):
+    model = topology.SyntheticLinkModel(
+        world, beta_gbps=beta,
+        links={e: {"beta_gbps": slow_beta} for e in slow},
+    )
+    return topology.synthetic_map(model)
+
+
+# ---------------------------------------------------------------------
+# fit + map schema
+# ---------------------------------------------------------------------
+
+
+def test_fit_recovers_planted_alpha_beta():
+    model = topology.SyntheticLinkModel(4, alpha_s=3e-6, beta_gbps=18.0)
+    alpha, beta = topology.fit_alpha_beta(model.samples()[(0, 1)])
+    assert abs(alpha - 3e-6) < 1e-9
+    assert abs(beta - 18.0) < 1e-6
+
+
+def test_fit_degenerate_sweep_degrades_not_crashes():
+    # a single payload size cannot separate alpha from beta: the fit
+    # collapses to alpha=0 and prices everything as bandwidth
+    alpha, beta = topology.fit_alpha_beta([(1 << 20, 1e-3)] * 3)
+    assert alpha == 0.0 and beta > 0
+
+
+def test_map_schema_and_validate():
+    topo = golden_topo()
+    assert topo["schema"] == topology.SCHEMA == "m4t-topo/1"
+    assert topo["world"] == GOLDEN_WORLD
+    assert len(topo["edges"]) == GOLDEN_WORLD * (GOLDEN_WORLD - 1)
+    for edge in topo["edges"].values():
+        assert edge["beta_gbps"] > 0
+        assert edge["provenance"] == "synthetic"
+    assert topology.validate(topo) is topo
+
+
+@pytest.mark.parametrize("bad", [
+    None,
+    {"schema": "nope"},
+    {"schema": "m4t-topo/1", "world": 0},
+    {"schema": "m4t-topo/1", "world": 2, "edges": {"0->5": {"beta_gbps": 1}}},
+    {"schema": "m4t-topo/1", "world": 2, "edges": {"0->1": {"beta_gbps": 0}}},
+])
+def test_validate_rejects(bad):
+    with pytest.raises(ValueError):
+        topology.validate(bad)
+
+
+def test_save_load_find_roundtrip(tmp_path):
+    topo = golden_topo()
+    run = tmp_path / "run"
+    run.mkdir()
+    path = topology.save(str(run / topology.MAP_BASENAME), topo)
+    assert topology.load(path) == topo
+    assert topology.find([str(run)]) == topo
+    # a supervised run probes into the run root but the doctor reads
+    # per-attempt subdirectories: find() consults the parent too
+    attempt = run / "attempt01"
+    attempt.mkdir()
+    assert topology.find([str(attempt)]) == topo
+    assert topology.find([str(tmp_path / "elsewhere")]) is None
+
+
+def test_topo_golden_pin():
+    """The exact ``m4t-topo/1`` document for a fixed synthetic probe
+    is a contract (the doctor, the planner, and the CLI all consume
+    persisted maps); drift must be deliberate. Regen with
+    ``python tests/test_topology.py --regen``."""
+    got = golden_topo()
+    with open(GOLDEN) as f:
+        want = json.load(f)
+    assert got == want, (
+        "m4t-topo/1 schema drifted from tests/data/topo_golden.json; "
+        "if intentional, regen with `python tests/test_topology.py "
+        "--regen` and bump topology.SCHEMA if the layout changed"
+    )
+
+
+# ---------------------------------------------------------------------
+# slow-link detection / localization matrix
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("world,slow", [
+    (4, [(2, 3)]),                    # one directed edge
+    (4, [(2, 3), (3, 2)]),            # a symmetric pair
+    (8, [(0, 4)]),                    # group-crossing edge, larger world
+    (8, [(1, 2), (5, 6), (6, 5)]),    # several independent links
+])
+def test_slow_link_detection_matrix(world, slow):
+    topo = skewed_topo(world=world, slow=slow)
+    found = topology.slow_links(topo)
+    assert {(r["src"], r["dst"]) for r in found} == set(slow)
+    for row in found:
+        assert row["beta_gbps"] < topology.SLOW_LINK_FACTOR * (
+            row["fleet_median_gbps"]
+        )
+    # slowest-first ordering
+    assert [r["beta_gbps"] for r in found] == sorted(
+        r["beta_gbps"] for r in found
+    )
+
+
+def test_no_slow_links_on_uniform_fabric():
+    topo = skewed_topo(world=4, slow=())
+    assert topology.slow_links(topo) == []
+
+
+def test_classify_rank_link_bound_vs_rank_bound():
+    topo = skewed_topo(world=4, slow=[(2, 3)])
+    for rank in (2, 3):  # both endpoints of the slow edge
+        verdict = topology.classify_rank(topo, rank)
+        assert verdict["klass"] == "link-bound"
+        assert verdict["slowest_edge"] == "2->3"
+        assert verdict["slowest_edge_gbps"] < verdict["fleet_median_gbps"]
+    verdict = topology.classify_rank(topo, 0)
+    assert verdict["klass"] == "rank-bound"
+    assert topology.classify_rank({"schema": "m4t-topo/1", "world": 2,
+                                   "edges": {}}, 0) is None
+
+
+def test_doctor_join_names_the_slow_edge():
+    topo = skewed_topo(world=4, slow=[(2, 3)])
+    report = {"findings": [
+        {"kind": "straggler", "op": "AllReduce", "rank": 2,
+         "mean_s": 0.01, "peer_median_s": 0.002, "ratio": 5.0,
+         "samples": 8, "min_samples": 5, "peer_samples": {}},
+        {"kind": "straggler", "op": "AllReduce", "rank": 0,
+         "mean_s": 0.01, "peer_median_s": 0.002, "ratio": 5.0,
+         "samples": 8, "min_samples": 5, "peer_samples": {}},
+        {"kind": "hang", "rank": 1, "last_seq": 3},
+    ]}
+    assert doctor.attach_link_classification(report, topo) == 2
+    link, rank_b, hang = report["findings"]
+    assert link["link_diagnosis"]["klass"] == "link-bound"
+    assert rank_b["link_diagnosis"]["klass"] == "rank-bound"
+    assert "link_diagnosis" not in hang
+    txt = doctor._fmt_finding(link)
+    assert "link-bound" in txt and "2->3" in txt
+    assert "rank-bound" in doctor._fmt_finding(rank_b)
+
+
+def test_doctor_cli_auto_detects_map_beside_inputs(tmp_path):
+    # straggler logs + a persisted map in the same dir: the CLI joins
+    # them without --topo (topology.find auto-detection)
+    rundir = tmp_path / "run"
+    rundir.mkdir()
+    world = 4
+    for r in range(world):
+        recs = []
+        for s in range(1, 7):
+            recs.append({
+                "kind": "emission", "rank": r, "seq": s, "op": "AllReduce",
+                "shape": [8], "dtype": "float32", "axes": ["ranks"],
+                "world": world, "bytes": 1 << 20, "cid": f"c{s:04d}",
+                "t": 100.0 + s,
+            })
+            recs.append({
+                "kind": "latency", "rank": r, "op": "AllReduce",
+                "seconds": 0.05 if r == 2 else 0.001, "cid": f"c{s:04d}",
+                "t": 100.0 + s,
+            })
+        with open(rundir / f"events-rank{r}.jsonl", "w") as f:
+            for rec in recs:
+                f.write(json.dumps(rec) + "\n")
+    topology.save(str(rundir / topology.MAP_BASENAME),
+                  skewed_topo(world=world, slow=[(2, 3)]))
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.observability.doctor",
+         str(rundir)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    out = res.stdout + res.stderr
+    assert "link-bound" in out, out
+    assert "2->3" in out
+
+
+# ---------------------------------------------------------------------
+# edge decomposition + topo-aware cost
+# ---------------------------------------------------------------------
+
+
+def test_ring_edge_phases_conserve_wire_bytes():
+    n, b = 8, 1 << 20
+    phases = costmodel.edge_phases("AllReduce", nbytes=b, world=n)
+    # per-rank outgoing wire of a ring all-reduce: 2(n-1)b/n
+    out0 = sum(p["per_edge_bytes"] for p in phases
+               for (src, _dst) in p["edges"] if src == 0)
+    assert out0 == 2 * (n - 1) * b // n
+    assert costmodel.edge_phases("AllReduce", nbytes=b, world=1) == []
+    assert costmodel.edge_phases("AllReduce", nbytes=0, world=n) == []
+
+
+def test_expected_time_topo_slowest_edge_dominates():
+    n, b = 4, 1 << 20
+    uniform = costmodel.expected_time_topo(
+        "AllReduce", nbytes=b, world=n, betas={}, gbps=20.0, alpha=0.0)
+    slowed = costmodel.expected_time_topo(
+        "AllReduce", nbytes=b, world=n,
+        betas={(2, 3): 1.0}, gbps=20.0, alpha=0.0)
+    assert slowed > uniform
+    # every phase's drain is gated by the planted 1 GB/s edge
+    per_hop = (2 * (n - 1) * b / n) / n  # bytes per hop... gated hops
+    assert slowed >= per_hop / 1e9  # at least one hop at 1 GB/s
+    assert costmodel.expected_time_topo(
+        "Send", nbytes=b, world=n, betas={}, gbps=20.0) is None
+
+
+# ---------------------------------------------------------------------
+# per-link attribution
+# ---------------------------------------------------------------------
+
+
+def _attribution_world(world=4, nbytes=1 << 20, seconds=2e-3):
+    by_rank = {}
+    for r in range(world):
+        by_rank[r] = [
+            {"kind": "emission", "op": "AllReduce", "bytes": nbytes,
+             "dtype": "float32", "world": world, "axes": ["ranks"],
+             "seq": 1, "cid": f"c{r}", "rank": r, "t": 1.0},
+            {"kind": "latency", "op": "AllReduce", "cid": f"c{r}",
+             "seconds": seconds, "rank": r, "t": 1.1},
+        ]
+    return by_rank
+
+
+def test_attribute_links_ring_math():
+    world, nbytes, seconds = 4, 1 << 20, 2e-3
+    topo = skewed_topo(world=world, slow=())
+    out = topology.attribute_links(_attribution_world(), topo=topo)
+    assert set(out["links"]) == {
+        f"{r}->{(r + 1) % world}" for r in range(world)
+    }
+    row = out["links"]["0->1"]
+    expected = (2 * (world - 1) * nbytes / world) / seconds / 1e9
+    assert abs(row["gbps_p50"] - expected) < 1e-9
+    assert row["samples"] == 1
+    assert row["beta_gbps"] == pytest.approx(20.0)
+    assert row["vs_probe"] == pytest.approx(expected / row["beta_gbps"])
+
+
+def test_openmetrics_per_link_gauges():
+    out = topology.attribute_links(_attribution_world())
+    text = export.render_openmetrics(
+        {"ranks": [0, 1, 2, 3], "records": 8}, topo_links=out["links"])
+    assert "# TYPE m4t_topo_link_gbps gauge" in text
+    assert 'm4t_topo_link_gbps{dst="1",src="0"}' in text
+    assert 'm4t_topo_link_probe_gbps' in text
+    assert text.rstrip().endswith("# EOF")
+
+
+def test_trace_gains_links_counter_track():
+    from mpi4jax_tpu.observability import trace
+
+    doc = trace.build_trace(_attribution_world())
+    counters = [e for e in doc["traceEvents"] if e.get("ph") == "C"
+                and str(e.get("name", "")).startswith("link ")]
+    assert counters, doc["traceEvents"][:5]
+    names = {e["name"] for e in counters}
+    assert "link 0->1 GB/s" in names
+    links_pid = counters[0]["pid"]
+    assert links_pid == max(_attribution_world()) + 1
+    meta = [e for e in doc["traceEvents"]
+            if e.get("ph") == "M" and e.get("pid") == links_pid
+            and e.get("name") == "process_name"]
+    assert meta and meta[0]["args"]["name"] == "links"
+    for e in counters:
+        assert e["args"]["gbps"] > 0
+
+
+# ---------------------------------------------------------------------
+# planner consumption: the acceptance flip
+# ---------------------------------------------------------------------
+
+
+FLIP_KEY = planmod.plan_key(
+    "AllReduce", nbytes=12 << 20, dtype="float32", world=8,
+    axes=("a", "b"), platform="cpu",
+)
+FLIP_MESH = {"a": 2, "b": 4}
+
+
+def _crossing_topo():
+    model = topology.SyntheticLinkModel(
+        8, beta_gbps=20.0,
+        links={(0, 4): {"beta_gbps": 0.5}, (4, 0): {"beta_gbps": 0.5}},
+    )
+    return topology.synthetic_map(model)
+
+
+def test_sweep_topo_flips_impl_choice():
+    """Acceptance: a synthetic skewed topology measurably changes the
+    planner's impl choice vs the uniform-peak analytic seed, and the
+    winner records where its beta came from."""
+    plan_uniform, _ = autotune.sweep([FLIP_KEY], mesh=FLIP_MESH, gbps=20.0)
+    plan_topo, report = autotune.sweep(
+        [FLIP_KEY], mesh=FLIP_MESH, gbps=20.0, topo=_crossing_topo())
+    uniform_entry = plan_uniform.entries[FLIP_KEY]
+    topo_entry = plan_topo.entries[FLIP_KEY]
+    # pin the exact flip: the uniform seed picks the hierarchical
+    # reduction (it minimizes steps), the skewed map rejects it
+    # because its slow phase rides the planted 0.5 GB/s crossing
+    assert uniform_entry.impl == "hierarchical"
+    assert topo_entry.impl != "hierarchical"
+    assert uniform_entry.beta_source is None
+    assert topo_entry.beta_source == "topo-probe"
+    (row,) = [r for r in report if r["key"] == FLIP_KEY]
+    priced = [c for c in row["candidates"] if c["topo_s"] is not None]
+    assert priced, row
+    hier = [c for c in row["candidates"] if c["impl"] == "hierarchical"]
+    assert hier and (hier[0]["pruned"] or hier[0]["topo_s"]
+                     > min(c["topo_s"] for c in priced))
+
+
+def test_sweep_measured_attribution_overrides_topo():
+    # measured attribution data wins over probe-derived pricing, and
+    # the provenance pin says so
+    table = {
+        "schema": autotune.TABLE_SCHEMA,
+        "gbps": {},
+        "keys": {FLIP_KEY: {"hierarchical": 100.0}},
+        "sources": {"gbps": {}, "keys": {FLIP_KEY: {"hierarchical":
+                                                    "attribution"}}},
+    }
+    plan_both, _ = autotune.sweep(
+        [FLIP_KEY], mesh=FLIP_MESH, gbps=20.0, topo=_crossing_topo(),
+        measured=table,
+    )
+    entry = plan_both.entries[FLIP_KEY]
+    assert entry.impl == "hierarchical"
+    assert entry.source == "measured"
+    assert entry.beta_source == "attribution"
+
+
+def test_plan_entry_beta_source_roundtrip():
+    entry = planmod.PlanEntry(
+        impl="hlo", source="analytic", expected_gbps=5.0,
+        beta_source="topo-probe",
+    )
+    again = planmod.PlanEntry.from_json(entry.to_json())
+    assert again.beta_source == "topo-probe"
+    # absent stays absent: old plan files keep loading and old plan
+    # fingerprints stay stable
+    legacy = planmod.PlanEntry(impl="hlo", source="analytic")
+    assert "beta_source" not in legacy.to_json()
+    assert planmod.PlanEntry.from_json(legacy.to_json()).beta_source is None
+
+
+def test_bwtable_sources_schema_pin(tmp_path):
+    """The extended ``m4t-bwtable/1`` layout: float rows unchanged
+    (old readers keep working), provenance in a parallel ``sources``
+    mirror stamped ``attribution``."""
+    world, nbytes = 2, 1 << 20
+    for r in range(world):
+        with open(tmp_path / f"events-rank{r}.jsonl", "w") as f:
+            for rec in [
+                {"kind": "emission", "rank": r, "seq": 1, "op": "AllReduce",
+                 "shape": [nbytes // 4], "dtype": "float32",
+                 "axes": ["ranks"], "world": world, "bytes": nbytes,
+                 "cid": "c0001", "t": 100.0},
+                {"kind": "latency", "rank": r, "op": "AllReduce",
+                 "seconds": 1e-3, "cid": "c0001", "t": 100.1},
+            ]:
+                f.write(json.dumps(rec) + "\n")
+    table = autotune.measured_table_from_events(
+        [str(tmp_path)], platform="cpu")
+    assert table["schema"] == "m4t-bwtable/1"
+    assert sorted(table) == ["gbps", "keys", "schema", "sources"]
+    assert table["keys"], table
+    assert sorted(table["sources"]) == ["gbps", "keys"]
+    for impl, src in table["sources"]["gbps"].items():
+        assert src == "attribution"
+        assert isinstance(table["gbps"][impl], float)
+    for key, impls in table["sources"]["keys"].items():
+        assert set(impls.values()) == {"attribution"}
+        assert set(table["keys"][key]) == set(impls)
+
+
+# ---------------------------------------------------------------------
+# peak_gbps env fallback (costmodel satellite)
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("raw", ["abc", "-3"])
+def test_peak_gbps_bad_env_warns_once_and_falls_back(monkeypatch, raw):
+    monkeypatch.setenv("M4T_PEAK_GBPS", raw)
+    monkeypatch.setattr(costmodel, "_WARNED_PEAK", set())
+    with pytest.warns(RuntimeWarning, match="M4T_PEAK_GBPS"):
+        got = costmodel.peak_gbps("tpu v5e")
+    # the typo'd override must not poison the figure: generation table
+    assert got == costmodel.ICI_PEAK_GBPS["v5e"]
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # second call: warn-once
+        assert costmodel.peak_gbps("tpu v5e") == got
+
+
+def test_peak_gbps_empty_env_is_silent(monkeypatch):
+    monkeypatch.setenv("M4T_PEAK_GBPS", "")
+    monkeypatch.setattr(costmodel, "_WARNED_PEAK", set())
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert costmodel.peak_gbps("tpu v5e") == (
+            costmodel.ICI_PEAK_GBPS["v5e"])
+    monkeypatch.setenv("M4T_PEAK_GBPS", "123.5")
+    assert costmodel.peak_gbps() == 123.5
+
+
+# ---------------------------------------------------------------------
+# CLI: selftest, probe -> report -> tune --topo round trip
+# ---------------------------------------------------------------------
+
+
+def _topology_cli(*args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.observability.topology",
+         *args],
+        capture_output=True, text=True, cwd=cwd,
+    )
+
+
+def test_cli_selftest():
+    res = _topology_cli("--selftest")
+    assert res.returncode == 0, res.stderr
+    assert "topology selftest ok" in res.stdout
+
+
+def test_cli_probe_report_tune_roundtrip(tmp_path):
+    res = _topology_cli(
+        "probe", "--synthetic", "beta=20,0->4=0.5,4->0=0.5",
+        "--world", "8", "--out", str(tmp_path),
+    )
+    assert res.returncode == 0, res.stderr
+    mappath = str(tmp_path / topology.MAP_BASENAME)
+    topo = topology.load(mappath)
+    assert topo["world"] == 8
+
+    res = _topology_cli("report", mappath)
+    assert res.returncode == 0, res.stderr
+    assert "0->4" in res.stdout and "slow links" in res.stdout
+
+    res = _topology_cli("diff", mappath, mappath)
+    assert res.returncode == 0, res.stderr
+
+    tune = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.planner", "tune",
+         "--dry-run", "--json", "--world", "8", "--axes", "a,b",
+         "--mesh", "a=2,b=4", "--ops", "AllReduce",
+         "--topo", mappath],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert tune.returncode == 0, tune.stderr
+    doc = json.loads(tune.stdout)
+    entries = doc["plan"]["entries"]
+    assert any(e.get("beta_source") == "topo-probe"
+               for e in entries.values()), entries
+    assert "pricing candidates over" in tune.stderr
+
+    # a bad map is a clean exit-2, not a traceback
+    bad = tmp_path / "bad.json"
+    bad.write_text("{}")
+    tune = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.planner", "tune",
+         "--dry-run", "--topo", str(bad)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert tune.returncode == 2
+    assert "--topo" in tune.stderr
+
+
+# ---------------------------------------------------------------------
+# end-to-end: a real 2-rank probe on CPU
+# ---------------------------------------------------------------------
+
+
+needs_native = pytest.mark.skipif(
+    subprocess.run(["which", "g++"], capture_output=True).returncode != 0,
+    reason="no C++ toolchain",
+)
+
+
+@needs_native
+def test_launch_probe_topology_e2e(tmp_path):
+    """A real ``launch -n 2 --probe-topology`` world: the probe
+    sendrecv sweep runs before the workload, persists a validated
+    ``m4t-topo/1`` map with finite positive fitted betas for both
+    directed edges, and the workload still completes."""
+    rundir = str(tmp_path / "run")
+    path = str(tmp_path / "case.py")
+    with open(path, "w") as f:
+        f.write(f"import sys; sys.path.insert(0, {REPO!r})\n")
+        f.write(textwrap.dedent("""
+            import jax.numpy as jnp
+            import mpi4jax_tpu as m4t
+            from mpi4jax_tpu.runtime import shm
+            x = m4t.allreduce(jnp.arange(4.0) + shm.rank())
+            m4t.barrier()
+            print(f"OK{shm.rank()}")
+        """))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.launch", "-n", "2",
+         "--events-dir", rundir, "--probe-topology", path],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert res.returncode == 0, res.stderr
+    assert "OK0" in res.stdout and "OK1" in res.stdout
+    assert "topology probe" in res.stderr
+    topo = topology.load(os.path.join(rundir, topology.MAP_BASENAME))
+    assert topo["world"] == 2
+    assert set(topo["edges"]) == {"0->1", "1->0"}
+    for edge in topo["edges"].values():
+        assert math.isfinite(edge["beta_gbps"]) and edge["beta_gbps"] > 0
+        assert math.isfinite(edge["alpha_s"]) and edge["alpha_s"] >= 0
+        assert edge["samples"] >= 3
+        assert edge["provenance"].startswith("probe:")
+    # the probed map feeds straight into the offline doctor join
+    report = doctor.diagnose([rundir])
+    doctor.attach_link_classification(report, topo)
+
+
+def test_probe_topology_requires_events_dir():
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.launch", "-n", "2",
+         "--probe-topology", "nosuch.py"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert res.returncode == 2
+    assert "--events-dir" in res.stderr
+
+
+if __name__ == "__main__":
+    if "--regen" in sys.argv:
+        os.makedirs(os.path.dirname(GOLDEN), exist_ok=True)
+        with open(GOLDEN, "w") as f:
+            json.dump(golden_topo(), f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"regenerated {GOLDEN}")
+    else:
+        sys.exit(pytest.main([__file__, "-v"]))
