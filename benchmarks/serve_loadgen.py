@@ -34,6 +34,18 @@ Three modes:
   N-server drain wall clock; the run fails if any id is lost or
   double-finished (the federation's whole point).
 
+- ``--profile``: the control-plane observatory variant (PR 17). The
+  stub job mix is drained twice — disarmed, then armed with
+  ``M4T_CP_PROFILE=1`` (``serving/profile.py``) — and the record
+  carries the armed drain wall (headline ``value``), the profiler's
+  measured ``overhead_pct`` vs the disarmed drain, the per-job
+  queue-wait decomposition (coverage must be >= 90% or the run
+  fails), the syscall budget (fsyncs/renames/dir-scans per job), and
+  the wasted-wakeup ratio. This is the ``serve_controlplane``
+  trajectory: a control-plane regression (an extra fsync, a poll
+  loop gone wasteful) moves a named field here before it moves
+  total drain time anywhere else.
+
 Emits the benchmark JSON line on stdout (the BENCH ``parsed`` record)
 and, with ``--out BENCH_rNN_serve[_warm|_federated].json``, the full
 round wrapper — the ``serve`` / ``serve_warm`` / ``serve_federated``
@@ -42,6 +54,7 @@ variant trajectories ``perf gate`` covers::
     python benchmarks/serve_loadgen.py --jobs 24 --out BENCH_r10_serve.json
     python benchmarks/serve_loadgen.py --warm --out BENCH_r11_serve_warm.json
     python benchmarks/serve_loadgen.py --servers 2 --out BENCH_r14_serve_federated.json
+    python benchmarks/serve_loadgen.py --profile --out BENCH_r17_serve_controlplane.json
     python -m mpi4jax_tpu.observability.perf gate --variant serve_federated
 """
 
@@ -61,6 +74,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 METRIC = "serve_loadgen_drain"
 METRIC_WARM = "serve_loadgen_warm_drain"
 METRIC_FED = "serve_loadgen_federated_drain"
+METRIC_CP = "serve_loadgen_controlplane_drain"
 
 #: the --warm job payload: a job that pays what real serving jobs pay
 #: (python + jax + package import) cold, and nothing warm
@@ -151,14 +165,20 @@ def run_loadgen(jobs: int, tenants: int, nproc: int, *, stub: bool,
         # per-stage breakdown from the lifecycle spans (PR 12): the
         # dispatch stage is queue-machinery time the queue-wait and
         # run numbers both hide — a control-plane regression shows up
-        # here first, before total drain time moves
-        dispatch = sorted(
-            float(s.get("dur_s") or 0.0)
-            for s in spool.span_records()
-            if s.get("span") == "dispatch"
-        )
+        # here first, before total drain time moves. One definition,
+        # shared with `serving profile` (tests pin them equal).
+        from mpi4jax_tpu.serving import profile as cp_profile
+
+        span_records = spool.span_records()
+        dispatch = cp_profile.dispatch_durations(span_records)
+        cp = None
+        if cp_profile.profile_paths(spool.root):
+            cp = cp_profile.profile_report(
+                spool.root, spans=span_records,
+            )
         completed = len(waits)
         return {
+            "cp": cp,
             "rc": rc,
             "wall_s": wall_s,
             "accepted": accepted,
@@ -282,6 +302,13 @@ def main(argv=None) -> int:
                         "and then N registered serve loops sharing "
                         "the spool (the serve_federated BENCH "
                         "variant)")
+    parser.add_argument("--profile", action="store_true",
+                        help="control-plane observatory: the stub mix "
+                        "drained disarmed then armed with "
+                        "M4T_CP_PROFILE, recording the profiler's "
+                        "overhead, the queue-wait decomposition, and "
+                        "the syscall budget (the serve_controlplane "
+                        "BENCH variant)")
     parser.add_argument("--out", default=None, metavar="BENCH.json",
                         help="also write the BENCH round wrapper here")
     parser.add_argument("--round", type=int, default=None,
@@ -341,6 +368,98 @@ def main(argv=None) -> int:
         }
         if (fed["lost"] or fed["duplicate_ids"]
                 or solo["lost"] or solo["duplicate_ids"]):
+            result["rc"] = max(result["rc"], 1)
+    elif args.profile:
+        from mpi4jax_tpu.serving import profile as cp_mod
+
+        # disarmed baseline first, then the armed drain: same stub
+        # mix, same process, only M4T_CP_PROFILE differs — the wall
+        # delta IS the profiler's overhead
+        prev_env = os.environ.pop(cp_mod.ENV_VAR, None)
+        cp_mod.disarm()
+        try:
+            base = run_loadgen(
+                args.jobs, args.tenants, args.nproc,
+                stub=True, queue_cap=cap,
+            )
+            os.environ[cp_mod.ENV_VAR] = "1"
+            armed = run_loadgen(
+                args.jobs, args.tenants, args.nproc,
+                stub=True, queue_cap=cap,
+            )
+        finally:
+            cp_mod.disarm()
+            if prev_env is None:
+                os.environ.pop(cp_mod.ENV_VAR, None)
+            else:
+                os.environ[cp_mod.ENV_VAR] = prev_env
+        cp = armed["cp"] or {}
+        dec = cp.get("decomposition") or {}
+        sc = cp.get("syscalls") or {}
+        wk = (cp.get("wakeups") or {}).get("server") or {}
+        overhead_pct = (
+            100.0 * (armed["wall_s"] - base["wall_s"]) / base["wall_s"]
+            if base["wall_s"] > 0 else None
+        )
+        coverage_ok = bool(
+            dec.get("jobs")
+            and dec.get("complete") == dec.get("jobs")
+            and (dec.get("coverage_p50") or 0.0) >= 0.90
+        )
+        print(
+            f"# serve_loadgen [controlplane]: {armed['completed']}/"
+            f"{armed['accepted']} job(s): disarmed {base['wall_s']:.2f}s "
+            f"vs armed {armed['wall_s']:.2f}s "
+            f"({(overhead_pct or 0.0):+.1f}% overhead); decomposition "
+            f"{dec.get('complete')}/{dec.get('jobs')} exact, coverage "
+            f"p50 {dec.get('coverage_p50', 0):.1%}; "
+            f"{sc.get('fsyncs_per_job')} fsyncs/job; wasted wakeups "
+            f"{(wk.get('wasted_ratio') or 0):.0%}; rc base={base['rc']} "
+            f"armed={armed['rc']}",
+            file=sys.stderr,
+        )
+        record = {
+            "metric": METRIC_CP,
+            "value": round(armed["wall_s"], 3),
+            "unit": "s",
+            "vs_baseline": None,
+            "nproc": args.nproc,
+            "fused": None,
+            "jobs": args.jobs,
+            "mode": "controlplane",
+            "disarmed_wall_s": round(base["wall_s"], 3),
+            "overhead_pct": (
+                round(overhead_pct, 2)
+                if overhead_pct is not None else None
+            ),
+            "jobs_per_hour": round(armed["jobs_per_hour"], 1),
+            "queue_wait_p50_s": round(armed["queue_wait_p50_s"], 4),
+            "queue_wait_p99_s": round(armed["queue_wait_p99_s"], 4),
+            **_stage_fields(armed),
+            "cp_records": cp.get("records"),
+            "decomposition_jobs": dec.get("jobs"),
+            "decomposition_complete": dec.get("complete"),
+            "coverage_p50": dec.get("coverage_p50"),
+            "coverage_min": dec.get("coverage_min"),
+            "phase_p50_s": {
+                k: (round(v, 6) if v is not None else None)
+                for k, v in (dec.get("phase_p50_s") or {}).items()
+            },
+            "fsyncs_per_job": sc.get("fsyncs_per_job"),
+            "renames_per_job": sc.get("renames_per_job"),
+            "dir_scans_per_job": sc.get("dir_scans_per_job"),
+            "wasted_wakeup_ratio": wk.get("wasted_ratio"),
+            "claim_races_lost": (cp.get("claims") or {}).get("lost", 0),
+        }
+        result = {
+            **armed,
+            "rc": max(base["rc"], armed["rc"]),
+            "completed": min(base["completed"], armed["completed"]),
+            "accepted": max(base["accepted"], armed["accepted"]),
+        }
+        if not coverage_ok:
+            # a decomposition that stopped telescoping (or stopped
+            # covering) is the regression this variant exists to catch
             result["rc"] = max(result["rc"], 1)
     elif args.warm:
         cold = run_loadgen(
@@ -433,6 +552,7 @@ def main(argv=None) -> int:
                        f"--jobs {args.jobs} -n {args.nproc}"
                        + (" --stub" if args.stub else "")
                        + (" --warm" if args.warm else "")
+                       + (" --profile" if args.profile else "")
                        + (f" --servers {args.servers}"
                           if args.servers is not None else ""),
                 "rc": result["rc"],

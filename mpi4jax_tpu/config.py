@@ -129,6 +129,24 @@ Per-job distributed tracing (serving plane, ``docs/observability.md``
   ``job`` (set by the warm pool since PR 11; the cold spawn path sets
   it too now).
 
+Serving control plane (``serving/``, ``docs/serving.md`` "Profiling
+the control plane"):
+
+- ``M4T_CP_PROFILE``: truthy -> arm the control-plane micro-span
+  profiler (``serving/profile.py``): every spool submit/claim/finish
+  phase (fsync, rename, dir scan), scheduler pick, serve-loop and
+  pool-mailbox wakeup, lease renewal, and scavenger pass is stamped
+  with a monotonic-clock duration into ``SPOOL/cp_profile.jsonl``
+  (pool workers: ``SPOOL/pool/cp_profile.jsonl``). Unset, every hot
+  site pays one falsy check and the serving record schemas are
+  byte-identical to unarmed (drift-pinned). Read the sink back with
+  ``python -m mpi4jax_tpu.serving profile SPOOL``.
+- ``M4T_POOL_POLL_S``: float seconds -> warm-pool poll period: the
+  worker mailbox scan (default 0.02) and the controller result poll
+  (default 0.01). An explicit ``poll_s``/``--poll-interval`` argument
+  wins over the env; non-positive or malformed values warn and fall
+  back to the default.
+
 Flight recorder (``observability/recorder.py``):
 
 - ``M4T_FLIGHT_RECORDER``: set falsy to disable the always-cheap

@@ -1124,6 +1124,28 @@ def _print_slo_breaches(inputs: Iterable[str]) -> None:
         pass
 
 
+def _print_cp_profile(inputs: Iterable[str]) -> None:
+    """Narrate the control-plane profile (``SPOOL/cp_profile.jsonl``,
+    written when the server ran armed with ``M4T_CP_PROFILE=1``): each
+    job's queue wait decomposed into named phases ("71% scan wait +
+    18% submit fsync + 6% claim race lost"), the syscall budget, and
+    the wasted-wakeup / claim-contention summary. Best-effort, like
+    every other narration section."""
+    try:
+        from ..serving import profile as _cp
+
+        for path in inputs:
+            root = path if os.path.isdir(path) else os.path.dirname(path)
+            if not _cp.profile_paths(root):
+                continue
+            report = _cp.profile_report(root)
+            if report["records"]:
+                print(_cp.format_cp_narration(report))
+            return
+    except Exception:
+        pass
+
+
 # ---------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------
@@ -1241,6 +1263,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             if serving:
                 print(format_serving_timeline(serving))
                 _print_slo_breaches(args.inputs)
+                _print_cp_profile(args.inputs)
             return 0
         print("doctor: no usable records in the given inputs", file=sys.stderr)
         return 2
@@ -1322,6 +1345,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             # transitions, drain (mpi4jax_tpu/serving)
             print(format_serving_timeline(serving))
             _print_slo_breaches(args.inputs)
+            _print_cp_profile(args.inputs)
     if args.perf:
         from . import perf
 

@@ -37,7 +37,12 @@ attributed). Tracks are keyed by **(job, rank)** — two jobs' rank-0
 streams can never land on one track — and carry
 ``process_sort_index`` metadata ordering the file tenant-by-tenant,
 job-by-job, so Perfetto renders per-tenant groups with each job's
-per-rank activity nested under its ``run`` span.
+per-rank activity nested under its ``run`` span. When the spool was
+served armed (``M4T_CP_PROFILE=1``, ``serving/profile.py``), each
+serving loop / pool worker / the submit side additionally gets a
+``controlplane · <id>`` process track of its micro-spans (fsyncs,
+renames, dir scans, scheduler picks, poll wakeups), so "where did the
+queue wait go" is answerable on the same timeline as the job spans.
 
 Timestamps are microseconds relative to the earliest record across
 all inputs, so unsynchronized-but-same-host processes line up the way
@@ -373,7 +378,20 @@ def load_serve(spool_root: str) -> Dict[str, Any]:
                 spool_root, job, trace_id
             ),
         })
-    return {"jobs": jobs}
+    from ..serving import profile as _cp_profile
+
+    return {"jobs": jobs, "cp": _cp_profile.load_cp(spool_root)}
+
+
+def _cp_track_key(rec: Dict[str, Any]) -> str:
+    """Which control-plane track a cp micro-span renders on: the
+    serving loop that recorded it, a pool worker's mailbox plane, or
+    the submit side (client-process records carry neither id)."""
+    if rec.get("server"):
+        return f"server {rec['server']}"
+    if rec.get("worker") is not None:
+        return f"pool worker {rec['worker']}"
+    return "submit"
 
 
 def build_serve_trace(serve_data: Dict[str, Any]) -> Dict[str, Any]:
@@ -383,6 +401,7 @@ def build_serve_trace(serve_data: Dict[str, Any]) -> Dict[str, Any]:
     from . import spans as _spans
 
     jobs = serve_data.get("jobs") or []
+    cp_records = serve_data.get("cp") or []
     times: List[float] = []
     for job in jobs:
         for span in job.get("spans") or []:
@@ -394,6 +413,10 @@ def build_serve_trace(serve_data: Dict[str, Any]) -> Dict[str, Any]:
                 float(r["t"]) for r in recs
                 if isinstance(r.get("t"), (int, float))
             )
+    for rec in cp_records:
+        t = rec.get("t")
+        if isinstance(t, (int, float)):
+            times.append(float(t) - float(rec.get("dur_s") or 0.0))
     t0 = min(times) if times else 0.0
 
     trace_events: List[Dict[str, Any]] = []
@@ -442,22 +465,65 @@ def build_serve_trace(serve_data: Dict[str, Any]) -> Dict[str, Any]:
                 _THREAD_NAMES,
             )
             _rank_events(trace_events, by_rank[rank], pid=pid, t0=t0)
+
+    # control-plane tracks (M4T_CP_PROFILE micro-spans): one process
+    # per serving loop / pool worker / the submit side, rendered after
+    # the job blocks so the data plane stays on top
+    cp_by_track: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in cp_records:
+        if isinstance(rec.get("t"), (int, float)):
+            cp_by_track.setdefault(_cp_track_key(rec), []).append(rec)
+    cp_base = len(jobs) * JOB_PID_STRIDE
+    cp_tracks: List[Dict[str, Any]] = []
+    for i, track in enumerate(sorted(cp_by_track)):
+        pid = cp_base + i
+        _process_meta(
+            trace_events, pid, f"controlplane · {track}", pid,
+            {0: "micro-spans"},
+        )
+        cp_tracks.append({"track": track, "pid": pid,
+                          "records": len(cp_by_track[track])})
+        for rec in cp_by_track[track]:
+            dur = max(0.0, float(rec.get("dur_s") or 0.0))
+            args = {
+                k: rec[k]
+                for k in ("job", "tenant", "server", "worker", "item",
+                          "useful", "picked", "depth", "n", "epoch",
+                          "outcome", "items", "actions", "by")
+                if rec.get(k) is not None
+            }
+            trace_events.append(
+                {
+                    "name": rec.get("phase", "?"),
+                    "ph": "X",
+                    "pid": pid,
+                    "tid": 0,
+                    "ts": _micros(float(rec["t"]) - dur, t0),
+                    "dur": round(dur * 1e6, 1),
+                    "args": args,
+                }
+            )
+    other: Dict[str, Any] = {
+        "producer": "mpi4jax_tpu.observability.trace",
+        "jobs": [
+            {
+                "job": job.get("id"),
+                "tenant": job.get("tenant"),
+                "trace": job.get("trace"),
+                "pid": i * JOB_PID_STRIDE,
+                "ranks": sorted(job.get("by_rank") or {}),
+            }
+            for i, job in enumerate(jobs)
+        ],
+    }
+    if cp_tracks:
+        # armed-only key: an unarmed spool's export stays byte-identical
+        # to the PR 12 golden (tests/data/serve_trace_golden.json)
+        other["controlplane"] = cp_tracks
     return {
         "traceEvents": trace_events,
         "displayTimeUnit": "ms",
-        "otherData": {
-            "producer": "mpi4jax_tpu.observability.trace",
-            "jobs": [
-                {
-                    "job": job.get("id"),
-                    "tenant": job.get("tenant"),
-                    "trace": job.get("trace"),
-                    "pid": i * JOB_PID_STRIDE,
-                    "ranks": sorted(job.get("by_rank") or {}),
-                }
-                for i, job in enumerate(jobs)
-            ],
-        },
+        "otherData": other,
     }
 
 

@@ -272,6 +272,24 @@ def _cmd_reclaim(args) -> int:
     return 0
 
 
+def _cmd_profile(args) -> int:
+    from . import profile as cp_profile
+
+    report = cp_profile.profile_report(args.spool)
+    if not report["records"]:
+        print(
+            f"profile: no control-plane records under {args.spool} — "
+            f"arm with {cp_profile.ENV_VAR}=1 before serving",
+            file=sys.stderr,
+        )
+        return 2
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(cp_profile.format_report(report))
+    return 0
+
+
 def _cmd_drain(args) -> int:
     spool = Spool(args.spool)
     spool.request_drain(note=args.note or "")
@@ -817,8 +835,10 @@ def main(argv=None) -> int:
     p.add_argument("--queue-cap", type=int, default=None, metavar="C",
                    help="pin the bounded-queue capacity (submits past "
                    "it are rejected queue_full)")
-    p.add_argument("--poll", type=float, default=0.2, metavar="S",
-                   help="idle poll period (default %(default)s)")
+    p.add_argument("--poll", "--poll-interval", type=float,
+                   default=0.2, metavar="S", dest="poll",
+                   help="idle poll period between queue scans "
+                   "(default %(default)s)")
     p.add_argument("--max-jobs", type=int, default=None, metavar="N",
                    help="exit 0 after serving N jobs (harness bound)")
     p.add_argument("--idle-exit", type=float, default=None,
@@ -930,6 +950,14 @@ def main(argv=None) -> int:
                    "before it counts as expired")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=_cmd_reclaim)
+
+    p = sub.add_parser("profile", help="control-plane micro-span "
+                       "report: per-phase p50/p99, syscall budget, "
+                       "wasted wakeups, queue-wait decomposition "
+                       "(arm the server with M4T_CP_PROFILE=1 first)")
+    p.add_argument("spool")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=_cmd_profile)
 
     p = sub.add_parser("drain", help="stop admission; optionally wait "
                        "for the queue to empty")

@@ -209,7 +209,41 @@ def serving_snapshot(
         "jobs": jobs,
         "slo_breaches": slo_breaches,
         "pool": pool_snapshot(spool),
+        "cp": _cp_snapshot(spool),
     }
+
+
+#: cp-report refresh throttle: the serve loop rewrites metrics.prom
+#: every iteration, but a full profile report re-reads the whole cp
+#: sink — recompute at most this often and reuse the cached block in
+#: between (the profiler must not dominate the loop it measures).
+#: Patchable; set to 0.0 for always-fresh (tests).
+CP_SNAPSHOT_TTL_S = 2.0
+
+_cp_cache: Dict[str, Any] = {}
+
+
+def _cp_snapshot(spool: Spool) -> Optional[Dict[str, Any]]:
+    """Control-plane profile report when a cp sink exists (the server
+    ran armed with ``M4T_CP_PROFILE=1``), else None — at most
+    :data:`CP_SNAPSHOT_TTL_S` stale. Best-effort: a torn or
+    half-written sink never breaks the snapshot."""
+    from . import profile as cp_profile
+
+    now = time.monotonic()
+    hit = _cp_cache.get(spool.root)
+    if hit is not None and (now - hit[0]) < CP_SNAPSHOT_TTL_S:
+        return hit[1]
+    report: Optional[Dict[str, Any]] = None
+    if cp_profile.profile_paths(spool.root):
+        try:
+            report = cp_profile.profile_report(spool.root)
+            if not report["records"]:
+                report = None
+        except (OSError, ValueError):
+            report = None
+    _cp_cache[spool.root] = (now, report)
+    return report
 
 
 def render_serving_metrics(snap: Dict[str, Any]) -> str:
@@ -404,6 +438,11 @@ def render_serving_metrics(snap: Dict[str, Any]) -> str:
         c = _export._Family(out, "m4t_pool_poisoned_total", "counter",
                             "Jobs poisoned by the two-strikes rule.")
         c.sample(counters.get("poisoned", 0))
+
+    if snap.get("cp"):
+        from . import profile as cp_profile
+
+        cp_profile.render_cp_families(out, snap["cp"])
 
     out.append("# EOF")
     return "\n".join(out) + "\n"

@@ -122,6 +122,40 @@ STRIKE_REASONS = frozenset({"wedged", "job_timeout"})
 DEFAULT_HEARTBEAT_S = 0.5
 DEFAULT_MAX_STRIKES = 2
 
+#: mailbox poll interval override (seconds): the profiler's
+#: wasted-wakeup findings are actionable without a code edit
+POLL_ENV = "M4T_POOL_POLL_S"
+
+#: hardcoded-era defaults, kept as the documented fallbacks
+DEFAULT_WORKER_POLL_S = 0.02
+DEFAULT_CONTROLLER_POLL_S = 0.01
+
+
+def resolve_poll_s(poll_s: Optional[float], fallback: float) -> float:
+    """The mailbox poll interval: an explicit value wins, else
+    ``M4T_POOL_POLL_S`` (read at call time, so a harness can set it
+    after import), else ``fallback``. Explicit non-positive values are
+    an error; a malformed or non-positive env value warns and falls
+    back rather than wedging the pool (the ``config.py`` contract)."""
+    if poll_s is not None:
+        value = float(poll_s)
+        if value <= 0.0:
+            raise ValueError("poll interval must be > 0")
+        return value
+    raw = os.environ.get(POLL_ENV, "")
+    if raw:
+        try:
+            value = float(raw)
+        except ValueError:
+            value = -1.0
+        if value > 0.0:
+            return value
+        sys.stderr.write(
+            f"m4t.pool: ignoring invalid {POLL_ENV}={raw!r} "
+            f"(want a positive float); using {fallback}\n"
+        )
+    return fallback
+
 
 def _write_json_atomic(path: str, obj: Any) -> str:
     """The spool/ckpt idiom: whole file or no file."""
@@ -363,13 +397,21 @@ def worker_loop(
     *,
     incarnation: int = 0,
     heartbeat_s: float = DEFAULT_HEARTBEAT_S,
-    poll_s: float = 0.02,
+    poll_s: Optional[float] = None,
 ) -> int:
     """The resident loop one pool worker runs until its STOP sentinel
     appears: heartbeat, claim the oldest inbox item, execute it
-    in-process, write the result, sweep hygiene, repeat."""
-    from ..observability import events
+    in-process, write the result, sweep hygiene, repeat.
 
+    ``poll_s`` defaults from ``M4T_POOL_POLL_S`` (else
+    ``DEFAULT_WORKER_POLL_S``); see :func:`resolve_poll_s`."""
+    from ..observability import events
+    from . import profile as _profile
+
+    poll_s = resolve_poll_s(poll_s, DEFAULT_WORKER_POLL_S)
+    # workers are separate processes: each arms from the inherited
+    # env and sinks to its own <pool_root>/cp_profile.jsonl
+    _profile.arm_from_env(root)
     wdir = worker_dir(root, rank)
     inbox = os.path.join(wdir, INBOX_DIR)
     outbox = os.path.join(wdir, OUTBOX_DIR)
@@ -395,8 +437,15 @@ def worker_loop(
                 incarnation=incarnation, jobs=served, t=time.time(),
             ))
             return 0
+        prof = _profile.active
+        t_poll = prof.t() if prof is not None else 0.0
         name = _oldest_entry(inbox)
         if name is None:
+            if prof is not None:
+                # a wasted wakeup: one listdir bought nothing
+                prof.phase(
+                    "pool.wakeup", t_poll, worker=rank, useful=False,
+                )
             time.sleep(poll_s)
             continue
         try:
@@ -414,6 +463,24 @@ def worker_loop(
             except OSError:
                 pass
             continue
+        if prof is not None:
+            prof.phase(
+                "pool.wakeup", t_poll, worker=rank, useful=True,
+            )
+            # mailbox-write -> worker-claim lag, measured from the
+            # item name's time_ns prefix (_write_item's stamp): the
+            # worker_pickup leg of the dispatch hand-off
+            try:
+                lag = max(
+                    0.0,
+                    _profile.wall() - int(name.split("-", 1)[0]) / 1e9,
+                )
+            except (ValueError, IndexError):
+                lag = 0.0
+            prof.phase(
+                "pool.pickup", dur_s=lag, worker=rank,
+                job=item.get("job"), item=item.get("item"),
+            )
         events.emit(events.event(
             "pool", event="job_start", worker=rank,
             job=item.get("job"), item=item.get("item"),
@@ -462,7 +529,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--incarnation", type=int, default=0)
     parser.add_argument("--heartbeat", type=float,
                         default=DEFAULT_HEARTBEAT_S)
-    parser.add_argument("--poll", type=float, default=0.02)
+    parser.add_argument(
+        "--poll", "--poll-interval", type=float, default=None,
+        metavar="S", dest="poll",
+        help="mailbox poll interval in seconds (default: "
+        f"${POLL_ENV} else {DEFAULT_WORKER_POLL_S})",
+    )
     args = parser.parse_args(argv)
 
     # the warm import: everything a payload needs is resident before
@@ -543,7 +615,7 @@ class WorkerPool:
         deadline_s: Optional[float] = None,
         start_deadline_s: Optional[float] = None,
         check_s: float = 0.05,
-        poll_s: float = 0.01,
+        poll_s: Optional[float] = None,
         acquire_timeout_s: float = 60.0,
         mesh: bool = False,
         plan_cache: Optional[str] = None,
@@ -572,7 +644,9 @@ class WorkerPool:
             else max(self.deadline_s, 30.0)
         )
         self.check_s = float(check_s)
-        self.poll_s = float(poll_s)
+        #: the poll interval spawned workers are told to use
+        #: (explicit > $M4T_POOL_POLL_S > DEFAULT_CONTROLLER_POLL_S)
+        self.poll_s = resolve_poll_s(poll_s, DEFAULT_CONTROLLER_POLL_S)
         self.acquire_timeout_s = float(acquire_timeout_s)
         self.mesh = bool(mesh)
         self.plan_cache = plan_cache
@@ -1093,6 +1167,10 @@ class WorkerPool:
             "pool_dispatch", job=job, attempt=attempt, world=world,
             workers=ranks,
         )
+        from . import profile as _profile
+
+        prof = _profile.active
+        t_deliver = prof.t() if prof is not None else 0.0
         for i, w in enumerate(workers):
             item_id = f"{job}.a{attempt:02d}.g{i:02d}"
             w.item = item_id
@@ -1114,6 +1192,13 @@ class WorkerPool:
                     "world": self.size,
                 },
             })
+        if prof is not None:
+            # the item fan-out: the mailbox_delivery leg of the warm
+            # dispatch hand-off (tmp+fsync+rename per gang member)
+            prof.phase(
+                "pool.deliver", t_deliver, job=job,
+                items=len(workers),
+            )
         if self._span_fn is not None:
             # acquire + item fan-out: the warm path's whole dispatch
             # cost — the number the cold path's `spawn` span is

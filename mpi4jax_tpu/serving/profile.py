@@ -1,0 +1,867 @@
+"""Control-plane micro-span profiler: where the serving fast path burns time.
+
+BENCH r11/r14 left the serving plane with an embarrassing shape: a warm
+job *executes* in 0.015 s but *waits* 0.16–0.25 s (p50), and ROADMAP
+item 4 blames the filesystem control plane — per-job fsync'd renames,
+polling mailboxes, one-claim-at-a-time dispatch. PR 12's SLO
+attribution can say "83% queue-wait -> capacity" but not *which*
+control-plane operation burned the wait. Before the lock-free dispatch
+refactor rebuilds this path, it gets the PR 16 treatment the fabric
+got: measure it, attribute it per operation, gate on it.
+
+**Arming.** ``M4T_CP_PROFILE=1`` (or :func:`arm`). The standard is
+``resilience/faults.py``'s: unarmed, every instrumented site pays one
+module-attribute falsy check (``profile.active is None``) and nothing
+else — no clock reads, no allocation, and the unarmed record schemas
+on ``serving.jsonl`` are byte-identical to the disarmed build
+(drift-pinned in ``tests/test_cp_profile.py``). Armed, hot-path
+operations bracket themselves with ``time.monotonic()`` reads and
+append ``kind: "cp"`` micro-span records (schema ``m4t-cp/1``) to a
+*separate* sink, ``<root>/cp_profile.jsonl`` — the audit/span streams
+never change shape, they just gain a sibling file. Pool workers arm
+from the same env var (inherited through spawn) and write to their own
+``<pool_root>/cp_profile.jsonl``; the loaders read both.
+
+**Phase vocabulary** (the instrumented sites)::
+
+    submit / submit.scan / submit.write / submit.fsync / submit.rename
+    claim                the winning pending->running rename
+    claim.lost           a rename lost to a peer (the contention signal)
+    finish / finish.fence / finish.write / finish.fsync / finish.rename
+    lease.renew          one federated heartbeat write
+    scavenge             one reclaim pass
+    sched.pick           one scheduler decision (picked= names the job)
+    loop.scan            one Spool.pending() directory scan
+    loop.wakeup          one serve-loop iteration (useful= bool)
+    pool.wakeup          one worker mailbox poll (useful= bool)
+    pool.deliver         controller item fan-out for one job
+    pool.pickup          mailbox write -> worker claim lag (per item)
+
+**Queue-wait decomposition.** Each job's PR 12 ``queued`` span is
+split into named control-plane phases whose boundaries are the cp
+records' wall-clock stamps::
+
+    submit_visible   submit() entry -> entry durable in pending/
+    scan_wait        durable -> the winning scheduler pick started
+    sched_pick       the pick decision itself
+    claim_rename     pick -> the claim rename landed
+    residual         claim -> the server's queued-span boundary clock
+
+The five phases telescope — their sum equals the measured queue span
+exactly (float rounding aside), which :func:`decompose_job` self-checks
+(``ok``) and reports as ``coverage`` (the non-residual share; the
+acceptance bar is >= 90%). The warm pool's post-claim hand-off
+(``mailbox_delivery``, ``worker_pickup``) is reported alongside — it
+lives inside the ``dispatch`` span, not ``queued``, and the one
+definition of dispatch both this module and ``serve_loadgen`` use is
+:func:`dispatch_durations` (asserted equal in tests, so BENCH cohorts
+and ``profile`` reports can never disagree).
+
+CLI::
+
+    python -m mpi4jax_tpu.serving profile SPOOL [--json]
+    python -m mpi4jax_tpu.serving.profile SPOOL [--json]
+    python -m mpi4jax_tpu.serving.profile --selftest
+
+plus OpenMetrics families (``m4t_cp_*``) merged into the serving
+exposition, a per-server control-plane track in ``trace --serve``,
+doctor narration ("job j7: queue-wait 0.21 s = 71% scan wait + 18%
+submit fsync"), and the ``serve_controlplane`` BENCH variant
+(``benchmarks/serve_loadgen.py --profile``) wired into ``perf gate``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional
+
+CP_SCHEMA = "m4t-cp/1"
+REPORT_SCHEMA = "m4t-cp-report/1"
+
+#: the arming switch (any non-empty value arms)
+ENV_VAR = "M4T_CP_PROFILE"
+
+#: the profiler's own sink, beside serving.jsonl — a sibling file so
+#: the unarmed audit/span schemas stay byte-identical by construction
+PROFILE_NAME = "cp_profile.jsonl"
+
+#: every phase an instrumented site may emit; a typo'd phase name is a
+#: bug the selftest should catch, not a silently separate bucket
+PHASES = frozenset({
+    "submit", "submit.scan", "submit.write", "submit.fsync",
+    "submit.rename",
+    "claim", "claim.lost",
+    "finish", "finish.fence", "finish.write", "finish.fsync",
+    "finish.rename",
+    "lease.renew", "scavenge",
+    "sched.pick", "loop.scan", "loop.wakeup",
+    "pool.wakeup", "pool.deliver", "pool.pickup",
+})
+
+#: the queue-wait decomposition, in lifecycle order
+QUEUE_PHASES = (
+    "submit_visible", "scan_wait", "sched_pick", "claim_rename",
+    "residual",
+)
+
+#: dispatch-side hand-off phases (inside the ``dispatch`` span)
+DISPATCH_PHASES = ("mailbox_delivery", "worker_pickup")
+
+#: the telescoped phase sum must equal the queue span to float
+#: rounding; anything past this is a decomposition bug, not jitter
+SUM_TOLERANCE_S = 1e-6
+
+#: how many syscalls of each kind one record of a phase represents
+#: (records may override with an explicit ``n`` field, e.g. the
+#: submit scan's 4 listdirs or a scavenge pass's variable scan count)
+FSYNC_PHASES = frozenset({
+    "submit.fsync", "finish.fsync", "lease.renew",
+})
+RENAME_PHASES = frozenset({
+    "submit.rename", "claim", "claim.lost", "finish.fence",
+    "finish.rename", "lease.renew",
+})
+DIR_SCAN_PHASES = frozenset({
+    "submit.scan", "loop.scan", "pool.wakeup", "scavenge",
+})
+
+#: patchable clocks: ``wall`` places records on the span plane's
+#: timeline (``spans.now`` convention), ``clock`` measures durations
+wall = time.time
+clock = time.monotonic
+
+
+def cp_record(
+    phase: str, *, dur_s: float, t: float, **fields: Any
+) -> Dict[str, Any]:
+    """Build one ``m4t-cp/1`` record. ``t`` is the wall clock at the
+    *end* of the phase; ``dur_s`` is monotonic-measured, so the phase
+    started at roughly ``t - dur_s`` on the span timeline."""
+    rec: Dict[str, Any] = {
+        "kind": "cp",
+        "schema": CP_SCHEMA,
+        "phase": str(phase),
+        "t": float(t),
+        "dur_s": round(max(0.0, float(dur_s)), 9),
+    }
+    for key, value in fields.items():
+        if value is not None:
+            rec[key] = value
+    return rec
+
+
+class CPProfiler:
+    """The armed profiler: a thread-safe append-only JSONL writer.
+
+    Every ``phase()`` is best-effort — the control plane must keep
+    serving when its profile cannot be written — and cheap: one dict,
+    one ``json.dumps``, one appended line, no fsync (losing the tail
+    of a *profile* on a crash is fine; the audit stream is the durable
+    one)."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self.path = os.path.join(self.root, PROFILE_NAME)
+        self._lock = threading.Lock()
+        #: lazily opened, held for the profiler's lifetime — an
+        #: open/close per record would dominate the cost it measures
+        self._f = None
+
+    def t(self) -> float:
+        """A monotonic phase-start stamp (pass back to :meth:`phase`)."""
+        return clock()
+
+    def phase(
+        self,
+        name: str,
+        t0: Optional[float] = None,
+        *,
+        dur_s: Optional[float] = None,
+        **fields: Any,
+    ) -> Optional[Dict[str, Any]]:
+        """Record one finished phase: ``t0`` is a :meth:`t` stamp
+        (duration measured now), or pass ``dur_s`` directly."""
+        if dur_s is None:
+            dur_s = (clock() - t0) if t0 is not None else 0.0
+        rec = cp_record(name, dur_s=dur_s, t=wall(), **fields)
+        try:
+            line = json.dumps(rec, default=str)
+            with self._lock:
+                if self._f is None:
+                    # O_APPEND: pool workers are separate processes
+                    # sharing one sink; whole-line appends interleave
+                    # without tearing (the loader skips torn tails)
+                    self._f = open(self.path, "a")
+                self._f.write(line + "\n")
+                self._f.flush()
+        except (OSError, ValueError):
+            return None
+        return rec
+
+    def mark(self, name: str, **fields: Any) -> Optional[Dict[str, Any]]:
+        """A zero-duration counter record (e.g. a wasted wakeup)."""
+        return self.phase(name, dur_s=0.0, **fields)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+
+
+# ---------------------------------------------------------------------
+# arming (the faults.py standard)
+# ---------------------------------------------------------------------
+
+#: the armed profiler, or None. Every instrumented hot-path site gates
+#: on ``profile.active is not None`` — the whole unarmed cost.
+active: Optional[CPProfiler] = None
+
+_env_checked = False
+
+
+def arm(root: str) -> CPProfiler:
+    """Activate profiling for this process, sinking to
+    ``<root>/cp_profile.jsonl`` (tests and benches; served processes
+    arm from ``M4T_CP_PROFILE`` automatically at spool/pool init)."""
+    global active, _env_checked
+    prof = CPProfiler(root)
+    if active is not None:
+        active.close()
+    active = prof
+    _env_checked = True
+    return prof
+
+
+def disarm() -> None:
+    global active, _env_checked
+    if active is not None:
+        active.close()
+    active = None
+    _env_checked = False
+
+
+def arm_from_env(root: str) -> Optional[CPProfiler]:
+    """Arm for ``root`` when ``M4T_CP_PROFILE`` is set. Called from
+    ``Spool.__init__`` / the pool worker loop, so whichever root the
+    process actually serves gets the sink — re-arming to a new root is
+    deliberate (one profiler per process, latest spool wins; the
+    federated loadgen shares one spool across its whole fleet)."""
+    global _env_checked
+    _env_checked = True
+    if not os.environ.get(ENV_VAR, ""):
+        return None
+    root = os.path.abspath(root)
+    if active is not None and active.root == root:
+        return active
+    return arm(root)
+
+
+# ---------------------------------------------------------------------
+# reading
+# ---------------------------------------------------------------------
+
+
+def profile_paths(root: str) -> List[str]:
+    """The cp sinks under a spool root: the server's own plus the warm
+    pool's (workers are separate processes with their own files)."""
+    root = os.path.abspath(root)
+    cands = [
+        os.path.join(root, PROFILE_NAME),
+        os.path.join(root, "pool", PROFILE_NAME),
+    ]
+    return [p for p in cands if os.path.exists(p)]
+
+
+def load_cp(root: str) -> List[Dict[str, Any]]:
+    """Every ``kind == "cp"`` record under a spool root, sorted by
+    wall-clock stamp (the two sinks interleave on one timeline)."""
+    records: List[Dict[str, Any]] = []
+    for path in profile_paths(root):
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if isinstance(rec, dict) and rec.get("kind") == "cp":
+                        records.append(rec)
+        except OSError:
+            continue
+    records.sort(key=lambda r: float(r.get("t") or 0.0))
+    return records
+
+
+def dispatch_durations(
+    span_records: Iterable[Dict[str, Any]],
+) -> List[float]:
+    """THE definition of per-job dispatch latency: the lifecycle
+    ``dispatch`` span's duration (claim -> supervisor start), sorted.
+    ``serve_loadgen``'s ``dispatch_p50/p99_s`` and the profile report
+    both call this — one definition, asserted equal in tests, so BENCH
+    cohorts and ``profile`` output cannot drift apart."""
+    return sorted(
+        float(s.get("dur_s") or 0.0)
+        for s in span_records
+        if s.get("kind") == "span" and s.get("span") == "dispatch"
+    )
+
+
+# ---------------------------------------------------------------------
+# queue-wait decomposition
+# ---------------------------------------------------------------------
+
+
+def decompose_job(
+    queued: Dict[str, Any],
+    cp: Iterable[Dict[str, Any]],
+) -> Dict[str, Any]:
+    """Split one job's ``queued`` span into :data:`QUEUE_PHASES` using
+    the job's cp records as boundary stamps. Returns::
+
+        {"job", "tenant", "queue_wait_s", "phases": {...},
+         "sum_s", "ok", "coverage", "claim_lost_s", "claim_losses",
+         "mailbox_delivery_s", "worker_pickup_s"}
+
+    The phases telescope (see module docstring), so ``ok`` asserts
+    ``|sum - queue_wait| <= SUM_TOLERANCE_S`` and ``coverage`` is the
+    non-residual share — what the profiler *named*, vs the hand-off
+    sliver it could only bound."""
+    job = str(queued.get("job"))
+    tq0 = float(queued.get("t0") or 0.0)
+    tq1 = float(queued.get("t1") or 0.0)
+    span = max(0.0, tq1 - tq0)
+    mine = [r for r in cp if str(r.get("job") or "") == job]
+    picks = sorted(
+        (r for r in cp
+         if r.get("phase") == "sched.pick"
+         and str(r.get("picked") or "") == job),
+        key=lambda r: float(r.get("t") or 0.0),
+    )
+
+    def last(phase: str) -> Optional[Dict[str, Any]]:
+        recs = [r for r in mine if r.get("phase") == phase]
+        return recs[-1] if recs else None
+
+    sub = last("submit")
+    won = last("claim")
+    ts = float(sub["t"]) if sub else tq0
+    if won is not None:
+        tc = float(won["t"])
+        dc = float(won.get("dur_s") or 0.0)
+        before = [p for p in picks if float(p["t"]) <= tc + 1e-9]
+        pick = before[-1] if before else None
+    else:
+        tc, dc, pick = tq1, 0.0, (picks[-1] if picks else None)
+    if pick is not None:
+        tp = float(pick["t"])
+        dp = float(pick.get("dur_s") or 0.0)
+    else:
+        # no scheduler record (e.g. a bare spool.claim): charge the
+        # rename itself and let the wait end at its start
+        tp, dp = tc - dc, 0.0
+    phases = {
+        "submit_visible": ts - tq0,
+        "scan_wait": (tp - dp) - ts,
+        "sched_pick": dp,
+        "claim_rename": tc - tp,
+        "residual": tq1 - tc,
+    }
+    total = sum(phases.values())
+    named = total - phases["residual"]
+    lost = [r for r in mine if r.get("phase") == "claim.lost"]
+    out: Dict[str, Any] = {
+        "job": job,
+        "tenant": queued.get("tenant"),
+        "queue_wait_s": span,
+        "phases": {k: round(v, 9) for k, v in phases.items()},
+        "sum_s": round(total, 9),
+        "ok": abs(total - span) <= SUM_TOLERANCE_S,
+        "coverage": (named / span) if span > 0 else 1.0,
+        "claim_losses": len(lost),
+        "claim_lost_s": round(
+            sum(float(r.get("dur_s") or 0.0) for r in lost), 9
+        ),
+    }
+    deliver = last("pool.deliver")
+    if deliver is not None:
+        out["mailbox_delivery_s"] = float(deliver.get("dur_s") or 0.0)
+    pickups = [r for r in mine if r.get("phase") == "pool.pickup"]
+    if pickups:
+        # the gang waits for its slowest rank's pickup
+        out["worker_pickup_s"] = max(
+            float(r.get("dur_s") or 0.0) for r in pickups
+        )
+    return out
+
+
+def decompose(
+    root: str,
+    *,
+    spans: Optional[Iterable[Dict[str, Any]]] = None,
+    cp: Optional[Iterable[Dict[str, Any]]] = None,
+) -> List[Dict[str, Any]]:
+    """Per-job queue-wait decompositions for every job with a
+    ``queued`` span under ``root``, in submit order."""
+    if spans is None:
+        from ..observability import spans as _spans
+
+        spans = _spans.load_spans([root])
+    if cp is None:
+        cp = load_cp(root)
+    cp = list(cp)
+    queued = sorted(
+        (s for s in spans
+         if s.get("span") == "queued" and s.get("job")),
+        key=lambda s: float(s.get("t0") or 0.0),
+    )
+    return [decompose_job(q, cp) for q in queued]
+
+
+def narrate_job(decomp: Dict[str, Any]) -> str:
+    """One line an operator can act on: the queue wait and its top
+    contributors by share — e.g. ``job j7: queue-wait 0.21 s = 71%
+    scan wait + 18% submit fsync + 6% claim race lost``."""
+    span = float(decomp.get("queue_wait_s") or 0.0)
+    if span <= 0:
+        return f"job {decomp.get('job')}: queue-wait 0 s"
+    labels = {
+        "submit_visible": "submit visibility",
+        "scan_wait": "scan wait (poll interval + server busy)",
+        "sched_pick": "scheduler pick",
+        "claim_rename": "claim rename",
+        "residual": "hand-off",
+    }
+    shares = [
+        (max(0.0, float(v)) / span, labels[k])
+        for k, v in (decomp.get("phases") or {}).items()
+        if k in labels
+    ]
+    lost = float(decomp.get("claim_lost_s") or 0.0)
+    if lost > 0:
+        shares.append((lost / span, "claim race lost"))
+    shares.sort(reverse=True)
+    parts = [
+        f"{share:.0%} {label}"
+        for share, label in shares[:3] if share >= 0.01
+    ]
+    return (
+        f"job {decomp.get('job')}: queue-wait {span:.3g} s = "
+        + " + ".join(parts or ["(all phases < 1%)"])
+    )
+
+
+# ---------------------------------------------------------------------
+# the report
+# ---------------------------------------------------------------------
+
+
+def _pct(sorted_vals: List[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    i = min(
+        len(sorted_vals) - 1,
+        max(0, int(round(q * (len(sorted_vals) - 1)))),
+    )
+    return sorted_vals[i]
+
+
+def _wakeup_stats(recs: List[Dict[str, Any]]) -> Dict[str, Any]:
+    total = len(recs)
+    useful = sum(1 for r in recs if r.get("useful"))
+    return {
+        "total": total,
+        "useful": useful,
+        "wasted": total - useful,
+        "wasted_ratio": (
+            round((total - useful) / total, 4) if total else None
+        ),
+    }
+
+
+def profile_report(
+    root: str,
+    *,
+    cp: Optional[List[Dict[str, Any]]] = None,
+    spans: Optional[List[Dict[str, Any]]] = None,
+) -> Dict[str, Any]:
+    """The whole story for one spool: per-phase latency percentiles,
+    the syscall budget, wakeup efficiency, claim contention, and the
+    per-job queue-wait decomposition."""
+    if cp is None:
+        cp = load_cp(root)
+    if spans is None:
+        from ..observability import spans as _spans
+
+        spans = _spans.load_spans([root])
+    by_phase: Dict[str, List[float]] = {}
+    for rec in cp:
+        by_phase.setdefault(str(rec.get("phase")), []).append(
+            float(rec.get("dur_s") or 0.0)
+        )
+    phases = {}
+    for name in sorted(by_phase):
+        vals = sorted(by_phase[name])
+        phases[name] = {
+            "count": len(vals),
+            "p50_s": _pct(vals, 0.50),
+            "p99_s": _pct(vals, 0.99),
+            "total_s": round(sum(vals), 9),
+        }
+
+    def _ops(kinds: frozenset) -> int:
+        return sum(
+            int(r.get("n") or 1)
+            for r in cp if r.get("phase") in kinds
+        )
+
+    claims = len(by_phase.get("claim", []))
+    losses = len(by_phase.get("claim.lost", []))
+    jobs = max(1, claims)
+    syscalls = {
+        "fsyncs": _ops(FSYNC_PHASES),
+        "renames": _ops(RENAME_PHASES),
+        "dir_scans": _ops(DIR_SCAN_PHASES),
+        "jobs": claims,
+    }
+    for key in ("fsyncs", "renames", "dir_scans"):
+        syscalls[f"{key}_per_job"] = round(syscalls[key] / jobs, 2)
+    decomps = decompose(root, spans=spans, cp=cp)
+    dec_summary: Dict[str, Any] = {"jobs": len(decomps)}
+    if decomps:
+        covs = sorted(float(d["coverage"]) for d in decomps)
+        dec_summary.update({
+            "complete": sum(1 for d in decomps if d["ok"]),
+            "coverage_p50": round(_pct(covs, 0.50), 4),
+            "coverage_min": round(covs[0], 4),
+        })
+        for stat, q in (("p50", 0.50), ("p99", 0.99)):
+            dec_summary[f"phase_{stat}_s"] = {
+                name: _pct(sorted(
+                    float(d["phases"][name]) for d in decomps
+                ), q)
+                for name in QUEUE_PHASES
+            }
+    dispatch = dispatch_durations(spans)
+    return {
+        "schema": REPORT_SCHEMA,
+        "root": os.path.abspath(root),
+        "records": len(cp),
+        "phases": phases,
+        "wakeups": {
+            "server": _wakeup_stats([
+                r for r in cp if r.get("phase") == "loop.wakeup"
+            ]),
+            "pool": _wakeup_stats([
+                r for r in cp if r.get("phase") == "pool.wakeup"
+            ]),
+        },
+        "claims": {
+            "won": claims,
+            "lost": losses,
+            "lost_ratio": (
+                round(losses / (claims + losses), 4)
+                if (claims + losses) else None
+            ),
+            "lost_s_total": round(sum(by_phase.get("claim.lost", [])), 9),
+        },
+        "syscalls": syscalls,
+        "dispatch_p50_s": _pct(dispatch, 0.50),
+        "dispatch_p99_s": _pct(dispatch, 0.99),
+        "decomposition": dec_summary,
+        "per_job": decomps,
+    }
+
+
+def _fmt_s(value: Optional[float]) -> str:
+    if value is None:
+        return "-"
+    if value < 1e-3:
+        return f"{value * 1e6:.0f}us"
+    if value < 1.0:
+        return f"{value * 1e3:.1f}ms"
+    return f"{value:.3f}s"
+
+
+def format_report(report: Dict[str, Any]) -> str:
+    """The human rendering of :func:`profile_report`."""
+    lines = [
+        f"control-plane profile: {report.get('records', 0)} record(s) "
+        f"in {report.get('root')}",
+    ]
+    phases = report.get("phases") or {}
+    if phases:
+        lines.append("  phase latency (count / p50 / p99 / total):")
+        width = max(len(n) for n in phases)
+        for name in sorted(phases):
+            st = phases[name]
+            lines.append(
+                f"    {name:<{width}}  {st['count']:>5}  "
+                f"{_fmt_s(st['p50_s']):>8}  {_fmt_s(st['p99_s']):>8}  "
+                f"{_fmt_s(st['total_s']):>9}"
+            )
+    sc = report.get("syscalls") or {}
+    if sc.get("jobs"):
+        lines.append(
+            f"  syscall budget ({sc['jobs']} dispatched job(s)): "
+            f"{sc.get('fsyncs_per_job')} fsyncs + "
+            f"{sc.get('renames_per_job')} renames + "
+            f"{sc.get('dir_scans_per_job')} dir-scans per job"
+        )
+    for plane in ("server", "pool"):
+        wk = (report.get("wakeups") or {}).get(plane) or {}
+        if wk.get("total"):
+            lines.append(
+                f"  {plane} wakeups: {wk['total']} "
+                f"({wk['useful']} useful, "
+                f"{wk['wasted_ratio']:.0%} wasted)"
+            )
+    cl = report.get("claims") or {}
+    if cl.get("lost"):
+        lines.append(
+            f"  claim contention: {cl['lost']} race(s) lost vs "
+            f"{cl['won']} won ({cl['lost_ratio']:.0%}), "
+            f"{_fmt_s(cl['lost_s_total'])} burned"
+        )
+    dec = report.get("decomposition") or {}
+    if dec.get("jobs"):
+        lines.append(
+            f"  queue-wait decomposition: {dec.get('complete', 0)}/"
+            f"{dec['jobs']} job(s) telescope exactly; coverage p50 "
+            f"{dec.get('coverage_p50', 0):.1%} (min "
+            f"{dec.get('coverage_min', 0):.1%})"
+        )
+        p50 = dec.get("phase_p50_s") or {}
+        if p50:
+            lines.append(
+                "    p50 by phase: " + ", ".join(
+                    f"{name}={_fmt_s(p50.get(name))}"
+                    for name in QUEUE_PHASES
+                )
+            )
+    for d in (report.get("per_job") or [])[:8]:
+        lines.append("  " + narrate_job(d))
+    if not phases:
+        lines.append(
+            "  (no cp records — arm with M4T_CP_PROFILE=1 and serve)"
+        )
+    return "\n".join(lines)
+
+
+def format_cp_narration(report: Dict[str, Any]) -> str:
+    """The doctor's control-plane section: one actionable line per
+    job (:func:`narrate_job`) plus the wakeup/contention summary —
+    :func:`format_report` minus the phase table, for embedding under
+    the serving timeline."""
+    lines = [
+        f"control-plane profile ({report.get('records', 0)} micro-"
+        "span(s), M4T_CP_PROFILE):"
+    ]
+    for d in (report.get("per_job") or [])[:16]:
+        lines.append("  " + narrate_job(d))
+    sc = report.get("syscalls") or {}
+    if sc.get("jobs"):
+        lines.append(
+            f"  syscall budget: {sc.get('fsyncs_per_job')} fsyncs + "
+            f"{sc.get('renames_per_job')} renames + "
+            f"{sc.get('dir_scans_per_job')} dir-scans per job"
+        )
+    for plane in ("server", "pool"):
+        wk = (report.get("wakeups") or {}).get(plane) or {}
+        if wk.get("total"):
+            lines.append(
+                f"  {plane} wakeups: {wk['total']} ({wk['useful']} "
+                f"useful, {wk['wasted_ratio']:.0%} wasted)"
+            )
+    cl = report.get("claims") or {}
+    if cl.get("lost"):
+        lines.append(
+            f"  claim contention: {cl['lost']} race(s) lost vs "
+            f"{cl['won']} won, {_fmt_s(cl['lost_s_total'])} burned"
+        )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------
+# OpenMetrics
+# ---------------------------------------------------------------------
+
+
+def render_cp_families(out: List[str], report: Dict[str, Any]) -> None:
+    """Append the ``m4t_cp_*`` exposition families for a
+    :func:`profile_report` (shared by the serving exporter; the caller
+    owns the trailing ``# EOF``)."""
+    from ..observability import export as _export
+
+    g = _export._Family(
+        out, "m4t_cp_phase_seconds", "gauge",
+        "Control-plane micro-span latency quantiles per phase "
+        "(serving/profile.py, armed via M4T_CP_PROFILE).",
+    )
+    for name in sorted(report.get("phases") or {}):
+        st = report["phases"][name]
+        for quantile, key in (("p50", "p50_s"), ("p99", "p99_s")):
+            g.sample(st.get(key), phase=name, quantile=quantile)
+    c = _export._Family(
+        out, "m4t_cp_phase_ops_total", "counter",
+        "Control-plane operations profiled, per phase.",
+    )
+    for name in sorted(report.get("phases") or {}):
+        c.sample(report["phases"][name]["count"], phase=name)
+    sc = report.get("syscalls") or {}
+    c = _export._Family(
+        out, "m4t_cp_fsync_total", "counter",
+        "fsync calls the control plane paid while profiled.",
+    )
+    c.sample(sc.get("fsyncs", 0))
+    c = _export._Family(
+        out, "m4t_cp_rename_total", "counter",
+        "Atomic renames the control plane paid while profiled.",
+    )
+    c.sample(sc.get("renames", 0))
+    c = _export._Family(
+        out, "m4t_cp_dir_scan_total", "counter",
+        "Directory scans the control plane paid while profiled.",
+    )
+    c.sample(sc.get("dir_scans", 0))
+    c = _export._Family(
+        out, "m4t_cp_poll_wakeups_total", "counter",
+        "Poll-loop wakeups by usefulness (plane: server loop / pool "
+        "worker mailbox). wasted = woke, scanned, found nothing.",
+    )
+    for plane in ("server", "pool"):
+        wk = (report.get("wakeups") or {}).get(plane) or {}
+        c.sample(wk.get("useful", 0), plane=plane, useful="true")
+        c.sample(wk.get("wasted", 0), plane=plane, useful="false")
+    c = _export._Family(
+        out, "m4t_cp_claim_races_lost_total", "counter",
+        "Pending->running renames lost to a peer server (federated "
+        "claim contention).",
+    )
+    c.sample((report.get("claims") or {}).get("lost", 0))
+
+
+# ---------------------------------------------------------------------
+# CLI + selftest
+# ---------------------------------------------------------------------
+
+
+def selftest() -> int:
+    """Device-free proof of the profiler: a stub-runner serving loop
+    under ``M4T_CP_PROFILE`` emits real cp records from the actual
+    instrumented sites; every job's decomposition telescopes exactly
+    at >=90% coverage; disarmed, the same loop writes no cp sink at
+    all and the profiler costs one falsy check."""
+    import tempfile
+
+    from .server import Server
+    from .spool import Spool
+
+    prev_env = os.environ.get(ENV_VAR)
+    prev_active, prev_checked = active, _env_checked
+
+    def _serve(tmp: str) -> Spool:
+        spool = Spool(os.path.join(tmp, "spool"))
+        for i in range(4):
+            r = spool.submit({
+                "id": f"p{i}", "tenant": f"t{i % 2}",
+                "cmd": ["-c", "pass"],
+            })
+            assert r["status"] == "queued", r
+        server = Server(
+            spool, nproc=1, max_jobs=4, poll_s=0.01,
+            runner=lambda *a: (0, []), log=lambda msg: None,
+        )
+        assert server.serve() == 0
+        return spool
+
+    try:
+        # disarmed: no sink appears, no schema changes
+        disarm()
+        os.environ.pop(ENV_VAR, None)
+        with tempfile.TemporaryDirectory() as tmp:
+            spool = _serve(tmp)
+            assert profile_paths(spool.root) == [], "unarmed cp sink!"
+            assert active is None
+
+        # armed from env: the real instrumented sites
+        os.environ[ENV_VAR] = "1"
+        disarm()
+        with tempfile.TemporaryDirectory() as tmp:
+            spool = _serve(tmp)
+            cp = load_cp(spool.root)
+            assert cp, "armed run wrote no cp records"
+            seen = {r["phase"] for r in cp}
+            assert seen <= PHASES, sorted(seen - PHASES)
+            for needed in ("submit", "submit.fsync", "submit.rename",
+                           "claim", "sched.pick", "loop.scan",
+                           "loop.wakeup", "finish", "finish.fsync"):
+                assert needed in seen, (needed, sorted(seen))
+            report = profile_report(spool.root)
+            assert report["records"] == len(cp)
+            assert report["claims"]["won"] == 4
+            assert report["syscalls"]["fsyncs_per_job"] >= 1
+            dec = report["decomposition"]
+            assert dec["jobs"] == 4 and dec["complete"] == 4, dec
+            assert dec["coverage_min"] >= 0.90, dec
+            for d in report["per_job"]:
+                assert d["ok"], d
+                line = narrate_job(d)
+                assert d["job"] in line and "queue-wait" in line, line
+            text = format_report(report)
+            assert "syscall budget" in text, text
+            assert "queue-wait decomposition" in text, text
+            out: List[str] = []
+            render_cp_families(out, report)
+            prom = "\n".join(out)
+            for family in ("m4t_cp_phase_seconds", "m4t_cp_fsync_total",
+                           "m4t_cp_poll_wakeups_total",
+                           "m4t_cp_claim_races_lost_total"):
+                assert family in prom, family
+    finally:
+        disarm()
+        if prev_env is None:
+            os.environ.pop(ENV_VAR, None)
+        else:
+            os.environ[ENV_VAR] = prev_env
+        globals()["active"] = prev_active
+        globals()["_env_checked"] = prev_checked
+    print("cp profile selftest ok")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--selftest" in argv:
+        return selftest()
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi4jax_tpu.serving.profile",
+        description="Report control-plane micro-span profiles from a "
+        "serving spool (arm serving with M4T_CP_PROFILE=1 first).",
+    )
+    parser.add_argument("spool", help="spool root directory")
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args(argv)
+    report = profile_report(args.spool)
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        print(format_report(report))
+    return 0 if report.get("records") else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

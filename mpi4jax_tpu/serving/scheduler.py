@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from . import profile as _profile
 from .spool import JobSpec
 
 
@@ -42,6 +43,21 @@ class FairScheduler:
         repeat tenant would cut the line."""
         if not pending:
             return None
+        prof = _profile.active
+        if prof is None:
+            return self._pick(pending)
+        # armed: the decision is micro-spanned (``picked=`` joins it to
+        # the winning job's queue-wait decomposition); determinism is
+        # untouched — the profiler only brackets, never reorders
+        t0 = prof.t()
+        spec = self._pick(pending)
+        prof.phase(
+            "sched.pick", t0, picked=spec.id if spec else None,
+            depth=len(pending),
+        )
+        return spec
+
+    def _pick(self, pending: List[JobSpec]) -> Optional[JobSpec]:
         first: Dict[str, JobSpec] = {}
         order: Dict[str, int] = {}
         for i, spec in enumerate(pending):

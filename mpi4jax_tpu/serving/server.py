@@ -52,6 +52,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..resilience.supervisor import RetryPolicy, Supervisor
+from . import profile as _profile
 from .scheduler import FairScheduler
 from .spool import (
     DEFAULT_LEASE_S,
@@ -103,6 +104,8 @@ class Server:
             raise ValueError("serve needs nproc >= 1")
         if min_ranks < 1 or min_ranks > nproc:
             raise ValueError("min_ranks must be in [1, nproc]")
+        if float(poll_s) <= 0.0:
+            raise ValueError("poll_s must be > 0")
         self.spool = spool
         #: this serving loop's federation identity: its lease file,
         #: its claims' owner suffix, and the id the fence checks
@@ -768,6 +771,8 @@ class Server:
         rc = 0
         try:
             while True:
+                prof = _profile.active
+                t_iter = prof.t() if prof is not None else 0.0
                 self._federation_tick()
                 if (
                     self.max_jobs is not None
@@ -775,7 +780,13 @@ class Server:
                 ):
                     self._log(f"served {self.jobs_served} job(s); done")
                     break
+                t_scan = prof.t() if prof is not None else 0.0
                 pending = self.spool.pending()
+                if prof is not None:
+                    prof.phase(
+                        "loop.scan", t_scan, server=self.server_id,
+                        depth=len(pending),
+                    )
                 spec = self.scheduler.pick(pending)
                 if spec is None:
                     if self.spool.draining():
@@ -796,6 +807,12 @@ class Server:
                         self._log("idle bound reached; exiting")
                         break
                     self._write_metrics()
+                    if prof is not None:
+                        # a wasted wakeup: woke, scanned, found nothing
+                        prof.phase(
+                            "loop.wakeup", t_iter,
+                            server=self.server_id, useful=False,
+                        )
                     time.sleep(self.poll_s)
                     continue
                 idle_since = time.monotonic()
@@ -805,6 +822,11 @@ class Server:
                     # turn back so losing a race costs no fairness
                     self.scheduler.revert()
                     continue
+                if prof is not None:
+                    prof.phase(
+                        "loop.wakeup", t_iter, server=self.server_id,
+                        useful=True, job=claimed.id,
+                    )
                 self.run_job(claimed)
                 self.jobs_served += 1
                 self._write_metrics()
@@ -840,6 +862,8 @@ class Server:
         rc = 0
         try:
             while True:
+                prof = _profile.active
+                t_iter = prof.t() if prof is not None else 0.0
                 self._federation_tick()
                 # one pool-doctor pass per loop turn: reap worker
                 # exits, enforce heartbeat deadlines, flip started
@@ -872,7 +896,14 @@ class Server:
                         continue
                     self._log(f"served {self.jobs_served} job(s); done")
                     break
-                spec = self.scheduler.pick(self.spool.pending())
+                t_scan = prof.t() if prof is not None else 0.0
+                pending = self.spool.pending()
+                if prof is not None:
+                    prof.phase(
+                        "loop.scan", t_scan, server=self.server_id,
+                        depth=len(pending),
+                    )
+                spec = self.scheduler.pick(pending)
                 if spec is None:
                     if not running:
                         if self.spool.draining():
@@ -893,6 +924,11 @@ class Server:
                             self._log("idle bound reached; exiting")
                             break
                         self._write_metrics()
+                    if prof is not None:
+                        prof.phase(
+                            "loop.wakeup", t_iter,
+                            server=self.server_id, useful=False,
+                        )
                     time.sleep(self.poll_s)
                     continue
                 idle_since = time.monotonic()
@@ -906,6 +942,11 @@ class Server:
                 if claimed is None:
                     self.scheduler.revert()
                     continue  # a peer server won the rename
+                if prof is not None:
+                    prof.phase(
+                        "loop.wakeup", t_iter, server=self.server_id,
+                        useful=True, job=claimed.id,
+                    )
                 t = threading.Thread(
                     target=self.run_job, args=(claimed,),
                     name=f"m4t-job-{claimed.id}",

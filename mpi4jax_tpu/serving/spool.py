@@ -66,6 +66,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from . import profile as _profile
+
 JOB_SCHEMA = "m4t-job/1"
 SPOOL_SCHEMA = "m4t-spool/1"
 
@@ -372,6 +374,10 @@ class Spool:
         # the warm pool's serve loop audits from concurrent job
         # threads; one writer at a time keeps lines whole
         self._audit_lock = threading.Lock()
+        # control-plane micro-span profiling arms here when
+        # M4T_CP_PROFILE is set; unarmed, every instrumented site
+        # below pays one falsy check (serving/profile.py)
+        _profile.arm_from_env(self.root)
 
     # -- audit --------------------------------------------------------
 
@@ -546,6 +552,8 @@ class Spool:
             # inherits it rather than minting its own
             spec.trace = f"tr-{t_ns:x}-{os.getpid() % 0xFFFF:04x}"
         spec.submitted_t = now
+        prof = _profile.active
+        t_sub = prof.t() if prof is not None else 0.0
         if self.draining():
             self.audit(
                 "rejected", job=spec.id, tenant=spec.tenant,
@@ -555,6 +563,7 @@ class Spool:
                 "job": spec.id, "status": "rejected",
                 "reason": "draining",
             }
+        t_scan = prof.t() if prof is not None else 0.0
         depth = len(self._entries(PENDING_DIR))
         cap = self.capacity
         if depth >= cap:
@@ -578,19 +587,39 @@ class Spool:
                 "reason": "duplicate_id",
             }
         self._sweep_tmp(PENDING_DIR)
+        if prof is not None:
+            # n=5 listdirs: the depth count, the 3 known-id dirs, and
+            # the tmp sweep — the submit path's whole scan budget
+            prof.phase("submit.scan", t_scan, job=spec.id, n=5)
         entry = f"{t_ns:020d}-{spec.id}.json"
         spec.entry = entry
         final = os.path.join(self._dir(PENDING_DIR), entry)
         tmp = os.path.join(self._dir(PENDING_DIR), f".tmp-{entry}")
+        t0 = prof.t() if prof is not None else 0.0
         with open(tmp, "w") as f:
             json.dump(spec.to_json(), f, indent=1)
+            if prof is not None:
+                prof.phase("submit.write", t0, job=spec.id)
+                t0 = prof.t()
             f.flush()
             os.fsync(f.fileno())
+        if prof is not None:
+            prof.phase("submit.fsync", t0, job=spec.id)
+            t0 = prof.t()
         os.replace(tmp, final)
+        if prof is not None:
+            prof.phase("submit.rename", t0, job=spec.id)
         self.audit(
             "submitted", job=spec.id, tenant=spec.tenant,
             nproc=spec.nproc, depth=depth + 1, trace=spec.trace,
         )
+        if prof is not None:
+            # the total's wall stamp is the submit-visible boundary
+            # the queue-wait decomposition keys on
+            prof.phase(
+                "submit", t_sub, job=spec.id, tenant=spec.tenant,
+                depth=depth + 1,
+            )
         return {"job": spec.id, "status": "queued", "trace": spec.trace}
 
     # -- scanning -----------------------------------------------------
@@ -666,9 +695,16 @@ class Spool:
             epoch = int(spec.reclaims) + 1
             dst_name = f"{spec.entry}@{server}@{epoch}"
         dst = os.path.join(self._dir(RUNNING_DIR), dst_name)
+        prof = _profile.active
+        t0 = prof.t() if prof is not None else 0.0
         try:
             os.replace(src, dst)
         except OSError:
+            if prof is not None:
+                # the contention signal: this rename lost to a peer
+                prof.phase(
+                    "claim.lost", t0, job=spec.id, server=server,
+                )
             return None
         spec.entry = dst_name
         spec.owner = server
@@ -679,6 +715,12 @@ class Spool:
             self.audit(
                 "claimed", job=spec.id, tenant=spec.tenant,
                 server=server, epoch=epoch,
+            )
+        if prof is not None:
+            # rename + claim audit; the wall stamp is the claim
+            # boundary the queue-wait decomposition keys on
+            prof.phase(
+                "claim", t0, job=spec.id, server=server, epoch=epoch,
             )
         return spec
 
@@ -718,6 +760,8 @@ class Spool:
         record is rejected, a ``fenced`` audit record names the zombie
         and the current holder, and the method returns False without
         writing anything. Returns True when the record landed."""
+        prof = _profile.active
+        t_fin = prof.t() if prof is not None else 0.0
         base = self._entry_base(spec.entry) if spec.entry else spec.entry
         token: Optional[str] = None
         if server is not None:
@@ -732,6 +776,7 @@ class Spool:
             token = os.path.join(
                 self.job_dir(spec.id), f".terminal@{server}@{epoch}"
             )
+            t0 = prof.t() if prof is not None else 0.0
             try:
                 os.replace(running, token)
             except OSError:
@@ -745,15 +790,28 @@ class Spool:
                     holder=self._running_holder(spec.id),
                 )
                 return False
+            if prof is not None:
+                prof.phase(
+                    "finish.fence", t0, job=spec.id, server=server,
+                )
         record = dict(spec.to_json())
         record.update(outcome=outcome, finished_t=time.time(), **extra)
         final = os.path.join(self._dir(DONE_DIR), base)
         tmp = os.path.join(self._dir(DONE_DIR), f".tmp-{base}")
+        t0 = prof.t() if prof is not None else 0.0
         with open(tmp, "w") as f:
             json.dump(record, f, indent=1, default=str)
+            if prof is not None:
+                prof.phase("finish.write", t0, job=spec.id)
+                t0 = prof.t()
             f.flush()
             os.fsync(f.fileno())
+        if prof is not None:
+            prof.phase("finish.fsync", t0, job=spec.id)
+            t0 = prof.t()
         os.replace(tmp, final)
+        if prof is not None:
+            prof.phase("finish.rename", t0, job=spec.id)
         if token is not None:
             try:
                 os.unlink(token)
@@ -766,6 +824,8 @@ class Spool:
                 )
             except OSError:
                 pass
+        if prof is not None:
+            prof.phase("finish", t_fin, job=spec.id, outcome=outcome)
         return True
 
     # -- server registry / leases -------------------------------------
@@ -816,6 +876,18 @@ class Spool:
         """Refresh the heartbeat. A server whose registry file was
         removed (scavenged as dead, operator cleanup) re-registers —
         its old claims are already forfeit, but its next ones count."""
+        prof = _profile.active
+        if prof is None:
+            return self._renew_lease(server_id, now=now)
+        t0 = prof.t()
+        try:
+            return self._renew_lease(server_id, now=now)
+        finally:
+            prof.phase("lease.renew", t0, server=server_id)
+
+    def _renew_lease(
+        self, server_id: str, *, now: Optional[float] = None
+    ) -> None:
         t = time.time() if now is None else float(now)
         path = self._server_path(server_id)
         try:
@@ -961,6 +1033,27 @@ class Spool:
         Unowned (single-server era) running entries are never touched.
         ``by`` names the scavenging server so it skips its own claims.
         """
+        prof = _profile.active
+        if prof is None:
+            return self._reclaim(
+                now=now, by=by, max_reclaims=max_reclaims,
+                grace_s=grace_s,
+            )
+        t0 = prof.t()
+        actions = self._reclaim(
+            now=now, by=by, max_reclaims=max_reclaims, grace_s=grace_s,
+        )
+        prof.phase("scavenge", t0, by=by, actions=len(actions))
+        return actions
+
+    def _reclaim(
+        self,
+        *,
+        now: Optional[float] = None,
+        by: Optional[str] = None,
+        max_reclaims: int = DEFAULT_MAX_RECLAIMS,
+        grace_s: float = 0.0,
+    ) -> List[Dict[str, Any]]:
         t = time.time() if now is None else float(now)
         servers = {rec["id"]: rec for rec in self.servers(now=t)}
         actions: List[Dict[str, Any]] = []
