@@ -1,0 +1,168 @@
+"""Placement + generated-algorithm benchmark on the adversarial fabric.
+
+Device-free (pure cost-model arithmetic over a synthetic ``m4t-topo/1``
+map — the same pricing the autotuner pins winners with): build the PR
+18 acceptance fabric (``planner.placement.adversarial_topo`` — a fast
+Hamiltonian cycle shuffled among slow links, hostile to the identity
+ring), then measure how much of the gap the two PR 18 mechanisms
+recover:
+
+- **algogen**: ``planner/algogen.py`` searches the ``m4t-algo/1``
+  space for schedules specialized to the measured map, admitting a
+  candidate only when the full M4T201/202/204/205 proof pipeline is
+  clean at every target world AND it beats the shipped ring under
+  ``costmodel.expected_time_topo``;
+- **placement**: ``planner/placement.py`` derives the ring-neighbor-
+  cost-minimizing rank permutation and proves it schedule-equivalent
+  (M4T206) before anything may arm it.
+
+The headline ``value`` is the best proven expected time for one
+AllReduce on the fabric (min over the admitted generated schedules and
+the placed shipped ring) — lower is better, the BENCH trajectory
+convention. The record carries the unplaced shipped-ring baseline,
+both per-mechanism times and gains, the admission counts, and the
+M4T206 verdict; the run **fails** (rc 1) unless at least one
+generated schedule is admitted, the placement proof is clean, and the
+combined result actually beats the baseline.
+
+Emits the benchmark JSON line on stdout (the BENCH ``parsed`` record)
+and, with ``--out``, the full round wrapper — the ``placement``
+variant trajectory ``perf gate`` covers::
+
+    python benchmarks/placement_search.py --out BENCH_r18_placement.json
+    python -m mpi4jax_tpu.observability.perf gate --variant placement
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("MPI4JAX_TPU_SKIP_VERSION_CHECK", "1")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from mpi4jax_tpu.analysis import placement_check  # noqa: E402
+from mpi4jax_tpu.observability import costmodel, topology  # noqa: E402
+from mpi4jax_tpu.planner import algogen, placement  # noqa: E402
+
+
+def run(world: int, nbytes: int, seed: int):
+    topo = placement.adversarial_topo(world, seed=seed)
+    betas = topology.edge_betas(topo)
+    gbps = costmodel.peak_gbps()
+    alpha = costmodel.alpha_s()
+
+    # baseline: the shipped ring on the identity placement
+    ring_s = costmodel.expected_time_topo(
+        "AllReduce", nbytes=nbytes, world=world, betas=betas,
+        gbps=gbps, alpha=alpha,
+    )
+
+    # mechanism 1: proof-gated schedule-space search
+    with tempfile.TemporaryDirectory() as tmp:
+        search = algogen.search(topo, worlds=(2, 4, world), out_dir=tmp)
+    admitted = [
+        c for c in search["candidates"] if c["verdict"] == "admitted"
+    ]
+    gen_times = {
+        c["name"]: c["expected_s"][str(world)][str(nbytes)]
+        for c in admitted
+        if c["expected_s"][str(world)].get(str(nbytes)) is not None
+    }
+    gen_best_s = min(gen_times.values()) if gen_times else None
+    gen_best = (
+        min(gen_times, key=gen_times.get) if gen_times else None
+    )
+
+    # mechanism 2: verified rank placement under the shipped ring
+    doc = placement.derive(topo, nbytes=nbytes)
+    reports = placement.verify(doc)
+    m4t206_clean = placement_check.reports_clean(reports)
+    if m4t206_clean:
+        doc = placement.prove(doc)
+    placed_s = doc["expected_s"]
+
+    candidates = [t for t in (gen_best_s, placed_s) if t is not None]
+    best_s = min(candidates) if candidates else None
+    rec = {
+        "metric": "placement_algogen_adversarial",
+        "value": best_s,
+        "unit": "s",
+        "vs_baseline": None,
+        "nproc": world,
+        "fused": None,
+        "nbytes": nbytes,
+        "seed": seed,
+        "ring_identity_s": ring_s,
+        "gen_best": gen_best,
+        "gen_best_s": gen_best_s,
+        "gen_gain": (
+            ring_s / gen_best_s if ring_s and gen_best_s else None
+        ),
+        "gen_admitted": len(admitted),
+        "gen_rejected": len(search["candidates"]) - len(admitted),
+        "placed_perm": doc["perm"],
+        "placed_method": doc["method"],
+        "placed_s": placed_s,
+        "placement_gain": doc["gain"],
+        "m4t206": "verified" if m4t206_clean else "failed",
+        "m4t206_programs": len(
+            [r for r in reports if r.verdict != "unprovable"]
+        ),
+        "combined_gain": ring_s / best_s if ring_s and best_s else None,
+    }
+    ok = bool(
+        admitted
+        and m4t206_clean
+        and best_s is not None
+        and ring_s is not None
+        and best_s < ring_s
+    )
+    return rec, ok
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--world", type=int, default=8)
+    ap.add_argument("--nbytes", type=int, default=1 << 20)
+    ap.add_argument("--seed", type=int, default=18)
+    ap.add_argument(
+        "--round", type=int, default=18,
+        help="BENCH round number for the --out wrapper",
+    )
+    ap.add_argument(
+        "--out", default=None, metavar="BENCH_rNN_placement.json",
+        help="also write the BENCH round wrapper {n, cmd, rc, tail, parsed}",
+    )
+    args = ap.parse_args()
+    rec, ok = run(args.world, args.nbytes, args.seed)
+    line = json.dumps(rec)
+    print(line)
+    rc = 0 if ok else 1
+    if rc:
+        print(
+            "placement_search: FAILED acceptance (need an admitted "
+            "generated schedule, a clean M4T206 proof, and a combined "
+            f"win over the baseline ring): {rec}",
+            file=sys.stderr,
+        )
+    if args.out:
+        wrapper = {
+            "n": args.round,
+            "cmd": "python benchmarks/placement_search.py "
+                   f"--world {args.world} --nbytes {args.nbytes} "
+                   f"--seed {args.seed}",
+            "rc": rc,
+            "tail": line + "\n",
+            "parsed": rec,
+        }
+        with open(args.out, "w") as f:
+            json.dump(wrapper, f, indent=1)
+            f.write("\n")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

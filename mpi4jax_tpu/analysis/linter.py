@@ -292,12 +292,15 @@ def reports_to_json(reports: List[Report]) -> Dict[str, Any]:
 
 def rule_catalog() -> str:
     """One line per registered rule (the ``--rules`` CLI listing):
-    the M4T1xx lint rules, the M4T2xx simulation verdicts, and the
-    algorithm admission rules (M4T204/M4T205)."""
+    the M4T1xx lint rules, the M4T2xx simulation verdicts, the
+    algorithm admission rules (M4T204/M4T205), and the placement
+    admission rule (M4T206)."""
     from .algo_check import algo_rule_catalog
+    from .placement_check import placement_rule_catalog
     from .simulate import sim_rule_catalog
 
     lint_lines = "\n".join(
         f"{r.code} [{r.severity}] {r.title}" for r in RULES.values()
     )
-    return lint_lines + "\n" + sim_rule_catalog() + "\n" + algo_rule_catalog()
+    return (lint_lines + "\n" + sim_rule_catalog() + "\n"
+            + algo_rule_catalog() + "\n" + placement_rule_catalog())

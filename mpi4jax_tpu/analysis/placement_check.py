@@ -1,0 +1,279 @@
+"""M4T206: static verification of rank-placement permutations.
+
+PR 18's topology-aware placement (``planner/placement.py``) permutes
+which *physical* rank hosts which *logical* rank so that
+communication-heavy neighbors land on fast measured links (Cloud
+Collectives, arXiv:2105.14088). A permutation changes which wires
+bytes ride — it must never change what any rank *does*. This module
+is the admission oracle for that property: before a permutation may
+arm (``launch --place`` / a plan-cache placement entry), the PR 6
+schedule simulator is re-run over the permuted edge mapping and the
+permutation is admitted only when
+
+1. the permuted program still **completes** (deadlock-free — the
+   permuted run is replayed through ``simulate.simulate_rounds``, so
+   an M4T201 rank-cycle in the relabeled world surfaces with its
+   witness), and
+2. the run is **schedule-isomorphic** to the original: physical rank
+   ``perm[r]`` executes exactly logical rank ``r``'s event sequence
+   (same fingerprint sequence, partners mapped through the
+   permutation) and every synchronization round advances the mapped
+   rank set — placement relabels the wires, never the schedule.
+
+Like the M4T20x rules this is device-free, emits
+:class:`..analysis.simulate.SimReport` verdicts with structured
+witnesses, and joins the shared rule catalog (``analysis --rules``,
+SARIF export). The checked programs default to a canonical ring
+schedule plus every registered ``m4t-algo/1`` algorithm feasible at
+the world, so arming a permutation proves it against everything the
+planner could actually route.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .simulate import SimFinding, SimReport, SimRule, simulate_rounds
+
+#: the placement verdict catalog (documentation + ``--rules`` + SARIF)
+PLACEMENT_RULES: Dict[str, SimRule] = {
+    "M4T206": SimRule(
+        "M4T206",
+        "placement permutation not schedule-equivalent (permuted "
+        "program deadlocks or breaks per-rank schedule isomorphism)",
+        "error",
+    ),
+}
+
+
+def placement_rule_catalog() -> str:
+    return "\n".join(
+        f"{r.code} [{r.severity}] {r.title}"
+        for r in PLACEMENT_RULES.values()
+    )
+
+
+#: the canonical probe program: the bandwidth-optimal ring allreduce,
+#: valid at every world >= 2 — so every permutation has at least one
+#: schedule to prove equivalence against even when no registered
+#: algorithm is feasible at its world
+_PROBE_RING_RAW = {
+    "schema": "m4t-algo/1",
+    "name": "placement-probe-ring",
+    "collective": "AllReduce",
+    "reduce": "SUM",
+    "worlds": [2],
+    "chunks": "n",
+    "phases": [
+        {"repeat": "n - 1", "steps": [
+            {"to": "(r + 1) % n", "from": "(r - 1) % n",
+             "send": "(r - i) % n", "recv": "(r - i - 1) % n",
+             "action": "reduce"}]},
+        {"repeat": "n - 1", "steps": [
+            {"to": "(r + 1) % n", "from": "(r - 1) % n",
+             "send": "(r - i + 1) % n", "recv": "(r - i) % n",
+             "action": "copy"}]},
+    ],
+}
+
+
+def _finding(message: str, witness: Dict[str, Any]) -> SimFinding:
+    rule = PLACEMENT_RULES["M4T206"]
+    return SimFinding(
+        code=rule.code, severity=rule.severity, message=message,
+        witness=witness,
+    )
+
+
+def perm_error(perm: Sequence[int], world: int) -> Optional[str]:
+    """Why ``perm`` is not a bijection over ``range(world)`` (None
+    when it is one)."""
+    try:
+        vals = [int(p) for p in perm]
+    except (TypeError, ValueError):
+        return f"permutation is not a list of ints: {perm!r}"
+    if len(vals) != int(world):
+        return (f"permutation has {len(vals)} entries for world "
+                f"{world}")
+    if sorted(vals) != list(range(int(world))):
+        return (f"permutation {vals} is not a bijection over "
+                f"range({world})")
+    return None
+
+
+def permute_events(events: Dict[int, List[Any]],
+                   perm: Sequence[int]) -> Dict[int, List[Any]]:
+    """Relabel a per-rank event map through ``perm``: logical rank
+    ``r``'s schedule is executed by physical rank ``perm[r]``, with
+    every rank reference (group, edges, send/recv peers) mapped the
+    same way. Fingerprints are untouched — the relabeled transfer is
+    the same transfer on different wires."""
+    p = [int(x) for x in perm]
+    out: Dict[int, List[Any]] = {}
+    for r, evs in events.items():
+        out[p[r]] = [
+            dataclasses.replace(
+                e,
+                group=tuple(sorted(p[g] for g in e.group)),
+                edges=tuple((p[s], p[d]) for s, d in e.edges),
+                sends=tuple(p[x] for x in e.sends),
+                recvs=tuple(p[x] for x in e.recvs),
+            )
+            for e in evs
+        ]
+    return out
+
+
+def fingerprint_sequences(
+    events: Dict[int, List[Any]],
+) -> Dict[int, Tuple[str, ...]]:
+    """Per-rank ordered event-fingerprint sequences — the identity a
+    verified permutation must carry over unchanged (rank ``perm[r]``
+    inherits rank ``r``'s sequence verbatim)."""
+    return {
+        r: tuple(e.fingerprint for e in evs)
+        for r, evs in events.items()
+    }
+
+
+def _default_specs(world: int) -> List[Any]:
+    from ..planner import algo as _algo
+
+    specs = [_algo.parse(dict(_PROBE_RING_RAW))]
+    try:
+        reg = _algo.registry()
+    except Exception:  # the check must not depend on registry health
+        reg = {}
+    for tag in sorted(reg):
+        impl = reg[tag]
+        if impl.static_feasible(impl.op, world=world):
+            specs.append(impl.spec)
+    return specs
+
+
+def check_permutation(
+    perm: Sequence[int],
+    world: int,
+    *,
+    specs: Optional[Sequence[Any]] = None,
+) -> List[SimReport]:
+    """Prove one placement permutation schedule-equivalent (M4T206).
+
+    Returns one :class:`SimReport` per checked program; the
+    permutation may arm only when every report is deadlock-free."""
+    from ..planner import algo as _algo
+
+    world = int(world)
+    bad = perm_error(perm, world)
+    if bad is not None:
+        return [SimReport(
+            target=f"placement[w{world}]",
+            axis_env={},
+            world=world,
+            verdict="findings",
+            findings=[_finding(
+                f"invalid placement permutation: {bad}",
+                {"perm": list(perm) if hasattr(perm, "__iter__")
+                 else repr(perm), "world": world},
+            )],
+        )]
+    p = [int(x) for x in perm]
+    if specs is None:
+        specs = _default_specs(world)
+    reports: List[SimReport] = []
+    for spec in specs:
+        target = f"placement[w{world}]:{spec.name}"
+        try:
+            program = _algo.expand(spec, world)
+        except _algo.AlgoError as exc:
+            # the program is infeasible at this world: nothing for the
+            # permutation to break — named skip, not a verdict
+            reports.append(SimReport(
+                target=target, axis_env={}, world=world,
+                verdict="unprovable",
+                reason=f"program infeasible at world {world}: {exc}",
+            ))
+            continue
+        events = _algo.events_for(program)
+        ok_o, adv_o, find_o = simulate_rounds(events)
+        if not ok_o:
+            codes = ",".join(sorted({f.code for f in find_o})) or "stuck"
+            reports.append(SimReport(
+                target=target, axis_env={}, world=world,
+                verdict="error",
+                reason=f"base schedule does not complete ({codes}) — "
+                       "fix the algorithm before placing it",
+            ))
+            continue
+        permuted = permute_events(events, p)
+        ok_p, adv_p, find_p = simulate_rounds(permuted)
+        findings: List[SimFinding] = []
+        if not ok_p:
+            for f in find_p:
+                findings.append(_finding(
+                    f"permuted program does not complete: {f.message}",
+                    {"perm": p, "base_code": f.code,
+                     "base_witness": f.witness},
+                ))
+            if not find_p:
+                findings.append(_finding(
+                    "permuted program does not complete (no progress)",
+                    {"perm": p},
+                ))
+        else:
+            # per-rank schedule isomorphism: physical rank perm[r]
+            # must walk logical rank r's fingerprint sequence...
+            seq_o = fingerprint_sequences(events)
+            seq_p = fingerprint_sequences(permuted)
+            for r in range(world):
+                if seq_p.get(p[r]) != seq_o.get(r):
+                    findings.append(_finding(
+                        f"rank {p[r]} does not execute logical rank "
+                        f"{r}'s schedule fingerprint sequence under "
+                        "the permutation",
+                        {"perm": p, "logical_rank": r,
+                         "physical_rank": p[r],
+                         "expected": list(seq_o.get(r) or ()),
+                         "got": list(seq_p.get(p[r]) or ())},
+                    ))
+            # ...and every synchronization round must advance exactly
+            # the mapped rank set (same rounds, same progress shape)
+            if len(adv_p) != len(adv_o):
+                findings.append(_finding(
+                    f"permuted program takes {len(adv_p)} rounds, "
+                    f"original takes {len(adv_o)} — not isomorphic",
+                    {"perm": p, "rounds_original": len(adv_o),
+                     "rounds_permuted": len(adv_p)},
+                ))
+            else:
+                for t, adv in enumerate(adv_o):
+                    want = {(p[r], pc) for r, pc in adv}
+                    got = set(adv_p[t])
+                    if want != got:
+                        findings.append(_finding(
+                            f"round {t} advances "
+                            f"{sorted(got - want) or sorted(want - got)}"
+                            " instead of the mapped rank set",
+                            {"perm": p, "round": t,
+                             "expected": sorted(want),
+                             "got": sorted(got)},
+                        ))
+                        break
+        reports.append(SimReport(
+            target=target,
+            axis_env={},
+            world=world,
+            verdict="deadlock-free" if not findings else "findings",
+            findings=findings,
+            n_events={r: len(evs) for r, evs in events.items()},
+            rounds=len(adv_p) if ok_p else 0,
+        ))
+    return reports
+
+
+def reports_clean(reports: Sequence[SimReport]) -> bool:
+    """Armable: every checked program proved deadlock-free or was a
+    named infeasibility skip (nothing to break at that world)."""
+    provable = [r for r in reports if r.verdict != "unprovable"]
+    return bool(provable) and all(r.deadlock_free for r in provable)

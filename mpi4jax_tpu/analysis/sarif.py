@@ -56,8 +56,10 @@ def _rules_meta() -> List[Dict[str, Any]]:
             }
         )
     from .algo_check import ALGO_RULES
+    from .placement_check import PLACEMENT_RULES
 
-    for r in list(SIM_RULES.values()) + list(ALGO_RULES.values()):
+    for r in (list(SIM_RULES.values()) + list(ALGO_RULES.values())
+              + list(PLACEMENT_RULES.values())):
         rules.append(
             {
                 "id": r.code,

@@ -34,6 +34,7 @@ from __future__ import annotations
 import collections as _collections
 import dataclasses
 import math
+import os
 from typing import Callable, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -432,6 +433,7 @@ class CartComm(Comm):
         dims: Sequence[int],
         periods: Union[bool, Sequence[bool]] = True,
         axis: Union[str, Sequence[str]] = WORLD_AXIS,
+        placement: Optional[Sequence[int]] = None,
     ):
         super().__init__(axis)
         self.dims = tuple(int(d) for d in dims)
@@ -441,16 +443,42 @@ class CartComm(Comm):
         if len(self.periods) != len(self.dims):
             raise ValueError("periods must match dims")
         self._n = math.prod(self.dims)
+        if placement is None and os.environ.get("M4T_PLACEMENT"):
+            # a launcher-armed, M4T206-verified permutation applies
+            # transparently: grid position p is hosted by physical
+            # rank perm[p], so every neighbor table this communicator
+            # builds routes over the verified placement
+            from .planner import placement as _placement
+
+            armed = _placement.armed(self._n)
+            placement = list(armed) if armed is not None else None
+        if placement is not None:
+            perm = tuple(int(p) for p in placement)
+            if sorted(perm) != list(range(self._n)):
+                raise ValueError(
+                    f"placement {list(perm)} is not a bijection over "
+                    f"range({self._n})"
+                )
+            self.placement: Optional[Tuple[int, ...]] = perm
+            self._inv = {p: i for i, p in enumerate(perm)}
+        else:
+            self.placement = None
+            self._inv = None
 
     @property
     def nranks(self) -> int:
         return self._n
 
     def coords(self, rank: int) -> Tuple[int, ...]:
+        if self._inv is not None:
+            rank = self._inv[int(rank)]
         return tuple(int(c) for c in np.unravel_index(rank, self.dims))
 
     def rank_at(self, coords: Sequence[int]) -> int:
-        return int(np.ravel_multi_index(tuple(coords), self.dims, mode="wrap"))
+        r = int(np.ravel_multi_index(tuple(coords), self.dims, mode="wrap"))
+        if self.placement is not None:
+            return self.placement[r]
+        return r
 
     def neighbor(self, rank: int, dim: int, disp: int) -> int:
         """Rank displaced by ``disp`` along ``dim``; PROC_NULL at a
@@ -471,7 +499,8 @@ class CartComm(Comm):
         return source, dest
 
     def __hash__(self):
-        return hash((type(self).__name__, self._axes, self.dims, self.periods))
+        return hash((type(self).__name__, self._axes, self.dims,
+                     self.periods, self.placement))
 
     def __eq__(self, other):
         return (
@@ -479,10 +508,14 @@ class CartComm(Comm):
             and other._axes == self._axes
             and other.dims == self.dims
             and other.periods == self.periods
+            and other.placement == self.placement
         )
 
     def __repr__(self):
-        return f"CartComm(dims={self.dims}, periods={self.periods}, axes={self._axes})"
+        place = (f", placement={list(self.placement)}"
+                 if self.placement is not None else "")
+        return (f"CartComm(dims={self.dims}, periods={self.periods}, "
+                f"axes={self._axes}{place})")
 
 
 @dataclasses.dataclass(frozen=True)

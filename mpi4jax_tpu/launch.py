@@ -346,6 +346,136 @@ def _verify_prelaunch(args, world=None) -> int:
     return 0
 
 
+def _place_prelaunch(args, world=None) -> int:
+    """``--place``: arm a rank-placement permutation only after its
+    M4T206 schedule-equivalence proof holds at this world, *before any
+    rank spawns*.
+
+    Truth over trust: the stamped proof is necessary but not
+    sufficient — the simulator re-runs over the permuted edge mapping
+    here, so a placement proven against yesterday's registry still
+    re-proves against today's. Any failure (unreadable document,
+    fingerprint drift, missing/stale proof, world mismatch, or a live
+    M4T206 finding) blocks the launch with the witness on stderr and
+    no rank spawned. On success ``M4T_PLACEMENT`` is exported, which
+    ``rank_env`` copies into every rank: ``parallel.mesh.world_mesh``
+    and ``comm.CartComm`` then apply the permutation transparently.
+    """
+    path = getattr(args, "place", None)
+    if not path:
+        return 0
+    world = args.nproc if world is None else int(world)
+    from .analysis import placement_check
+    from .planner import placement as _placemod
+
+    sys.stderr.write(
+        f"mpi4jax_tpu.launch: --place: verifying placement {path!r} "
+        f"(M4T206) at n={world} before spawning\n"
+    )
+    try:
+        doc = _placemod.load(path)
+    except _placemod.PlacementError as exc:
+        sys.stderr.write(
+            f"mpi4jax_tpu.launch: --place BLOCKED the launch: {path}: "
+            f"{exc} [{exc.reason}] — no rank was spawned.\n"
+        )
+        return 1
+    if int(doc.get("world") or 0) != world:
+        sys.stderr.write(
+            f"mpi4jax_tpu.launch: --place BLOCKED the launch: "
+            f"placement {path} was derived for world {doc.get('world')}"
+            f", this launch is -n {world} — no rank was spawned. "
+            "Re-derive it (`python -m mpi4jax_tpu.planner placement "
+            "derive --topo ...`).\n"
+        )
+        return 1
+    stale = _placemod.proof_mismatch(doc)
+    if stale is not None:
+        sys.stderr.write(
+            f"mpi4jax_tpu.launch: --place BLOCKED the launch: {path}: "
+            f"{stale} — no rank was spawned. An unproven permutation "
+            "must never route traffic; re-prove it (`python -m "
+            "mpi4jax_tpu.planner placement derive`).\n"
+        )
+        return 1
+    reports = _placemod.verify(doc)
+    for rep in reports:
+        sys.stderr.write(rep.to_text() + "\n")
+    if not placement_check.reports_clean(reports):
+        sys.stderr.write(
+            "mpi4jax_tpu.launch: --place BLOCKED the launch: the "
+            "permutation failed M4T206 re-verification (witnesses "
+            "above) — no rank was spawned.\n"
+        )
+        return 1
+    os.environ[_placemod.ENV_VAR] = _placemod.arm_string(doc)
+    gain = doc.get("gain")
+    sys.stderr.write(
+        f"mpi4jax_tpu.launch: --place: permutation {doc['perm']} "
+        f"verified against {len(reports)} program(s)"
+        + (f" (expected gain {gain:.2f}x)" if gain else "")
+        + "; arming M4T_PLACEMENT\n"
+    )
+    return 0
+
+
+def _replace_placement_elastic(args, new_world, search_dirs) -> None:
+    """Elastic placement: the shrunk world cannot reuse the old
+    permutation (M4T206 proofs are per-world), so re-derive from the
+    newest probed topology map restricted to the surviving ranks and
+    re-prove at ``new_world`` — or disarm, loudly. Placement must
+    never block a shrink it cannot help."""
+    from .planner import placement as _placemod
+
+    if not (getattr(args, "place", None)
+            or os.environ.get(_placemod.ENV_VAR)):
+        return
+    from .analysis import placement_check
+    from .observability import topology as _topology
+
+    def _log(msg):
+        sys.stderr.write(f"mpi4jax_tpu.launch: elastic: {msg}\n")
+
+    topo = None
+    try:
+        found = _topology.find([d for d in search_dirs if d])
+        if found:
+            topo = _topology.load(found)
+    except (OSError, ValueError):
+        topo = None
+    if topo is not None and int(topo.get("world") or 0) >= new_world:
+        sub_edges = {
+            k: v for k, v in (topo.get("edges") or {}).items()
+            if max(_topology.parse_edge(k)) < new_world
+        }
+        sub = dict(topo, world=new_world, edges=sub_edges)
+        try:
+            doc = _placemod.derive(sub, source="elastic")
+            reports = _placemod.verify(doc)
+            if placement_check.reports_clean(reports):
+                os.environ[_placemod.ENV_VAR] = _placemod.arm_string(doc)
+                gain = doc.get("gain")
+                _log(
+                    f"re-derived placement {doc['perm']} at the shrunk "
+                    f"world {new_world} (M4T206 verified"
+                    + (f", expected gain {gain:.2f}x" if gain else "")
+                    + ")"
+                )
+                return
+            _log(
+                f"re-derived placement failed M4T206 at world "
+                f"{new_world}; disarming"
+            )
+        except (ValueError, _placemod.PlacementError) as exc:
+            _log(f"placement re-derivation failed ({exc}); disarming")
+    else:
+        _log(
+            f"no probed topology map covers the shrunk world "
+            f"{new_world}; disarming placement"
+        )
+    os.environ.pop(_placemod.ENV_VAR, None)
+
+
 #: rank exit signatures that read "preemption notice honored": the
 #: PreemptGuard's graceful 143, or death by unhandled SIGTERM
 _PREEMPT_RCS = (143, -signal.SIGTERM)
@@ -366,7 +496,8 @@ def make_world_args(**overrides):
         events_dir=None, hang_timeout=0.0, heartbeat=5.0,
         doctor=False, live=False, live_grace=None, dashboard=False,
         metrics_port=None, perf=False, plan=None, tune=False,
-        verify=False, algo=None, static_check="off", fault_plan=None,
+        verify=False, algo=None, place=None, static_check="off",
+        fault_plan=None,
         retries=0, backoff=1.0, resume_dir=None,
         elastic=False, min_ranks=1,
         plan_cache_env=None, _live_report=None,
@@ -912,6 +1043,18 @@ def main(argv=None):
         "blocks the launch",
     )
     parser.add_argument(
+        "--place", default=None, metavar="PLACE.json",
+        help="arm a topology-aware rank placement (m4t-place/1, "
+        "planner/placement.py): the permutation is re-verified "
+        "schedule-equivalent (M4T206) at -n ranks before any rank "
+        "spawns — an unproven, stale, or world-mismatched placement "
+        "blocks the launch with a witness; on success every rank "
+        "inherits M4T_PLACEMENT and the world mesh / CartComm "
+        "neighbor tables ride the permuted links. With --elastic the "
+        "shrunk world re-derives placement from the probed topology "
+        "map (or disarms)",
+    )
+    parser.add_argument(
         "--static-check", choices=("off", "warn", "error"), default="off",
         help="set M4T_STATIC_CHECK for every rank: screen each op "
         "emission at trace time with the site-local static-analysis "
@@ -1023,6 +1166,15 @@ def main(argv=None):
 
     if args.verify:
         rc = _verify_prelaunch(args)
+        if rc != 0:
+            return rc
+
+    if args.place:
+        # unconditional (not only under --verify): an armed permutation
+        # reroutes every neighbor exchange, so it is simulator-verified
+        # or it does not spawn
+        args.place = os.path.abspath(args.place)
+        rc = _place_prelaunch(args)
         if rc != 0:
             return rc
 
@@ -1256,6 +1408,9 @@ def main(argv=None):
             )
             _log(state["blocked"])
             return None
+        _replace_placement_elastic(
+            args, new_world, [state.get("dir"), events_dir, resume_dir]
+        )
         state["transition"] = {
             "world": old_world,
             "next_world": new_world,

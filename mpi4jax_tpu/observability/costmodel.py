@@ -429,22 +429,7 @@ def _impl_edge_phases(
             return None
         if low is None:
             return None
-        chunk_b = -(-b // max(1, int(low.chunks)))
-        phases: List[Dict[str, Any]] = []
-        for groups in low.rounds:
-            first = True
-            for g in groups:
-                if not g.edges:
-                    continue
-                phases.append({
-                    "edges": [(int(s), int(d)) for s, d in g.edges],
-                    "per_edge_bytes": int(g.count) * chunk_b,
-                    # one synchronization round per simulator round,
-                    # however many fused bundles it carries
-                    "steps": 1 if first else 0,
-                })
-                first = False
-        return phases
+        return lowered_phases(low, b)
     if impl == "pallas_ring" and op in (
         "AllReduce", "ReduceScatter", "AllGather"
     ):
@@ -480,6 +465,31 @@ def _impl_edge_phases(
              "steps": 2 * (slow - 1)},
         ]
     return None
+
+
+def lowered_phases(low: Any, nbytes: int) -> List[Dict[str, Any]]:
+    """Edge phases of one ``m4t-algo/1`` :class:`~..planner.algo.Lowered`
+    schedule at a payload — the decomposition ``expected_time_topo``
+    prices. Public so the schedule-space generator (``planner/algogen``)
+    and ``planner algo lower --topo`` can price *candidate* lowerings
+    that are not (yet) registered impls."""
+    b = max(0, int(nbytes))
+    chunk_b = -(-b // max(1, int(low.chunks)))
+    phases: List[Dict[str, Any]] = []
+    for groups in low.rounds:
+        first = True
+        for g in groups:
+            if not g.edges:
+                continue
+            phases.append({
+                "edges": [(int(s), int(d)) for s, d in g.edges],
+                "per_edge_bytes": int(g.count) * chunk_b,
+                # one synchronization round per simulator round,
+                # however many fused bundles it carries
+                "steps": 1 if first else 0,
+            })
+            first = False
+    return phases
 
 
 def record_edge_phases(record: Dict[str, Any]) -> List[Dict[str, Any]]:
@@ -520,18 +530,49 @@ def expected_time_topo(
     )
     if not phases:
         return None
+    return phases_time_topo(phases, betas=betas, gbps=gbps, alpha=alpha)
+
+
+def phase_drain_topo(
+    phase: Dict[str, Any],
+    *,
+    betas: Dict[Tuple[int, int], float],
+    gbps: Optional[float] = None,
+) -> Tuple[float, Optional[Tuple[int, int]]]:
+    """Drain time of one edge phase over a measured link map: the
+    phase completes when its slowest link has moved its bytes.
+    Returns ``(seconds, slowest_edge)`` (edge None when the phase has
+    no positive-bandwidth edges). Unmeasured edges price at the
+    uniform ``gbps``."""
     gbps = peak_gbps() if gbps is None else float(gbps)
+    worst = 0.0
+    worst_edge: Optional[Tuple[int, int]] = None
+    for src, dst in phase["edges"]:
+        e = (int(src), int(dst))
+        beta = betas.get(e, gbps)
+        if beta and beta > 0:
+            drain = int(phase["per_edge_bytes"]) / (beta * 1e9)
+            if drain >= worst:
+                worst, worst_edge = drain, e
+    return worst, worst_edge
+
+
+def phases_time_topo(
+    phases: List[Dict[str, Any]],
+    *,
+    betas: Dict[Tuple[int, int], float],
+    gbps: Optional[float] = None,
+    alpha: Optional[float] = None,
+) -> float:
+    """Total edge-aware alpha-beta time of a phase list (the
+    :func:`expected_time_topo` accumulation, factored out so
+    ``planner/algogen`` and ``algo lower --topo`` price candidate
+    lowerings through the identical formula)."""
     alpha = alpha_s() if alpha is None else float(alpha)
     t = 0.0
     for phase in phases:
         t += int(phase["steps"]) * alpha
-        worst = 0.0
-        for src, dst in phase["edges"]:
-            beta = betas.get((int(src), int(dst)), gbps)
-            if beta and beta > 0:
-                worst = max(
-                    worst, int(phase["per_edge_bytes"]) / (beta * 1e9)
-                )
+        worst, _edge = phase_drain_topo(phase, betas=betas, gbps=gbps)
         t += worst
     return t
 

@@ -376,11 +376,16 @@ def find(inputs: Iterable[str]) -> Optional[Dict[str, Any]]:
 
 def edge_betas(topo: Dict[str, Any]) -> Dict[Edge, float]:
     """``{(src, dst): beta_gbps}`` — the shape
-    ``costmodel.expected_time_topo`` and the autotune sweep consume."""
-    return {
-        parse_edge(k): float(v["beta_gbps"])
-        for k, v in (topo.get("edges") or {}).items()
-    }
+    ``costmodel.expected_time_topo`` and the autotune sweep consume.
+    Entries without a positive numeric beta (a partial probe that
+    failed some edges) are skipped, not a KeyError: consumers treat an
+    absent edge as unmeasured."""
+    out: Dict[Edge, float] = {}
+    for k, v in (topo.get("edges") or {}).items():
+        beta = (v or {}).get("beta_gbps")
+        if isinstance(beta, (int, float)) and beta > 0:
+            out[parse_edge(k)] = float(beta)
+    return out
 
 
 def fleet_median_beta(topo: Dict[str, Any]) -> Optional[float]:
@@ -465,8 +470,10 @@ def attribute_links(
 
     Returns ``{"links": {"src->dst": {"src", "dst", "samples",
     "gbps_p50", "bytes"}}}``, with ``"beta_gbps"``/``"vs_probe"``
-    joined in when a probe map is given. ``by_rank`` is the
-    ``doctor.load`` shape."""
+    joined in when a probe map is given. A decomposed edge the probe
+    map does not cover (partial probe, shrunk world, failed fit) is a
+    warned skip counted in ``"missing_edges"`` — never a KeyError.
+    ``by_rank`` is the ``doctor.load`` shape."""
     from . import doctor
 
     per_edge: Dict[Edge, List[float]] = {}
@@ -503,6 +510,7 @@ def attribute_links(
                 bytes_edge[e] = bytes_edge.get(e, 0) + nbytes
     betas = edge_betas(topo) if topo else {}
     links: Dict[str, Any] = {}
+    missing: List[str] = []
     for e in sorted(per_edge):
         src, dst = e
         p50 = statistics.median(per_edge[e])
@@ -517,8 +525,20 @@ def attribute_links(
         if beta:
             row["beta_gbps"] = beta
             row["vs_probe"] = p50 / beta
+        elif topo:
+            missing.append(edge_key(src, dst))
         links[edge_key(src, dst)] = row
-    return {"links": links}
+    out: Dict[str, Any] = {"links": links}
+    if topo:
+        out["missing_edges"] = len(missing)
+        if missing:
+            print(
+                f"# topology: {len(missing)} attributed edge(s) not in "
+                f"the probe map (no vs_probe): {' '.join(missing[:8])}"
+                + (" ..." if len(missing) > 8 else ""),
+                file=sys.stderr,
+            )
+    return out
 
 
 # ---------------------------------------------------------------------

@@ -77,7 +77,16 @@ def local_blocks(global_array) -> np.ndarray:
 
 
 def world_mesh(n: Optional[int] = None, axis: str = WORLD_AXIS) -> Mesh:
-    """A 1-D mesh over ``n`` (default: all) devices in topology order."""
+    """A 1-D mesh over ``n`` (default: all) devices in topology order.
+
+    When the launcher armed a verified placement permutation
+    (``M4T_PLACEMENT``, written only after the M4T206 schedule-
+    equivalence proof — ``planner/placement.py``), mesh position ``r``
+    is hosted by device ``perm[r]``: neighbor exchanges along the mesh
+    axis then ride the measured-fastest links instead of enumeration
+    order."""
+    import os
+
     devices = jax.devices()
     if n is not None:
         if n > len(devices):
@@ -90,6 +99,12 @@ def world_mesh(n: Optional[int] = None, axis: str = WORLD_AXIS) -> Mesh:
         dev_array = mesh_utils.create_device_mesh((n,), devices=devices)
     except Exception:
         dev_array = np.asarray(devices)
+    if os.environ.get("M4T_PLACEMENT"):
+        from ..planner import placement as _placement
+
+        placed = _placement.apply_to_sequence(list(dev_array.flat))
+        if len(placed) == n:
+            dev_array = np.asarray(placed)
     return Mesh(dev_array, (axis,))
 
 

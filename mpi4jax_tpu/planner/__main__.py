@@ -167,6 +167,44 @@ def _cmd_tune(args: argparse.Namespace) -> int:
         prune=args.prune,
         topo=topo,
     )
+    if args.from_verdicts and topo is not None:
+        # PR 8's loop closure goes one step further here: a confirmed
+        # straggler does not just re-tune impl choices — over the same
+        # measured map it proposes a *re-permutation* of rank placement,
+        # attached to the plan only after M4T206 proves it
+        from ..analysis import placement_check
+        from . import placement as _placement
+
+        doc = _placement.derive(
+            topo,
+            gbps=args.peak_gbps,
+            alpha=(args.alpha_us * 1e-6
+                   if args.alpha_us is not None else None),
+            source="retune",
+        )
+        reports = _placement.verify(doc)
+        if placement_check.reports_clean(reports):
+            doc = dict(doc)
+            doc["proof"] = _placement.build_proof(doc, reports)
+            planobj.placement = doc
+            print(
+                f"tune: re-permutation {doc['perm']} verified (M4T206, "
+                f"{len(reports)} program(s)); expected "
+                f"{doc['expected_s']:.3g}s vs identity "
+                f"{doc['identity_s']:.3g}s (gain {doc['gain']:.2f}x) — "
+                "attached to the plan",
+                file=sys.stderr,
+            )
+        else:
+            bad = [
+                f"{r.target}: {f.message}"
+                for r in reports for f in r.findings
+            ]
+            print(
+                "tune: re-permutation proposal failed M4T206 — not "
+                f"attached: {'; '.join(bad) or 'no provable program'}",
+                file=sys.stderr,
+            )
     cache = _cache_path(args)
     if cache and not args.dry_run:
         if not args.fresh and os.path.exists(cache):
@@ -371,6 +409,16 @@ def _cmd_algo_lower(args: argparse.Namespace) -> int:
     except _algo.AlgoError as exc:
         print(f"lower: {args.file}: {exc}", file=sys.stderr)
         return 1
+    betas = None
+    if args.topo:
+        from ..observability import costmodel as _costmodel
+        from ..observability import topology as _topology
+
+        try:
+            betas = _topology.edge_betas(_topology.load(args.topo))
+        except (OSError, ValueError) as exc:
+            print(f"lower: --topo {args.topo}: {exc}", file=sys.stderr)
+            return 2
     worlds = _parse_ranks(args.ranks) or list(spec.worlds)
     out = {}
     for n in worlds:
@@ -381,6 +429,7 @@ def _cmd_algo_lower(args: argparse.Namespace) -> int:
                   file=sys.stderr)
             return 1
         out[str(n)] = low.to_json()
+        chunk_b = -(-int(args.payload) // max(1, low.chunks))
         if not args.json:
             print(f"{spec.tag} world={n}: {len(low.rounds)} rounds, "
                   f"wire_chunks={low.wire_chunks}, "
@@ -390,9 +439,216 @@ def _cmd_algo_lower(args: argparse.Namespace) -> int:
                     edges = " ".join(
                         f"{a}->{b}" for a, b in g.edges
                     )
-                    print(f"  round {t} (x{g.count}): {edges}")
+                    drain = ""
+                    if betas is not None:
+                        # the measured-map view: each round drains at
+                        # its slowest edge (the expected_time_topo
+                        # objective, printed one round at a time)
+                        secs, worst = _costmodel.phase_drain_topo(
+                            {"edges": g.edges,
+                             "per_edge_bytes": g.count * chunk_b},
+                            betas=betas,
+                        )
+                        if worst is not None:
+                            drain = (f"  drain={secs * 1e6:.2f}us "
+                                     f"slowest={worst[0]}->{worst[1]}")
+                    print(f"  round {t} (x{g.count}): {edges}{drain}")
+        elif betas is not None:
+            drains = []
+            for groups in low.rounds:
+                for g in groups:
+                    secs, worst = _costmodel.phase_drain_topo(
+                        {"edges": g.edges,
+                         "per_edge_bytes": g.count * chunk_b},
+                        betas=betas,
+                    )
+                    drains.append({
+                        "drain_s": secs,
+                        "slowest_edge": list(worst) if worst else None,
+                    })
+            out[str(n)]["topo_drains"] = drains
     if args.json:
         print(json.dumps(out, indent=1))
+    return 0
+
+
+# ---------------------------------------------------------------------
+# algogen: proof-gated schedule-space search
+# ---------------------------------------------------------------------
+
+
+_OP_NAMES = {"allreduce": "AllReduce", "alltoall": "AllToAll"}
+
+
+def _load_topo_or_exit2(path: str, label: str):
+    from ..observability import topology as _topology
+
+    try:
+        return _topology.load(path)
+    except (OSError, ValueError) as exc:
+        print(f"{label}: --topo {path}: {exc}", file=sys.stderr)
+        return None
+
+
+def _cmd_algogen_search(args: argparse.Namespace) -> int:
+    from . import algogen as _algogen
+
+    topo = _load_topo_or_exit2(args.topo, "algogen search")
+    if topo is None:
+        return 2
+    op = _OP_NAMES.get(args.op.lower(), args.op)
+    worlds = _parse_ranks(args.worlds) or [2, 4, 8]
+    payloads = tuple(
+        _parse_ranks(args.payloads) or _algogen.DEFAULT_PAYLOADS
+    )
+    try:
+        out = _algogen.search(
+            topo,
+            op=op,
+            worlds=worlds,
+            out_dir=args.out,
+            payloads=payloads,
+            gbps=args.peak_gbps,
+            alpha=(args.alpha_us * 1e-6
+                   if args.alpha_us is not None else None),
+            keep_all=args.keep_all,
+        )
+    except ValueError as exc:
+        print(f"algogen search: {exc}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(out, indent=1))
+    else:
+        sw = str(out["candidates"][0]["score_world"]) \
+            if out["candidates"] else "?"
+        for row in out["candidates"]:
+            mark = "ok" if row["verdict"] == "admitted" else "SKIP"
+            times = " ".join(
+                f"b{b}={t * 1e6:.1f}us" if t is not None else f"b{b}=-"
+                for b, t in sorted(
+                    (int(k), v)
+                    for k, v in row["expected_s"][sw].items()
+                )
+            )
+            print(f"{mark:4} {row['name']} w{sw} {times} "
+                  f"beats_ring={row['beats_ring']}")
+            if row["verdict"] != "admitted":
+                print(f"     {row['verdict']}")
+            elif row.get("file"):
+                print(f"     wrote {row['file']} (+ proof)")
+        n_adm = sum(
+            1 for r in out["candidates"] if r["verdict"] == "admitted"
+        )
+        print(f"# {n_adm}/{len(out['candidates'])} candidate(s) "
+              f"admitted at worlds {out['worlds']}"
+              + (f"; {len(out['written'])} written to {args.out}"
+                 if args.out else " (dry run: no --out)"))
+    return 0 if out["written"] or not args.out else 1
+
+
+# ---------------------------------------------------------------------
+# placement: derive / verify / show (M4T206-gated)
+# ---------------------------------------------------------------------
+
+
+def _cmd_placement_derive(args: argparse.Namespace) -> int:
+    from ..analysis import placement_check
+    from . import placement as _placement
+
+    topo = _load_topo_or_exit2(args.topo, "placement derive")
+    if topo is None:
+        return 2
+    kw = {}
+    if args.payload is not None:
+        kw["nbytes"] = args.payload
+    doc = _placement.derive(
+        topo,
+        gbps=args.peak_gbps,
+        alpha=(args.alpha_us * 1e-6
+               if args.alpha_us is not None else None),
+        **kw,
+    )
+    reports = _placement.verify(doc)
+    clean = placement_check.reports_clean(reports)
+    if clean:
+        doc = dict(doc)
+        doc["proof"] = _placement.build_proof(doc, reports)
+    if args.json:
+        print(json.dumps({
+            "placement": doc,
+            "verified": clean,
+            "reports": [
+                {"target": r.target, "verdict": r.verdict,
+                 "findings": [f.message for f in r.findings]}
+                for r in reports
+            ],
+        }, indent=1))
+    else:
+        _print_algo_reports(reports)
+        gain = doc.get("gain")
+        print(f"# perm {doc['perm']} ({doc['method']}) expected "
+              f"{doc['expected_s']:.3g}s vs identity "
+              f"{doc['identity_s']:.3g}s"
+              + (f" (gain {gain:.2f}x)" if gain else ""))
+    if not clean:
+        print("placement derive: M4T206 failed — document not "
+              "armable and not written", file=sys.stderr)
+        return 1
+    if args.out:
+        _placement.save(doc, args.out)
+        print(f"# proven placement written to {args.out} "
+              f"(fingerprint {doc['fingerprint']})", file=sys.stderr)
+    return 0
+
+
+def _cmd_placement_verify(args: argparse.Namespace) -> int:
+    from ..analysis import placement_check
+    from . import placement as _placement
+
+    try:
+        doc = _placement.load(args.file)
+    except _placement.PlacementError as exc:
+        print(f"verify: {args.file}: {exc} [{exc.reason}]",
+              file=sys.stderr)
+        return 1
+    stale = _placement.proof_mismatch(doc)
+    reports = _placement.verify(doc)
+    clean = placement_check.reports_clean(reports)
+    if args.json:
+        from ..analysis.simulate import sim_reports_to_json
+
+        print(json.dumps({
+            "file": args.file,
+            "proof_mismatch": stale,
+            "verified": clean and stale is None,
+            "reports": sim_reports_to_json(reports),
+        }, indent=1))
+    else:
+        _print_algo_reports(reports)
+        if stale is not None:
+            print(f"FAIL proof: {stale}")
+    return 0 if clean and stale is None else 1
+
+
+def _cmd_placement_show(args: argparse.Namespace) -> int:
+    from . import placement as _placement
+
+    try:
+        doc = _placement.load(args.file)
+    except _placement.PlacementError as exc:
+        print(f"show: {args.file}: {exc} [{exc.reason}]",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(doc, indent=1))
+        return 0
+    stale = _placement.proof_mismatch(doc)
+    for k in ("schema", "world", "perm", "op", "nbytes", "method",
+              "identity_s", "expected_s", "gain", "source",
+              "fingerprint"):
+        print(f"{k}: {doc.get(k)}")
+    print(f"proven: {stale is None}"
+          + (f" ({stale})" if stale else ""))
     return 0
 
 
@@ -620,6 +876,14 @@ def selftest() -> int:
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if "--selftest" in argv:
+        if "placement" in argv:
+            from . import placement as _placement
+
+            return _placement.selftest()
+        if "algogen" in argv:
+            from . import algogen as _algogen
+
+            return _algogen.selftest()
         return selftest()
     parser = argparse.ArgumentParser(
         prog="python -m mpi4jax_tpu.planner",
@@ -773,8 +1037,112 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     a_lower.add_argument("file", metavar="FILE")
     a_lower.add_argument("--ranks", default=None, metavar="N[,M...]")
+    a_lower.add_argument(
+        "--topo", default=None, metavar="TOPO.json",
+        help="measured m4t-topo/1 map: annotate every round with its "
+        "slowest-edge drain time over the measured betas (exit 2 on a "
+        "bad map, like `tune --topo`)",
+    )
+    a_lower.add_argument(
+        "--payload", type=int, default=1 << 20, metavar="BYTES",
+        help="payload size the --topo drain times assume "
+        "(default %(default)s)",
+    )
     a_lower.add_argument("--json", action="store_true")
     a_lower.set_defaults(func=_cmd_algo_lower)
+
+    p_gen = sub.add_parser(
+        "algogen",
+        help="search the m4t-algo/1 schedule space over a measured "
+        "topology; write only proof-stamped winners (device-free)",
+    )
+    gen_sub = p_gen.add_subparsers(dest="algogen_command", required=True)
+    g_search = gen_sub.add_parser(
+        "search",
+        help="generate candidate algorithms specialized to a measured "
+        "m4t-topo/1 map, score them against the shipped ring "
+        "(costmodel.expected_time_topo objective), prove admitted "
+        "candidates (M4T201/202/204/205) at every target world, and "
+        "write spec + proof files the registry accepts unchanged",
+    )
+    g_search.add_argument(
+        "--topo", required=True, metavar="TOPO.json",
+        help="measured m4t-topo/1 topology map (exit 2 on a bad map)",
+    )
+    g_search.add_argument(
+        "--op", default="allreduce",
+        help="collective to generate for (default %(default)s)",
+    )
+    g_search.add_argument(
+        "--worlds", default="2,4,8", metavar="2,4,8",
+        help="world sizes every winner must prove at "
+        "(default %(default)s)",
+    )
+    g_search.add_argument(
+        "--out", default=None, metavar="DIR",
+        help="directory for the proof-stamped winner files (omit for "
+        "a dry run that only reports the scoring)",
+    )
+    g_search.add_argument(
+        "--payloads", default=None, metavar="4096,1048576",
+        help="payload classes to score at (default: a 4KiB latency "
+        "probe and a 1MiB bandwidth probe)",
+    )
+    g_search.add_argument(
+        "--keep-all", action="store_true",
+        help="write every proven candidate, even ones the shipped "
+        "ring beats",
+    )
+    g_search.add_argument("--peak-gbps", type=float, default=None)
+    g_search.add_argument("--alpha-us", type=float, default=None)
+    g_search.add_argument("--json", action="store_true")
+    g_search.set_defaults(func=_cmd_algogen_search)
+
+    p_place = sub.add_parser(
+        "placement",
+        help="derive / verify / show topology-aware rank placements "
+        "(M4T206-gated; `placement --selftest` runs the smoke)",
+    )
+    place_sub = p_place.add_subparsers(dest="placement_command",
+                                       required=True)
+    pl_derive = place_sub.add_parser(
+        "derive",
+        help="compute the ring-neighbor-cost-minimizing permutation "
+        "for a measured m4t-topo/1 map, prove it (M4T206) and write "
+        "the m4t-place/1 document",
+    )
+    pl_derive.add_argument(
+        "--topo", required=True, metavar="TOPO.json",
+        help="measured m4t-topo/1 topology map (exit 2 on a bad map)",
+    )
+    pl_derive.add_argument(
+        "--out", default=None, metavar="PLACE.json",
+        help="where to write the proven placement document "
+        "(default: print only)",
+    )
+    pl_derive.add_argument(
+        "--payload", type=int, default=None, metavar="BYTES",
+        help="payload size the search objective assumes "
+        "(default 1MiB)",
+    )
+    pl_derive.add_argument("--peak-gbps", type=float, default=None)
+    pl_derive.add_argument("--alpha-us", type=float, default=None)
+    pl_derive.add_argument("--json", action="store_true")
+    pl_derive.set_defaults(func=_cmd_placement_derive)
+    pl_verify = place_sub.add_parser(
+        "verify",
+        help="re-run the M4T206 check for a placement document and "
+        "report the per-program verdicts (exit 1 on findings)",
+    )
+    pl_verify.add_argument("file", metavar="PLACE.json")
+    pl_verify.add_argument("--json", action="store_true")
+    pl_verify.set_defaults(func=_cmd_placement_verify)
+    pl_show = place_sub.add_parser(
+        "show", help="print a placement document's summary",
+    )
+    pl_show.add_argument("file", metavar="PLACE.json")
+    pl_show.add_argument("--json", action="store_true")
+    pl_show.set_defaults(func=_cmd_placement_show)
 
     args = parser.parse_args(argv)
     return args.func(args)

@@ -1,0 +1,552 @@
+"""Topology-aware rank placement: verified ``m4t-place/1`` permutations.
+
+Cloud Collectives (arXiv:2105.14088) shows large collective-time wins
+from *permuting ranks* so that communication-heavy neighbors land on
+fast physical links. PR 16's topology observatory measures the links
+(a fitted per-edge alpha/beta ``m4t-topo/1`` map); this module turns
+the map into a **rank permutation** that minimizes the ring-neighbor
+cost, and — the PR 18 contract — admits it only through static
+analysis: a permutation may arm only with a fresh **M4T206** proof
+(:mod:`..analysis.placement_check`) that the permuted program is
+deadlock-free and schedule-isomorphic to the original.
+
+The artifact is a small JSON document::
+
+    {"schema": "m4t-place/1", "world": 4, "perm": [0, 2, 1, 3],
+     "op": "AllReduce", "nbytes": 1048576, "method": "exact",
+     "identity_s": 4.6e-4, "expected_s": 1.9e-4, "gain": 2.4,
+     "source": "derive", "topo_provenance": {...},
+     "fingerprint": "<sha256/16 over the body>",
+     "proof": {"schema": "m4t-place-proof/1", "fingerprint": ...,
+               "world": 4, "rules": ["M4T206"],
+               "verdict": "verified", "checked": {...}}}
+
+content-fingerprinted like ``m4t-plan/1`` so a hand-edited permutation
+can never keep a stale proof. ``launch --place FILE`` re-verifies
+before any rank spawns (truth over trust) and, on success, exports
+``M4T_PLACEMENT`` so every rank applies the permutation transparently:
+``parallel.mesh.world_mesh`` reorders the device list (logical mesh
+position ``r`` is hosted on physical slot ``perm[r]``) and
+``comm.CartComm`` embeds its logical grid through the same map.
+
+Semantics: ``perm[logical] = physical``. The *logical* program — what
+every rank computes, the plan keys, the schedule fingerprints — is
+untouched; only the wires change. That is exactly what M4T206 proves.
+
+Device-free throughout (``selftest`` runs on any container).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+import os
+import sys
+import tempfile
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..observability import costmodel as _costmodel
+from ..observability import topology as _topology
+
+#: placement document schema tag
+SCHEMA = "m4t-place/1"
+#: proof artifact schema tag
+PROOF_SCHEMA = "m4t-place-proof/1"
+#: the static rules a placement proof certifies
+PROOF_RULES = ("M4T206",)
+#: env var carrying the armed permutation into every rank
+ENV_VAR = "M4T_PLACEMENT"
+#: nominal payload the search objective prices (one size class is
+#: enough: the ring objective is bandwidth-dominated and the argmax
+#: over edges is payload-independent)
+DEFAULT_NBYTES = 1 << 20
+#: worlds searched exhaustively ((n-1)! candidates with the rotation
+#: symmetry fixed); larger worlds use greedy + 2-opt
+EXACT_LIMIT = 8
+
+
+class PlacementError(ValueError):
+    """Invalid placement document. ``reason``:
+    ``schema | parse | fingerprint | world | proof``."""
+
+    def __init__(self, reason: str, message: str):
+        super().__init__(message)
+        self.reason = reason
+
+
+# ---------------------------------------------------------------------
+# document identity
+# ---------------------------------------------------------------------
+
+
+def body_fingerprint(doc: Dict[str, Any]) -> str:
+    """sha256/16 over the canonical body (everything except the
+    fingerprint itself and the attached proof) — the ``plan.Plan``
+    recipe, so hand-edits can never keep a stale stamp."""
+    body = {
+        k: v for k, v in doc.items() if k not in ("fingerprint", "proof")
+    }
+    blob = json.dumps(body, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------
+# the search objective
+# ---------------------------------------------------------------------
+
+
+def placed_betas(
+    betas: Dict[Tuple[int, int], float], perm: Sequence[int]
+) -> Dict[Tuple[int, int], float]:
+    """Logical-edge beta map under a placement: logical edge
+    ``(i, j)`` rides physical link ``(perm[i], perm[j])``."""
+    p = [int(x) for x in perm]
+    out: Dict[Tuple[int, int], float] = {}
+    for i in range(len(p)):
+        for j in range(len(p)):
+            if i == j:
+                continue
+            beta = betas.get((p[i], p[j]))
+            if beta is not None:
+                out[(i, j)] = beta
+    return out
+
+
+def placement_time(
+    perm: Sequence[int],
+    betas: Dict[Tuple[int, int], float],
+    *,
+    world: int,
+    op: str = "AllReduce",
+    nbytes: int = DEFAULT_NBYTES,
+    impl: Optional[str] = None,
+    gbps: Optional[float] = None,
+    alpha: Optional[float] = None,
+) -> Optional[float]:
+    """Expected time of one collective under a placement — the same
+    :func:`..observability.costmodel.expected_time_topo` pricing the
+    autotuner uses, over the permuted edge map."""
+    return _costmodel.expected_time_topo(
+        op, nbytes=nbytes, world=world,
+        betas=placed_betas(betas, perm),
+        impl=impl, gbps=gbps, alpha=alpha,
+    )
+
+
+def _ring_key(
+    perm: Sequence[int],
+    betas: Dict[Tuple[int, int], float],
+    gbps: float,
+) -> Tuple[float, float]:
+    """Cheap search key: the ring phase drains at its slowest logical
+    edge, so minimize ``max(1/beta)`` with ``sum(1/beta)`` breaking
+    ties (prefer uniformly fast rings among equal bottlenecks)."""
+    n = len(perm)
+    worst = 0.0
+    total = 0.0
+    for i in range(n):
+        beta = betas.get((perm[i], perm[(i + 1) % n]), gbps)
+        inv = 1.0 / beta if beta > 0 else float("inf")
+        worst = max(worst, inv)
+        total += inv
+    return (worst, total)
+
+
+def _search_exact(
+    betas: Dict[Tuple[int, int], float], world: int, gbps: float
+) -> List[int]:
+    best = list(range(world))
+    best_key = _ring_key(best, betas, gbps)
+    # the ring objective is rotation-invariant: fix perm[0] = 0
+    for rest in itertools.permutations(range(1, world)):
+        cand = [0, *rest]
+        key = _ring_key(cand, betas, gbps)
+        if key < best_key:
+            best, best_key = cand, key
+    return best
+
+
+def _search_greedy_2opt(
+    betas: Dict[Tuple[int, int], float], world: int, gbps: float
+) -> List[int]:
+    # greedy nearest neighbor on directed beta from rank 0
+    perm = [0]
+    left = set(range(1, world))
+    while left:
+        cur = perm[-1]
+        nxt = max(left, key=lambda c: (betas.get((cur, c), gbps), -c))
+        perm.append(nxt)
+        left.discard(nxt)
+    # 2-opt: segment reversals + pair swaps until no improvement
+    best_key = _ring_key(perm, betas, gbps)
+    improved = True
+    rounds = 0
+    while improved and rounds < 64:
+        improved = False
+        rounds += 1
+        for i in range(1, world - 1):
+            for j in range(i + 1, world):
+                for cand in (
+                    perm[:i] + perm[i:j][::-1] + perm[j:],  # reverse
+                    None,
+                ):
+                    if cand is None:
+                        cand = list(perm)
+                        cand[i], cand[j % world] = (
+                            cand[j % world], cand[i]
+                        )
+                    key = _ring_key(cand, betas, gbps)
+                    if key < best_key:
+                        perm, best_key = list(cand), key
+                        improved = True
+    return perm
+
+
+# ---------------------------------------------------------------------
+# derivation
+# ---------------------------------------------------------------------
+
+
+def derive(
+    topo: Dict[str, Any],
+    *,
+    op: str = "AllReduce",
+    nbytes: int = DEFAULT_NBYTES,
+    gbps: Optional[float] = None,
+    alpha: Optional[float] = None,
+    exact_limit: int = EXACT_LIMIT,
+    source: str = "derive",
+) -> Dict[str, Any]:
+    """Compute the ring-neighbor-cost-minimizing permutation for one
+    measured ``m4t-topo/1`` map. Exact search up to ``exact_limit``
+    ranks, greedy + 2-opt above. The result is *unproven* — run
+    :func:`prove` (M4T206) before arming it anywhere."""
+    topo = _topology.validate(topo)
+    world = int(topo["world"])
+    betas = _topology.edge_betas(topo)
+    uniform = _costmodel.peak_gbps() if gbps is None else float(gbps)
+    if world <= exact_limit:
+        perm, method = _search_exact(betas, world, uniform), "exact"
+    else:
+        perm, method = (
+            _search_greedy_2opt(betas, world, uniform), "greedy+2opt"
+        )
+    kw = dict(world=world, op=op, nbytes=nbytes, gbps=gbps, alpha=alpha)
+    identity_s = placement_time(list(range(world)), betas, **kw)
+    expected_s = placement_time(perm, betas, **kw)
+    if expected_s is not None and identity_s is not None \
+            and expected_s > identity_s:
+        # never propose a regression: identity is always admissible
+        perm, expected_s, method = (
+            list(range(world)), identity_s, method + ":identity"
+        )
+    doc = {
+        "schema": SCHEMA,
+        "world": world,
+        "perm": [int(p) for p in perm],
+        "op": op,
+        "nbytes": int(nbytes),
+        "method": method,
+        "identity_s": identity_s,
+        "expected_s": expected_s,
+        "gain": (
+            identity_s / expected_s
+            if identity_s and expected_s else None
+        ),
+        "source": source,
+        "topo_provenance": {
+            "platform": topo.get("platform"),
+            "edges": len(topo.get("edges") or {}),
+            **(topo.get("provenance") or {}),
+        },
+    }
+    doc["fingerprint"] = body_fingerprint(doc)
+    return doc
+
+
+# ---------------------------------------------------------------------
+# proof: M4T206 admission
+# ---------------------------------------------------------------------
+
+
+def verify(doc: Dict[str, Any], *, specs=None):
+    """Run the M4T206 check for one placement document. Returns the
+    per-program :class:`~..analysis.simulate.SimReport` list."""
+    from ..analysis import placement_check
+
+    return placement_check.check_permutation(
+        doc.get("perm") or [], int(doc.get("world") or 0), specs=specs,
+    )
+
+
+def build_proof(doc: Dict[str, Any], reports) -> Dict[str, Any]:
+    """Assemble the proof artifact from clean M4T206 reports; raises
+    ``ValueError`` when any report is unclean (no proof for a broken
+    permutation, ever)."""
+    from ..analysis import placement_check
+
+    if not placement_check.reports_clean(reports):
+        bad = [
+            (r.target, r.verdict, [f.code for f in r.findings])
+            for r in reports if not r.deadlock_free
+        ]
+        raise ValueError(f"placement not clean: {bad}")
+    return {
+        "schema": PROOF_SCHEMA,
+        "fingerprint": body_fingerprint(doc),
+        "world": int(doc["world"]),
+        "rules": list(PROOF_RULES),
+        "verdict": "verified",
+        "checked": {
+            r.target: r.rounds
+            for r in reports if r.verdict != "unprovable"
+        },
+    }
+
+
+def prove(doc: Dict[str, Any], *, specs=None) -> Dict[str, Any]:
+    """Verify (M4T206) and stamp the proof onto the document."""
+    out = dict(doc)
+    out["proof"] = build_proof(doc, verify(doc, specs=specs))
+    return out
+
+
+def proof_mismatch(doc: Dict[str, Any]) -> Optional[str]:
+    """Why this document's proof must not be trusted (None when the
+    stamp is present, fresh, and verified)."""
+    proof = doc.get("proof")
+    if not isinstance(proof, dict):
+        return "unproven placement: no attached M4T206 proof"
+    if proof.get("schema") != PROOF_SCHEMA:
+        return (f"proof schema mismatch: want {PROOF_SCHEMA!r}, got "
+                f"{proof.get('schema')!r}")
+    fp = body_fingerprint(doc)
+    if proof.get("fingerprint") != fp:
+        return (f"stale proof: placement fingerprint {fp} != proven "
+                f"{proof.get('fingerprint')}")
+    if proof.get("world") != doc.get("world"):
+        return (f"proof world {proof.get('world')} != placement world "
+                f"{doc.get('world')}")
+    if proof.get("verdict") != "verified":
+        return f"proof verdict {proof.get('verdict')!r} != 'verified'"
+    if not set(PROOF_RULES) <= set(proof.get("rules") or []):
+        return f"proof does not certify {PROOF_RULES}"
+    return None
+
+
+# ---------------------------------------------------------------------
+# persistence (atomic, fingerprint-validated)
+# ---------------------------------------------------------------------
+
+
+def save(doc: Dict[str, Any], path: str) -> str:
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(prefix=".place-", dir=d)
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def load(path: str) -> Dict[str, Any]:
+    """Load + validate one placement document. Raises
+    :class:`PlacementError` (reason ``parse | schema | fingerprint |
+    world``) on anything that must not be trusted."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as exc:
+        raise PlacementError("parse", f"{path}: {exc}")
+    if not isinstance(doc, dict) or doc.get("schema") != SCHEMA:
+        got = doc.get("schema") if isinstance(doc, dict) else type(doc)
+        raise PlacementError(
+            "schema", f"{path}: expected {SCHEMA!r}, got {got!r}"
+        )
+    world = doc.get("world")
+    perm = doc.get("perm")
+    if not isinstance(world, int) or not isinstance(perm, list):
+        raise PlacementError(
+            "world", f"{path}: needs integer 'world' and list 'perm'"
+        )
+    from ..analysis.placement_check import perm_error
+
+    bad = perm_error(perm, world)
+    if bad is not None:
+        raise PlacementError("world", f"{path}: {bad}")
+    fp = body_fingerprint(doc)
+    if doc.get("fingerprint") != fp:
+        raise PlacementError(
+            "fingerprint",
+            f"{path}: fingerprint drift (body {fp} != stamped "
+            f"{doc.get('fingerprint')}) — the document was edited "
+            "after derivation",
+        )
+    return doc
+
+
+# ---------------------------------------------------------------------
+# arming: the env seam every rank reads
+# ---------------------------------------------------------------------
+
+
+def arm_string(doc_or_perm) -> str:
+    perm = (
+        doc_or_perm.get("perm")
+        if isinstance(doc_or_perm, dict) else doc_or_perm
+    )
+    return ",".join(str(int(p)) for p in perm)
+
+
+_warned_bad_env = False
+
+
+def armed(world: Optional[int] = None) -> Optional[Tuple[int, ...]]:
+    """The armed permutation from ``M4T_PLACEMENT`` (or None). The
+    launcher only exports the variable after the M4T206 gate passed;
+    a malformed or world-mismatched value is ignored with one warning
+    — placement must never break a run it cannot help."""
+    global _warned_bad_env
+    raw = os.environ.get(ENV_VAR, "").strip()
+    if not raw:
+        return None
+    from ..analysis.placement_check import perm_error
+
+    try:
+        perm = tuple(int(p) for p in raw.split(","))
+    except ValueError:
+        perm = ()
+    n = len(perm) if world is None else int(world)
+    if not perm or perm_error(perm, n) is not None:
+        if not _warned_bad_env:
+            _warned_bad_env = True
+            print(
+                f"# placement: ignoring invalid {ENV_VAR}={raw!r}"
+                + (f" at world {world}" if world is not None else ""),
+                file=sys.stderr,
+            )
+        return None
+    return perm
+
+
+def apply_to_sequence(seq: Sequence[Any]) -> List[Any]:
+    """Transparent application: reorder a per-rank sequence (e.g. the
+    device list behind ``parallel.mesh.world_mesh``) so that logical
+    position ``r`` is hosted on physical slot ``perm[r]``. Identity
+    when nothing is armed or the world does not match."""
+    perm = armed(len(seq))
+    if perm is None:
+        return list(seq)
+    return [seq[p] for p in perm]
+
+
+# ---------------------------------------------------------------------
+# selftest (device-free; wired into CI via `planner placement --selftest`)
+# ---------------------------------------------------------------------
+
+
+def adversarial_topo(world: int = 8, *, seed: int = 18) -> Dict[str, Any]:
+    """The PR 18 acceptance fabric: ranks shuffled so that identity
+    ring neighbors ride slow crossing links while a measured fast
+    cycle hides in the permutation space. Deterministic in ``seed``."""
+    import random
+
+    rng = random.Random(seed)
+    order = list(range(world))
+    rng.shuffle(order)
+    links: Dict[Tuple[int, int], Dict[str, float]] = {}
+    fast, slow = 40.0, 2.5
+    cycle = {}
+    for k in range(world):
+        a, b = order[k], order[(k + 1) % world]
+        cycle[(a, b)] = True
+    for s in range(world):
+        for d in range(world):
+            if s == d:
+                continue
+            links[(s, d)] = {
+                "beta_gbps": fast if (s, d) in cycle else slow
+            }
+    model = _topology.SyntheticLinkModel(
+        world, alpha_s=2e-6, beta_gbps=slow, links=links
+    )
+    return _topology.synthetic_map(model)
+
+
+def selftest() -> int:
+    from ..analysis import placement_check
+
+    topo = adversarial_topo(6)
+    doc = derive(topo)
+    assert doc["schema"] == SCHEMA and len(doc["perm"]) == 6
+    assert doc["gain"] and doc["gain"] > 1.0, (
+        f"adversarial fabric must reward placement: {doc}"
+    )
+    # M4T206: the derived permutation proves schedule-equivalent
+    reports = verify(doc)
+    assert placement_check.reports_clean(reports), [
+        (r.target, r.verdict) for r in reports
+    ]
+    proven = prove(doc)
+    assert proof_mismatch(proven) is None
+    # hand-editing the permutation invalidates the proof
+    edited = dict(proven, perm=list(reversed(proven["perm"])))
+    drift = proof_mismatch(edited)
+    assert drift and "stale proof" in drift, drift
+    # a non-bijection never proves
+    bad = placement_check.check_permutation([0, 0, 1, 2, 3, 4], 6)
+    assert not placement_check.reports_clean(bad)
+    assert any(
+        f.code == "M4T206" for r in bad for f in r.findings
+    )
+    # persistence round-trip + tamper detection
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "place.json")
+        save(proven, path)
+        loaded = load(path)
+        assert loaded["perm"] == proven["perm"]
+        assert proof_mismatch(loaded) is None
+        tampered = json.load(open(path))
+        tampered["perm"] = list(range(6))
+        with open(path, "w") as f:
+            json.dump(tampered, f)
+        try:
+            load(path)
+        except PlacementError as exc:
+            assert exc.reason == "fingerprint", exc.reason
+        else:
+            raise AssertionError("edited perm must invalidate")
+    # env arming round-trip
+    saved = os.environ.get(ENV_VAR)
+    try:
+        os.environ[ENV_VAR] = arm_string(proven)
+        assert armed(6) == tuple(proven["perm"])
+        devices = [f"dev{i}" for i in range(6)]
+        placed = apply_to_sequence(devices)
+        assert sorted(placed) == sorted(devices)
+        assert placed == [devices[p] for p in proven["perm"]]
+        os.environ[ENV_VAR] = "0,0,1"
+        assert armed(6) is None
+    finally:
+        if saved is None:
+            os.environ.pop(ENV_VAR, None)
+        else:
+            os.environ[ENV_VAR] = saved
+    # identity fabric: derivation must not invent a permutation win
+    flat = _topology.synthetic_map(
+        _topology.SyntheticLinkModel(4, beta_gbps=20.0)
+    )
+    flat_doc = derive(flat)
+    assert flat_doc["gain"] is None or flat_doc["gain"] <= 1.0 + 1e-9
+    print("placement selftest ok")
+    return 0

@@ -209,20 +209,25 @@ class PlanEntry:
         )
 
 
-def _canonical_body(platform: str, entries: Dict[str, PlanEntry]) -> str:
+def _canonical_body(
+    platform: str,
+    entries: Dict[str, PlanEntry],
+    placement: Optional[Dict[str, Any]] = None,
+) -> str:
     """The byte sequence the plan fingerprint covers: schema, platform
     and sorted entries — everything that changes routing. ``created``
     deliberately does not participate, so re-saving an identical plan
-    keeps its id."""
-    return json.dumps(
-        {
-            "schema": SCHEMA,
-            "platform": platform,
-            "entries": {k: entries[k].to_json() for k in sorted(entries)},
-        },
-        sort_keys=True,
-        separators=(",", ":"),
-    )
+    keeps its id. A placement entry (an ``m4t-place/1`` document the
+    tune loop derived and verified) joins the body only when present,
+    so plans without one keep their pre-placement plan_id."""
+    body: Dict[str, Any] = {
+        "schema": SCHEMA,
+        "platform": platform,
+        "entries": {k: entries[k].to_json() for k in sorted(entries)},
+    }
+    if placement is not None:
+        body["placement"] = placement
+    return json.dumps(body, sort_keys=True, separators=(",", ":"))
 
 
 @dataclass
@@ -233,19 +238,25 @@ class Plan:
     entries: Dict[str, PlanEntry] = field(default_factory=dict)
     source: str = "analytic"
     created: float = 0.0
+    #: optional verified rank-placement document (``m4t-place/1``,
+    #: ``planner/placement.py``) the tune loop attached — provenance
+    #: for ``launch --place``-style arming from the plan cache
+    placement: Optional[Dict[str, Any]] = None
 
     @property
     def plan_id(self) -> str:
         """Content fingerprint: 16 hex chars of sha256 over the
         canonical body."""
-        blob = _canonical_body(self.platform, self.entries).encode()
+        blob = _canonical_body(
+            self.platform, self.entries, self.placement
+        ).encode()
         return hashlib.sha256(blob).hexdigest()[:16]
 
     def lookup(self, key: str) -> Optional[PlanEntry]:
         return self.entries.get(key)
 
     def to_json(self) -> Dict[str, Any]:
-        return {
+        out = {
             "schema": SCHEMA,
             "plan_id": self.plan_id,
             "platform": self.platform,
@@ -255,6 +266,9 @@ class Plan:
                 k: self.entries[k].to_json() for k in sorted(self.entries)
             },
         }
+        if self.placement is not None:
+            out["placement"] = self.placement
+        return out
 
     @classmethod
     def from_json(cls, data: Any) -> "Plan":
@@ -274,6 +288,7 @@ class Plan:
             entries=entries,
             source=str(data.get("source", "analytic")),
             created=float(data.get("created") or 0.0),
+            placement=data.get("placement"),
         )
         recorded = data.get("plan_id")
         if recorded is not None and recorded != plan.plan_id:
@@ -357,6 +372,8 @@ def merge(base: Optional[Plan], update: Plan) -> Plan:
         entries=entries,
         source="mixed" if base.entries else update.source,
         created=update.created,
+        placement=(update.placement if update.placement is not None
+                   else base.placement),
     )
 
 

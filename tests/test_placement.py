@@ -1,0 +1,641 @@
+"""PR 18: topology-aware placement + proof-gated schedule-space search.
+
+Covers the acceptance surface:
+
+- ``planner/placement.py``: the m4t-place/1 lifecycle — derivation
+  beats identity on the adversarial fabric, M4T206 admission
+  (``analysis/placement_check.py``), fingerprint/proof drift, atomic
+  persistence with tamper detection, env arming;
+- the 1000-seed schedule-isomorphism property: a verified permutation
+  never changes any rank's schedule fingerprint sequence;
+- ``launch --place``: simulator-verified-only — an unproven, stale,
+  or world-mismatched permutation is BLOCKED before any rank spawns
+  (witness on stderr), a proven one arms ``M4T_PLACEMENT`` into every
+  rank end to end;
+- transparent application: ``comm.CartComm`` grid embedding and
+  ``parallel.mesh.world_mesh`` device reorder;
+- ``planner/algogen.py``: the generator emits candidates that pass
+  the full M4T201/202/204/205 proof pipeline, beat the shipped ring
+  under ``costmodel.expected_time_topo`` on the adversarial fabric,
+  register through the PR 15 registry unchanged, and are swept by
+  ``planner tune`` on equal footing (the registry still refuses an
+  unproven generated file);
+- plan-cache provenance: the optional ``placement`` field round-trips
+  and plans without one keep their pre-placement ``plan_id``;
+- rule-catalog pins: M4T206 in ``analysis --rules`` and SARIF.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+
+import pytest
+
+from mpi4jax_tpu.analysis import placement_check
+from mpi4jax_tpu.observability import topology
+from mpi4jax_tpu.planner import algo as algomod
+from mpi4jax_tpu.planner import algogen
+from mpi4jax_tpu.planner import placement as placemod
+from mpi4jax_tpu.planner import plan as planmod
+
+pytestmark = [pytest.mark.tuning, pytest.mark.placement]
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+WORLD = 8
+
+
+def _clean_env():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("M4T_ALGO_PATH", None)
+    env.pop("M4T_PLACEMENT", None)
+    env.pop("M4T_PLAN_CACHE", None)
+    return env
+
+
+def _planner(*argv, timeout=240, env=None):
+    return subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.planner", *argv],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO,
+        env=env or _clean_env(),
+    )
+
+
+def _launch(*argv, timeout=240, env=None):
+    return subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.launch", *argv],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO,
+        env=env or _clean_env(),
+    )
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    # placement must never leak between tests (armed() reads the env
+    # lazily), and the algo registry cache must not carry a previous
+    # test's M4T_ALGO_PATH view
+    monkeypatch.delenv(placemod.ENV_VAR, raising=False)
+    monkeypatch.delenv("M4T_ALGO_PATH", raising=False)
+    algomod.invalidate_cache()
+    yield
+    algomod.invalidate_cache()
+
+
+@pytest.fixture(scope="module")
+def adversarial():
+    """The PR 18 acceptance fabric: a fast Hamiltonian cycle hidden
+    among slow links, hostile to the identity ring."""
+    return placemod.adversarial_topo(WORLD)
+
+
+@pytest.fixture(scope="module")
+def derived(adversarial):
+    return placemod.derive(adversarial)
+
+
+@pytest.fixture(scope="module")
+def proven(derived):
+    return placemod.prove(derived)
+
+
+@pytest.fixture(scope="module")
+def search_out(tmp_path_factory, adversarial):
+    """One proof-gated algogen search over the adversarial fabric,
+    shared by the admission / registry / tune tests."""
+    out_dir = str(tmp_path_factory.mktemp("algogen"))
+    return out_dir, algogen.search(adversarial, out_dir=out_dir)
+
+
+# ---------------------------------------------------------------------
+# derivation + M4T206 admission
+# ---------------------------------------------------------------------
+
+
+def test_derive_beats_identity_on_adversarial_fabric(derived):
+    assert derived["schema"] == placemod.SCHEMA
+    assert derived["world"] == WORLD
+    assert derived["perm"] != list(range(WORLD))
+    assert sorted(derived["perm"]) == list(range(WORLD))
+    assert derived["expected_s"] < derived["identity_s"]
+    assert derived["gain"] is not None and derived["gain"] > 1.0
+    assert derived["fingerprint"] == placemod.body_fingerprint(derived)
+
+
+def test_derive_never_proposes_a_regression():
+    # a uniform fabric has nothing to gain: derivation must fall back
+    # to the always-admissible identity, never a speculative shuffle
+    flat = topology.synthetic_map(
+        topology.SyntheticLinkModel(4, beta_gbps=20.0)
+    )
+    doc = placemod.derive(flat)
+    assert doc["gain"] is None or doc["gain"] <= 1.0 + 1e-9
+
+
+def test_derived_perm_proves_m4t206_clean(derived):
+    reports = placemod.verify(derived)
+    assert placement_check.reports_clean(reports)
+    provable = [r for r in reports if r.verdict != "unprovable"]
+    # at minimum the canonical probe ring plus the shipped registry
+    # algorithms feasible at world 8
+    assert len(provable) >= 2
+    assert all(r.verdict == "deadlock-free" for r in provable)
+
+
+def test_non_bijection_is_an_m4t206_finding():
+    reports = placement_check.check_permutation([0, 0, 1, 2], 4)
+    assert not placement_check.reports_clean(reports)
+    codes = {f.code for r in reports for f in r.findings}
+    assert codes == {"M4T206"}
+    msg = reports[0].findings[0].message
+    assert "not a bijection" in msg
+
+
+def test_perm_error_names_each_failure_mode():
+    assert "2 entries" in placement_check.perm_error([0, 1], 4)
+    assert "bijection" in placement_check.perm_error([0, 2], 2)
+    assert "not a list of ints" in placement_check.perm_error(
+        ["x", None], 2)
+    assert placement_check.perm_error([1, 0], 2) is None
+
+
+def test_infeasible_program_is_a_named_skip_not_a_verdict():
+    # recursive doubling cannot run at world 3: the permutation has
+    # nothing to break there, so the report is a named "unprovable"
+    # skip and the probe ring still carries the proof
+    rd = algomod.load(os.path.join(
+        REPO, "mpi4jax_tpu", "planner", "algos", "recursive_double.json"
+    ))
+    probe = algomod.parse(dict(placement_check._PROBE_RING_RAW))
+    reports = placement_check.check_permutation(
+        [2, 0, 1], 3, specs=[probe, rd]
+    )
+    assert placement_check.reports_clean(reports)
+    skipped = [r for r in reports if r.verdict == "unprovable"]
+    assert len(skipped) == 1
+    assert "infeasible at world 3" in skipped[0].reason
+
+
+# ---------------------------------------------------------------------
+# proof lifecycle: stamp, drift, persistence
+# ---------------------------------------------------------------------
+
+
+def test_proof_stamps_and_hand_edit_invalidates(proven):
+    assert placemod.proof_mismatch(proven) is None
+    proof = proven["proof"]
+    assert proof["schema"] == placemod.PROOF_SCHEMA
+    assert proof["rules"] == ["M4T206"]
+    assert proof["verdict"] == "verified"
+    edited = dict(proven, perm=list(reversed(proven["perm"])))
+    drift = placemod.proof_mismatch(edited)
+    assert drift is not None and "stale proof" in drift
+    unproven = {k: v for k, v in proven.items() if k != "proof"}
+    assert "unproven placement" in placemod.proof_mismatch(unproven)
+
+
+def test_build_proof_refuses_unclean_reports():
+    reports = placement_check.check_permutation([0, 0, 1, 2], 4)
+    doc = {"schema": placemod.SCHEMA, "world": 4, "perm": [0, 0, 1, 2]}
+    with pytest.raises(ValueError, match="placement not clean"):
+        placemod.build_proof(doc, reports)
+
+
+def test_save_load_roundtrip_and_tamper_detection(tmp_path, proven):
+    path = str(tmp_path / "place.json")
+    placemod.save(proven, path)
+    loaded = placemod.load(path)
+    assert loaded["perm"] == proven["perm"]
+    assert placemod.proof_mismatch(loaded) is None
+    with open(path) as f:
+        doc = json.load(f)
+    doc["perm"] = list(range(len(doc["perm"])))
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    with pytest.raises(placemod.PlacementError) as exc:
+        placemod.load(path)
+    assert exc.value.reason == "fingerprint"
+
+
+def test_load_rejects_wrong_schema_and_bad_perm(tmp_path):
+    path = str(tmp_path / "bad.json")
+    with open(path, "w") as f:
+        json.dump({"schema": "nope"}, f)
+    with pytest.raises(placemod.PlacementError) as exc:
+        placemod.load(path)
+    assert exc.value.reason == "schema"
+    doc = {"schema": placemod.SCHEMA, "world": 3, "perm": [0, 1]}
+    doc["fingerprint"] = placemod.body_fingerprint(doc)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    with pytest.raises(placemod.PlacementError) as exc:
+        placemod.load(path)
+    assert exc.value.reason == "world"
+
+
+# ---------------------------------------------------------------------
+# the fingerprint-preservation property (satellite 4)
+# ---------------------------------------------------------------------
+
+
+def test_verified_permutation_preserves_fingerprints_1000_seeds():
+    """Across 1000 random (world, permutation) draws, a permutation
+    never changes any rank's schedule fingerprint sequence: physical
+    rank ``perm[r]`` walks logical rank ``r``'s sequence verbatim.
+    That is the invariant M4T206 certifies — checked here directly
+    against the relabeling primitive, with the full simulator pass on
+    a subsample."""
+    spec = algomod.parse(dict(placement_check._PROBE_RING_RAW))
+    cache = {}
+    for seed in range(1000):
+        rng = random.Random(seed)
+        world = rng.randint(2, 8)
+        perm = list(range(world))
+        rng.shuffle(perm)
+        if world not in cache:
+            events = algomod.events_for(algomod.expand(spec, world))
+            cache[world] = (
+                events, placement_check.fingerprint_sequences(events)
+            )
+        events, seq_o = cache[world]
+        permuted = placement_check.permute_events(events, perm)
+        seq_p = placement_check.fingerprint_sequences(permuted)
+        for r in range(world):
+            assert seq_p[perm[r]] == seq_o[r], (seed, world, perm, r)
+        if seed % 97 == 0:
+            reports = placement_check.check_permutation(
+                perm, world, specs=[spec]
+            )
+            assert placement_check.reports_clean(reports), (seed, perm)
+    assert set(cache) == set(range(2, 9))  # every world was drawn
+
+
+# ---------------------------------------------------------------------
+# rule-catalog pins (satellite 4)
+# ---------------------------------------------------------------------
+
+
+def test_m4t206_joins_the_shared_rule_catalog():
+    from mpi4jax_tpu.analysis import linter, sarif
+
+    catalog = linter.rule_catalog()
+    assert "M4T206 [error]" in catalog
+    assert "schedule-equivalent" in catalog
+    ids = [r["id"] for r in sarif._rules_meta()]
+    assert "M4T206" in ids
+    assert ids.index("M4T206") > ids.index("M4T205")
+
+
+def test_analysis_cli_rules_lists_m4t206():
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.analysis", "--rules"],
+        capture_output=True, text=True, timeout=240, cwd=REPO,
+        env=_clean_env(),
+    )
+    assert res.returncode == 0, res.stderr
+    assert "M4T206" in res.stdout
+
+
+# ---------------------------------------------------------------------
+# arming + transparent application
+# ---------------------------------------------------------------------
+
+
+def test_apply_to_sequence_identity_unless_armed_and_matching(
+    monkeypatch,
+):
+    monkeypatch.delenv(placemod.ENV_VAR, raising=False)
+    assert placemod.apply_to_sequence(["a", "b"]) == ["a", "b"]
+    monkeypatch.setenv(placemod.ENV_VAR, "1,0")
+    assert placemod.apply_to_sequence(["a", "b"]) == ["b", "a"]
+    # world mismatch: placement must never break a run it cannot help
+    assert placemod.apply_to_sequence(["a", "b", "c"]) == ["a", "b", "c"]
+
+
+def test_cartcomm_placement_remaps_wires_not_logic():
+    from mpi4jax_tpu import comm as commmod
+
+    perm = [0, 2, 1, 3]
+    base = commmod.CartComm((4,), True)
+    placed = commmod.CartComm((4,), True, placement=perm)
+    assert placed.placement == tuple(perm)
+    assert placed != base and hash(placed) != hash(base)
+    # grid position p is hosted by physical rank perm[p]
+    assert placed.rank_at((1,)) == 2
+    assert placed.coords(2) == (1,)
+    src0, dest0 = base.shift(0, 1)
+    src, dest = placed.shift(0, 1)
+    for p in range(4):
+        # the identity wire tables, relabeled through the permutation
+        assert dest[perm[p]] == perm[dest0[p]]
+        assert src[perm[p]] == perm[src0[p]]
+
+
+def test_cartcomm_rejects_non_bijection():
+    from mpi4jax_tpu import comm as commmod
+
+    with pytest.raises(ValueError, match="bijection"):
+        commmod.CartComm((4,), True, placement=[0, 0, 1, 2])
+
+
+def test_cartcomm_picks_up_armed_placement(monkeypatch):
+    from mpi4jax_tpu import comm as commmod
+
+    monkeypatch.setenv(placemod.ENV_VAR, "1,0,3,2")
+    placed = commmod.CartComm((2, 2))
+    assert placed.placement == (1, 0, 3, 2)
+
+
+def test_world_mesh_applies_armed_placement(monkeypatch):
+    from mpi4jax_tpu.parallel import mesh as meshmod
+
+    monkeypatch.delenv(placemod.ENV_VAR, raising=False)
+    base = list(meshmod.world_mesh().devices.flat)
+    n = len(base)
+    perm = list(reversed(range(n)))
+    monkeypatch.setenv(
+        placemod.ENV_VAR, ",".join(str(p) for p in perm)
+    )
+    placed = list(meshmod.world_mesh().devices.flat)
+    assert placed == [base[p] for p in perm]
+
+
+# ---------------------------------------------------------------------
+# launch --place: simulator-verified-only, end to end
+# ---------------------------------------------------------------------
+
+
+def _manual_doc(perm, world):
+    doc = {
+        "schema": placemod.SCHEMA,
+        "world": world,
+        "perm": list(perm),
+        "op": "AllReduce",
+        "nbytes": 1 << 20,
+        "method": "manual",
+        "source": "test",
+    }
+    doc["fingerprint"] = placemod.body_fingerprint(doc)
+    return doc
+
+
+def _rank_script(tmp_path):
+    target = str(tmp_path / "rank.py")
+    with open(target, "w") as f:
+        f.write(
+            "import os\n"
+            "print('PLACED=' + os.environ.get('M4T_PLACEMENT', 'none'))\n"
+        )
+    return target
+
+
+def test_launch_place_blocks_unproven_doc_before_spawn(tmp_path):
+    path = str(tmp_path / "place.json")
+    placemod.save(_manual_doc([1, 0], 2), path)
+    res = _launch("-n", "2", "--place", path, _rank_script(tmp_path))
+    assert res.returncode == 1
+    assert "BLOCKED" in res.stderr
+    assert "no rank was spawned" in res.stderr
+    assert "unproven placement" in res.stderr
+    assert "PLACED=" not in res.stdout
+
+
+def test_launch_place_blocks_tampered_doc_before_spawn(tmp_path):
+    proven2 = placemod.prove(_manual_doc([1, 0], 2))
+    # re-stamp the fingerprint after editing so only the *proof* is
+    # stale — the launch gate must still refuse it
+    tampered = dict(proven2, perm=[0, 1])
+    tampered["fingerprint"] = placemod.body_fingerprint(tampered)
+    path = str(tmp_path / "place.json")
+    placemod.save(tampered, path)
+    res = _launch("-n", "2", "--place", path, _rank_script(tmp_path))
+    assert res.returncode == 1
+    assert "BLOCKED" in res.stderr and "stale proof" in res.stderr
+    assert "PLACED=" not in res.stdout
+
+
+def test_launch_place_blocks_world_mismatch_before_spawn(tmp_path):
+    proven4 = placemod.prove(_manual_doc([1, 0, 3, 2], 4))
+    path = str(tmp_path / "place.json")
+    placemod.save(proven4, path)
+    res = _launch("-n", "2", "--place", path, _rank_script(tmp_path))
+    assert res.returncode == 1
+    assert "BLOCKED" in res.stderr
+    assert "derived for world 4" in res.stderr
+    assert "PLACED=" not in res.stdout
+
+
+def test_launch_place_arms_verified_permutation_end_to_end(tmp_path):
+    proven2 = placemod.prove(_manual_doc([1, 0], 2))
+    path = str(tmp_path / "place.json")
+    placemod.save(proven2, path)
+    res = _launch("-n", "2", "--place", path, _rank_script(tmp_path))
+    assert res.returncode == 0, res.stderr
+    assert "arming M4T_PLACEMENT" in res.stderr
+    # both ranks saw the armed permutation
+    assert res.stdout.count("PLACED=1,0") == 2
+
+
+# ---------------------------------------------------------------------
+# placement CLI
+# ---------------------------------------------------------------------
+
+
+def test_cli_placement_derive_verify_show_roundtrip(tmp_path):
+    topo_path = str(tmp_path / "topo.json")
+    topology.save(topo_path, placemod.adversarial_topo(6))
+    place_path = str(tmp_path / "place.json")
+    res = _planner(
+        "placement", "derive", "--topo", topo_path, "--out", place_path
+    )
+    assert res.returncode == 0, res.stderr
+    assert "# perm" in res.stdout and "gain" in res.stdout
+    assert "proven placement written" in res.stderr
+
+    res = _planner("placement", "verify", place_path)
+    assert res.returncode == 0, res.stderr
+
+    res = _planner("placement", "show", place_path)
+    assert res.returncode == 0
+    assert "proven: True" in res.stdout
+
+    # hand-edit: load refuses the fingerprint drift
+    with open(place_path) as f:
+        doc = json.load(f)
+    doc["perm"] = list(reversed(doc["perm"]))
+    with open(place_path, "w") as f:
+        json.dump(doc, f)
+    res = _planner("placement", "verify", place_path)
+    assert res.returncode == 1
+    assert "fingerprint" in res.stderr
+
+
+def test_cli_placement_derive_bad_topo_exits_2(tmp_path):
+    missing = str(tmp_path / "nope.json")
+    res = _planner("placement", "derive", "--topo", missing)
+    assert res.returncode == 2
+    assert missing in res.stderr
+
+
+def test_cli_placement_selftest():
+    res = _planner("placement", "--selftest")
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "placement selftest ok" in res.stdout
+
+
+# ---------------------------------------------------------------------
+# algogen: proof-gated schedule-space search (the tentpole)
+# ---------------------------------------------------------------------
+
+
+def test_algogen_search_admits_a_topology_beating_candidate(search_out):
+    out_dir, out = search_out
+    assert out["worlds"] == [2, 4, 8]
+    admitted = [c for c in out["candidates"] if c["verdict"] == "admitted"]
+    assert admitted, out["candidates"]
+    topo_ring = next(
+        c for c in out["candidates"] if c["name"] == "gen-topo-ring"
+    )
+    assert topo_ring["verdict"] == "admitted"
+    assert any(topo_ring["beats_ring"].values())
+    assert topo_ring["proof_rules"] == [
+        "M4T201", "M4T202", "M4T204", "M4T205"
+    ]
+    # it really is cheaper than the shipped ring under the measured
+    # per-edge cost model at the fabric's world
+    w = str(out["topo_world"])
+    for b, beats in topo_ring["beats_ring"].items():
+        if beats:
+            assert (topo_ring["expected_s"][w][str(b)]
+                    < topo_ring["baseline_ring_s"][str(b)])
+
+
+def test_algogen_rejections_are_named_and_never_written(search_out):
+    out_dir, out = search_out
+    rejected = [
+        c for c in out["candidates"] if c["verdict"] != "admitted"
+    ]
+    for c in rejected:
+        assert c["verdict"].startswith("rejected:")
+        assert "file" not in c
+        assert not any(
+            os.path.basename(p).startswith(c["name"])
+            for p in out["written"]
+        )
+
+
+def test_algogen_written_files_register_unchanged(search_out, monkeypatch):
+    out_dir, out = search_out
+    assert out["written"]
+    monkeypatch.setenv("M4T_ALGO_PATH", out_dir)
+    algomod.invalidate_cache()
+    reg = algomod.registry(refresh=True)
+    for c in out["candidates"]:
+        if c.get("file"):
+            assert c["tag"] in reg, (c["tag"], sorted(reg))
+
+
+def test_registry_refuses_unproven_generated_file(tmp_path, monkeypatch):
+    # a generated spec dropped into the registry path *without* its
+    # proof artifact must be rejected, not silently registered
+    raw = algogen.tree_spec((2, 4, 8))
+    path = str(tmp_path / "gen-tree.json")
+    with open(path, "w") as f:
+        json.dump(raw, f)
+    monkeypatch.setenv("M4T_ALGO_PATH", str(tmp_path))
+    algomod.invalidate_cache()
+    reg = algomod.registry(refresh=True)
+    assert not any("gen-tree" in tag for tag in reg)
+    rejects = dict(algomod.registry_rejects())
+    assert path in rejects
+    assert "unproven" in rejects[path]
+
+
+def test_tune_sweeps_generated_algos_on_equal_footing(
+    search_out, tmp_path
+):
+    """Acceptance: the admitted generator output joins the tune sweep
+    next to the built-ins and wins buckets on the adversarial fabric
+    under ``expected_time_topo``."""
+    out_dir, out = search_out
+    topo_path = str(tmp_path / "topo.json")
+    topology.save(topo_path, placemod.adversarial_topo(WORLD))
+    cache = str(tmp_path / "plan.json")
+    env = _clean_env()
+    env["M4T_ALGO_PATH"] = out_dir
+    res = _planner(
+        "tune", "--cache", cache, "--topo", topo_path,
+        "--world", str(WORLD), "--dtypes", "float32",
+        "--ops", "AllReduce", env=env,
+    )
+    assert res.returncode == 0, res.stderr
+    planobj = planmod.load(cache)
+    impls = {e.impl for e in planobj.entries.values()}
+    assert any(i.startswith("algo:gen-") for i in impls), impls
+
+
+def test_cli_algogen_search_writes_admitted_candidates(tmp_path):
+    topo_path = str(tmp_path / "topo.json")
+    topology.save(topo_path, placemod.adversarial_topo(WORLD))
+    out_dir = str(tmp_path / "algos")
+    res = _planner(
+        "algogen", "search", "--topo", topo_path, "--out", out_dir,
+        "--worlds", "2,4,8", timeout=480,
+    )
+    assert res.returncode == 0, res.stderr
+    assert "gen-topo-ring" in res.stdout
+    assert "beats_ring=" in res.stdout
+    files = sorted(os.listdir(out_dir))
+    assert any(f.endswith(".proof.json") for f in files)
+    for f in files:
+        if f.endswith(".json") and not f.endswith(".proof.json"):
+            assert f.replace(".json", ".proof.json") in files
+
+
+def test_cli_algogen_selftest():
+    res = _planner("algogen", "--selftest", timeout=480)
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "algogen selftest ok" in res.stdout
+
+
+# ---------------------------------------------------------------------
+# plan-cache provenance
+# ---------------------------------------------------------------------
+
+
+def test_plan_placement_roundtrips_and_old_ids_stay_stable(
+    tmp_path, proven
+):
+    bare = planmod.Plan(platform="cpu")
+    with_place = planmod.Plan(platform="cpu", placement=proven)
+    # plans without a placement keep their pre-placement identity:
+    # the canonical body only grows the key when one is attached
+    assert "placement" not in planmod._canonical_body("cpu", {})
+    assert "placement" in planmod._canonical_body(
+        "cpu", {}, placement=proven
+    )
+    assert bare.plan_id != with_place.plan_id
+    path = str(tmp_path / "plan.json")
+    planmod.save(with_place, path)
+    loaded = planmod.load(path)
+    assert loaded.placement == proven
+    assert loaded.plan_id == with_place.plan_id
+
+
+def test_plan_merge_carries_placement(proven):
+    base = planmod.Plan(platform="cpu", placement=proven)
+    update = planmod.Plan(platform="cpu")
+    merged = planmod.merge(base, update)
+    assert merged.placement == proven
+    base2 = planmod.Plan(platform="cpu")
+    merged2 = planmod.merge(base2, planmod.Plan(
+        platform="cpu", placement=proven,
+    ))
+    assert merged2.placement == proven
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))

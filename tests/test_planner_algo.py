@@ -679,6 +679,96 @@ def test_cli_lower_json_roundtrips():
     assert len(lowered["rounds"]) == 3
 
 
+def test_cli_check_shipped_algos_at_non_power_of_two_worlds():
+    """Satellite (PR 18): every shipped algorithm at worlds {3, 5, 6}
+    either proves deadlock-free or names its infeasibility — no
+    unexplained failures in the catalog."""
+    res = _planner(
+        "algo", "check", shipped_path("ring"), "--ranks", "3,5,6",
+    )
+    assert res.returncode == 0, res.stderr
+    assert res.stdout.count("deadlock-free") == 3
+    # the ring's round count is 2(n-1) at every world, pow2 or not
+    for n, rounds in ((3, 4), (5, 8), (6, 10)):
+        assert f"world={n} deadlock-free rounds={rounds}" in res.stdout
+
+
+def test_cli_check_recursive_double_names_log2_infeasibility():
+    res = _planner(
+        "algo", "check", shipped_path("recursive_double"),
+        "--ranks", "3,5,6",
+    )
+    assert res.returncode == 1
+    for n in (3, 5, 6):
+        assert f"log2({n}) is not an integer" in res.stdout, res.stdout
+
+
+def test_cli_check_alltoall_twophase_names_rank_range_infeasibility():
+    res = _planner(
+        "algo", "check", shipped_path("alltoall_twophase"),
+        "--ranks", "3,5,6",
+    )
+    assert res.returncode == 1
+    # the stride pattern walks off the rank space at non-pow2 worlds;
+    # the verdict names the exact phase, step, and offending rank
+    assert "to 3 outside [0, 3)" in res.stdout
+    assert "to 5 outside [0, 5)" in res.stdout
+    assert "to 6 outside [0, 6)" in res.stdout
+    assert "use -1 for PROC_NULL" in res.stdout
+
+
+def _topo_file(tmp_path, world=8):
+    from mpi4jax_tpu.observability import topology
+    from mpi4jax_tpu.planner import placement
+
+    path = str(tmp_path / "topo.json")
+    topology.save(path, placement.adversarial_topo(world))
+    return path
+
+
+def test_cli_lower_topo_prints_per_round_drain_times(tmp_path):
+    """Satellite (PR 18): ``algo lower --topo`` annotates every round
+    with its drain time at the slowest measured edge — the
+    ``expected_time_topo`` objective, one round at a time."""
+    res = _planner(
+        "algo", "lower", shipped_path("ring"), "--ranks", "8",
+        "--topo", _topo_file(tmp_path),
+    )
+    assert res.returncode == 0, res.stderr
+    round_lines = [
+        ln for ln in res.stdout.splitlines()
+        if ln.strip().startswith("round ")
+    ]
+    assert len(round_lines) == 14  # 2(n-1) rounds of the ring at n=8
+    for ln in round_lines:
+        assert "drain=" in ln and "us slowest=" in ln, ln
+
+
+def test_cli_lower_topo_json_carries_drains(tmp_path):
+    res = _planner(
+        "algo", "lower", shipped_path("ring"), "--ranks", "4",
+        "--topo", _topo_file(tmp_path, world=4), "--json",
+    )
+    assert res.returncode == 0, res.stderr
+    payload = json.loads(res.stdout)
+    drains = payload["4"]["topo_drains"]
+    assert len(drains) == 6
+    for d in drains:
+        assert d["drain_s"] > 0
+        src, dst = d["slowest_edge"]
+        assert 0 <= src < 4 and 0 <= dst < 4
+
+
+def test_cli_lower_topo_bad_map_exits_two(tmp_path):
+    missing = str(tmp_path / "nope.json")
+    res = _planner(
+        "algo", "lower", shipped_path("ring"), "--ranks", "8",
+        "--topo", missing,
+    )
+    assert res.returncode == 2
+    assert missing in res.stderr
+
+
 def test_rule_catalog_lists_all_simulation_rules():
     res = subprocess.run(
         [sys.executable, "-m", "mpi4jax_tpu.analysis", "--rules"],

@@ -327,6 +327,44 @@ def test_trace_gains_links_counter_track():
         assert e["args"]["gbps"] > 0
 
 
+def test_edge_betas_skips_unfit_edges_not_keyerror():
+    """Satellite (PR 18): a partial probe map — an edge whose fit
+    failed or produced a non-positive beta — is skipped by
+    ``edge_betas``, never a KeyError downstream."""
+    topo = skewed_topo(world=4, slow=())
+    del topo["edges"]["0->1"]["beta_gbps"]
+    topo["edges"]["1->2"]["beta_gbps"] = 0.0
+    topo["edges"]["2->3"]["beta_gbps"] = "broken"
+    betas = topology.edge_betas(topo)
+    for gone in ((0, 1), (1, 2), (2, 3)):
+        assert gone not in betas
+    assert betas[(3, 0)] == pytest.approx(20.0)
+
+
+def test_attribute_links_warns_and_counts_missing_probe_edges(capfd):
+    """Satellite (PR 18): attribution against a probe map that does
+    not cover every decomposed edge is a warned skip counted in
+    ``missing_edges`` — the join must not crash on a shrunk world or
+    failed fit."""
+    topo = skewed_topo(world=4, slow=())
+    del topo["edges"]["0->1"]
+    out = topology.attribute_links(_attribution_world(), topo=topo)
+    assert out["missing_edges"] == 1
+    row = out["links"]["0->1"]
+    assert row["gbps_p50"] > 0  # the sample itself still attributes
+    assert "beta_gbps" not in row and "vs_probe" not in row
+    covered = out["links"]["1->2"]
+    assert covered["beta_gbps"] == pytest.approx(20.0)
+    err = capfd.readouterr().err
+    assert "not in the probe map" in err and "0->1" in err
+    # a fully covered map reports zero missing and stays quiet
+    out2 = topology.attribute_links(
+        _attribution_world(), topo=skewed_topo(world=4, slow=())
+    )
+    assert out2["missing_edges"] == 0
+    assert "not in the probe map" not in capfd.readouterr().err
+
+
 # ---------------------------------------------------------------------
 # planner consumption: the acceptance flip
 # ---------------------------------------------------------------------
