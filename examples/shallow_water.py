@@ -304,14 +304,23 @@ def main():
         h = np.asarray(st.h)
         return h[None] if n == 1 else h
 
+    from mpi4jax_tpu import observability as obs
+
     snapshots = []
     if not args.benchmark:
         snapshots.append(snapshot(state))
     start = time.perf_counter()
-    for _ in range(n_calls):
-        state = multi(state)
+    for call in range(n_calls):
+        # overlap observatory (launch --overlap / M4T_STEP_SPAN): one
+        # step span per multistep call, the compute span marking the
+        # device-busy window its halo exchanges are judged against
+        # (hidden vs exposed). Unarmed both are no-ops.
+        with obs.step_span(step=call):
+            with obs.compute_span():
+                state = multi(state)
+                if not args.benchmark:
+                    device_sync(state)
         if not args.benchmark:
-            device_sync(state)
             snapshots.append(snapshot(state))
     device_sync(state)
     elapsed = time.perf_counter() - start

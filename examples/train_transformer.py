@@ -301,8 +301,14 @@ def main():
         # collectives once at trace, so without this a long training
         # run looks dead to the doctor (no-op when no sink is armed)
         obs.heartbeat("train_step", step=i)
-        params, loss = step(params)
-        lval = get_loss((params, loss))
+        # overlap observatory (launch --overlap / M4T_STEP_SPAN): the
+        # step span brackets one optimizer step; the compute span marks
+        # the device-busy window the latency-sampled collectives are
+        # judged against (hidden vs exposed). Unarmed both are no-ops.
+        with obs.step_span(step=i):
+            with obs.compute_span():
+                params, loss = step(params)
+                lval = get_loss((params, loss))
         if i == start_step:
             first = lval
         last = lval

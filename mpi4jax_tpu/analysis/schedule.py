@@ -964,13 +964,23 @@ def cost_report(
         costs = [event_cost(e) for e in events]
         agg = costmodel.total_cost(costs, gbps=gbps, alpha=alpha)
         agg["n_events"] = len(events)
+        # expected *exposed* time: the cost model's per-impl
+        # overlappable fraction discounts what a well-pipelined step
+        # loop hides behind compute (overlap observatory calibrates
+        # the achieved fraction against this prediction)
+        agg["exposed_s"] = sum(
+            costmodel.expected_exposed_s(
+                c, impl=c.get("impl"), gbps=gbps, alpha=alpha
+            )
+            for c in costs
+        )
         per_rank[rank] = agg
     if per_rank:
         worst = max(per_rank, key=lambda r: per_rank[r]["expected_s"])
     else:
         worst = 0
         per_rank[0] = {"wire_bytes": 0, "steps": 0, "expected_s": 0.0,
-                       "n_events": 0}
+                       "n_events": 0, "exposed_s": 0.0}
     groups: Dict[Tuple[str, str], Dict[str, Any]] = {}
     for e in schedule.events.get(worst, []):
         c = event_cost(e)
@@ -978,7 +988,8 @@ def cost_report(
         g = groups.setdefault(
             key,
             {"fingerprint": e.fingerprint, "source": e.source, "op": e.op,
-             "count": 0, "wire_bytes": 0, "steps": 0, "expected_s": 0.0},
+             "count": 0, "wire_bytes": 0, "steps": 0, "expected_s": 0.0,
+             "exposed_s": 0.0},
         )
         if c.get("impl"):
             # armed planner: name the impl the plan routes this site
@@ -988,6 +999,9 @@ def cost_report(
         g["wire_bytes"] += c["wire_bytes"]
         g["steps"] += c["steps"]
         g["expected_s"] += costmodel.expected_time_s(c, gbps=gbps, alpha=alpha)
+        g["exposed_s"] += costmodel.expected_exposed_s(
+            c, impl=c.get("impl"), gbps=gbps, alpha=alpha
+        )
     top = sorted(groups.values(), key=lambda g: -g["expected_s"])[:top_k]
     return {
         "world": schedule.world,
@@ -1011,13 +1025,17 @@ def format_cost_report(report: Dict[str, Any]) -> str:
         f"  per-program (max rank {report['max_rank']}): "
         f"{prog['n_events']} collective(s), "
         f"{prog['wire_bytes']} wire bytes, {prog['steps']} steps, "
-        f"expected {prog['expected_s'] * 1e6:.1f} us",
+        f"expected {prog['expected_s'] * 1e6:.1f} us"
+        + (f" ({prog['exposed_s'] * 1e6:.1f} us exposed)"
+           if "exposed_s" in prog else ""),
     ]
     if report["top"]:
         out.append("  dominant collectives:")
     for g in report["top"]:
         out.append(
-            f"    {g['expected_s'] * 1e6:8.1f} us  {g['count']:3d}x "
+            f"    {g['expected_s'] * 1e6:8.1f} us "
+            f"({g.get('exposed_s', g['expected_s']) * 1e6:8.1f} us "
+            f"exposed)  {g['count']:3d}x "
             f"{g['fingerprint']}  [{g['wire_bytes']} B, "
             f"{g['steps']} steps]  {g['source']}"
         )

@@ -78,6 +78,14 @@ Performance attribution (``observability/{costmodel,perf}.py``):
 - ``M4T_PERF_Z``: float -> anomaly z-score threshold (default 6.0).
 - ``M4T_PERF_WARMUP``: int -> samples per fingerprint before the
   watch may flag anything (default 10).
+- ``M4T_STEP_SPAN``: truthy -> arm the overlap observatory's
+  step-scoped span API (``observability/overlap.py``;
+  ``launch --overlap`` sets it for every rank): ``obs.step_span()`` /
+  ``obs.compute_span()`` append ``step``/``compute`` interval records
+  to the event sink and stamp the current step onto
+  emission/exec/latency records. Unarmed, the span API is a no-op
+  behind one falsy check and every record schema is byte-identical
+  to pre-overlap runs (drift-pinned).
 
 Adaptive collective planner (``planner/``):
 
@@ -270,6 +278,9 @@ PERF_WATCH = env_flag2("M4T_PERF_WATCH", "MPI4JAX_TPU_PERF_WATCH")
 PERF_Z = max(1.0, env_float("M4T_PERF_Z", 6.0))
 #: per-fingerprint warmup sample count before anomalies can fire
 PERF_WARMUP = max(2, env_int("M4T_PERF_WARMUP", 10))
+#: overlap observatory step-span arming (observability/overlap.py);
+#: seeds overlap.armed() — launch --overlap exports it per rank
+STEP_SPAN = env_flag2("M4T_STEP_SPAN", "MPI4JAX_TPU_STEP_SPAN")
 
 def _static_check_mode() -> str:
     """Normalize M4T_STATIC_CHECK into '' | 'warn' | 'error'."""

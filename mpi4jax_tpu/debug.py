@@ -80,6 +80,7 @@ def log_emission(
     plan: Optional[str] = None,
     trace: Optional[str] = None,
     job: Optional[str] = None,
+    step: Optional[int] = None,
 ) -> str:
     """Record a trace-time emission; returns the correlation id.
 
@@ -106,6 +107,7 @@ def log_emission(
             plan=plan,
             trace=trace,
             job=job,
+            step=step,
         )
         _obs.events.emit(record)
     return ident

@@ -205,6 +205,97 @@ def _run_tune(events_dir, plan_path):
         sys.stderr.write(f"mpi4jax_tpu.launch: --tune failed: {exc!r}\n")
 
 
+def _run_overlap_report(events_dir):
+    """``--overlap``: print the exposed-communication summary over the
+    artifacts this world just wrote. Best-effort like the doctor — a
+    report failure must not change the run's exit code."""
+    try:
+        from .observability import doctor, overlap
+
+        rep = overlap.build_report(doctor.load([events_dir]))
+        if not rep["ranks"]:
+            sys.stderr.write(
+                "mpi4jax_tpu.launch: --overlap: no step spans in "
+                f"{events_dir}; wrap the step loop in obs.step_span()\n"
+            )
+            return
+        sys.stderr.write(
+            "mpi4jax_tpu.launch: overlap attribution "
+            f"({events_dir}):\n{overlap.format_exposed(rep)}\n"
+        )
+    except Exception as exc:  # pragma: no cover — report best-effort
+        sys.stderr.write(
+            f"mpi4jax_tpu.launch: overlap report failed: {exc!r}\n"
+        )
+
+
+def _propose_placement(events_dir, audit_path=None):
+    """Close the confirmed-straggler retune loop (ROADMAP item 1
+    follow-on): when the live plane confirmed a straggler and the
+    evidence is link-localized, derive a re-permutation proposal from
+    the verdicts (``planner/placement.derive_from_verdicts``), prove
+    it, write it beside the artifacts as ``placement-proposal.json``,
+    and audit the proposal in ``supervisor.jsonl``. Never arms
+    anything by itself — the operator (or the next launch) picks the
+    proposal up explicitly via ``--place``. Best-effort."""
+    try:
+        from .observability import events
+        from .planner import placement
+
+        doc, evidence = placement.derive_from_verdicts([events_dir])
+        if doc is None:
+            reason = evidence.get("reason", "no evidence")
+            if evidence.get("verdicts"):
+                # only narrate when there *were* verdicts to act on
+                sys.stderr.write(
+                    "mpi4jax_tpu.launch: no placement proposal: "
+                    f"{reason}\n"
+                )
+            return
+        reports = placement.verify(doc)
+        from .analysis import placement_check
+
+        if not placement_check.reports_clean(reports):
+            sys.stderr.write(
+                "mpi4jax_tpu.launch: placement proposal failed "
+                "M4T206 verification; discarded\n"
+            )
+            return
+        doc = dict(doc)
+        doc["proof"] = placement.build_proof(doc, reports)
+        out = os.path.join(events_dir, "placement-proposal.json")
+        placement.save(doc, out)
+        record = {
+            "event": "placement_proposal",
+            "perm": doc["perm"],
+            "method": doc["method"],
+            "expected_s": doc["expected_s"],
+            "identity_s": doc["identity_s"],
+            "gain": doc.get("gain"),
+            "fingerprint": doc["fingerprint"],
+            "path": out,
+            "evidence": doc.get("verdict_evidence"),
+        }
+        if audit_path:
+            try:
+                events.EventLog(audit_path).append(
+                    events.event("supervisor", **record)
+                )
+            except OSError:
+                pass
+        sys.stderr.write(
+            "mpi4jax_tpu.launch: straggler verdicts propose "
+            f"re-permutation {doc['perm']} "
+            f"(expected {doc['expected_s']:.3g}s vs identity "
+            f"{doc['identity_s']:.3g}s) — written to {out}; arm with "
+            "--place to apply\n"
+        )
+    except Exception as exc:  # pragma: no cover — proposal best-effort
+        sys.stderr.write(
+            f"mpi4jax_tpu.launch: placement proposal failed: {exc!r}\n"
+        )
+
+
 def _verify_prelaunch(args, world=None) -> int:
     """``--verify``: prove the target's collective schedules
     deadlock-free at ``-n`` ranks *before any rank spawns*.
@@ -495,7 +586,8 @@ def make_world_args(**overrides):
         nproc=1, module=None, cmd=[],
         events_dir=None, hang_timeout=0.0, heartbeat=5.0,
         doctor=False, live=False, live_grace=None, dashboard=False,
-        metrics_port=None, perf=False, plan=None, tune=False,
+        metrics_port=None, perf=False, overlap=False,
+        plan=None, tune=False,
         verify=False, algo=None, place=None, static_check="off",
         fault_plan=None,
         retries=0, backoff=1.0, resume_dir=None,
@@ -529,6 +621,7 @@ def rank_env(
     resume_step=None,
     runtime_sampling=False,
     perf_watch=False,
+    overlap=False,
     mesh=True,
     trace_id=None,
     job_id=None,
@@ -611,6 +704,11 @@ def rank_env(
                 M4T_TELEMETRY_RUNTIME="1",
                 M4T_PERF_WATCH="1" if perf_watch else "0",
             )
+        if overlap:
+            # overlap observatory (observability/overlap.py): step
+            # spans + compute spans land on the same per-rank sink and
+            # are joined against the runtime latency intervals
+            env["M4T_STEP_SPAN"] = "1"
     return env
 
 
@@ -676,8 +774,10 @@ def _spawn_world(
                 fault_attempt=attempt,
                 plan_cache=getattr(args, "plan_cache_env", None),
                 resume_step=resume_step,
-                runtime_sampling=(args.perf or args.tune or args.live),
+                runtime_sampling=(args.perf or args.tune or args.live
+                                  or getattr(args, "overlap", False)),
                 perf_watch=(args.perf or args.live),
+                overlap=getattr(args, "overlap", False),
                 trace_id=getattr(args, "trace_id", None),
                 job_id=getattr(args, "job_id", None),
             )
@@ -1010,6 +1110,16 @@ def main(argv=None):
         "achieved-bandwidth / %%-of-peak table",
     )
     parser.add_argument(
+        "--overlap", action="store_true",
+        help="arm the overlap observatory (requires --events-dir): "
+        "every rank gets M4T_STEP_SPAN=1 plus runtime latency "
+        "sampling, so step loops wrapped in obs.step_span() / "
+        "obs.compute_span() record per-step compute/communication "
+        "occupancy; the launcher prints the exposed-communication "
+        "summary at the end (full report: `python -m "
+        "mpi4jax_tpu.observability.overlap DIR`)",
+    )
+    parser.add_argument(
         "--plan", default=None, metavar="PLAN.json",
         help="arm a collective plan cache (planner/plan.py, "
         "M4T_PLAN_CACHE) in every rank: plannable collectives "
@@ -1193,6 +1303,9 @@ def main(argv=None):
     if args.probe_topology and not events_dir:
         parser.error("--probe-topology requires --events-dir (where "
                      "topology.json is persisted)")
+    if getattr(args, "overlap", False) and not events_dir:
+        parser.error("--overlap requires --events-dir (the step spans "
+                     "and latency samples it joins live there)")
     if events_dir:
         events_dir = os.path.abspath(events_dir)
         os.makedirs(events_dir, exist_ok=True)
@@ -1251,6 +1364,15 @@ def main(argv=None):
             _run_perf_report(events_dir)
         if args.tune and exit_code == 0:
             _run_tune(events_dir, args.plan)
+        if events_dir and getattr(args, "overlap", False):
+            _run_overlap_report(events_dir)
+        if events_dir:
+            # confirmed-straggler retune loop: link-localized verdicts
+            # propose a re-permutation (audited in supervisor.jsonl)
+            _propose_placement(
+                events_dir,
+                os.path.join(events_dir, "supervisor.jsonl"),
+            )
         return exit_code
 
     # -- supervised path (--retries K) --------------------------------
@@ -1473,6 +1595,14 @@ def main(argv=None):
         _run_perf_report(state["dir"])
     if args.tune and exit_code == 0 and state.get("dir"):
         _run_tune(state["dir"], args.plan)
+    if getattr(args, "overlap", False) and state.get("dir"):
+        _run_overlap_report(state["dir"])
+    if state.get("dir"):
+        _propose_placement(
+            state["dir"],
+            (os.path.join(audit_root, "supervisor.jsonl")
+             if audit_root else None),
+        )
     return exit_code
 
 

@@ -97,7 +97,8 @@ def __getattr__(name):
     # doctor/trace they are monitor-side modules; eager import here
     # would also make `python -m mpi4jax_tpu.observability.perf` warn
     # about the module pre-existing in sys.modules)
-    if name in ("costmodel", "perf", "live", "stream_doctor", "export"):
+    if name in ("costmodel", "perf", "live", "stream_doctor", "export",
+                "overlap"):
         import importlib
 
         return importlib.import_module(f".{name}", __name__)
@@ -106,6 +107,13 @@ def __getattr__(name):
 
         return getattr(_perf, {"PerfWatch": "PerfWatch",
                                "perf_report": "perf_report"}[name])
+    if name in ("step_span", "compute_span"):
+        # the overlap observatory's step-scoped span API
+        # (obs.step_span() around a training step; armed by
+        # M4T_STEP_SPAN / launch --overlap, no-op otherwise)
+        from . import overlap as _overlap
+
+        return getattr(_overlap, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 # doctor/trace are import-light (no jax) but only needed offline;
@@ -134,6 +142,7 @@ __all__ = [
     "heartbeat",
     "live",
     "metrics",
+    "overlap",
     "perf",
     "perf_report",
     "recorder",
@@ -143,5 +152,7 @@ __all__ = [
     "runtime_enabled",
     "snapshot",
     "start_heartbeat",
+    "step_span",
+    "compute_span",
     "stream_doctor",
 ]

@@ -636,3 +636,68 @@ def achieved_gbps(c: Dict[str, Any], seconds: float) -> Optional[float]:
     if seconds <= 0 or c["wire_bytes"] <= 0:
         return None
     return c["wire_bytes"] / seconds / 1e9
+
+
+# ---------------------------------------------------------------------
+# overlappable fraction (the overlap observatory's planning prior)
+# ---------------------------------------------------------------------
+
+#: per-impl prior for the fraction of an op's wire time a step loop
+#: can hide behind independent compute. Chunked/pipelined schedules
+#: (the Pallas RDMA ring streams chunk k while chunk k-1 reduces;
+#: generated ``algo:`` schedules move data in per-round ppermute hops)
+#: expose windows compute can fill; monolithic collectives (one HLO
+#: AllReduce, flat quantize->wire->dequantize) hold the whole payload
+#: on the critical path. These are *priors*, not measurements — the
+#: overlap report prints predicted-vs-achieved per route precisely so
+#: the table can be corrected from evidence. Kept separate from
+#: :func:`cost` on purpose: the cost() result dict is golden-pinned.
+OVERLAPPABLE_FRACTION: Dict[str, float] = {
+    "hlo": 0.0,
+    "shm": 0.0,
+    "quantized": 0.0,
+    "pallas_ring": 0.75,
+    "hierarchical": 0.25,
+}
+
+#: chunked ppermute rounds of a generated m4t-algo/1 schedule
+ALGO_OVERLAPPABLE = 0.5
+
+#: impl tag unknown / unplanned emission: assume nothing hides
+DEFAULT_OVERLAPPABLE = 0.0
+
+
+def overlappable_fraction(op: str, impl: Optional[str] = None) -> float:
+    """Expected fraction of ``op``'s comm time hideable behind compute
+    when routed through ``impl`` (None/unknown impl = the conservative
+    default). Point-to-point ops are fully overlappable by
+    construction — the caller decides when to wait on them."""
+    if op in ("Isend", "Irecv"):
+        return 1.0
+    if impl is None:
+        return DEFAULT_OVERLAPPABLE
+    tag = str(impl)
+    if tag.startswith("algo:"):
+        return ALGO_OVERLAPPABLE
+    return OVERLAPPABLE_FRACTION.get(tag, DEFAULT_OVERLAPPABLE)
+
+
+def expected_exposed_s(
+    c: Dict[str, Any],
+    *,
+    impl: Optional[str] = None,
+    gbps: Optional[float] = None,
+    alpha: Optional[float] = None,
+    fraction: Optional[float] = None,
+) -> float:
+    """Predicted *exposed* (critical-path) seconds of one costed
+    emission: the alpha-beta expected time scaled by the fraction the
+    impl cannot hide. ``lint --cost`` sums this per rank so a schedule
+    review predicts exposed time before a single step runs."""
+    t = expected_time_s(c, gbps=gbps, alpha=alpha)
+    f = (
+        overlappable_fraction(c.get("op", "?"), impl)
+        if fraction is None
+        else float(fraction)
+    )
+    return t * max(0.0, 1.0 - min(1.0, f))

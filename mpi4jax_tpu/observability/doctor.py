@@ -1196,7 +1196,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         action="store_true",
         help="append the perf attribution section: per-op achieved "
         "bandwidth and %%-of-peak from the same logs, via the "
-        "analytic cost model (observability/perf.py)",
+        "analytic cost model (observability/perf.py); runs armed "
+        "with step spans (launch --overlap) additionally get the "
+        "exposed-communication section (observability/overlap.py)",
     )
     parser.add_argument(
         "--json", action="store_true", help="print the report as JSON"
@@ -1349,8 +1351,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.perf:
         from . import perf
 
+        by_rank = load(args.inputs)
         print()
-        print(perf.format_table(perf.attribute(load(args.inputs))))
+        print(perf.format_table(perf.attribute(by_rank)))
+        try:
+            from . import overlap as _overlap
+
+            orep = _overlap.build_report(by_rank)
+            if orep["ranks"]:
+                # exposed-communication section: only for armed runs
+                # (streams carrying step spans), best-effort like the
+                # rest of the perf tail
+                print()
+                print(_overlap.format_exposed(orep))
+        except Exception:
+            pass
     return 1 if report["findings"] else 0
 
 

@@ -29,6 +29,8 @@ exposition-format rules)::
     m4t_pct_of_peak{op=,impl=,axes=}    gauge   achieved vs cost model
     m4t_plan_key_emissions_total{key=}  counter per plan-key traffic
     m4t_anomalies_total                 counter perf-watch anomalies
+    m4t_overlap_ratio[{rank=}]          gauge   comm hidden / total comm
+    m4t_comm_exposed_seconds_total[{rank=}] counter exposed comm time
     m4t_topo_link_gbps{src=,dst=}       gauge   per-link achieved GB/s
     m4t_topo_link_probe_gbps{src=,dst=} gauge   per-link probed beta
     m4t_verdicts_total{kind=,klass=}    counter confirmed verdicts
@@ -170,6 +172,23 @@ def render_openmetrics(
     c = _Family(out, "m4t_anomalies_total", "counter",
                 "Perf-watch anomaly events observed.")
     c.sample(snap.get("anomalies", 0))
+
+    overlap = snap.get("overlap")
+    if overlap:
+        # overlap observatory (armed runs only: the snapshot carries
+        # the section only when step spans exist on the sinks)
+        g = _Family(out, "m4t_overlap_ratio", "gauge",
+                    "Fraction of communication time hidden behind "
+                    "compute inside step spans (no label: fleet; "
+                    "rank label: per rank).")
+        g.sample(overlap.get("overlap_ratio"))
+        c = _Family(out, "m4t_comm_exposed_seconds_total", "counter",
+                    "Communication time not hidden behind compute "
+                    "inside step spans.")
+        c.sample(overlap.get("comm_exposed_s"))
+        for rank, tot in sorted((overlap.get("per_rank") or {}).items()):
+            g.sample(tot.get("overlap_ratio"), rank=rank)
+            c.sample(tot.get("comm_exposed_s"), rank=rank)
 
     if topo_links:
         g = _Family(out, "m4t_topo_link_gbps", "gauge",

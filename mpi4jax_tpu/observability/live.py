@@ -61,6 +61,11 @@ NON_RANK_SINKS = frozenset({"live.jsonl", "supervisor.jsonl"})
 #: throughput window for the rolling rates (seconds)
 DEFAULT_WINDOW_S = 30.0
 
+#: rolling per-rank interval buffers for the overlap view (step spans,
+#: compute spans, comm intervals): enough for a long tail view, bounded
+#: so an unbounded run cannot grow the aggregator without limit
+OVERLAP_SPANS = 512
+
 
 # ---------------------------------------------------------------------
 # torn-line-safe file tailing
@@ -251,6 +256,12 @@ class LiveAggregator:
         #: anomaly records new since the last drain (stream doctor's
         #: retune feed)
         self._fresh_anomalies: List[Dict[str, Any]] = []
+        #: overlap observatory (armed runs only — step/compute span
+        #: records appear on the sinks only under M4T_STEP_SPAN):
+        #: rank -> bounded deques of (t0, t1) intervals
+        self.step_spans: Dict[int, deque] = {}
+        self.compute_spans: Dict[int, deque] = {}
+        self.comm_spans: Dict[int, deque] = {}
 
     # -- discovery ----------------------------------------------------
 
@@ -289,8 +300,28 @@ class LiveAggregator:
             self.anomalies_total += 1
             self._fresh_anomalies.append(dict(rec, rank=rank))
             return
+        if kind in ("step", "compute"):
+            # overlap observatory spans (observability/overlap.py):
+            # a closed step is progress too
+            self.progress_t = now
+            t0, t1 = rec.get("t0"), rec.get("t1")
+            if isinstance(t0, (int, float)) and isinstance(t1, (int, float)):
+                spans = (self.step_spans if kind == "step"
+                         else self.compute_spans)
+                spans.setdefault(
+                    rank, deque(maxlen=OVERLAP_SPANS)
+                ).append((float(t0), float(t1)))
+            return
         if kind in ("emission", "recorder", "exec", "latency"):
             self.progress_t = now
+        if kind == "latency":
+            # each latency sample measured the comm interval
+            # [t - seconds, t] — the overlap view's comm side
+            s = rec.get("seconds")
+            if (t is not None and isinstance(s, (int, float)) and s > 0):
+                self.comm_spans.setdefault(
+                    rank, deque(maxlen=OVERLAP_SPANS)
+                ).append((float(t) - float(s), float(t)))
         if kind in ("emission", "recorder"):
             seq = rec.get("seq")
             if isinstance(seq, int):
@@ -421,6 +452,33 @@ class LiveAggregator:
             },
             "anomalies": self.anomalies_total,
         }
+        if self.step_spans:
+            # overlap observatory rollup (armed runs only — the key is
+            # absent otherwise, so the exporter's families only appear
+            # when step spans exist)
+            from . import overlap as _overlap
+
+            per_rank: Dict[str, Any] = {}
+            agg = {"steps": 0, "comm_exposed_s": 0.0,
+                   "comm_overlapped_s": 0.0}
+            for r in sorted(self.step_spans):
+                tot = _overlap.occupancy_totals(
+                    list(self.step_spans[r]),
+                    list(self.compute_spans.get(r, ())),
+                    list(self.comm_spans.get(r, ())),
+                )
+                per_rank[str(r)] = tot
+                agg["steps"] = max(agg["steps"], tot["steps"])
+                agg["comm_exposed_s"] += tot["comm_exposed_s"]
+                agg["comm_overlapped_s"] += tot["comm_overlapped_s"]
+            comm = agg["comm_exposed_s"] + agg["comm_overlapped_s"]
+            snap["overlap"] = {
+                **agg,
+                "overlap_ratio": (
+                    agg["comm_overlapped_s"] / comm if comm > 0 else None
+                ),
+                "per_rank": per_rank,
+            }
         if attribute and self.by_rank:
             from . import perf
 
@@ -501,6 +559,15 @@ def render_dashboard(
                 + (f" {pct:>5.1f}%" if pct is not None else f" {'-':>6}")
                 + (f" {slow:>5.1f}x" if slow is not None else f" {'-':>6}")
             )
+    ov = snap.get("overlap")
+    if ov:
+        ratio = ov.get("overlap_ratio")
+        ratio_txt = (f"{ratio * 100.0:.0f}% of comm hidden"
+                     if ratio is not None else "no comm inside steps")
+        lines.append(
+            f"overlap: {ratio_txt}, exposed "
+            f"{ov['comm_exposed_s']:.3f}s across {ov['steps']} step(s)"
+        )
     if snap.get("anomalies"):
         lines.append(f"anomalies: {snap['anomalies']}")
     for v in (verdicts or [])[-5:]:
@@ -527,6 +594,9 @@ def status_line(
         f"stalled {_fmt_age(snap['stalled_s'])} "
         f"{_fmt_bytes(rate)}/s"
     )
+    ov = snap.get("overlap")
+    if ov and ov.get("overlap_ratio") is not None:
+        txt += f" ovl {ov['overlap_ratio'] * 100.0:.0f}%"
     if snap.get("anomalies"):
         txt += f" anomalies {snap['anomalies']}"
     if verdicts:
@@ -897,14 +967,38 @@ def selftest() -> int:  # noqa: C901 — one linear smoke script
         planobj, _report = autotune.sweep(keys)
         assert set(planobj.entries) == set(keys)
 
-        # -- dashboard + OpenMetrics render ----------------------------
+        # -- overlap view (step spans on the live sinks) ---------------
+        snap = agg.snapshot()
+        assert "overlap" not in snap, "unarmed snapshot carries no overlap"
+        with open(sink0, "a") as f:
+            # one step [100, 110): compute [100, 107); the six latency
+            # samples above land at [104-eps, 109] — part hidden, part
+            # exposed
+            f.write(json.dumps({"kind": "step", "rank": 0, "step": 0,
+                                "t0": 100.0, "t1": 110.0, "t": 110.0})
+                    + "\n")
+            f.write(json.dumps({"kind": "compute", "rank": 0, "step": 0,
+                                "t0": 100.0, "t1": 107.0, "t": 107.0})
+                    + "\n")
+        agg.poll()
         snap = agg.snapshot(attribute=True)
+        ov = snap.get("overlap")
+        assert ov and ov["steps"] == 1, ov
+        assert ov["comm_exposed_s"] > 0 and ov["comm_overlapped_s"] > 0
+        assert 0.0 < ov["overlap_ratio"] < 1.0, ov
+        assert "0" in ov["per_rank"], ov
+
+        # -- dashboard + OpenMetrics render ----------------------------
         dash = render_dashboard(snap, sdoc.confirmed)
         assert "rank" in dash and "VERDICT" in dash
+        assert "overlap:" in dash, dash
+        assert "ovl" in status_line(snap)
         text = export.render_openmetrics(snap, verdicts=sdoc.confirmed)
         assert text.endswith("# EOF\n"), "OpenMetrics must end with # EOF"
         assert 'm4t_rank_last_seq{rank="1"} 3' in text, text
         assert "m4t_verdicts_total" in text
+        assert "m4t_overlap_ratio" in text, text
+        assert 'm4t_comm_exposed_seconds_total{rank="0"}' in text, text
         export.write_prom(os.path.join(tmp, "metrics.prom"), text)
         assert open(os.path.join(tmp, "metrics.prom")).read() == text
 

@@ -187,6 +187,7 @@ class MetricsRegistry:
         plan: Optional[str] = None,
         trace: Optional[str] = None,
         job: Optional[str] = None,
+        step: Optional[int] = None,
     ) -> Dict[str, Any]:
         """Count one trace-time op emission; returns the record stored
         in the emission ring (shared schema with the JSONL event log).
@@ -197,9 +198,10 @@ class MetricsRegistry:
         [op]['seq']``); both restart from 1 after :meth:`reset`.
         ``impl``/``plan`` (the planner's routing stamp) and
         ``trace``/``job`` (the serving plane's per-job trace context,
-        ``M4T_TRACE_ID``/``M4T_JOB_ID``) are recorded only when given
-        — unarmed emissions stay schema-identical to pre-planner /
-        pre-tracing records.
+        ``M4T_TRACE_ID``/``M4T_JOB_ID``) and ``step`` (the overlap
+        observatory's step context, ``M4T_STEP_SPAN``) are recorded
+        only when given — unarmed emissions stay schema-identical to
+        pre-planner / pre-tracing / pre-overlap records.
         """
         record = {
             "kind": "emission",
@@ -221,6 +223,8 @@ class MetricsRegistry:
             record["trace"] = str(trace)
         if job is not None:
             record["job"] = str(job)
+        if step is not None:
+            record["step"] = int(step)
         key = _axes_key(axes)
         with self._lock:
             m = self._ops.get(op)
@@ -258,7 +262,7 @@ class MetricsRegistry:
         with self._lock:
             self._inflight[cid] = time.perf_counter()
             rec = self._cid_rec.get(cid)
-        from . import events
+        from . import events, overlap
 
         if events.get_sink() is not None:
             exec_rec = {
@@ -275,6 +279,12 @@ class MetricsRegistry:
                 exec_rec["trace"] = rec["trace"]
             if rec and rec.get("job") is not None:
                 exec_rec["job"] = rec["job"]
+            # the step open *now* (callback time, not trace time):
+            # an emission traced once at step 0 executes every step,
+            # and this stamp is what attributes each execution
+            step = overlap.current_step()
+            if step is not None:
+                exec_rec["step"] = step
             events.emit(exec_rec)
 
     def mark_runtime_end(self, cid: str, op: str) -> Optional[float]:
@@ -297,7 +307,7 @@ class MetricsRegistry:
                 m = self._ops[op] = OpMetrics(op, self._reservoir)
             m.latency.add(sample)
             rec = self._cid_rec.get(cid)
-        from . import events, perf
+        from . import events, overlap, perf
 
         lat_rec = {
             "kind": "latency",
@@ -311,6 +321,9 @@ class MetricsRegistry:
             lat_rec["trace"] = rec["trace"]
         if rec and rec.get("job") is not None:
             lat_rec["job"] = rec["job"]
+        step = overlap.current_step()
+        if step is not None:
+            lat_rec["step"] = step
         events.emit(lat_rec)
         perf.observe_runtime(op, sample, record=rec, cid=cid)
         return sample

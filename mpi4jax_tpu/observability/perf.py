@@ -659,10 +659,32 @@ def _cmd_report(args: argparse.Namespace) -> int:
         print("perf: no usable records in the given inputs", file=sys.stderr)
         return 2
     result = attribute(by_rank, peak=args.peak_gbps, alpha=args.alpha_s)
+    orep = None
+    try:
+        from . import overlap as _overlap
+
+        orep = _overlap.build_report(
+            by_rank, gbps=args.peak_gbps, alpha=args.alpha_s
+        )
+        if not orep["ranks"]:
+            orep = None
+    except Exception:  # pragma: no cover — overlap section best-effort
+        orep = None
     if args.json:
+        if orep is not None:
+            # armed runs only (streams with step spans): the overlap
+            # observatory's predicted-vs-achieved route rows ride along
+            result = dict(
+                result,
+                overlap={"totals": orep["totals"],
+                         "routes": orep["routes"]},
+            )
         print(json.dumps(result, indent=1, default=str))
     else:
         print(format_table(result))
+        if orep is not None:
+            print()
+            print(_overlap.format_exposed(orep))
     if args.output:
         history_rows = (
             load_history(args.history_dir) if args.history_dir else None

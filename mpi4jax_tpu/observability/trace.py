@@ -23,7 +23,13 @@ process track per rank (``pid`` = rank, labeled ``rank N``), with
   algorithm rides (``costmodel.record_edge_phases`` — the topology
   observatory's attribution math), one "link src->dst GB/s" counter
   per measured edge, so *which link* degraded is visible without
-  leaving the timeline.
+  leaving the timeline,
+- **occupancy tracks** (armed runs only — streams carrying the
+  overlap observatory's ``step``/``compute`` span records,
+  ``launch --overlap``): each step is a slice on the rank's "steps"
+  thread and its exact compute/comm decomposition a stacked
+  "occupancy (s)" counter (compute-only / overlapped / exposed /
+  idle seconds per step).
 
 **Merged serving trace** (``--serve SPOOL``): one Perfetto file for a
 whole spool of jobs. Every job gets its *own* process group — a
@@ -68,6 +74,10 @@ from . import costmodel
 TID_EMISSIONS = 0
 TID_RUNTIME = 1
 TID_HEARTBEAT = 2
+#: step spans (overlap observatory; the thread_name meta is emitted
+#: only when a rank actually has step records, so unarmed exports —
+#: and the committed goldens — are byte-identical)
+TID_STEPS = 3
 
 _THREAD_NAMES = {
     TID_EMISSIONS: "collectives (trace-time)",
@@ -238,6 +248,77 @@ def _rank_events(
             )
 
 
+def _occupancy_events(
+    trace_events: List[Dict[str, Any]],
+    records: List[Dict[str, Any]],
+    *,
+    pid: int,
+    t0: float,
+) -> None:
+    """Overlap-observatory tracks for one rank (armed runs only — a
+    stream without ``step`` records emits nothing, which keeps the
+    committed goldens byte-identical): each step span becomes a slice
+    on the "steps" thread, and its exact interval-algebra decomposition
+    (``overlap.decompose``) becomes a stacked "occupancy (s)" counter —
+    compute-only / overlapped / exposed / idle seconds per step, so a
+    step whose communication fell out from behind compute shows as a
+    rising "comm_exposed" band right in the timeline."""
+    from . import overlap
+
+    steps = overlap.span_records(records, "step")
+    if not steps:
+        return
+    trace_events.append(
+        {
+            "name": "thread_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": TID_STEPS,
+            "args": {"name": "steps"},
+        }
+    )
+    compute = overlap.merge(
+        (r["t0"], r["t1"]) for r in overlap.span_records(records, "compute")
+    )
+    comm = overlap.merge(iv for iv, _rec in overlap.comm_samples(records))
+    for rec in steps:
+        d = overlap.decompose(rec["t0"], rec["t1"], compute, comm)
+        args: Dict[str, Any] = {
+            k: d[f"{k}_s"]
+            for k in ("compute_only", "comm_overlapped", "comm_exposed",
+                      "idle")
+        }
+        ratio = overlap.occupancy_ratio(d)
+        if rec.get("step") is not None:
+            args["step"] = rec["step"]
+        if ratio is not None:
+            args["overlap_ratio"] = round(ratio, 6)
+        trace_events.append(
+            {
+                "name": f"step {rec.get('step', '?')}",
+                "ph": "X",
+                "pid": pid,
+                "tid": TID_STEPS,
+                "ts": _micros(rec["t0"], t0),
+                "dur": round((rec["t1"] - rec["t0"]) * 1e6, 1),
+                "args": args,
+            }
+        )
+        trace_events.append(
+            {
+                "name": "occupancy (s)",
+                "ph": "C",
+                "pid": pid,
+                "ts": _micros(rec["t0"], t0),
+                "args": {
+                    k: round(d[f"{k}_s"], 6)
+                    for k in ("compute_only", "comm_overlapped",
+                              "comm_exposed", "idle")
+                },
+            }
+        )
+
+
 def _link_counter_events(
     trace_events: List[Dict[str, Any]],
     by_rank: Dict[int, List[Dict[str, Any]]],
@@ -315,6 +396,7 @@ def build_trace(by_rank: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
             trace_events, rank, f"rank {rank}", rank, _THREAD_NAMES
         )
         _rank_events(trace_events, by_rank[rank], pid=rank, t0=t0)
+        _occupancy_events(trace_events, by_rank[rank], pid=rank, t0=t0)
     links_pid = (max(by_rank) + 1) if by_rank else 0
     link_events: List[Dict[str, Any]] = []
     if _link_counter_events(link_events, by_rank, pid=links_pid, t0=t0):

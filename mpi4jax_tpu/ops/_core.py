@@ -171,6 +171,14 @@ def _telemetry_prologue(
     # without them, same contract as the planner stamp above.
     trace = _obs.events.current_trace()
     job = _obs.events.current_job()
+    # Overlap-observatory step context (armed by M4T_STEP_SPAN /
+    # launch --overlap): the step whose span was open when this op was
+    # *traced*. Executions are attributed per step by the runtime
+    # callbacks (metrics.mark_runtime_start/end stamp the step live);
+    # this trace-time stamp is the route-level join key. Unarmed it is
+    # None and the record schema is byte-identical, same contract as
+    # the trace/job stamp above.
+    step = _obs.overlap.current_step()
     # Flight recorder first (observability/recorder.py): unconditional
     # and telemetry-independent — its ring is the post-mortem record of
     # what this rank was about to emit, kept even when every other
@@ -202,6 +210,7 @@ def _telemetry_prologue(
         plan=plan_id,
         trace=trace,
         job=job,
+        step=step,
     )
     debug.log_runtime(bound_comm, ident, opname, details)
     # Fault injection LAST (resilience/faults.py): the recorder ring

@@ -555,19 +555,53 @@ def _cmd_placement_derive(args: argparse.Namespace) -> int:
     from ..analysis import placement_check
     from . import placement as _placement
 
-    topo = _load_topo_or_exit2(args.topo, "placement derive")
-    if topo is None:
+    from_verdicts = getattr(args, "from_verdicts", None)
+    if not from_verdicts and not args.topo:
+        print("placement derive: one of --topo or --from-verdicts "
+              "RUNDIR is required", file=sys.stderr)
         return 2
+    topo = None
+    if args.topo:
+        topo = _load_topo_or_exit2(args.topo, "placement derive")
+        if topo is None:
+            return 2
     kw = {}
     if args.payload is not None:
         kw["nbytes"] = args.payload
-    doc = _placement.derive(
-        topo,
-        gbps=args.peak_gbps,
-        alpha=(args.alpha_us * 1e-6
-               if args.alpha_us is not None else None),
-        **kw,
-    )
+    alpha = (args.alpha_us * 1e-6
+             if args.alpha_us is not None else None)
+    if from_verdicts:
+        # evidence-driven mode: the run's confirmed straggler verdicts
+        # correct the probed map (link-localized evidence only) and the
+        # search re-runs over the corrected betas
+        doc, evidence = _placement.derive_from_verdicts(
+            list(from_verdicts),
+            topo=topo,
+            gbps=args.peak_gbps,
+            alpha=alpha,
+            **kw,
+        )
+        if doc is None:
+            print(f"placement derive --from-verdicts: no proposal: "
+                  f"{evidence.get('reason')}", file=sys.stderr)
+            if args.json:
+                print(json.dumps(
+                    {"placement": None, "evidence": evidence}, indent=1
+                ))
+            return 1
+        print(f"# {evidence['verdicts']} straggler verdict(s), "
+              f"link-bound ranks "
+              f"{doc['verdict_evidence']['link_bound_ranks']}, "
+              f"penalized edges "
+              f"{doc['verdict_evidence']['penalized_edges']}",
+              file=sys.stderr)
+    else:
+        doc = _placement.derive(
+            topo,
+            gbps=args.peak_gbps,
+            alpha=alpha,
+            **kw,
+        )
     reports = _placement.verify(doc)
     clean = placement_check.reports_clean(reports)
     if clean:
@@ -1112,8 +1146,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         "the m4t-place/1 document",
     )
     pl_derive.add_argument(
-        "--topo", required=True, metavar="TOPO.json",
-        help="measured m4t-topo/1 topology map (exit 2 on a bad map)",
+        "--topo", default=None, metavar="TOPO.json",
+        help="measured m4t-topo/1 topology map (exit 2 on a bad map); "
+        "required unless --from-verdicts finds one beside the run "
+        "artifacts",
+    )
+    pl_derive.add_argument(
+        "--from-verdicts", nargs="+", default=None, metavar="RUNDIR",
+        help="derive from a run's confirmed straggler verdicts "
+        "(live.jsonl): link-localized stragglers penalize their "
+        "implicated edge in the (auto-found or --topo) map and the "
+        "search re-runs over the corrected betas; exit 1 with the "
+        "reason when the evidence proposes nothing",
     )
     pl_derive.add_argument(
         "--out", default=None, metavar="PLACE.json",

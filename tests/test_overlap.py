@@ -1,0 +1,722 @@
+"""Overlap observatory (``mpi4jax_tpu/observability/overlap.py``):
+per-step compute/communication occupancy attribution.
+
+Covers the PR 19 acceptance surface:
+
+- interval algebra: random interval sets decompose into
+  ``compute_only + comm_exposed + comm_overlapped + idle`` that
+  telescopes exactly to the step span (<= 1e-6 s residual) and is
+  invariant under permutation of the input intervals;
+- span API arming contract: ``obs.step_span()``/``obs.compute_span()``
+  are one-falsy-check no-ops unarmed; armed they emit pinned ``step``
+  and ``compute`` interval records and stamp ``step`` onto emission,
+  ``exec``, and ``latency`` records — the *unarmed* schemas stay
+  byte-identical (drift-pinned here, like the PR 11/12 pins);
+- golden report: ``build_report`` over a pinned synthetic 2-rank world
+  matches ``tests/data/overlap_golden.json`` key-for-key (regenerate
+  with ``python -m tests.test_overlap`` after intentional changes);
+- the CLI (``python -m mpi4jax_tpu.observability.overlap``):
+  --selftest, and RUNDIR report in text and --json forms;
+- cost model: ``overlappable_fraction`` / ``expected_exposed_s`` (the
+  ``lint --cost`` exposed-time column);
+- the confirmed-straggler re-permutation loop (ROADMAP item 1
+  follow-on): ``placement.derive_from_verdicts`` over live verdicts +
+  a probed map, the ``planner placement derive --from-verdicts`` CLI,
+  and the launcher's ``_propose_placement`` supervisor audit;
+- e2e (native toolchain): a 2-rank ``launch --overlap`` world whose
+  injected ``slowdown`` fault provably moves communication time from
+  ``comm_overlapped`` to ``comm_exposed``.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from mpi4jax_tpu.observability import costmodel, doctor, events, overlap
+from mpi4jax_tpu.observability import topology
+from mpi4jax_tpu.planner import placement
+
+pytestmark = pytest.mark.overlap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "data", "overlap_golden.json")
+
+
+# ---------------------------------------------------------------------
+# interval algebra (property tests)
+# ---------------------------------------------------------------------
+
+
+def _random_intervals(rng, n, lo, hi):
+    """Arbitrary intervals around [lo, hi]: overlapping, nested,
+    empty, inverted, and partially outside the window."""
+    out = []
+    for _ in range(n):
+        a = rng.uniform(lo - 1.0, hi + 1.0)
+        b = a + rng.uniform(-0.3, (hi - lo) * 0.6 + 0.1)
+        out.append((a, b))
+    return out
+
+
+PHASES = ("compute_only_s", "comm_exposed_s", "comm_overlapped_s", "idle_s")
+
+
+def test_decompose_telescopes_on_random_interval_sets():
+    rng = random.Random(190)
+    for _ in range(300):
+        t0 = rng.uniform(-5.0, 5.0)
+        t1 = t0 + rng.uniform(0.0, 10.0)
+        compute = _random_intervals(rng, rng.randint(0, 9), t0, t1)
+        comm = _random_intervals(rng, rng.randint(0, 9), t0, t1)
+        d = overlap.decompose(t0, t1, compute, comm)
+        assert d["ok"], d
+        assert d["residual_s"] <= overlap.SUM_TOLERANCE_S
+        assert abs(sum(d[k] for k in PHASES) - d["span_s"]) \
+            <= overlap.SUM_TOLERANCE_S
+        for k in PHASES:
+            assert d[k] >= -1e-9, (k, d)
+        assert 0.0 <= d["coverage"] <= 1.0 + 1e-9
+
+
+def test_decompose_is_permutation_invariant():
+    rng = random.Random(191)
+    for trial in range(50):
+        t0, t1 = 0.0, 10.0
+        compute = _random_intervals(rng, 7, t0, t1)
+        comm = _random_intervals(rng, 7, t0, t1)
+        base = overlap.decompose(t0, t1, compute, comm)
+        for seed in (1, 2, 3):
+            srng = random.Random(seed * 1000 + trial)
+            c2, m2 = list(compute), list(comm)
+            srng.shuffle(c2)
+            srng.shuffle(m2)
+            assert overlap.decompose(t0, t1, c2, m2) == base
+
+
+def test_merge_yields_disjoint_sorted_union():
+    rng = random.Random(192)
+    for _ in range(100):
+        ivs = _random_intervals(rng, rng.randint(0, 12), 0.0, 5.0)
+        merged = overlap.merge(ivs)
+        for (a, b) in merged:
+            assert a < b
+        for (_, b), (a2, _) in zip(merged, merged[1:]):
+            assert b < a2  # strictly disjoint and sorted
+        shuffled = list(ivs)
+        rng.shuffle(shuffled)
+        assert overlap.merge(shuffled) == merged
+
+
+def test_decompose_known_geometry():
+    # compute [0,6], comm [4,8] in a [0,10] step: 2s hidden, 2s exposed
+    d = overlap.decompose(0.0, 10.0, [(0.0, 6.0)], [(4.0, 8.0)])
+    assert d["compute_only_s"] == pytest.approx(4.0)
+    assert d["comm_overlapped_s"] == pytest.approx(2.0)
+    assert d["comm_exposed_s"] == pytest.approx(2.0)
+    assert d["idle_s"] == pytest.approx(2.0)
+    assert d["coverage"] == pytest.approx(0.8)
+    assert overlap.occupancy_ratio(d) == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------
+# span API: arming contract + unarmed drift pins
+# ---------------------------------------------------------------------
+
+#: the PR 11 unarmed schemas, pinned literally (as in test_spans.py):
+#: the overlap observatory must not widen any *unarmed* record
+UNARMED_EMISSION_KEYS = {
+    "kind", "cid", "op", "bytes", "dtype", "axes", "world",
+    "annotation", "shape", "t", "seq", "op_seq",
+}
+UNARMED_EXEC_FILE_KEYS = {"kind", "cid", "op", "seq", "t", "rank", "ts"}
+UNARMED_LATENCY_FILE_KEYS = {
+    "kind", "cid", "op", "seq", "seconds", "t", "rank", "ts",
+}
+STEP_FILE_KEYS = {"kind", "step", "t0", "t1", "t", "rank", "ts"}
+COMPUTE_FILE_KEYS = {"kind", "step", "t0", "t1", "t", "rank", "ts"}
+
+
+@pytest.fixture
+def armed_sink(tmp_path):
+    """A private JSONL sink + clean telemetry/overlap state; restores
+    everything (including the module sink) afterwards."""
+    from mpi4jax_tpu import observability as obs
+    from mpi4jax_tpu.observability import metrics as metrics_mod
+
+    path = str(tmp_path / "events-rank0.jsonl")
+    prev_sink = events._sink
+    prev_enabled = metrics_mod._enabled
+    events._sink = events.EventLog(path)
+    obs.reset()
+    obs.enable(runtime=True)
+    yield path
+    overlap.arm(False)
+    obs.reset()
+    metrics_mod._enabled = prev_enabled
+    events._sink.close()
+    events._sink = prev_sink
+
+
+def test_step_span_unarmed_is_a_noop(armed_sink):
+    assert overlap.current_step() is None
+    with overlap.step_span(step=5) as n:
+        assert n is None
+        assert overlap.current_step() is None
+        with overlap.compute_span() as c:
+            assert c is None
+    assert events.read(armed_sink) == []
+
+
+def test_step_span_armed_emits_pinned_records(armed_sink):
+    overlap.arm(True)
+    with overlap.step_span(step=7) as n:
+        assert n == 7
+        assert overlap.current_step() == 7
+        with overlap.compute_span():
+            pass
+    assert overlap.current_step() is None
+    compute, step = events.read(armed_sink)
+    assert step["kind"] == "step" and step["step"] == 7
+    assert set(step) == STEP_FILE_KEYS, sorted(step)
+    assert step["t0"] <= compute["t0"] <= compute["t1"] <= step["t1"]
+    assert compute["kind"] == "compute" and compute["step"] == 7
+    assert set(compute) == COMPUTE_FILE_KEYS, sorted(compute)
+
+
+def test_step_span_autonumbers_and_survives_exceptions(armed_sink):
+    overlap.arm(True)
+    with overlap.step_span() as a:
+        pass
+    with pytest.raises(RuntimeError):
+        with overlap.step_span() as b:
+            assert b == a + 1
+            raise RuntimeError("boom")
+    recs = events.read(armed_sink)
+    assert [r["step"] for r in recs] == [a, a + 1]  # both spans recorded
+
+
+def test_runtime_records_step_stamp_is_armed_only(armed_sink):
+    from mpi4jax_tpu import observability as obs
+
+    reg = obs.registry
+
+    def one_op(cid):
+        # the ops/_core.py prologue: the trace-time step stamp is
+        # whatever step context is open (None unarmed / outside)
+        rec = reg.record_emission(
+            "AllReduce", nbytes=64, dtype="float32", axes=("ranks",),
+            world=2, cid=cid, step=overlap.current_step(),
+        )
+        reg.mark_runtime_start(cid)
+        reg.mark_runtime_end(cid, "AllReduce")
+        return rec
+
+    # unarmed: emission/exec/latency schemas byte-identical to PR 11
+    em = one_op("c1")
+    assert set(em) == UNARMED_EMISSION_KEYS, sorted(em)
+    execs = [r for r in events.read(armed_sink) if r["kind"] == "exec"]
+    lats = [r for r in events.read(armed_sink) if r["kind"] == "latency"]
+    assert set(execs[0]) == UNARMED_EXEC_FILE_KEYS, sorted(execs[0])
+    assert set(lats[0]) == UNARMED_LATENCY_FILE_KEYS, sorted(lats[0])
+
+    # armed + inside a step: every runtime record gains exactly `step`
+    overlap.arm(True)
+    with overlap.step_span(step=3):
+        em2 = one_op("c2")
+    assert set(em2) == UNARMED_EMISSION_KEYS | {"step"}
+    assert em2["step"] == 3
+    execs = [r for r in events.read(armed_sink) if r["kind"] == "exec"]
+    lats = [r for r in events.read(armed_sink) if r["kind"] == "latency"]
+    assert set(execs[1]) == UNARMED_EXEC_FILE_KEYS | {"step"}
+    assert set(lats[1]) == UNARMED_LATENCY_FILE_KEYS | {"step"}
+    assert execs[1]["step"] == lats[1]["step"] == 3
+
+    # armed but outside any span: back to the unarmed schema
+    em3 = one_op("c3")
+    assert set(em3) == UNARMED_EMISSION_KEYS, sorted(em3)
+
+
+# ---------------------------------------------------------------------
+# golden report (pinned synthetic 2-rank world)
+# ---------------------------------------------------------------------
+
+
+def synthetic_overlap_world():
+    """Two identical ranks, two steps each, all timestamps pinned.
+
+    Geometry per rank: step 0 = [100, 101) with compute [100, 100.85)
+    and one fully-hidden + one exposed AllReduce; step 1 = [101, 102)
+    with compute [101, 101.92) and one hidden AllReduce; one
+    standalone AllReduce after the steps (the contention-free
+    bandwidth cohort). Regenerate the golden with
+    ``python -m tests.test_overlap`` after intentional changes."""
+    world = {}
+    for rank in (0, 1):
+        ca, cb = f"c{rank}a", f"c{rank}b"
+        world[rank] = [
+            {"kind": "emission", "rank": rank, "seq": 1, "op": "AllReduce",
+             "cid": ca, "bytes": 1 << 20, "dtype": "float32",
+             "axes": ["ranks"], "world": 2, "shape": [262144],
+             "impl": "pallas_ring", "plan": "cpu|AllReduce|f32|1048576|w2",
+             "t": 100.0, "step": 0},
+            {"kind": "emission", "rank": rank, "seq": 2, "op": "AllReduce",
+             "cid": cb, "bytes": 1 << 20, "dtype": "float32",
+             "axes": ["ranks"], "world": 2, "shape": [262144],
+             "impl": "hlo", "plan": "cpu|AllReduce|f32|1048576|w2",
+             "t": 100.1, "step": 0},
+            {"kind": "step", "rank": rank, "step": 0,
+             "t0": 100.0, "t1": 101.0, "t": 101.0},
+            {"kind": "compute", "rank": rank, "step": 0,
+             "t0": 100.0, "t1": 100.85, "t": 100.85},
+            {"kind": "latency", "rank": rank, "cid": ca, "op": "AllReduce",
+             "seq": 1, "seconds": 0.2, "t": 100.7, "step": 0},
+            {"kind": "latency", "rank": rank, "cid": cb, "op": "AllReduce",
+             "seq": 2, "seconds": 0.1, "t": 100.95, "step": 0},
+            {"kind": "step", "rank": rank, "step": 1,
+             "t0": 101.0, "t1": 102.0, "t": 102.0},
+            {"kind": "compute", "rank": rank, "step": 1,
+             "t0": 101.0, "t1": 101.92, "t": 101.92},
+            {"kind": "latency", "rank": rank, "cid": cb, "op": "AllReduce",
+             "seq": 2, "seconds": 0.3, "t": 101.9, "step": 1},
+            {"kind": "latency", "rank": rank, "cid": cb, "op": "AllReduce",
+             "seq": 2, "seconds": 0.1, "t": 103.0},
+        ]
+    return world
+
+
+def write_logs(tmp_path, per_rank):
+    for rank, records in per_rank.items():
+        with open(tmp_path / f"events-rank{rank}.jsonl", "w") as f:
+            for rec in records:
+                f.write(json.dumps(rec) + "\n")
+    return str(tmp_path)
+
+
+def test_report_decomposition_and_routes():
+    rep = overlap.build_report(synthetic_overlap_world())
+    assert rep["schema"] == overlap.SCHEMA
+    assert rep["ranks"] == 2
+    assert len(rep["steps"]) == 2  # distinct steps, aggregated cross-rank
+    assert rep["totals"]["steps"] == 4  # rank-steps
+    assert rep["ok"] and rep["covered"]
+    tot = rep["per_rank"]["0"]["totals"]
+    assert tot["comm_overlapped_s"] == pytest.approx(0.5)
+    assert tot["comm_exposed_s"] == pytest.approx(0.1)
+    assert tot["overlap_ratio"] == pytest.approx(0.5 / 0.6)
+    routes = {(r["op"], r["impl"]): r for r in rep["routes"]}
+    ring = routes[("AllReduce", "pallas_ring")]
+    hlo = routes[("AllReduce", "hlo")]
+    assert ring["samples"] == 2 and hlo["samples"] == 6
+    # the hidden sample is the during-compute bandwidth cohort, the
+    # exposed/outside-step ones the standalone cohort
+    assert ring["during_n"] == 2 and ring["standalone_n"] == 0
+    assert hlo["during_n"] == 2 and hlo["standalone_n"] == 4
+    assert hlo["gbps_during_p50"] is not None
+    assert hlo["gbps_standalone_p50"] is not None
+    assert ring["predicted_frac"] == pytest.approx(
+        costmodel.overlappable_fraction("AllReduce", "pallas_ring")
+    )
+
+
+def test_report_is_record_order_invariant():
+    base = overlap.build_report(synthetic_overlap_world())
+    shuffled = synthetic_overlap_world()
+    for rank in shuffled:
+        random.Random(42 + rank).shuffle(shuffled[rank])
+    assert overlap.build_report(shuffled) == base
+
+
+def test_report_matches_golden():
+    rep = json.loads(json.dumps(
+        overlap.build_report(synthetic_overlap_world()), sort_keys=True
+    ))
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    assert rep == golden, (
+        "overlap report drifted from tests/data/overlap_golden.json — "
+        "if intentional, regenerate with `python -m tests.test_overlap`"
+    )
+
+
+def test_format_report_and_exposed_render():
+    rep = overlap.build_report(synthetic_overlap_world())
+    txt = overlap.format_report(rep)
+    assert "overlap" in txt and "exposed" in txt
+    exp = overlap.format_exposed(rep)
+    assert "exposed communication" in exp
+    assert "AllReduce" in exp
+
+
+# ---------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------
+
+
+def _run_cli(mod, *argv, timeout=300):
+    return subprocess.run(
+        [sys.executable, "-m", mod, *argv],
+        capture_output=True, text=True, cwd=REPO, timeout=timeout,
+    )
+
+
+def test_cli_selftest():
+    res = _run_cli("mpi4jax_tpu.observability.overlap", "--selftest")
+    assert res.returncode == 0, res.stderr
+    assert "overlap selftest: ok" in res.stdout
+
+
+def test_cli_report_text_and_json(tmp_path):
+    rundir = write_logs(tmp_path, synthetic_overlap_world())
+    res = _run_cli("mpi4jax_tpu.observability.overlap", rundir)
+    assert res.returncode == 0, res.stderr
+    assert "exposed" in res.stdout
+    res = _run_cli("mpi4jax_tpu.observability.overlap", rundir, "--json")
+    assert res.returncode == 0, res.stderr
+    rep = json.loads(res.stdout)
+    assert rep["schema"] == overlap.SCHEMA and rep["ok"]
+
+
+def test_doctor_perf_gains_exposed_section(tmp_path):
+    rundir = write_logs(tmp_path, synthetic_overlap_world())
+    res = _run_cli("mpi4jax_tpu.observability.doctor", "--perf", rundir)
+    assert res.returncode == 0, res.stderr
+    assert "exposed communication" in res.stdout
+
+
+# ---------------------------------------------------------------------
+# trace export: occupancy tracks (armed runs only)
+# ---------------------------------------------------------------------
+
+
+def test_trace_gains_occupancy_track_for_armed_runs():
+    from mpi4jax_tpu.observability import trace
+
+    obj = trace.build_trace(synthetic_overlap_world())
+    names = [e for e in obj["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "thread_name"]
+    assert any(e["args"]["name"] == "steps" for e in names)
+    slices = [e for e in obj["traceEvents"]
+              if e.get("ph") == "X" and str(e.get("name", "")).startswith(
+                  "step ")]
+    assert len(slices) == 4  # 2 ranks x 2 steps
+    assert all("comm_exposed" in e["args"] for e in slices)
+    counters = [e for e in obj["traceEvents"]
+                if e.get("ph") == "C" and e.get("name") == "occupancy (s)"]
+    assert counters
+
+
+def test_trace_without_steps_is_unchanged():
+    from mpi4jax_tpu.observability import trace
+
+    world = {
+        rank: [r for r in recs if r["kind"] not in ("step", "compute")]
+        for rank, recs in synthetic_overlap_world().items()
+    }
+    obj = trace.build_trace(world)
+    assert not any(
+        e.get("name") == "occupancy (s)" for e in obj["traceEvents"]
+    )
+    assert not any(
+        e.get("ph") == "M" and e.get("args", {}).get("name") == "steps"
+        for e in obj["traceEvents"]
+    )
+
+
+# ---------------------------------------------------------------------
+# cost model: expected exposed time (the `lint --cost` column)
+# ---------------------------------------------------------------------
+
+
+def test_overlappable_fraction_by_impl():
+    assert costmodel.overlappable_fraction("Isend") == 1.0
+    assert costmodel.overlappable_fraction("Irecv") == 1.0
+    assert costmodel.overlappable_fraction("AllReduce", "hlo") == 0.0
+    assert costmodel.overlappable_fraction("AllReduce", "pallas_ring") \
+        == 0.75
+    assert costmodel.overlappable_fraction(
+        "AllReduce", "algo:recursive_halving") == 0.5
+    assert costmodel.overlappable_fraction("AllReduce") == 0.0
+
+
+def test_expected_exposed_never_exceeds_expected():
+    c = costmodel.record_cost(
+        {"op": "AllReduce", "bytes": 1 << 20, "world": 4,
+         "dtype": "float32"}
+    )
+    full = costmodel.expected_time_s(c)
+    for impl in (None, "hlo", "pallas_ring", "algo:ring"):
+        exp = costmodel.expected_exposed_s(c, impl=impl)
+        assert 0.0 <= exp <= full + 1e-12
+    # pipelined impls hide part of the wire time, monolithic ones none
+    assert costmodel.expected_exposed_s(c, impl="pallas_ring") < full
+    assert costmodel.expected_exposed_s(c, impl="hlo") \
+        == pytest.approx(full)
+    # fraction override wins over the impl default
+    assert costmodel.expected_exposed_s(c, fraction=1.0) \
+        == pytest.approx(0.0)
+    assert costmodel.expected_exposed_s(c, fraction=0.0) \
+        == pytest.approx(full)
+
+
+def test_cost_report_carries_exposed_column():
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as m4t
+    from mpi4jax_tpu.analysis import trace_schedule
+    from mpi4jax_tpu.analysis.schedule import cost_report
+    from mpi4jax_tpu.analysis.schedule import format_cost_report
+
+    def step(x):
+        return m4t.allreduce(x)
+
+    s = trace_schedule(step, (jnp.ones(8, jnp.float32),),
+                       axis_env={"ranks": 4})
+    rep = cost_report(s)
+    for agg in rep["per_rank"].values():
+        assert "exposed_s" in agg
+        assert 0.0 <= agg["exposed_s"] <= agg["expected_s"] + 1e-12
+    assert all("exposed_s" in g for g in rep["top"])
+    assert "exposed" in format_cost_report(rep)
+
+
+# ---------------------------------------------------------------------
+# confirmed-straggler re-permutation loop (ROADMAP item 1 follow-on)
+# ---------------------------------------------------------------------
+
+
+def _verdict(rank, ratio=2.5):
+    return {"kind": "verdict", "klass": "transient", "rank": rank,
+            "t": 1.0, "finding": {"kind": "straggler", "rank": rank,
+                                  "ratio": ratio, "op": "AllReduce"}}
+
+
+def verdict_rundir(tmp_path, *, world=4, slow=((2, 3),), slow_beta=1.0,
+                   ranks=(3,), ratio=2.5):
+    """A run directory shaped like a live supervised run: a probed
+    ``topology.json`` plus streaming-doctor verdicts in live.jsonl."""
+    topo = topology.synthetic_map(topology.SyntheticLinkModel(
+        world, beta_gbps=20.0,
+        links={e: {"beta_gbps": slow_beta} for e in slow},
+    ))
+    topology.save(str(tmp_path / "topology.json"), topo)
+    with open(tmp_path / "live.jsonl", "w") as f:
+        for r in ranks:
+            f.write(json.dumps(_verdict(r, ratio)) + "\n")
+    return str(tmp_path)
+
+
+def test_derive_from_verdicts_requires_a_map(tmp_path):
+    with open(tmp_path / "live.jsonl", "w") as f:
+        f.write(json.dumps(_verdict(3)) + "\n")
+    doc, evidence = placement.derive_from_verdicts([str(tmp_path)])
+    assert doc is None
+    assert "no m4t-topo/1 map" in evidence["reason"]
+
+
+def test_derive_from_verdicts_requires_verdicts(tmp_path):
+    verdict_rundir(tmp_path, ranks=())
+    doc, evidence = placement.derive_from_verdicts([str(tmp_path)])
+    assert doc is None
+    assert "no confirmed straggler" in evidence["reason"]
+
+
+def test_derive_from_verdicts_rank_bound_declines(tmp_path):
+    # uniform links: the straggler's links look like everyone else's
+    rundir = verdict_rundir(tmp_path, slow=(), ranks=(3,))
+    doc, evidence = placement.derive_from_verdicts([rundir])
+    assert doc is None
+    assert "rank-bound" in evidence["reason"]
+    assert evidence["verdicts"] == 1 and not evidence["link_bound"]
+
+
+def test_derive_from_verdicts_link_bound_proposes(tmp_path):
+    rundir = verdict_rundir(tmp_path)
+    doc, evidence = placement.derive_from_verdicts([rundir])
+    assert doc is not None, evidence
+    assert doc["perm"] != list(range(doc["world"]))
+    ev = doc["verdict_evidence"]
+    assert ev["verdicts"] == 1
+    assert ev["link_bound_ranks"] == [3]
+    assert ev["penalized_edges"] and all(
+        p >= 2.5 for p in ev["penalized_edges"].values()
+    )
+    assert evidence["penalized_edges"] == ev["penalized_edges"]
+    # the ordinary proof pipeline accepts the proposal
+    proven = placement.prove(doc)
+    assert proven["proof"]["verdict"] == "verified"
+
+
+def test_placement_derive_cli_from_verdicts(tmp_path):
+    rundir = verdict_rundir(tmp_path)
+    out = str(tmp_path / "placement.json")
+    res = _run_cli("mpi4jax_tpu.planner", "placement", "derive",
+                   "--from-verdicts", rundir, "--json", "--out", out)
+    assert res.returncode == 0, res.stderr
+    payload = json.loads(res.stdout)
+    assert payload["verified"] is True
+    doc = payload["placement"]
+    assert doc["verdict_evidence"]["link_bound_ranks"] == [3]
+    assert "straggler verdict" in res.stderr
+    saved = placement.load(out)
+    assert saved["perm"] == doc["perm"]
+
+
+def test_placement_derive_cli_needs_topo_or_verdicts():
+    res = _run_cli("mpi4jax_tpu.planner", "placement", "derive")
+    assert res.returncode == 2
+    assert "--from-verdicts" in res.stderr
+
+
+def test_launch_propose_placement_audits_supervisor(tmp_path, capsys):
+    from mpi4jax_tpu import launch
+
+    rundir = verdict_rundir(tmp_path)
+    audit = os.path.join(rundir, "supervisor.jsonl")
+    launch._propose_placement(rundir, audit)
+    proposal = os.path.join(rundir, "placement-proposal.json")
+    doc = placement.load(proposal)
+    assert doc["proof"]["verdict"] == "verified"  # arrives proven
+    (rec,) = [r for r in events.read(audit)
+              if r.get("event") == "placement_proposal"]
+    assert rec["perm"] == doc["perm"]
+    assert rec["fingerprint"] == doc["fingerprint"]
+    assert rec["evidence"]["link_bound_ranks"] == [3]
+    assert rec["path"] == proposal
+    assert "re-permutation" in capsys.readouterr().err
+
+
+def test_launch_propose_placement_silent_without_evidence(tmp_path):
+    from mpi4jax_tpu import launch
+
+    # no topology map, no verdicts: must not create audit artifacts
+    # (the --retries 0 backcompat contract: no supervisor.jsonl)
+    with open(tmp_path / "events-rank0.jsonl", "w") as f:
+        f.write(json.dumps({"kind": "heartbeat", "rank": 0, "t": 1.0})
+                + "\n")
+    launch._propose_placement(
+        str(tmp_path), os.path.join(str(tmp_path), "supervisor.jsonl")
+    )
+    assert not os.path.exists(tmp_path / "supervisor.jsonl")
+    assert not os.path.exists(tmp_path / "placement-proposal.json")
+
+
+# ---------------------------------------------------------------------
+# launcher e2e (native toolchain): --overlap arming + slowdown shift
+# ---------------------------------------------------------------------
+
+needs_native = pytest.mark.skipif(
+    subprocess.run(["which", "g++"], capture_output=True).returncode != 0,
+    reason="no C++ toolchain",
+)
+
+
+def _launch(tmp_path, n, script, *launch_args, timeout=240):
+    path = str(tmp_path / "case.py")
+    with open(path, "w") as f:
+        f.write(f"import sys; sys.path.insert(0, {REPO!r})\n")
+        f.write(textwrap.dedent(script))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    return subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.launch", "-n", str(n),
+         *launch_args, path],
+        env=env, capture_output=True, text=True, timeout=timeout, cwd=REPO,
+    )
+
+
+def test_launch_overlap_requires_events_dir(tmp_path):
+    res = _launch(tmp_path, 2, "pass", "--overlap")
+    assert res.returncode == 2
+    assert "--overlap requires --events-dir" in res.stderr
+
+
+#: eager per-call collectives driven from a background thread while
+#: the main thread owns the compute span: the comm tail past the
+#: compute span is the *exposed* time the decomposition must name
+OVERLAP_SCRIPT = """
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m4t
+from mpi4jax_tpu import observability as obs
+
+x = jnp.ones(4096, jnp.float32)
+jax.block_until_ready(m4t.allreduce(x, op=m4t.SUM))  # warmup
+
+
+def comm_loop():
+    for _ in range(12):
+        jax.block_until_ready(m4t.allreduce(x, op=m4t.SUM))
+
+
+for s in range(2):
+    with obs.step_span(step=s):
+        th = threading.Thread(target=comm_loop)
+        with obs.compute_span():
+            th.start()
+            t_end = time.perf_counter() + 0.25
+            while time.perf_counter() < t_end:
+                sum(i * i for i in range(5000))
+        th.join()
+"""
+
+
+@needs_native
+def test_launch_overlap_slowdown_moves_comm_to_exposed(tmp_path):
+    """Acceptance: in a 2-rank ``--overlap`` world the decomposition
+    telescopes at full coverage, and an injected ``slowdown`` on every
+    rank-0 AllReduce provably moves time from ``comm_overlapped`` to
+    ``comm_exposed``."""
+    base_dir = str(tmp_path / "base")
+    res = _launch(tmp_path, 2, OVERLAP_SCRIPT,
+                  "--events-dir", base_dir, "--overlap")
+    assert res.returncode == 0, res.stderr
+    assert "overlap attribution" in res.stderr  # the launcher's recap
+    base = overlap.build_report(doctor.load([base_dir]))
+    assert base["ranks"] == 2 and base["totals"]["steps"] == 4
+    assert base["ok"], base["totals"]
+    assert base["covered"]  # >= 90% of every step span is named
+
+    slow_dir = str(tmp_path / "slow")
+    res = _launch(
+        tmp_path, 2, OVERLAP_SCRIPT,
+        "--events-dir", slow_dir, "--overlap", "--fault-plan",
+        '[{"rank": 0, "op": "AllReduce", "nth": 2, '
+        '"action": "slowdown", "ms": 40}]',
+    )
+    assert res.returncode == 0, res.stderr
+    slow = overlap.build_report(doctor.load([slow_dir]))
+    assert slow["ok"], slow["totals"]
+    # 11 slowed calls x 40ms per step dwarf the 0.25s compute window:
+    # the comm tail lands after compute ends, i.e. exposed
+    assert slow["totals"]["comm_exposed_s"] > \
+        base["totals"]["comm_exposed_s"] + 0.1
+    assert slow["totals"]["overlap_ratio"] < base["totals"]["overlap_ratio"]
+    # unarmed control: same workload without --overlap writes no spans
+    # and no step stamps (the byte-identical schema contract, e2e)
+    plain_dir = str(tmp_path / "plain")
+    res = _launch(tmp_path, 2, OVERLAP_SCRIPT, "--events-dir", plain_dir)
+    assert res.returncode == 0, res.stderr
+    recs = [r for rs in doctor.load([plain_dir]).values() for r in rs]
+    assert not any(r["kind"] in ("step", "compute") for r in recs)
+    assert not any("step" in r for r in recs)
+
+
+if __name__ == "__main__":
+    # regenerate the golden report after an intentional schema change
+    rep = json.loads(json.dumps(
+        overlap.build_report(synthetic_overlap_world()), sort_keys=True
+    ))
+    with open(GOLDEN, "w") as f:
+        json.dump(rep, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"golden rewritten: {GOLDEN}")
