@@ -33,6 +33,15 @@ Inside ``run``, *child* spans attribute where the time went:
   (``serving/pool.py``),
 - ``reshard`` — the elastic checkpoint reshard between attempts.
 
+The event-driven dispatch plane (``serving/dispatch.py``) batches
+claims and coalesces same-shape jobs into one sub-mesh run; every
+member job still gets its own full chain, with adjacent boundaries
+shared across members by the same reused-clock-read construction, so
+:func:`verify_chain` holds unchanged. Coalesced members' spans carry
+additive ``coalesced``/``batch``/``leader`` fields (never emitted on
+the classic path — its record schema stays byte-identical) that mark
+which world actually executed.
+
 Span records are ``kind: "span"`` lines appended to the *same*
 ``serving.jsonl`` the audit uses (one file still tells the whole
 story; every pre-existing reader filters on ``kind == "serving"`` and

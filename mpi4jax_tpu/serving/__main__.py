@@ -21,6 +21,10 @@ Subcommands:
 - ``reclaim SPOOL`` — one offline scavenger pass: requeue running
   entries whose owner's lease expired (the same pass every federated
   server runs in its loop; this is the no-server-left recovery tool).
+- ``dispatch SPOOL`` — the event-driven dispatch plane's counters
+  (active wake wire, wakeups, batch sizes, coalesced jobs, group
+  commits, fsyncs/job); ``dispatch --selftest`` exercises the wires,
+  batched claims, coalescing and group commit device-free.
 - ``--selftest`` — device-free exercise of the whole control plane
   (spool protocol, scheduler fairness, server loop under a stub
   runner including elastic shrink over a real resharded checkpoint,
@@ -49,6 +53,11 @@ from .spool import (
 
 def _cmd_serve(args) -> int:
     spool = Spool(args.spool)
+    if args.fastpath:
+        # pool workers are separate processes: they learn the serve
+        # loop runs event-driven from the env and arm their own
+        # mailbox wake wires (serving/pool.py worker_loop)
+        os.environ["M4T_DISPATCH_FASTPATH"] = str(args.fastpath)
     if args.queue_cap is not None:
         spool.configure(args.queue_cap)
     slo = None
@@ -92,6 +101,9 @@ def _cmd_serve(args) -> int:
             server_id=args.server_id,
             lease_s=args.lease,
             max_reclaims=args.max_reclaims,
+            fastpath=args.fastpath,
+            batch=args.batch,
+            coalesce=not args.no_coalesce,
         )
     except ValueError as e:
         print(f"serve: {e}", file=sys.stderr)
@@ -226,6 +238,17 @@ def _cmd_status(args) -> int:
         print("  outcomes: " + ", ".join(
             f"{k}={v}" for k, v in sorted(status["outcomes"].items())
         ))
+    disp = sexport._dispatch_snapshot(spool)
+    if disp is not None:
+        wakeups = sum((disp.get("wakeups") or {}).values())
+        fpj = disp.get("fsyncs_per_job")
+        print(
+            f"  dispatch: wire {disp.get('wire')}, "
+            f"{wakeups} wakeup(s), {disp.get('batches', 0)} batch(es) "
+            f"(p50 {disp.get('batch_size_p50')}), "
+            f"{disp.get('coalesced_jobs', 0)} coalesced job(s)"
+            + (f", {fpj:g} fsyncs/job" if fpj is not None else "")
+        )
     if pool is not None:
         counters = pool.get("counters", {})
         print(
@@ -288,6 +311,14 @@ def _cmd_profile(args) -> int:
     else:
         print(cp_profile.format_report(report))
     return 0
+
+
+def _cmd_dispatch(args) -> int:
+    from . import dispatch as _dispatch
+
+    return _dispatch.main(
+        [args.spool] + (["--json"] if args.json else [])
+    )
 
 
 def _cmd_drain(args) -> int:
@@ -802,6 +833,10 @@ def selftest() -> int:  # noqa: C901 — one linear smoke script
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv[:1] == ["dispatch"] and "--selftest" in argv:
+        from . import dispatch as _dispatch
+
+        return _dispatch.selftest()
     if "--selftest" in argv:
         return selftest()
     # everything after a standalone `--` is the job's argv, verbatim —
@@ -896,6 +931,20 @@ def main(argv=None) -> int:
                    help="per-job reclaim cap: a job orphaned more "
                    "than K times ends failed: reclaim_exhausted "
                    "(default %(default)s)")
+    p.add_argument("--fastpath", nargs="?", const="auto",
+                   default=None, metavar="WIRE",
+                   help="event-driven dispatch (serving/dispatch.py): "
+                   "wake wires instead of idle polls, batched lease "
+                   "claims, same-shape job coalescing, group-"
+                   "committed terminal records; WIRE pins the wake "
+                   "wire (inotify|socket|poll-fallback; default: "
+                   "best available)")
+    p.add_argument("--batch", type=int, default=8, metavar="K",
+                   help="with --fastpath: lease up to K jobs per "
+                   "claim batch (default %(default)s)")
+    p.add_argument("--no-coalesce", action="store_true",
+                   help="with --fastpath: never fuse same-shape jobs "
+                   "into one sub-mesh dispatch")
     p.set_defaults(fn=_cmd_serve)
 
     p = sub.add_parser("submit", help="enqueue one job")
@@ -958,6 +1007,15 @@ def main(argv=None) -> int:
     p.add_argument("spool")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=_cmd_profile)
+
+    p = sub.add_parser("dispatch", help="event-driven dispatch "
+                       "counters: active wake wire, wakeups, batch "
+                       "sizes, coalesced jobs, group commits, "
+                       "fsyncs/job (run serve --fastpath first; "
+                       "--selftest exercises the plane device-free)")
+    p.add_argument("spool")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=_cmd_dispatch)
 
     p = sub.add_parser("drain", help="stop admission; optionally wait "
                        "for the queue to empty")

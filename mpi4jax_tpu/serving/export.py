@@ -210,7 +210,19 @@ def serving_snapshot(
         "slo_breaches": slo_breaches,
         "pool": pool_snapshot(spool),
         "cp": _cp_snapshot(spool),
+        "dispatch": _dispatch_snapshot(spool),
     }
+
+
+def _dispatch_snapshot(spool: Spool) -> Optional[Dict[str, Any]]:
+    """The event-driven dispatch counters (``dispatch.json``) when a
+    fastpath server has run against this spool, else None."""
+    try:
+        from . import dispatch as _dispatch
+
+        return _dispatch.load_snapshot(spool.root)
+    except Exception:
+        return None
 
 
 #: cp-report refresh throttle: the serve loop rewrites metrics.prom
@@ -438,6 +450,49 @@ def render_serving_metrics(snap: Dict[str, Any]) -> str:
         c = _export._Family(out, "m4t_pool_poisoned_total", "counter",
                             "Jobs poisoned by the two-strikes rule.")
         c.sample(counters.get("poisoned", 0))
+
+    disp = snap.get("dispatch")
+    if disp:
+        g = _export._Family(out, "m4t_dispatch_wire", "gauge",
+                            "1 for the wake wire the event-driven "
+                            "dispatch plane is running on (inotify, "
+                            "socket, or poll-fallback).")
+        g.sample(1, wire=str(disp.get("wire")))
+        c = _export._Family(out, "m4t_dispatch_wakeups_total",
+                            "counter",
+                            "Wake-wire deliveries that woke the serve "
+                            "loop, by wire.")
+        for wire, n in sorted((disp.get("wakeups") or {}).items()):
+            c.sample(n, wire=wire)
+        c = _export._Family(out, "m4t_dispatch_batches_total",
+                            "counter",
+                            "Claim batches leased by claim_batch.")
+        c.sample(disp.get("batches", 0))
+        g = _export._Family(out, "m4t_dispatch_batch_size", "gauge",
+                            "Jobs per claim batch (quantiles over "
+                            "the server's lifetime).")
+        for q, key in (("0.5", "batch_size_p50"),
+                       ("0.9", "batch_size_p90"),
+                       ("1.0", "batch_size_max")):
+            if disp.get(key) is not None:
+                g.sample(disp[key], quantile=q)
+        c = _export._Family(out, "m4t_dispatch_coalesced_jobs_total",
+                            "counter",
+                            "Jobs that rode a shared sub-mesh "
+                            "dispatch instead of their own.")
+        c.sample(disp.get("coalesced_jobs", 0))
+        c = _export._Family(out, "m4t_dispatch_group_commits_total",
+                            "counter",
+                            "Batched terminal-record flushes (one "
+                            "fsync each).")
+        c.sample(disp.get("group_commits", 0))
+        if disp.get("fsyncs_per_job") is not None:
+            g = _export._Family(out, "m4t_dispatch_fsyncs_per_job",
+                                "gauge",
+                                "Estimated fsyncs per job on the "
+                                "fastpath (submit fsync + amortized "
+                                "group commit).")
+            g.sample(disp["fsyncs_per_job"])
 
     if snap.get("cp"):
         from . import profile as cp_profile

@@ -420,6 +420,28 @@ def worker_loop(
     stop_path = os.path.join(wdir, STOP_SENTINEL)
     current = os.path.join(wdir, "current.json")
 
+    # event-driven dispatch (PR 20): when the serve loop runs its
+    # fastpath it exports M4T_DISPATCH_FASTPATH, and the worker arms a
+    # wake wire on its inbox so the controller's item fan-out lands in
+    # microseconds instead of a poll_s nap. The retained bounded wait
+    # below is the lost-wakeup recovery; unset env means the classic
+    # sleep, byte-for-byte.
+    wake = None
+    _fast = os.environ.get("M4T_DISPATCH_FASTPATH")
+    if _fast:
+        try:
+            from . import dispatch as _dispatch
+
+            wake = _dispatch.open_listener(
+                inbox, advertise_dir=wdir,
+                prefer=_fast if _fast in (
+                    _dispatch.WIRE_INOTIFY, _dispatch.WIRE_SOCKET,
+                    _dispatch.WIRE_POLL,
+                ) else None,
+            )
+        except Exception:
+            wake = None
+
     # the library heartbeat daemon into this worker's sink — the pool
     # doctor's liveness signal. Restarted after every job because a
     # payload may have replaced it (start_heartbeat is idempotent) or
@@ -436,6 +458,11 @@ def worker_loop(
                 "pool", event="worker_stop", worker=rank,
                 incarnation=incarnation, jobs=served, t=time.time(),
             ))
+            if wake is not None:
+                try:
+                    wake.close()
+                except Exception:
+                    pass
             return 0
         prof = _profile.active
         t_poll = prof.t() if prof is not None else 0.0
@@ -446,7 +473,10 @@ def worker_loop(
                 prof.phase(
                     "pool.wakeup", t_poll, worker=rank, useful=False,
                 )
-            time.sleep(poll_s)
+            if wake is not None:
+                wake.wait(poll_s)
+            else:
+                time.sleep(poll_s)
             continue
         try:
             os.replace(os.path.join(inbox, name), current)
@@ -1199,6 +1229,17 @@ class WorkerPool:
                 "pool.deliver", t_deliver, job=job,
                 items=len(workers),
             )
+        if os.environ.get("M4T_DISPATCH_FASTPATH"):
+            # event-driven dispatch: wake each gang member's mailbox
+            # listener — one datagram (or a free inotify event) beats
+            # a poll_s nap of pickup latency. Best-effort: a missed
+            # wake only costs the worker its retained bounded wait.
+            from . import dispatch as _dispatch
+
+            for w in workers:
+                _dispatch.notify(
+                    worker_dir(self.root, w.rank), job=job
+                )
         if self._span_fn is not None:
             # acquire + item fan-out: the warm path's whole dispatch
             # cost — the number the cold path's `spawn` span is

@@ -36,18 +36,22 @@ from the same env var (inherited through spawn) and write to their own
     pool.wakeup          one worker mailbox poll (useful= bool)
     pool.deliver         controller item fan-out for one job
     pool.pickup          mailbox write -> worker claim lag (per item)
+    claim_batch          one batched lease (k= asked, won= leased)
+    wake_latency         submit rename -> wake-wire delivery (wire=)
 
 **Queue-wait decomposition.** Each job's PR 12 ``queued`` span is
 split into named control-plane phases whose boundaries are the cp
 records' wall-clock stamps::
 
     submit_visible   submit() entry -> entry durable in pending/
-    scan_wait        durable -> the winning scheduler pick started
+    wake_latency     durable -> the wake wire woke the serve loop
+                     (zero on the poll path — the wake IS the scan)
+    scan_wait        wake -> the winning scheduler pick started
     sched_pick       the pick decision itself
     claim_rename     pick -> the claim rename landed
     residual         claim -> the server's queued-span boundary clock
 
-The five phases telescope — their sum equals the measured queue span
+The six phases telescope — their sum equals the measured queue span
 exactly (float rounding aside), which :func:`decompose_job` self-checks
 (``ok``) and reports as ``coverage`` (the non-residual share; the
 acceptance bar is >= 90%). The warm pool's post-claim hand-off
@@ -100,12 +104,20 @@ PHASES = frozenset({
     "lease.renew", "scavenge",
     "sched.pick", "loop.scan", "loop.wakeup",
     "pool.wakeup", "pool.deliver", "pool.pickup",
+    # the event-driven dispatch plane (PR 20): one claim_batch record
+    # brackets each batched lease, one wake_latency record stamps a
+    # wake-wire delivery (submit rename -> listener woke; wire= names
+    # the channel)
+    "claim_batch", "wake_latency",
 })
 
-#: the queue-wait decomposition, in lifecycle order
+#: the queue-wait decomposition, in lifecycle order. ``wake_latency``
+#: is zero on the poll path (the wake *is* the scan that found the
+#: job); under an event-driven server it splits the old scan wait into
+#: "the wire delivering" and "the loop getting to the job"
 QUEUE_PHASES = (
-    "submit_visible", "scan_wait", "sched_pick", "claim_rename",
-    "residual",
+    "submit_visible", "wake_latency", "scan_wait", "sched_pick",
+    "claim_rename", "residual",
 )
 
 #: dispatch-side hand-off phases (inside the ``dispatch`` span)
@@ -371,9 +383,24 @@ def decompose_job(
         # no scheduler record (e.g. a bare spool.claim): charge the
         # rename itself and let the wait end at its start
         tp, dp = tc - dc, 0.0
+    # the wake wire's delivery stamp (event-driven servers): the wall
+    # clock when the listener woke for this job, clamped between the
+    # submit-visible boundary and the pick start so the telescoping
+    # identity survives clock jitter; absent (poll path), the wake is
+    # the scan itself and the phase is zero
+    wakes = [r for r in mine if r.get("phase") == "wake_latency"]
+    before_pick = [
+        r for r in wakes if float(r.get("t") or 0.0) <= (tp - dp) + 1e-9
+    ]
+    wake = before_pick[-1] if before_pick else None
+    if wake is not None:
+        tw = min(max(float(wake["t"]), ts), tp - dp)
+    else:
+        tw = ts
     phases = {
         "submit_visible": ts - tq0,
-        "scan_wait": (tp - dp) - ts,
+        "wake_latency": tw - ts,
+        "scan_wait": (tp - dp) - tw,
         "sched_pick": dp,
         "claim_rename": tc - tp,
         "residual": tq1 - tc,
@@ -438,6 +465,7 @@ def narrate_job(decomp: Dict[str, Any]) -> str:
         return f"job {decomp.get('job')}: queue-wait 0 s"
     labels = {
         "submit_visible": "submit visibility",
+        "wake_latency": "wake latency (wire delivery)",
         "scan_wait": "scan wait (poll interval + server busy)",
         "sched_pick": "scheduler pick",
         "claim_rename": "claim rename",

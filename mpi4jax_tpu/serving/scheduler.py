@@ -73,6 +73,60 @@ class FairScheduler:
         self._last_pick[tenant] = self._clock
         return first[tenant]
 
+    def pick_batch(
+        self, pending: List[JobSpec], k: int
+    ) -> List[JobSpec]:
+        """Up to ``k`` jobs in fair pick order — the batch the
+        event-driven loop leases in one :meth:`~.spool.Spool.claim_batch`.
+
+        Pure simulation: repeated single picks are replayed against
+        *copies* of the fairness state, so tenant round-robin holds
+        across the batch boundary (three jobs from tenant ``a`` and
+        one each from ``b``/``c`` batch as ``a, b, c`` — never
+        ``a, a, a``) while the real state stays untouched until
+        :meth:`commit_batch` records the claim *winners*. A federated
+        server that loses part of the batch to a peer therefore burns
+        no tenant's turn for jobs it never dispatched."""
+        if k <= 0 or not pending:
+            return []
+        prof = _profile.active
+        last = dict(self._last_pick)
+        clock = self._clock
+        remaining = list(pending)
+        out: List[JobSpec] = []
+        while remaining and len(out) < k:
+            first: Dict[str, JobSpec] = {}
+            order: Dict[str, int] = {}
+            for i, spec in enumerate(remaining):
+                if spec.tenant not in first:
+                    first[spec.tenant] = spec
+                    order[spec.tenant] = i
+            t0 = prof.t() if prof is not None else 0.0
+            tenant = min(
+                first, key=lambda t: (last.get(t, -1), order[t])
+            )
+            clock += 1
+            last[tenant] = clock
+            spec = first[tenant]
+            if prof is not None:
+                prof.phase(
+                    "sched.pick", t0, picked=spec.id,
+                    depth=len(remaining),
+                )
+            out.append(spec)
+            remaining.remove(spec)
+        return out
+
+    def commit_batch(self, won: List[JobSpec]) -> None:
+        """Fold the claim winners of a :meth:`pick_batch` into the
+        real fairness state, in pick order — exactly the mutations a
+        sequence of single :meth:`pick` calls for those jobs would
+        have made (race losers simply never happened)."""
+        for spec in won:
+            self._clock += 1
+            self._last_pick[spec.tenant] = self._clock
+        self._prev = None
+
     def revert(self) -> None:
         """Undo the most recent :meth:`pick`. A federated server that
         loses the claim race to a peer must not burn the tenant's

@@ -87,6 +87,9 @@ class Server:
         min_ranks: int = 1,
         verify: bool = False,
         poll_s: float = 0.2,
+        fastpath: Optional[str] = None,
+        batch: int = 8,
+        coalesce: bool = True,
         max_jobs: Optional[int] = None,
         idle_exit_s: Optional[float] = None,
         runner: Optional[Runner] = None,
@@ -122,6 +125,20 @@ class Server:
         self.min_ranks = int(min_ranks)
         self.verify = bool(verify)
         self.poll_s = float(poll_s)
+        #: the event-driven dispatch plane (serving/dispatch.py),
+        #: strictly opt-in: None keeps the classic poll loop
+        #: byte-identical; "auto" (or a wire name — "inotify" /
+        #: "socket" / "poll") arms wake wires, batched claims,
+        #: coalescing and group commit
+        self.fastpath = fastpath
+        if int(batch) < 1:
+            raise ValueError("batch must be >= 1")
+        self.batch = int(batch)
+        self.coalesce = bool(coalesce)
+        #: armed inside a fastpath batch: _finish fences now and
+        #: buffers the terminal record for one group-commit fsync
+        self._finish_buffer: Optional[List[Dict[str, Any]]] = None
+        self._dispatch_stats: Optional[Any] = None
         self.max_jobs = max_jobs
         self.idle_exit_s = idle_exit_s
         self.scheduler = FairScheduler()
@@ -458,7 +475,29 @@ class Server:
         reclaimed while we ran it, its story belongs to the claimant
         now, and *nothing* more may be written for it. A spec claimed
         without an owner (single-server harnesses driving
-        :meth:`run_job` directly) takes the unfenced legacy path."""
+        :meth:`run_job` directly) takes the unfenced legacy path.
+
+        Inside a fastpath batch (``_finish_buffer`` armed) the fence
+        still happens *now* — the exactly-once arbiter and the audits
+        it gates stay truthful — but the durable write is buffered for
+        one group-commit fsync (``Spool.finish_batch``) at the end of
+        the batch."""
+        if self._finish_buffer is not None:
+            token = self.spool.fence(
+                spec, outcome, server=spec.owner, epoch=spec.epoch,
+            )
+            if token is None:
+                self._log(
+                    f"job {spec.id}: fenced — claim epoch "
+                    f"{spec.epoch} was superseded; dropping late "
+                    f"'{outcome}' record"
+                )
+                return False
+            self._finish_buffer.append({
+                "spec": spec, "outcome": outcome, "extra": dict(extra),
+                "token": token,
+            })
+            return True
         if spec.owner is None:
             self.spool.finish(spec, outcome, **extra)
             return True
@@ -761,6 +800,12 @@ class Server:
         )
         self._start_metrics()
         self._register()
+        if self.fastpath:
+            try:
+                return self._serve_fastpath()
+            finally:
+                self._deregister()
+                self._stop_metrics()
         if self._pool is not None:
             try:
                 return self._serve_concurrent()
@@ -961,3 +1006,395 @@ class Server:
                 t.join(timeout=10.0)
             self._write_metrics()
         return rc
+
+    # -- the event-driven loop: wake wires, batched claims, coalescing,
+    #    group commit (serving/dispatch.py; opt-in via fastpath=) ------
+
+    def _serve_fastpath(self) -> int:
+        """The serve loop with the poll/fsync/scan tax removed: idle
+        waits block on a wake wire (bounded by ``poll_s`` — the
+        retained poll is the lost-wakeup recovery), the scheduler
+        picks a fair *batch* leased in one ``claim_batch``, same-shape
+        jobs coalesce into one sub-mesh dispatch, and the batch's
+        terminal records flush with one group-commit fsync. The spool
+        stays the durable source of truth throughout; federation,
+        fencing and poison semantics are exactly the classic loop's."""
+        from . import dispatch as _dispatch
+
+        prefer = (
+            None if self.fastpath in (True, "auto", "1")
+            else str(self.fastpath)
+        )
+        listener = _dispatch.open_listener(
+            os.path.join(self.spool.root, "pending"),
+            advertise_dir=self.spool.root,
+            prefer=prefer,
+        )
+        stats = _dispatch.DispatchStats(wire=listener.wire)
+        self._dispatch_stats = stats
+        self.spool.audit(
+            "dispatch_armed", server=self.server_id,
+            wire=listener.wire, batch=self.batch,
+            coalesce=self.coalesce,
+        )
+        self._log(
+            f"event-driven dispatch armed (wire {listener.wire}, "
+            f"batch <= {self.batch}"
+            + (", coalescing" if self.coalesce else "")
+            + ")"
+        )
+        stats.write(self.spool.root)
+        idle_since = time.monotonic()
+        rc = 0
+        try:
+            while True:
+                prof = _profile.active
+                t_iter = prof.t() if prof is not None else 0.0
+                self._federation_tick()
+                if self._pool is not None:
+                    try:
+                        self._pool.check()
+                    except Exception:
+                        pass
+                if (
+                    self.max_jobs is not None
+                    and self.jobs_served >= self.max_jobs
+                ):
+                    self._log(f"served {self.jobs_served} job(s); done")
+                    break
+                t_scan = prof.t() if prof is not None else 0.0
+                pending = self.spool.pending()
+                if prof is not None:
+                    prof.phase(
+                        "loop.scan", t_scan, server=self.server_id,
+                        depth=len(pending),
+                    )
+                k = self.batch
+                if self.max_jobs is not None:
+                    k = min(k, self.max_jobs - self.jobs_served)
+                picked = self.scheduler.pick_batch(pending, k)
+                if picked and self._pool is not None:
+                    head_world = min(
+                        picked[0].nproc, max(self.capacity, 1)
+                    )
+                    if self._pool.idle_count() < head_world:
+                        # head-of-line job does not fit yet: wait for
+                        # a sub-mesh, don't leapfrog it
+                        time.sleep(self.poll_s)
+                        continue
+                if not picked:
+                    if self.spool.draining():
+                        self.spool.audit(
+                            "drained", jobs=self.jobs_served,
+                            world=self.capacity,
+                        )
+                        self._log(
+                            "drained: queue empty after "
+                            f"{self.jobs_served} job(s); exiting"
+                        )
+                        break
+                    if (
+                        self.idle_exit_s is not None
+                        and time.monotonic() - idle_since
+                        > self.idle_exit_s
+                    ):
+                        self._log("idle bound reached; exiting")
+                        break
+                    self._write_metrics()
+                    if prof is not None:
+                        prof.phase(
+                            "loop.wakeup", t_iter,
+                            server=self.server_id, useful=False,
+                        )
+                    events = listener.wait(self.poll_s)
+                    if events:
+                        stats.wakeup(listener.wire, len(events))
+                        if prof is not None:
+                            t_now = _profile.wall()
+                            for ev in events:
+                                sent = ev.get("t")
+                                prof.phase(
+                                    "wake_latency",
+                                    dur_s=(
+                                        max(0.0, t_now - float(sent))
+                                        if sent is not None else 0.0
+                                    ),
+                                    job=ev.get("job"),
+                                    wire=ev.get("wire", listener.wire),
+                                )
+                    continue
+                idle_since = time.monotonic()
+                won = self.spool.claim_batch(
+                    picked, server=self.server_id
+                )
+                self.scheduler.commit_batch(won)
+                if not won:
+                    continue  # peers took the whole batch
+                if prof is not None:
+                    prof.phase(
+                        "loop.wakeup", t_iter, server=self.server_id,
+                        useful=True, batch=len(won),
+                    )
+                stats.batch(len(won))
+                groups = (
+                    _dispatch.coalesce(won) if self.coalesce
+                    else [[w] for w in won]
+                )
+                buffer: List[Dict[str, Any]] = []
+                self._finish_buffer = buffer
+                try:
+                    for group in groups:
+                        stats.group(len(group))
+                        if len(group) == 1:
+                            self.run_job(group[0])
+                        else:
+                            self._run_coalesced(group)
+                        self.jobs_served += len(group)
+                finally:
+                    self._finish_buffer = None
+                stats.group_commit(self.spool.finish_batch(buffer))
+                self._check_slo()
+                self._write_metrics()
+                stats.write(self.spool.root)
+                if self.capacity_lost:
+                    self._log(
+                        "capacity below --min-ranks; cannot keep "
+                        "serving"
+                    )
+                    rc = 1
+                    break
+        except KeyboardInterrupt:
+            self._log("interrupted; exiting")
+            rc = 130
+        finally:
+            self._finish_buffer = None
+            try:
+                listener.close()
+            except Exception:
+                pass
+            stats.write(self.spool.root)
+            self._write_metrics()
+        return rc
+
+    def _run_coalesced(self, group: List[JobSpec]) -> str:
+        """Run one coalesced group: several same-fingerprint jobs
+        (``dispatch.coalesce_key``) fused into a single sub-mesh
+        dispatch, the way continuous-batching servers fuse requests.
+        One world executes — the leader's spec, which is
+        indistinguishable from every member's — while every member
+        keeps its own id, trace, audits, span chain and terminal
+        record. Member spans share boundary clock reads (queued ends,
+        dispatch/run/result start and end on the same stamps), so each
+        member's chain is gapless by construction; the additive
+        ``coalesced``/``batch``/``leader`` fields mark the sharing for
+        readers without changing any pinned schema on the classic
+        path. Poisoned members are refused individually before the
+        shared dispatch; fencing per member keeps every id terminal
+        exactly once."""
+        t0 = time.time()
+        live: List[JobSpec] = []
+        for spec in group:
+            wait_s = max(0.0, t0 - (spec.submitted_t or t0))
+            if self.spool.poisoned(spec.id):
+                self._log(
+                    f"job {spec.id}: refused — poisoned verdict on "
+                    "the spool"
+                )
+                if self._finish(
+                    spec, "failed", reason="poisoned", refused=True,
+                    queue_wait_s=round(wait_s, 6),
+                ):
+                    self.spool.audit(
+                        "failed", job=spec.id, tenant=spec.tenant,
+                        reason="poisoned", refused=True,
+                    )
+                continue
+            live.append(spec)
+        if not live:
+            return "failed"
+        leader = live[0]
+        world = min(leader.nproc, self.capacity)
+        n = len(live)
+        for spec in live:
+            wait_s = max(0.0, t0 - (spec.submitted_t or t0))
+            self.spool.audit(
+                "admitted", job=spec.id, tenant=spec.tenant,
+                world=world, requested_nproc=spec.nproc,
+                queue_wait_s=round(wait_s, 6), trace=spec.trace,
+                coalesced=True, batch=n, leader=leader.id,
+            )
+            self._job_span(
+                spec, "queued", (spec.submitted_t or t0), t0,
+                depth_wait_s=round(wait_s, 6), coalesced=True,
+            )
+        t_gate = t0
+        if self.verify:
+            # per-job verify opts a spec out of coalescing entirely
+            # (coalesce_key), so only the server-wide gate runs here —
+            # once, for the shared shape
+            verified = self._verify_fn(leader, world)
+            t_gate = time.time()
+            for spec in live:
+                self._job_span(
+                    spec, "verify", t0, t_gate, world=world,
+                    passed=verified, coalesced=True,
+                )
+            if not verified:
+                for spec in live:
+                    wait_s = max(0.0, t0 - (spec.submitted_t or t0))
+                    if self._finish(
+                        spec, "rejected", reason="verify_failed",
+                        world=world, queue_wait_s=wait_s,
+                    ):
+                        self.spool.audit(
+                            "rejected", job=spec.id,
+                            tenant=spec.tenant,
+                            reason="verify_failed", world=world,
+                        )
+                return "rejected"
+
+        jobdir = self.spool.job_dir(leader.id)
+        state: Dict[str, Any] = {
+            "world": world, "world_ran": world, "preempted": [],
+            "transition": None, "blocked": None, "dir": None,
+        }
+
+        def run_fn(attempt: int, resume_step: Optional[int]) -> int:
+            if state["blocked"]:
+                self._log(
+                    f"job {leader.id}: attempt {attempt} not "
+                    f"spawned: {state['blocked']}"
+                )
+                return 1
+            d = os.path.join(jobdir, f"attempt{attempt:02d}")
+            os.makedirs(d, exist_ok=True)
+            state["dir"] = d
+            state["world_ran"] = state["world"]
+            self._log(
+                f"job {leader.id}: attempt {attempt} "
+                f"(world {state['world']}, coalesced x{n})"
+            )
+            rc, preempted = self._runner(
+                leader, state["world"], d, attempt, resume_step
+            )
+            state["preempted"] = list(preempted or [])
+            return rc
+
+        def diagnose_fn(attempt: int):
+            d = state.get("dir")
+            if not d:
+                return None
+            try:
+                from ..observability import doctor
+
+                return doctor.diagnose([d])
+            except Exception:
+                return None
+
+        def resume_fn():
+            # coalescible specs carry no resume_dir by definition;
+            # only the elastic shrink path can move the next attempt
+            try:
+                if self.elastic and state["preempted"]:
+                    return self._shrink_for(leader, state)
+            except Exception as exc:
+                self._log(
+                    f"job {leader.id}: elastic shrink failed: {exc!r}"
+                )
+            return None
+
+        def extra_fn(attempt: int) -> Dict[str, Any]:
+            rec: Dict[str, Any] = {
+                "job": leader.id, "tenant": leader.tenant,
+                "world": state["world_ran"], "coalesced": True,
+                "batch": n,
+            }
+            if state["preempted"]:
+                rec["preempted_ranks"] = list(state["preempted"])
+            if state["blocked"]:
+                rec["elastic_blocked"] = state["blocked"]
+            return rec
+
+        def abort_fn(attempt: int) -> Optional[str]:
+            if (
+                self._pool is not None
+                and self._pool.poisoned(leader.id)
+            ):
+                return "poisoned"
+            if self.spool.poisoned(leader.id):
+                return "poisoned"
+            return None
+
+        sup = Supervisor(
+            run_fn,
+            policy=RetryPolicy(
+                retries=leader.retries, backoff_s=leader.backoff_s
+            ),
+            diagnose_fn=diagnose_fn,
+            resume_fn=resume_fn,
+            extra_fn=extra_fn,
+            abort_fn=abort_fn,
+            span_fn=lambda name, s0, s1, **f: self._job_span(
+                leader, name, s0, s1, **f
+            ),
+            audit_path=self.spool.audit_path,
+            log=self._log,
+        )
+        t_run = time.time()
+        for spec in live:
+            self._job_span(
+                spec, "dispatch", t_gate, t_run, world=world,
+                coalesced=True, batch=n, leader=leader.id,
+            )
+        rc = sup.run(None)
+        t_run_end = time.time()
+        for spec in live:
+            self._job_span(
+                spec, "run", t_run, t_run_end,
+                attempts=len(sup.attempts), exit_code=rc,
+                world=state["world_ran"], coalesced=True,
+            )
+        run_s = time.time() - t0
+        last = sup.attempts[-1] if sup.attempts else {}
+        t_result = time.time()
+        outcome = "completed" if rc == 0 else "failed"
+        for spec in live:
+            wait_s = max(0.0, t0 - (spec.submitted_t or t0))
+            common = dict(
+                world=state["world_ran"], attempts=len(sup.attempts),
+                queue_wait_s=round(wait_s, 6), run_s=round(run_s, 6),
+                coalesced=True, batch=n, leader=leader.id,
+            )
+            if rc == 0:
+                if not self._finish(spec, "completed", **common):
+                    continue  # fenced: this member's story moved on
+                self.spool.audit(
+                    "completed", job=spec.id, tenant=spec.tenant,
+                    **common,
+                )
+                self._job_span(
+                    spec, "result", t_run_end, t_result,
+                    outcome="completed", coalesced=True,
+                )
+                continue
+            if self.spool.poisoned(leader.id):
+                reason = "poisoned"
+            else:
+                reason = state["blocked"] or last.get(
+                    "reason", "exit_nonzero"
+                )
+            if not self._finish(
+                spec, "failed", exit_code=rc, klass=last.get("klass"),
+                reason=reason, **common,
+            ):
+                continue
+            self.spool.audit(
+                "failed", job=spec.id, tenant=spec.tenant,
+                exit_code=rc, klass=last.get("klass"), reason=reason,
+                **common,
+            )
+            self._job_span(
+                spec, "result", t_run_end, t_result,
+                outcome="failed", reason=reason, coalesced=True,
+            )
+        return outcome

@@ -247,7 +247,7 @@ def job_breakdown(
         "result": _span_dur(spans, "result"),
     }
     total = sum(stages.values())
-    return {
+    out = {
         "job": job_id,
         "trace": trace,
         "stages": {k: round(v, 9) for k, v in stages.items()},
@@ -256,6 +256,12 @@ def job_breakdown(
         "sampled": comm_s > 0.0,
         "ranks": sorted(by_rank),
     }
+    if any(s.get("coalesced") for s in spans):
+        # additive: this job's run/dispatch time was shared with its
+        # coalesced batch (serving/dispatch.py), so per-stage seconds
+        # attribute the shared world, not an exclusive one
+        out["coalesced"] = True
+    return out
 
 
 def dominant_stage(breakdown: Dict[str, Any]) -> Tuple[str, float]:

@@ -64,7 +64,7 @@ import re
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from . import profile as _profile
 
@@ -78,6 +78,10 @@ JOBS_DIR = "jobs"
 SERVERS_DIR = "servers"
 VERDICTS_DIR = "verdicts"
 AUDIT_NAME = "serving.jsonl"
+#: the group-commit journal: a batch of terminal records becomes
+#: durable here with one fsync before the per-job done/ files are
+#: materialized (Spool.finish_batch)
+COMMIT_NAME = "commit.jsonl"
 CONFIG_NAME = "spool.json"
 DRAIN_SENTINEL = "DRAIN"
 
@@ -395,6 +399,32 @@ class Spool:
         except OSError:
             pass
 
+    def audit_many(
+        self, records: List[Tuple[str, Dict[str, Any]]]
+    ) -> None:
+        """Append a batch of serving audit records in one lock
+        acquisition and one file open — the group-commit shape for the
+        event-driven loop's per-batch bookkeeping. Each entry is
+        ``(event, fields)``; schema and best-effort contract are
+        exactly :meth:`audit`'s."""
+        if not records:
+            return
+        from ..observability import events
+
+        try:
+            lines = []
+            for event, fields in records:
+                rec = events.event(
+                    "serving", event=event, t=time.time(), **fields
+                )
+                rec.setdefault("ts", events.utc_stamp())
+                lines.append(json.dumps(rec, default=str))
+            with self._audit_lock:
+                with open(self.audit_path, "a") as f:
+                    f.write("\n".join(lines) + "\n")
+        except OSError:
+            pass
+
     def audit_records(self) -> List[Dict[str, Any]]:
         from ..observability import events
 
@@ -609,6 +639,13 @@ class Spool:
         os.replace(tmp, final)
         if prof is not None:
             prof.phase("submit.rename", t0, job=spec.id)
+        # wake whoever listens on this spool's wire — strictly after
+        # the rename (the event must never precede the durable fact),
+        # strictly best-effort (one failed stat when nobody listens;
+        # an event-driven server's retained poll recovers any loss)
+        from . import dispatch as _dispatch
+
+        _dispatch.notify(self.root, job=spec.id)
         self.audit(
             "submitted", job=spec.id, tenant=spec.tenant,
             nproc=spec.nproc, depth=depth + 1, trace=spec.trace,
@@ -724,6 +761,43 @@ class Spool:
             )
         return spec
 
+    def claim_batch(
+        self,
+        specs: Any,
+        *,
+        server: Optional[str] = None,
+    ) -> List[JobSpec]:
+        """Lease up to K jobs in one batch under the same owner/epoch
+        fencing as :meth:`claim`. ``specs`` is the scheduler-picked
+        batch (``FairScheduler.pick_batch`` keeps tenant round-robin
+        fairness across the batch boundary) or an int K, which leases
+        the first K pending jobs FIFO.
+
+        Each lease is still its own atomic pending->running rename —
+        the exactly-once arbiter is unchanged, so racing servers
+        partition a batch instead of duplicating it; entries lost to a
+        peer are skipped. Returns the claimed specs in pick order.
+        Armed, the whole batch is bracketed by one ``claim_batch``
+        cp record (``k=``/``won=``) while the per-job ``claim`` /
+        ``claim.lost`` records keep the rename accounting and the
+        queue-wait decomposition exact."""
+        if isinstance(specs, int):
+            specs = self.pending()[: max(0, specs)]
+        specs = list(specs)
+        prof = _profile.active
+        t0 = prof.t() if prof is not None else 0.0
+        won: List[JobSpec] = []
+        for spec in specs:
+            got = self.claim(spec, server=server)
+            if got is not None:
+                won.append(got)
+        if prof is not None:
+            prof.phase(
+                "claim_batch", t0, k=len(specs), won=len(won),
+                server=server,
+            )
+        return won
+
     @staticmethod
     def _entry_base(entry: str) -> str:
         """The pending/done filename for a (possibly owned) entry."""
@@ -827,6 +901,149 @@ class Spool:
         if prof is not None:
             prof.phase("finish", t_fin, job=spec.id, outcome=outcome)
         return True
+
+    # -- group commit (the event-driven finish path) -------------------
+
+    def fence(
+        self,
+        spec: JobSpec,
+        outcome: str,
+        *,
+        server: Optional[str] = None,
+        epoch: Optional[int] = None,
+    ) -> Optional[str]:
+        """Atomically take ``spec``'s claim instance ahead of a
+        buffered group commit — the same exactly-once arbiter
+        :meth:`finish` runs first, split out so the event-driven loop
+        can fence *now* (audits and spans stay truthful) and flush the
+        terminal records *later* in one :meth:`finish_batch` fsync.
+
+        Returns the private tombstone path to hand to
+        :meth:`finish_batch` (empty string for an unowned,
+        single-server claim — there is nothing to take), or None when
+        this claim epoch was superseded: the ``fenced`` audit record
+        lands immediately and the caller must write nothing more for
+        the job. A crash between a successful fence and the flush
+        leaves the tombstone for :meth:`reclaim`'s interrupted-
+        transition sweep — the job is requeued and still ends terminal
+        exactly once."""
+        if server is None:
+            return ""
+        if epoch is None:
+            epoch = (
+                spec.epoch if spec.epoch is not None
+                else int(spec.reclaims) + 1
+            )
+        base = self._entry_base(spec.entry) if spec.entry else spec.entry
+        running = os.path.join(
+            self._dir(RUNNING_DIR), f"{base}@{server}@{epoch}"
+        )
+        token = os.path.join(
+            self.job_dir(spec.id), f".terminal@{server}@{epoch}"
+        )
+        prof = _profile.active
+        t0 = prof.t() if prof is not None else 0.0
+        try:
+            os.replace(running, token)
+        except OSError:
+            self.audit(
+                "fenced", job=spec.id, tenant=spec.tenant,
+                server=server, epoch=int(epoch),
+                outcome_rejected=outcome,
+                holder=self._running_holder(spec.id),
+            )
+            return None
+        if prof is not None:
+            prof.phase("finish.fence", t0, job=spec.id, server=server)
+        return token
+
+    def finish_batch(
+        self, items: List[Dict[str, Any]],
+    ) -> int:
+        """Group commit: flush a batch of already-fenced terminal
+        records with **one** fsync. Each item is
+        ``{"spec", "outcome", "extra", "token"}`` where ``token`` came
+        from :meth:`fence` ('""' for unowned claims).
+
+        Durability order: (1) every record is appended to
+        ``commit.jsonl`` and fsynced once — the commit point; (2) each
+        ``done/`` record is then materialized tmp+rename *without* a
+        per-file fsync (its bytes are already durable in the journal,
+        and the rename is atomic so scanners never see a torn record);
+        (3) tombstones / running entries are cleared. A process killed
+        anywhere in between loses nothing: fenced-but-unflushed jobs
+        are requeued by the interrupted-transition sweep and re-run to
+        their single terminal record. Returns the number of records
+        landed."""
+        if not items:
+            return 0
+        prof = _profile.active
+        now = time.time()
+        batch: List[Dict[str, Any]] = []
+        for item in items:
+            spec = item["spec"]
+            record = dict(spec.to_json())
+            record.update(
+                outcome=item["outcome"], finished_t=now,
+                **(item.get("extra") or {}),
+            )
+            batch.append(record)
+        # (1) the commit point: one append, one fsync for the batch
+        journal_ok = True
+        t0 = prof.t() if prof is not None else 0.0
+        try:
+            with open(os.path.join(self.root, COMMIT_NAME), "a") as f:
+                for record in batch:
+                    f.write(json.dumps(record, default=str) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError:
+            journal_ok = False
+        if prof is not None and journal_ok:
+            prof.phase("finish.fsync", t0, n=1, jobs=len(batch))
+        landed = 0
+        for item, record in zip(items, batch):
+            spec = item["spec"]
+            base = (
+                self._entry_base(spec.entry) if spec.entry
+                else spec.entry
+            )
+            final = os.path.join(self._dir(DONE_DIR), base)
+            tmp = os.path.join(self._dir(DONE_DIR), f".tmp-{base}")
+            t0 = prof.t() if prof is not None else 0.0
+            try:
+                with open(tmp, "w") as f:
+                    json.dump(record, f, indent=1, default=str)
+                    if not journal_ok:
+                        # no journal to lean on: fall back to the
+                        # per-record durability finish() provides
+                        f.flush()
+                        os.fsync(f.fileno())
+                        if prof is not None:
+                            prof.phase("finish.fsync", t0, job=spec.id)
+                            t0 = prof.t()
+                os.replace(tmp, final)
+            except OSError:
+                continue
+            if prof is not None:
+                prof.phase("finish.rename", t0, job=spec.id)
+            token = item.get("token")
+            try:
+                if token:
+                    os.unlink(token)
+                elif spec.entry:
+                    os.unlink(
+                        os.path.join(self._dir(RUNNING_DIR), spec.entry)
+                    )
+            except OSError:
+                pass
+            landed += 1
+            if prof is not None:
+                prof.phase(
+                    "finish", dur_s=0.0, job=spec.id,
+                    outcome=item["outcome"], batched=True,
+                )
+        return landed
 
     # -- server registry / leases -------------------------------------
 

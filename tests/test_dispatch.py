@@ -1,0 +1,709 @@
+"""Event-driven dispatch plane (PR 20): serving/dispatch.py.
+
+Covers the ISSUE-20 acceptance surface:
+
+- wake wires: selection/fallback matrix (inotify -> socket -> poll),
+  socket round-trip with advertisement retraction, inotify
+  rename-is-the-event, and lost-wakeup recovery via the retained
+  bounded poll;
+- batched lease claims: racing servers partition a batch exactly once
+  (no job lost, none duplicated), and ``FairScheduler.pick_batch`` /
+  ``commit_batch`` keep tenant round-robin across the batch boundary
+  (with ``k=1`` exactly matching single ``pick``);
+- job coalescing: fingerprint grouping (per-job state opts out),
+  and a coalesced fastpath drain where every member keeps its own id,
+  audits, gapless span chain, and terminal record while the runner
+  executes fewer worlds than jobs;
+- group commit: fsyncs-per-job < 2.0 at load (armed cp accounting and
+  the dispatch snapshot agree), and a SIGKILL between fence and flush
+  loses nothing — the interrupted-transition sweep requeues and the
+  job still ends terminal exactly once;
+- queue-wait decomposition: ``wake_latency`` joins the telescoping
+  identity at >= 90% coverage on an armed fastpath drain;
+- surfaces: ``dispatch --selftest`` + snapshot CLI, ``serve
+  --fastpath`` round-trip with the ``status`` wire line,
+  ``m4t_dispatch_*`` OpenMetrics families;
+- chaos e2e (slow, ``-m 'dispatch and chaos'``): the PR 14 SIGKILL
+  failover rerun with both servers on ``--fastpath`` — zero lost or
+  duplicate ids.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from mpi4jax_tpu.observability import spans as ospans
+from mpi4jax_tpu.serving import dispatch
+from mpi4jax_tpu.serving import export as sexport
+from mpi4jax_tpu.serving import profile
+from mpi4jax_tpu.serving.scheduler import FairScheduler
+from mpi4jax_tpu.serving.server import Server
+from mpi4jax_tpu.serving.spool import Spool, parse_job
+
+pytestmark = [pytest.mark.dispatch, pytest.mark.serving]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cli_env():
+    env = dict(os.environ)
+    env.setdefault("MPI4JAX_TPU_SKIP_VERSION_CHECK", "1")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return env
+
+
+def _cli(*argv, timeout=300):
+    return subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.serving", *argv],
+        capture_output=True, text=True, cwd=REPO, timeout=timeout,
+        env=_cli_env(),
+    )
+
+
+def _submit_mix(spool, jobs, tenants=2, cmd=("-c", "pass")):
+    for i in range(jobs):
+        r = spool.submit({
+            "id": f"j{i}", "tenant": f"t{i % tenants}",
+            "cmd": list(cmd),
+        })
+        assert r["status"] == "queued", r
+
+
+def _fast_drain(root, jobs=6, tenants=2, runner=None, **kw):
+    """Submit + serve a stub mix through the event-driven loop."""
+    spool = Spool(root)
+    spool.configure(max(16, jobs))
+    _submit_mix(spool, jobs, tenants)
+    calls = []
+
+    def default_runner(spec, world, events_dir, attempt, resume_step):
+        calls.append(spec.id)
+        return 0, []
+
+    kw.setdefault("fastpath", "socket")
+    server = Server(
+        spool, nproc=1, max_jobs=jobs, poll_s=0.01,
+        runner=runner or default_runner, log=lambda msg: None, **kw,
+    )
+    assert server.serve() == 0
+    return spool, calls
+
+
+@pytest.fixture
+def armed(tmp_path, monkeypatch):
+    monkeypatch.setenv(profile.ENV_VAR, "1")
+    monkeypatch.setattr(sexport, "CP_SNAPSHOT_TTL_S", 0.0)
+    profile.disarm()
+    yield str(tmp_path / "spool")
+    profile.disarm()
+
+
+# ---------------------------------------------------------------------
+# wake wires
+# ---------------------------------------------------------------------
+
+
+def test_wire_selection_and_fallback(tmp_path, monkeypatch):
+    watch = str(tmp_path / "pending")
+    # explicit poll: no events, bounded wait
+    lst = dispatch.open_listener(watch, prefer="poll")
+    assert lst.wire == dispatch.WIRE_POLL
+    t0 = time.monotonic()
+    assert lst.wait(0.01) == []
+    assert time.monotonic() - t0 < 1.0
+    lst.close()
+    # explicit socket
+    with dispatch.open_listener(watch, prefer="socket") as lst:
+        assert lst.wire == dispatch.WIRE_SOCKET
+    # inotify requested on a host without it: falls through the chain,
+    # never raises
+    monkeypatch.setattr(dispatch, "inotify_available", lambda: False)
+    with dispatch.open_listener(watch, prefer="inotify") as lst:
+        assert lst.wire == dispatch.WIRE_SOCKET
+    # the default order picks the best available wire
+    with dispatch.open_listener(watch) as lst:
+        assert lst.wire in (dispatch.WIRE_SOCKET, dispatch.WIRE_POLL)
+
+
+def test_socket_wire_round_trip(tmp_path):
+    root = str(tmp_path)
+    watch = os.path.join(root, "pending")
+    lst = dispatch.open_listener(watch, advertise_dir=root,
+                                 prefer="socket")
+    try:
+        ad = os.path.join(root, dispatch.WAKE_NAME)
+        assert os.path.exists(ad)
+        with open(ad) as f:
+            rec = json.load(f)
+        assert rec["port"] == lst.port
+        t_sent = time.time()
+        assert dispatch.notify(root, job="jx") is True
+        (ev,) = lst.wait(5.0)
+        assert ev["job"] == "jx" and ev["wire"] == dispatch.WIRE_SOCKET
+        # the datagram carries the submit stamp: wake latency is
+        # attributable at the listener
+        assert abs(float(ev["t"]) - t_sent) < 5.0
+    finally:
+        lst.close()
+    # close retracts the advertisement; notify degrades to a no-op
+    assert not os.path.exists(os.path.join(root, dispatch.WAKE_NAME))
+    assert dispatch.notify(root, job="jy") is False
+
+
+@pytest.mark.skipif(not dispatch.inotify_available(),
+                    reason="inotify unavailable on this host")
+def test_inotify_wire_rename_is_the_event(tmp_path):
+    watch = str(tmp_path / "pending")
+    with dispatch.open_listener(watch, prefer="inotify") as lst:
+        assert lst.wire == dispatch.WIRE_INOTIFY
+        stamp = time.time_ns()
+        name = f"{stamp:020d}-jz.json"
+        tmp = os.path.join(watch, f".tmp-{name}")
+        with open(tmp, "w") as f:
+            f.write("{}")
+        os.replace(tmp, os.path.join(watch, name))
+        evs = lst.wait(5.0)
+        (ev,) = [e for e in evs if e.get("job") == "jz"]
+        # the entry-name time_ns prefix is recovered as the wake stamp
+        assert ev["t"] == pytest.approx(stamp / 1e9)
+        # the tmp write itself was filtered, not reported
+        assert not any(
+            e.get("name", "").startswith(".tmp-") for e in evs
+        )
+
+
+def test_lost_wakeup_recovery(tmp_path, monkeypatch):
+    """Every datagram dropped: the retained bounded poll still finds
+    the work within a poll interval — wake delivery is advisory,
+    never correctness."""
+    monkeypatch.setattr(dispatch, "notify",
+                        lambda root, job=None: False)
+    spool = Spool(str(tmp_path / "sp"))
+    server = Server(
+        spool, nproc=1, max_jobs=1, poll_s=0.02, fastpath="socket",
+        runner=lambda *a: (0, []), log=lambda msg: None,
+    )
+    t = threading.Thread(target=server.serve)
+    t.start()
+    try:
+        time.sleep(0.1)  # the loop is idle-waiting on the wire
+        assert spool.submit({"id": "lost", "cmd": ["-c", "pass"]})[
+            "status"] == "queued"
+        t.join(30)
+        assert not t.is_alive()
+    finally:
+        t.join(5)
+    (rec,) = spool.done()
+    assert rec["id"] == "lost" and rec["outcome"] == "completed"
+
+
+def test_submit_notifies_the_serve_loop(tmp_path):
+    """The wake path end to end: a submit's datagram lands on the
+    spool listener without any server in the loop."""
+    spool = Spool(str(tmp_path / "sp"))
+    lst = dispatch.open_listener(
+        os.path.join(spool.root, "pending"),
+        advertise_dir=spool.root, prefer="socket",
+    )
+    try:
+        assert spool.submit({"id": "w0", "cmd": ["-c", "pass"]})[
+            "status"] == "queued"
+        evs = lst.wait(5.0)
+        assert any(e.get("job") == "w0" for e in evs), evs
+    finally:
+        lst.close()
+
+
+# ---------------------------------------------------------------------
+# batched lease claims
+# ---------------------------------------------------------------------
+
+
+def test_claim_batch_exactly_once_under_racing_servers(tmp_path):
+    spool = Spool(str(tmp_path / "sp"))
+    spool.configure(64)
+    for i in range(12):
+        assert spool.submit({"id": f"b{i}", "cmd": ["-c", "pass"]})[
+            "status"] == "queued"
+    wins = {}
+    barrier = threading.Barrier(4)
+
+    def racer(sid):
+        mine = spool.pending()
+        barrier.wait()
+        wins[sid] = [s.id for s in spool.claim_batch(mine, server=sid)]
+
+    threads = [threading.Thread(target=racer, args=(f"s{i}",))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    claimed = [j for ids in wins.values() for j in ids]
+    # partitioned: every id leased exactly once across the fleet
+    assert sorted(claimed) == sorted(f"b{i}" for i in range(12)), wins
+    assert spool.pending() == []
+    # every winner knows its owner and epoch (the PR 14 fence inputs)
+    for sid, ids in wins.items():
+        for rec in spool.audit_records():
+            if rec["event"] == "claimed" and rec["job"] in ids:
+                assert rec["server"] in wins
+
+
+def test_claim_batch_int_form_is_fifo(tmp_path):
+    spool = Spool(str(tmp_path / "sp"))
+    for i in range(5):
+        assert spool.submit({"id": f"k{i}", "cmd": ["-c", "pass"]})[
+            "status"] == "queued"
+    won = spool.claim_batch(3, server="s1")
+    assert [s.id for s in won] == ["k0", "k1", "k2"]
+    assert [s.id for s in spool.pending()] == ["k3", "k4"]
+
+
+def test_pick_batch_fairness_across_the_boundary(tmp_path):
+    sched = FairScheduler()
+    mix = [parse_job({"id": f"f{i}", "tenant": t,
+                      "cmd": ["-c", "pass"]})
+           for i, t in enumerate(["a", "a", "a", "b", "c"])]
+    picked = sched.pick_batch(mix, 3)
+    # round-robin across tenants inside the batch, not 3x tenant a
+    assert [s.id for s in picked] == ["f0", "f3", "f4"]
+    sched.commit_batch(picked)
+    rest = [s for s in mix if s not in picked]
+    assert [s.id for s in sched.pick_batch(rest, 3)] == ["f1", "f2"]
+
+
+def test_pick_batch_k1_matches_single_pick():
+    mix = [parse_job({"id": f"p{i}", "tenant": t,
+                      "cmd": ["-c", "pass"]})
+           for i, t in enumerate(["a", "b", "a", "c", "b", "a"])]
+    one, batch = FairScheduler(), FairScheduler()
+    singles, batched = [], []
+    p1, p2 = list(mix), list(mix)
+    while p1:
+        s = one.pick(p1)
+        singles.append(s.id)
+        p1.remove(s)
+        (t,) = batch.pick_batch(p2, 1)
+        batch.commit_batch([t])
+        batched.append(t.id)
+        p2.remove(t)
+    assert batched == singles
+
+
+def test_pick_batch_losers_burn_no_turn():
+    """A server that loses part of its picked batch to a peer commits
+    only the winners: the losing tenants' turns are intact."""
+    sched = FairScheduler()
+    mix = [parse_job({"id": f"r{i}", "tenant": t,
+                      "cmd": ["-c", "pass"]})
+           for i, t in enumerate(["a", "b"])]
+    picked = sched.pick_batch(mix, 2)
+    assert [s.id for s in picked] == ["r0", "r1"]
+    # the peer took r1: only r0 committed — tenant b never served
+    sched.commit_batch([picked[0]])
+    nxt = sched.pick_batch([mix[1]], 1)
+    assert [s.id for s in nxt] == ["r1"]
+
+
+# ---------------------------------------------------------------------
+# job coalescing
+# ---------------------------------------------------------------------
+
+
+def test_coalesce_groups_by_execution_fingerprint():
+    same = [parse_job({"id": f"c{i}", "cmd": ["-c", "pass"]})
+            for i in range(3)]
+    odd = parse_job({"id": "odd", "cmd": ["-c", "print(1)"]})
+    wide = parse_job({"id": "wide", "cmd": ["-c", "pass"], "nproc": 2})
+    groups = dispatch.coalesce([same[0], odd, same[1], wide, same[2]])
+    assert [[s.id for s in g] for g in groups] == [
+        ["c0", "c1", "c2"], ["odd"], ["wide"],
+    ]
+
+
+def test_per_job_state_never_coalesces(tmp_path):
+    base = {"cmd": ["-c", "pass"]}
+    resumes = parse_job(dict(base, id="r",
+                             resume_dir=str(tmp_path / "ck")))
+    faulty = parse_job(dict(base, id="f", fault_plan={"faults": [
+        {"rank": 0, "op": "AllReduce", "nth": 1, "action": "wedge"},
+    ]}))
+    gated = parse_job(dict(base, id="v", verify=True))
+    plain = parse_job(dict(base, id="p"))
+    for spec in (resumes, faulty, gated):
+        assert dispatch.coalesce_key(spec) is None
+    assert dispatch.coalesce_key(plain) is not None
+    groups = dispatch.coalesce([resumes, plain, faulty, gated])
+    assert [[s.id for s in g] for g in groups] == [
+        ["r"], ["p"], ["f"], ["v"],
+    ]
+
+
+def test_coalesced_drain_keeps_per_job_accounting(tmp_path):
+    """Six same-shape jobs + the shared fastpath loop: fewer worlds
+    than jobs execute, yet every id keeps its own terminal record,
+    audits, and a gapless span chain."""
+    spool, calls = _fast_drain(str(tmp_path / "sp"), jobs=6, batch=6)
+    assert 0 < len(calls) < 6, calls
+    done = {r["id"]: r for r in spool.done()}
+    assert sorted(done) == [f"j{i}" for i in range(6)]
+    assert all(r["outcome"] == "completed" for r in done.values())
+    # one terminal audit per id, exactly once
+    for i in range(6):
+        terms = [r for r in spool.audit_records()
+                 if r["event"] in ("completed", "failed", "rejected")
+                 and r.get("job") == f"j{i}"]
+        assert len(terms) == 1, (i, terms)
+    # gapless chains for every member (the boundary reads are shared)
+    verdicts = ospans.verify_chains(
+        spool.span_records(), jobs=[f"j{i}" for i in range(6)],
+    )
+    for job, v in verdicts.items():
+        assert v["complete"], (job, v)
+    # members that shared a dispatch say so (additive fields)
+    coalesced = [s for s in spool.span_records()
+                 if s.get("coalesced") and s.get("span") == "dispatch"]
+    assert coalesced
+    assert all(s.get("leader") for s in coalesced)
+
+
+def test_no_coalesce_runs_every_job_alone(tmp_path):
+    spool, calls = _fast_drain(
+        str(tmp_path / "sp"), jobs=4, coalesce=False, batch=4,
+    )
+    assert len(calls) == 4
+    snap = dispatch.load_snapshot(spool.root)
+    assert snap["coalesced_jobs"] == 0
+
+
+def test_coalesced_failure_fails_every_member(tmp_path):
+    spool, _ = _fast_drain(
+        str(tmp_path / "sp"), jobs=3, batch=3, tenants=1,
+        runner=lambda *a: (1, []),
+    )
+    done = {r["id"]: r for r in spool.done()}
+    assert sorted(done) == ["j0", "j1", "j2"]
+    assert all(r["outcome"] == "failed" for r in done.values())
+    for rec in done.values():
+        assert rec["exit_code"] == 1
+
+
+# ---------------------------------------------------------------------
+# group commit
+# ---------------------------------------------------------------------
+
+
+def test_group_commit_fsyncs_per_job_below_two(armed):
+    spool, _ = _fast_drain(armed, jobs=8, batch=8)
+    recs = profile.load_cp(spool.root)
+    fsyncs = sum(
+        int(r.get("n", 1)) for r in recs
+        if r.get("phase") in ("submit.fsync", "finish.fsync")
+    )
+    jobs = len(spool.done())
+    assert jobs == 8
+    assert fsyncs / jobs < 2.0, (fsyncs, jobs)
+    # the batch flushed through the journal: one commit point, and
+    # every record is in it
+    commits = [r for r in recs if r.get("phase") == "finish.fsync"]
+    assert sum(int(c.get("jobs", 0)) for c in commits) == 8
+    with open(os.path.join(spool.root, "commit.jsonl")) as f:
+        journal = [json.loads(line) for line in f if line.strip()]
+    assert sorted(r["id"] for r in journal) == sorted(
+        r["id"] for r in spool.done()
+    )
+    snap = dispatch.load_snapshot(spool.root)
+    assert snap["fsyncs_per_job"] is not None
+    assert snap["fsyncs_per_job"] < 2.0
+
+
+def test_group_commit_sigkill_between_fence_and_flush(tmp_path):
+    """The crash window group commit opens: a server dies after the
+    atomic fence but before the journal flush. The tombstone survives,
+    the sweep requeues, a healthy server re-runs — one terminal record,
+    exactly once."""
+    sp = str(tmp_path / "sp")
+    spool = Spool(sp)
+    assert spool.submit({"id": "gc", "cmd": ["-c", "pass"]})[
+        "status"] == "queued"
+    script = textwrap.dedent(f"""
+        import os, signal, sys
+        sys.path.insert(0, {REPO!r})
+        from mpi4jax_tpu.serving.spool import Spool
+        spool = Spool({sp!r})
+        (spec,) = spool.pending()
+        got = spool.claim(spec, server="crash-s1")
+        assert got is not None
+        token = spool.fence(got, "completed", server="crash-s1")
+        assert token and os.path.exists(token)
+        os.kill(os.getpid(), signal.SIGKILL)  # dies holding the take
+    """)
+    proc = subprocess.run(
+        [sys.executable, "-c", script], env=_cli_env(), timeout=120,
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+    # neither terminal nor pending — the fenced-but-unflushed state
+    assert spool.done() == [] and spool.pending() == []
+    # the scavenger resolves the interrupted transition
+    actions = spool.reclaim(by="sweeper")
+    assert any(a.get("reason") == "interrupted_transition"
+               and a.get("action") == "requeued" for a in actions), (
+        actions
+    )
+    (spec,) = spool.pending()
+    assert spec.id == "gc" and spec.reclaims == 1
+    # a healthy fastpath server completes it — terminal exactly once
+    server = Server(
+        spool, nproc=1, max_jobs=1, poll_s=0.01, fastpath="socket",
+        server_id="s2", runner=lambda *a: (0, []),
+        log=lambda msg: None,
+    )
+    assert server.serve() == 0
+    (rec,) = spool.done()
+    assert rec["id"] == "gc" and rec["outcome"] == "completed"
+    terms = [r for r in spool.audit_records()
+             if r["event"] in ("completed", "failed", "rejected")]
+    assert len(terms) == 1
+
+
+def test_buffered_fence_rejects_zombies_eagerly(tmp_path):
+    """A superseded epoch is fenced at fence() time, before any group
+    commit — the zombie's record never reaches the journal."""
+    spool = Spool(str(tmp_path / "sp"))
+    assert spool.submit({"id": "z", "cmd": ["-c", "pass"]})[
+        "status"] == "queued"
+    (spec,) = spool.pending()
+    got = spool.claim(spec, server="s1")
+    # s1 goes silent; the scavenger hands the job to s2 (epoch 2)
+    spool.reclaim(by="s2", now=time.time() + 3600)
+    (spec2,) = spool.pending()
+    got2 = spool.claim(spec2, server="s2")
+    assert got2.epoch == 2
+    # the zombie's fence fails and audits; s2's fence succeeds
+    assert spool.fence(got, "completed", server="s1") is None
+    fenced = [r for r in spool.audit_records()
+              if r["event"] == "fenced"]
+    assert fenced and fenced[-1]["server"] == "s1"
+    token = spool.fence(got2, "completed", server="s2")
+    assert token
+    landed = spool.finish_batch([{
+        "spec": got2, "outcome": "completed", "extra": {},
+        "token": token,
+    }])
+    assert landed == 1
+    (rec,) = spool.done()
+    assert rec["outcome"] == "completed"
+
+
+# ---------------------------------------------------------------------
+# queue-wait decomposition: wake_latency
+# ---------------------------------------------------------------------
+
+
+def test_wake_latency_in_decomposition(armed):
+    """Armed fastpath drain: the six queue phases (wake_latency
+    included) telescope to the queued span at >= 90% coverage."""
+    spool, _ = _fast_drain(armed, jobs=6, tenants=3, batch=3)
+    decomps = profile.decompose(spool.root)
+    assert len(decomps) == 6
+    for d in decomps:
+        assert d["ok"], d
+        assert set(d["phases"]) == set(profile.QUEUE_PHASES)
+        assert "wake_latency" in d["phases"]
+        assert abs(d["sum_s"] - d["queue_wait_s"]) <= (
+            profile.SUM_TOLERANCE_S
+        ), d
+        assert d["coverage"] >= 0.90, d
+        assert all(v >= 0 for v in d["phases"].values()), d
+
+
+def test_wake_latency_phase_is_in_the_vocabulary():
+    assert "wake_latency" in profile.PHASES
+    assert "claim_batch" in profile.PHASES
+    assert "wake_latency" in profile.QUEUE_PHASES
+
+
+# ---------------------------------------------------------------------
+# surfaces: snapshot, exporter, status, CLI
+# ---------------------------------------------------------------------
+
+
+def test_dispatch_snapshot_shape(tmp_path):
+    spool, _ = _fast_drain(str(tmp_path / "sp"), jobs=4, batch=4)
+    snap = dispatch.load_snapshot(spool.root)
+    assert snap["schema"] == dispatch.DISPATCH_SCHEMA
+    assert snap["wire"] == dispatch.WIRE_SOCKET
+    assert snap["jobs"] == 4
+    assert snap["batches"] >= 1
+    assert snap["batch_size_max"] <= 4
+    assert snap["group_commits"] >= 1
+    # a spool never served event-driven has no snapshot
+    assert dispatch.load_snapshot(str(tmp_path / "empty")) is None
+
+
+def test_exporter_dispatch_families(tmp_path):
+    spool, _ = _fast_drain(str(tmp_path / "sp"), jobs=4, batch=4)
+    snap = sexport.serving_snapshot(spool)
+    assert snap["dispatch"]["jobs"] == 4
+    text = sexport.render_serving_metrics(snap)
+    assert 'm4t_dispatch_wire{wire="socket"} 1' in text
+    assert "m4t_dispatch_batches_total" in text
+    assert 'm4t_dispatch_batch_size{quantile="0.5"}' in text
+    assert "m4t_dispatch_coalesced_jobs_total" in text
+    assert "m4t_dispatch_group_commits_total" in text
+    assert "m4t_dispatch_fsyncs_per_job" in text
+    assert text.rstrip().endswith("# EOF")
+
+
+def test_classic_drain_exports_no_dispatch_families(tmp_path):
+    """The families are fastpath-only: a classic drain's exposition is
+    unchanged."""
+    spool = Spool(str(tmp_path / "sp"))
+    _submit_mix(spool, 2)
+    server = Server(spool, nproc=1, max_jobs=2, poll_s=0.01,
+                    runner=lambda *a: (0, []), log=lambda msg: None)
+    assert server.serve() == 0
+    text = sexport.render_serving_metrics(
+        sexport.serving_snapshot(spool)
+    )
+    assert "m4t_dispatch_" not in text
+
+
+def test_dispatch_selftest_cli():
+    r = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.serving", "dispatch",
+         "--selftest"],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+        env=_cli_env(),
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "dispatch selftest ok" in r.stdout
+
+
+def test_fastpath_cli_round_trip(tmp_path):
+    """serve --fastpath over real subprocess jobs, then the dispatch
+    and status surfaces name the wire and the counters."""
+    sp = str(tmp_path / "sp")
+    for i in range(2):
+        r = _cli("submit", sp, "--id", f"cli{i}", "--", "-c", "pass")
+        assert r.returncode == 0, (r.stdout, r.stderr)
+    r = _cli("serve", sp, "-n", "1", "--fastpath", "socket",
+             "--batch", "4", "--max-jobs", "2", "--poll", "0.05")
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    spool = Spool(sp)
+    done = {rec["id"]: rec for rec in spool.done()}
+    assert sorted(done) == ["cli0", "cli1"]
+    assert all(rec["outcome"] == "completed"
+               for rec in done.values())
+    r = _cli("dispatch", sp)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "wire: socket" in r.stdout
+    r = _cli("dispatch", sp, "--json")
+    snap = json.loads(r.stdout)
+    assert snap["jobs"] == 2
+    r = _cli("status", sp)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "dispatch: wire socket" in r.stdout
+    # a spool with no event-driven history: explicit rc 2
+    r = _cli("dispatch", str(tmp_path / "never"))
+    assert r.returncode == 2
+
+
+# ---------------------------------------------------------------------
+# chaos e2e: federation failover on the fastpath
+# ---------------------------------------------------------------------
+
+
+def _wait_for(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.federation
+def test_chaos_sigkill_failover_on_fastpath(tmp_path):
+    """The ISSUE-14 chaos drill rerun with both servers event-driven:
+    the owner is SIGKILLed mid-job, the survivor's scavenger reclaims,
+    and every submitted id ends terminal exactly once — wake wires,
+    batched claims and group commit change no federation invariant."""
+    sp = str(tmp_path / "sp")
+    spool = Spool(sp)
+    job = textwrap.dedent("""
+        import sys, time
+        time.sleep(float(sys.argv[1]))
+    """)
+    script = str(tmp_path / "napper.py")
+    with open(script, "w") as f:
+        f.write(job)
+    assert spool.submit({
+        "id": "orph", "cmd": [script, "30"], "timeout_s": 120.0,
+    })["status"] == "queued"
+    assert spool.submit({
+        "id": "quick", "cmd": [script, "0"], "timeout_s": 60.0,
+    })["status"] == "queued"
+
+    def serve(server_id, log_path):
+        return subprocess.Popen(
+            [sys.executable, "-m", "mpi4jax_tpu.serving", "serve", sp,
+             "-n", "1", "--poll", "0.05", "--server-id", server_id,
+             "--lease", "0.5", "--fastpath", "socket", "--batch", "4"],
+            cwd=REPO, env=_cli_env(), start_new_session=True,
+            stdout=subprocess.DEVNULL, stderr=open(log_path, "w"),
+        )
+
+    p1 = serve("fp-s1", str(tmp_path / "s1.log"))
+    p2 = None
+    try:
+        _wait_for(
+            lambda: any(r["event"] == "claimed"
+                        and r.get("job") == "orph"
+                        and r.get("server") == "fp-s1"
+                        for r in spool.audit_records()),
+            60, "fp-s1 to claim the long job",
+        )
+        os.killpg(os.getpgid(p1.pid), signal.SIGKILL)
+        p1.wait(30)
+        p2 = serve("fp-s2", str(tmp_path / "s2.log"))
+        _wait_for(
+            lambda: {r["id"] for r in spool.done()} >= {"orph",
+                                                        "quick"},
+            120, "the survivor to reclaim and finish both jobs",
+        )
+    finally:
+        for p in (p1, p2):
+            if p is not None and p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+                except OSError:
+                    pass
+    _cli("drain", sp)
+    if p2 is not None:
+        p2.wait(120)
+    # zero lost, zero duplicated: every id terminal exactly once
+    done = [r["id"] for r in spool.done()]
+    assert sorted(done) == ["orph", "quick"]
+    for job_id in ("orph", "quick"):
+        terms = [r for r in spool.audit_records()
+                 if r["event"] in ("completed", "failed", "rejected")
+                 and r.get("job") == job_id]
+        assert len(terms) == 1, (job_id, terms)
+    # the orphan failed over: reclaimed by the survivor
+    (orph,) = [r for r in spool.done() if r["id"] == "orph"]
+    assert orph["reclaims"] == 1
+    assert orph["reclaimed_from"][0]["server"] == "fp-s1"
+    snap = dispatch.load_snapshot(sp)
+    assert snap is not None and snap["wire"] == dispatch.WIRE_SOCKET
